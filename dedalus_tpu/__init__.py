@@ -1,0 +1,29 @@
+"""
+Dedalus-TPU: a TPU-native spectral PDE framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of Dedalus v3
+(reference: kburns/dedalus, surveyed in SURVEY.md): global spectral methods
+for PDEs on Cartesian and curvilinear domains, symbolic vector equations,
+IMEX initial value problems, boundary/eigenvalue problems — with the hot
+path (transforms, pencil solves, distributed transposes) compiled by XLA
+onto TPU (MXU matmuls, fused elementwise, mesh collectives) instead of
+FFTW/MPI/SuperLU.
+
+Architecture notes:
+  * Symbolic problem layer runs on host (numpy/scipy), like the reference's
+    (reference: dedalus/core/problems.py, operators.py).
+  * The IVP step is ONE jitted function: spectral<->grid transforms,
+    pointwise nonlinearities, and a batched dense/banded LU solve over all
+    pencils (pencil index = batch dimension on the MXU).
+  * Distribution uses jax.sharding.Mesh + named shardings; the reference's
+    MPI Alltoallv pencil transposes (dedalus/core/transposes.pyx) become
+    XLA-inserted all-to-alls.
+"""
+
+__version__ = "0.1.0"
+
+# Double precision is the house dtype of spectral methods (the reference is
+# float64/complex128 end-to-end). Enable x64 before any jax import users run.
+import jax
+
+jax.config.update("jax_enable_x64", True)

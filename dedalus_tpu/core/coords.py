@@ -1,0 +1,93 @@
+"""
+Coordinate systems (reference: dedalus/core/coords.py).
+
+Coordinates are pure metadata: axis names and ordering, plus (for curvilinear
+systems, added with those geometries) the small unitary intertwiners mapping
+tensor components to spin/regularity components.
+"""
+
+import numpy as np
+
+
+class CoordinateSystem:
+    """Base class for coordinate systems."""
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and (self.names == other.names)
+
+    def __hash__(self):
+        return hash((type(self).__name__,) + tuple(self.names))
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self.coords[self.names.index(key)]
+        return self.coords[key]
+
+    @property
+    def first_axis(self):
+        return self.coords[0].axis
+
+    def set_distributor(self, dist):
+        for coord in self.coords:
+            coord.dist = dist
+
+
+class Coordinate(CoordinateSystem):
+    """A single named coordinate (reference: core/coords.py:66)."""
+
+    dim = 1
+
+    def __init__(self, name, cs=None):
+        self.name = name
+        self.names = (name,)
+        self.cs = cs
+        self.coords = (self,)
+        self.dist = None
+        self.axis = None  # set by Distributor
+
+    def __repr__(self):
+        return f"Coordinate({self.name!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Coordinate) and self.name == other.name and self.cs == other.cs
+
+    def __hash__(self):
+        return hash(("Coordinate", self.name))
+
+    def set_distributor(self, dist):
+        self.dist = dist
+
+
+class CartesianCoordinates(CoordinateSystem):
+    """
+    Cartesian coordinate system of any dimension
+    (reference: core/coords.py:159).
+    """
+
+    def __init__(self, *names, right_handed=True):
+        if len(set(names)) != len(names):
+            raise ValueError("Coordinate names must be unique.")
+        self.names = tuple(names)
+        self.dim = len(names)
+        self.right_handed = right_handed
+        self.coords = tuple(Coordinate(name, cs=self) for name in names)
+        self.dist = None
+
+    def __repr__(self):
+        return f"CartesianCoordinates{self.names}"
+
+    def set_distributor(self, dist):
+        self.dist = dist
+        for coord in self.coords:
+            coord.dist = dist
+
+    def unit_vector_fields(self, dist):
+        """Constant unit vector fields e_1 .. e_dim (reference API)."""
+        fields = []
+        for i, name in enumerate(self.names):
+            ei = dist.VectorField(self, name=f"e{name}")
+            data = np.zeros(self.dim)
+            data[i] = 1.0
+            ei["g"] = data.reshape((self.dim,) + (1,) * dist.dim)
+            fields.append(ei)
+        return tuple(fields)

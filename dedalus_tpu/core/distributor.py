@@ -1,0 +1,117 @@
+"""
+Distributor: process/device layout metadata and field factories
+(reference: dedalus/core/distributor.py:35).
+
+TPU-native redesign: instead of the reference's MPI layout chain (a ladder of
+Transform/Transpose states walked at runtime), the distributor holds a
+`jax.sharding.Mesh` and named shardings. Fields keep only two user-visible
+layouts ('c' full-coefficient, 'g' full-grid); all intermediate pencil states
+exist only inside jitted transform pipelines where XLA/GSPMD places the
+all-to-alls (reference: core/transposes.pyx -> ICI collectives).
+"""
+
+import numpy as np
+import jax
+
+from .coords import Coordinate, CartesianCoordinates, CoordinateSystem
+
+
+class Distributor:
+
+    def __init__(self, coordsystems, dtype=np.float64, mesh=None, comm=None):
+        if isinstance(coordsystems, CoordinateSystem):
+            coordsystems = (coordsystems,)
+        self.coordsystems = tuple(coordsystems)
+        self.dtype = np.dtype(dtype)
+        # Flatten coordinates and assign axes.
+        coords = []
+        for cs in self.coordsystems:
+            cs.set_distributor(self)
+            for coord in cs.coords:
+                coord.axis = len(coords)
+                coords.append(coord)
+        self.coords = tuple(coords)
+        self.dim = len(coords)
+        # Device mesh: a jax.sharding.Mesh (or None for single-device).
+        self.mesh = mesh
+        self.comm = comm  # unused; accepted for API familiarity
+
+    # ------------------------------------------------------------ factories
+
+    def Field(self, name=None, bases=None, dtype=None, tensorsig=()):
+        from .field import Field
+        return Field(self, bases=bases, name=name, tensorsig=tensorsig,
+                     dtype=dtype or self.dtype)
+
+    def ScalarField(self, *args, **kw):
+        return self.Field(*args, **kw)
+
+    def VectorField(self, coordsys, name=None, bases=None, dtype=None):
+        from .field import Field
+        return Field(self, bases=bases, name=name, tensorsig=(coordsys,),
+                     dtype=dtype or self.dtype)
+
+    def TensorField(self, coordsys, name=None, bases=None, dtype=None, order=2):
+        from .field import Field
+        if isinstance(coordsys, tuple):
+            tensorsig = coordsys
+        else:
+            tensorsig = (coordsys,) * order
+        return Field(self, bases=bases, name=name, tensorsig=tensorsig,
+                     dtype=dtype or self.dtype)
+
+    # -------------------------------------------------------------- helpers
+
+    def get_axis(self, coord):
+        if isinstance(coord, Coordinate):
+            return coord.axis
+        return coord.first_axis
+
+    def expand_bases(self, bases):
+        """Expand a basis/tuple-of-bases spec to a full per-axis tuple."""
+        full = [None] * self.dim
+        if bases is None:
+            return tuple(full)
+        if not isinstance(bases, (tuple, list)):
+            bases = (bases,)
+        for basis in bases:
+            if basis is None:
+                continue
+            axis = self.get_axis(basis.coord)
+            if full[axis] is not None:
+                raise ValueError(f"Multiple bases along axis {axis}")
+            full[axis] = basis
+        return tuple(full)
+
+    def remedy_scales(self, scales):
+        if scales is None:
+            scales = 1.0
+        if np.isscalar(scales):
+            return (float(scales),) * self.dim
+        return tuple(float(s) for s in scales)
+
+    def local_grid(self, basis, scale=None):
+        """Grid points of `basis`, shaped for broadcasting over the domain."""
+        scale = 1.0 if scale is None else scale
+        grid = basis.global_grid(scale)
+        axis = self.get_axis(basis.coord)
+        shape = [1] * self.dim
+        shape[axis] = grid.size
+        return grid.reshape(shape)
+
+    def local_grids(self, *bases, scales=None):
+        scales = self.remedy_scales(scales)
+        return tuple(self.local_grid(b, scales[self.get_axis(b.coord)]) for b in bases)
+
+    # ------------------------------------------------------------- sharding
+
+    @property
+    def process_index(self):
+        return jax.process_index()
+
+    def coeff_sharding(self, domain):
+        """NamedSharding for coefficient-layout arrays (None if no mesh)."""
+        return None
+
+    def grid_sharding(self, domain):
+        return None

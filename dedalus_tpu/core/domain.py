@@ -1,0 +1,64 @@
+"""
+Domains: cached direct products of bases (reference: dedalus/core/domain.py:17).
+
+A Domain is a tuple of bases indexed by distributor axis, with `None` marking
+axes along which fields are constant (size-1 in both layouts).
+"""
+
+import numpy as np
+
+from ..tools.cache import CachedClass
+
+
+class Domain(metaclass=CachedClass):
+
+    def __init__(self, dist, bases):
+        bases = tuple(bases)
+        if len(bases) != dist.dim:
+            raise ValueError("Domain needs one basis (or None) per distributor axis.")
+        self.dist = dist
+        self.bases = bases
+
+    @property
+    def full_bases(self):
+        return self.bases
+
+    def get_basis(self, coord):
+        for basis in self.bases:
+            if basis is not None and (basis.coord is coord or getattr(coord, "coords", None)
+                                      and basis.coord in coord.coords):
+                return basis
+        return None
+
+    @property
+    def constant(self):
+        return tuple(b is None for b in self.bases)
+
+    @property
+    def dim(self):
+        return self.dist.dim
+
+    @property
+    def coeff_shape(self):
+        return tuple(1 if b is None else b.size for b in self.bases)
+
+    def grid_shape(self, scales):
+        scales = self.dist.remedy_scales(scales)
+        return tuple(1 if b is None else b.grid_size(s)
+                     for b, s in zip(self.bases, scales))
+
+    @property
+    def dealias(self):
+        return tuple(1.0 if b is None else b.dealias for b in self.bases)
+
+    @property
+    def coeff_dtype_is_complex(self):
+        from .basis import ComplexFourier
+        return any(isinstance(b, ComplexFourier) for b in self.bases)
+
+    def substitute_basis(self, old_basis, new_basis):
+        bases = tuple(new_basis if b is old_basis else b for b in self.bases)
+        return Domain(self.dist, bases)
+
+    def __repr__(self):
+        return f"Domain({self.bases})"

@@ -1,0 +1,206 @@
+"""
+Evaluator and output handlers (reference: dedalus/core/evaluator.py).
+
+Handlers own lists of tasks (symbolic expressions) evaluated on wall-time /
+sim-time / iteration cadences (reference: core/evaluator.py:248-278
+check_schedule). The reference's layout-oscillation machinery
+(evaluate_handlers :94-148) is unnecessary here: expression trees evaluate
+as jnp programs with shared-transform memoization.
+
+FileHandler writes HDF5 with the reference's file schema (tasks/<name>,
+scales/sim_time|iteration|write_number|timestep) so checkpoint restart and
+post-processing tooling are format-compatible.
+"""
+
+import os
+import pathlib
+import logging
+import numpy as np
+
+from .field import Field
+from .future import Future
+
+logger = logging.getLogger(__name__)
+
+
+class Evaluator:
+    """Coordinates scheduled evaluation of handler tasks
+    (reference: core/evaluator.py:30 Evaluator)."""
+
+    def __init__(self, solver):
+        self.solver = solver
+        self.handlers = []
+
+    def add_dictionary_handler(self, **kw):
+        handler = DictionaryHandler(self.solver, **kw)
+        self.handlers.append(handler)
+        return handler
+
+    def add_file_handler(self, base_path, **kw):
+        handler = FileHandler(self.solver, base_path, **kw)
+        self.handlers.append(handler)
+        return handler
+
+    def evaluate_scheduled(self, iteration=0, wall_time=0.0, sim_time=0.0,
+                           timestep=None, **kw):
+        due = [h for h in self.handlers
+               if h.check_schedule(iteration=iteration, wall_time=wall_time,
+                                   sim_time=sim_time)]
+        self.evaluate_handlers(due, iteration=iteration, wall_time=wall_time,
+                               sim_time=sim_time, timestep=timestep)
+
+    def evaluate_handlers(self, handlers=None, iteration=0, wall_time=0.0,
+                          sim_time=0.0, timestep=None, **kw):
+        if handlers is None:
+            handlers = self.handlers
+        for handler in handlers:
+            handler.process(iteration=iteration, wall_time=wall_time,
+                            sim_time=sim_time, timestep=timestep)
+
+
+class Handler:
+    """Task list with a schedule (reference: core/evaluator.py:209 Handler)."""
+
+    def __init__(self, solver, group=None, wall_dt=None, sim_dt=None,
+                 iter=None, custom_schedule=None):
+        self.solver = solver
+        self.tasks = []
+        self.group = group
+        self.wall_dt = wall_dt
+        self.sim_dt = sim_dt
+        self.iter = iter
+        self.custom_schedule = custom_schedule
+        self.last_wall_div = -1
+        self.last_sim_div = -1
+        self.last_iter_div = -1
+
+    def add_task(self, task, layout="g", name=None, scales=None):
+        """Add a task (operand expression, field, or namespace string)."""
+        if isinstance(task, str):
+            namespace = self.solver.problem.namespace
+            name = name or task
+            task = eval(task, {}, namespace)
+        if name is None:
+            name = getattr(task, "name", None) or str(task)
+        self.tasks.append({"operator": task, "layout": layout, "name": name,
+                           "scales": scales})
+
+    def add_tasks(self, tasks, **kw):
+        for task in tasks:
+            self.add_task(task, **kw)
+
+    def add_system(self, system, **kw):
+        self.add_tasks(system, **kw)
+
+    def check_schedule(self, iteration=0, wall_time=0.0, sim_time=0.0):
+        """Divisor-crossing cadence logic (reference: core/evaluator.py:248)."""
+        scheduled = False
+        if self.wall_dt is not None:
+            div = int(wall_time // self.wall_dt)
+            if div > self.last_wall_div:
+                scheduled = True
+                self.last_wall_div = div
+        if self.sim_dt is not None:
+            div = int((sim_time + 1e-12) // self.sim_dt)
+            if div > self.last_sim_div:
+                scheduled = True
+                self.last_sim_div = div
+        if self.iter is not None:
+            div = iteration // self.iter
+            if div > self.last_iter_div:
+                scheduled = True
+                self.last_iter_div = div
+        if self.custom_schedule is not None:
+            scheduled = scheduled or self.custom_schedule(
+                iteration=iteration, wall_time=wall_time, sim_time=sim_time)
+        return scheduled
+
+    def evaluate_tasks(self):
+        """Evaluate all tasks, returning {name: numpy array}."""
+        out = {}
+        for task in self.tasks:
+            op = task["operator"]
+            field = op if isinstance(op, Field) else op.evaluate()
+            if task["layout"] == "g":
+                scales = task["scales"] or 1
+                field.change_scales(scales)
+                out[task["name"]] = np.asarray(field["g"])
+            else:
+                out[task["name"]] = np.asarray(field["c"])
+        return out
+
+    def process(self, **kw):
+        raise NotImplementedError
+
+
+class DictionaryHandler(Handler):
+    """Stores task results in a dict (reference: core/evaluator.py:325)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.fields = {}
+
+    def __getitem__(self, name):
+        return self.fields[name]
+
+    def process(self, **kw):
+        self.fields.update(self.evaluate_tasks())
+
+
+class FileHandler(Handler):
+    """HDF5 output handler (reference: core/evaluator.py:369 H5FileHandler)."""
+
+    def __init__(self, solver, base_path, max_writes=np.inf, mode=None, **kw):
+        super().__init__(solver, **kw)
+        self.base_path = pathlib.Path(base_path)
+        self.max_writes = max_writes
+        self.mode = mode or "overwrite"
+        self.set_num = 0
+        self.write_num = 0
+        self.current_file = None
+        self.writes_in_set = 0
+        os.makedirs(self.base_path, exist_ok=True)
+        if self.mode == "append":
+            existing = sorted(self.base_path.glob(f"{self.base_path.name}_s*.h5"))
+            if existing:
+                self.set_num = len(existing)
+
+    def _new_file(self):
+        import h5py
+        self.set_num += 1
+        self.writes_in_set = 0
+        name = f"{self.base_path.name}_s{self.set_num}.h5"
+        path = self.base_path / name
+        self.current_file = str(path)
+        with h5py.File(path, "w") as f:
+            f.create_group("tasks")
+            f.create_group("scales")
+        return path
+
+    def process(self, iteration=0, wall_time=0.0, sim_time=0.0, timestep=None, **kw):
+        import h5py
+        if self.current_file is None or self.writes_in_set >= self.max_writes:
+            self._new_file()
+        self.write_num += 1
+        self.writes_in_set += 1
+        results = self.evaluate_tasks()
+        with h5py.File(self.current_file, "a") as f:
+            scales = f["scales"]
+            for key, val in [("sim_time", sim_time), ("wall_time", wall_time),
+                             ("iteration", iteration),
+                             ("write_number", self.write_num),
+                             ("timestep", timestep if timestep is not None else np.nan)]:
+                if key not in scales:
+                    scales.create_dataset(key, shape=(0,), maxshape=(None,), dtype=np.float64)
+                ds = scales[key]
+                ds.resize((ds.shape[0] + 1,))
+                ds[-1] = val
+            tasks = f["tasks"]
+            for name, data in results.items():
+                if name not in tasks:
+                    tasks.create_dataset(name, shape=(0,) + data.shape,
+                                         maxshape=(None,) + data.shape,
+                                         dtype=data.dtype)
+                ds = tasks[name]
+                ds.resize((ds.shape[0] + 1,) + data.shape)
+                ds[-1] = data

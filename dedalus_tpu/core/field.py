@@ -1,0 +1,378 @@
+"""
+Operands and Fields (reference: dedalus/core/field.py).
+
+`Operand` is the arithmetic-overload base: `+ - * / ** @` and calls build
+symbolic expression nodes (reference: core/field.py:39-327). `Field` is the
+concrete distributed data container: an immutable-by-convention jnp array
+plus a current layout tag ('c' coefficient / 'g' grid) and grid scales.
+
+TPU-native design: user-facing Fields behave like the reference's (mutable
+layout walked on access), but all data lives on device as jnp arrays; the
+solver hot loop never touches Fields — it closes over pure pytrees of
+coefficient arrays (see solvers.py).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from .domain import Domain
+from ..tools.general import is_complex_dtype
+
+
+# ------------------------------------------------------------------
+# Transform pipeline: pure jnp, safe inside jit.
+
+def transform_to_coeff(data, domain, scales, tdim, library=None):
+    """Full grid -> full coefficient transform across all axes."""
+    for axis in range(domain.dim - 1, -1, -1):
+        basis = domain.bases[axis]
+        if basis is not None:
+            data = basis.forward_transform(data, tdim + axis, scales[axis], library)
+    return data
+
+
+def transform_to_grid(data, domain, scales, tdim, library=None):
+    """Full coefficient -> full grid transform across all axes."""
+    for axis in range(domain.dim):
+        basis = domain.bases[axis]
+        if basis is not None:
+            data = basis.backward_transform(data, tdim + axis, scales[axis], library)
+    return data
+
+
+class Operand:
+    """Base class for everything that can appear in symbolic expressions."""
+
+    __array_priority__ = 100.0  # win dispatch against numpy arrays
+
+    # ---- arithmetic overloads (lazy imports avoid circular deps) ----
+
+    def __add__(self, other):
+        from .arithmetic import Add
+        if np.isscalar(other) and other == 0:
+            return self
+        return Add(self, other)
+
+    def __radd__(self, other):
+        from .arithmetic import Add
+        if np.isscalar(other) and other == 0:
+            return self
+        return Add(other, self)
+
+    def __sub__(self, other):
+        return self + (-1) * other
+
+    def __rsub__(self, other):
+        return other + (-1) * self
+
+    def __neg__(self):
+        return (-1) * self
+
+    def __mul__(self, other):
+        from .arithmetic import Multiply
+        return Multiply(self, other)
+
+    def __rmul__(self, other):
+        from .arithmetic import Multiply
+        return Multiply(other, self)
+
+    def __truediv__(self, other):
+        from .arithmetic import Multiply, Power
+        if np.isscalar(other):
+            return Multiply(1.0 / other, self)
+        return Multiply(self, Power(other, -1))
+
+    def __rtruediv__(self, other):
+        from .arithmetic import Multiply, Power
+        return Multiply(other, Power(self, -1))
+
+    def __pow__(self, other):
+        from .arithmetic import Power
+        return Power(self, other)
+
+    def __matmul__(self, other):
+        from .arithmetic import DotProduct
+        return DotProduct(self, other)
+
+    def __rmatmul__(self, other):
+        from .arithmetic import DotProduct
+        return DotProduct(other, self)
+
+    def __call__(self, **positions):
+        """Interpolation: f(x=0.5) (reference: core/field.py API)."""
+        from .operators import Interpolate
+        out = self
+        for name, position in positions.items():
+            coord = self._lookup_coord(name)
+            out = Interpolate(out, coord, position)
+        return out
+
+    def _lookup_coord(self, name):
+        for coord in self.dist.coords:
+            if coord.name == name:
+                return coord
+        raise ValueError(f"Unknown coordinate: {name}")
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kw):
+        """Dispatch numpy ufuncs on operands to symbolic nodes
+        (reference: core/field.py:44)."""
+        from .arithmetic import Add, Multiply, Power, DotProduct
+        from .operators import UnaryGridFunction
+        if method != "__call__":
+            return NotImplemented
+        binary = {np.add: Add, np.multiply: Multiply, np.matmul: DotProduct}
+        if ufunc in binary and len(inputs) == 2:
+            return binary[ufunc](*inputs)
+        if ufunc is np.subtract and len(inputs) == 2:
+            return inputs[0] - inputs[1]
+        if ufunc is np.true_divide and len(inputs) == 2:
+            a, b = inputs
+            if isinstance(a, Operand):
+                return a / b
+            return a * Power(b, -1)
+        if ufunc is np.power and len(inputs) == 2:
+            return Power(*inputs)
+        if ufunc is np.negative:
+            return -inputs[0]
+        if len(inputs) == 1:
+            return UnaryGridFunction(ufunc, inputs[0])
+        return NotImplemented
+
+    # ---- symbolic tree API (overridden by Future) ----
+
+    def atoms(self, *types):
+        return set()
+
+    def has(self, *operands):
+        return any(self is op for op in operands)
+
+    def replace(self, old, new):
+        return new if self is old else self
+
+    @staticmethod
+    def cast(arg, dist):
+        if isinstance(arg, Operand):
+            return arg
+        raise TypeError(f"Cannot cast {arg!r} to an Operand")
+
+
+class Field(Operand):
+    """
+    Distributed spectral field (reference: core/field.py:32 Field/ScalarField,
+    with VectorField/TensorField as tensorsig variants).
+    """
+
+    def __init__(self, dist, bases=None, name=None, tensorsig=(), dtype=None):
+        self.dist = dist
+        self.name = name
+        self.tensorsig = tuple(tensorsig)
+        self.dtype = np.dtype(dtype or dist.dtype)
+        self.domain = Domain(dist, dist.expand_bases(bases))
+        if self.domain.coeff_dtype_is_complex and not is_complex_dtype(self.dtype):
+            raise ValueError("ComplexFourier bases require a complex dtype.")
+        self.scales = dist.remedy_scales(1)
+        self.layout = "c"
+        self.data = jnp.zeros(self.coeff_shape, dtype=self.coeff_dtype)
+
+    # ---- shapes & dtypes ----
+
+    @property
+    def tshape(self):
+        return tuple(cs.dim for cs in self.tensorsig)
+
+    @property
+    def tdim(self):
+        return len(self.tshape)
+
+    @property
+    def coeff_dtype(self):
+        return self.dtype
+
+    @property
+    def grid_dtype(self):
+        return self.dtype
+
+    @property
+    def coeff_shape(self):
+        return self.tshape + self.domain.coeff_shape
+
+    def grid_shape(self, scales=None):
+        scales = self.dist.remedy_scales(scales if scales is not None else self.scales)
+        return self.tshape + self.domain.grid_shape(scales)
+
+    def __repr__(self):
+        return f"Field(name={self.name!r}, bases={self.domain.bases})"
+
+    def __str__(self):
+        return self.name or f"F{id(self)%10000}"
+
+    # ---- layout management ----
+
+    def require_coeff_space(self):
+        if self.layout == "g":
+            self.data = transform_to_coeff(self.data, self.domain, self.scales, self.tdim)
+            self.layout = "c"
+        return self.data
+
+    def require_grid_space(self, scales=None):
+        if scales is not None:
+            self.change_scales(scales)
+        if self.layout == "c":
+            self.data = transform_to_grid(self.data, self.domain, self.scales, self.tdim)
+            self.layout = "g"
+        return self.data
+
+    def change_scales(self, scales):
+        scales = self.dist.remedy_scales(scales)
+        if scales != self.scales:
+            self.require_coeff_space()
+            self.scales = scales
+
+    def change_layout(self, layout):
+        if layout in ("c", 0, "coeff"):
+            self.require_coeff_space()
+        else:
+            self.require_grid_space()
+
+    def __getitem__(self, layout):
+        # Return a writable host copy: augmented assignment (u['g'] *= ...)
+        # round-trips through __setitem__ with this array.
+        if layout in ("c", 0, "coeff"):
+            return np.array(self.require_coeff_space())
+        elif layout in ("g", 1, "grid"):
+            return np.array(self.require_grid_space())
+        raise KeyError(f"Unknown layout: {layout}")
+
+    def __setitem__(self, layout, value):
+        if layout in ("c", 0, "coeff"):
+            self.layout = "c"
+            shape, dtype = self.coeff_shape, self.coeff_dtype
+        elif layout in ("g", 1, "grid"):
+            self.layout = "g"
+            shape, dtype = self.grid_shape(), self.grid_dtype
+        else:
+            raise KeyError(f"Unknown layout: {layout}")
+        value = jnp.asarray(value, dtype=dtype)
+        self.data = jnp.broadcast_to(value, shape)
+
+    # Solver-facing accessors -------------------------------------------------
+
+    def coeff_data(self):
+        """Device coefficient array (triggers transform if needed)."""
+        return self.require_coeff_space()
+
+    def preset_coeff(self, array):
+        """Install device coefficient data directly (solver scatter)."""
+        self.data = array
+        self.layout = "c"
+        self.scales = self.dist.remedy_scales(1)
+
+    # ---- utilities ----
+
+    def copy(self):
+        out = Field(self.dist, bases=self.domain.bases, name=self.name,
+                    tensorsig=self.tensorsig, dtype=self.dtype)
+        out.data = self.data
+        out.layout = self.layout
+        out.scales = self.scales
+        return out
+
+    def evaluate(self):
+        return self
+
+    def fill_random(self, layout="g", seed=None, distribution="normal", **kw):
+        """
+        Deterministic random fill (reference: core/field.py:847 fill_random).
+        Uses a global-shape numpy RNG so results are independent of sharding
+        (reference's ChunkedRandomArray guarantees the same property).
+        """
+        rng = np.random.default_rng(seed)
+        if layout in ("g", 1, "grid"):
+            shape, dtype = self.grid_shape(), self.grid_dtype
+        else:
+            shape, dtype = self.coeff_shape, self.coeff_dtype
+        scale = kw.pop("scale", 1)
+        if distribution in ("normal", "standard_normal"):
+            data = rng.standard_normal(shape)
+            if is_complex_dtype(dtype):
+                data = data + 1j * rng.standard_normal(shape)
+        elif distribution == "uniform":
+            data = rng.uniform(size=shape, **{k: kw[k] for k in ("low", "high") if k in kw})
+        else:
+            data = getattr(rng, distribution)(size=shape)
+        self[layout] = scale * data.astype(dtype)
+
+    def low_pass_filter(self, shape=None, scales=None):
+        """Zero coefficients above a per-axis mode cutoff
+        (reference: core/field.py API). `scales` gives cutoffs as fractions
+        of each axis size; `shape` gives them as mode counts."""
+        from .basis import RealFourier, ComplexFourier
+        if shape is None and scales is None:
+            return self
+        if shape is None:
+            scales = self.dist.remedy_scales(scales)
+            shape = [1 if b is None else int(s * b.size)
+                     for b, s in zip(self.domain.bases, scales)]
+        data = np.asarray(self.require_coeff_space())
+        mask = np.ones_like(data, dtype=bool)
+        for axis, (basis, cutoff) in enumerate(zip(self.domain.bases, shape)):
+            if basis is None:
+                continue
+            n = basis.size
+            if isinstance(basis, RealFourier):
+                # interleaved (cos, -sin) pairs: cutoff counts coefficients
+                keep = np.arange(n) < cutoff
+            elif isinstance(basis, ComplexFourier):
+                # FFT ordering: keep |k| < cutoff/2 on both branches
+                k = np.abs(np.fft.fftfreq(n, d=1.0 / n))
+                keep = k < cutoff / 2
+            else:
+                keep = np.arange(n) < cutoff
+            view = [np.newaxis] * data.ndim
+            view[self.tdim + axis] = slice(None)
+            mask = mask & keep[tuple(view)]
+        self.data = jnp.asarray(data * mask)
+        return self
+
+    def allreduce_data_norm(self, layout="c", order=2):
+        data = np.asarray(self[layout])
+        if order == np.inf:
+            return np.max(np.abs(data))
+        return np.linalg.norm(data.ravel(), ord=order)
+
+    def allgather_data(self, layout="g"):
+        return np.asarray(self[layout])
+
+    # Problem-layer helpers ---------------------------------------------------
+
+    def frechet_differential(self, variables, perturbations):
+        """
+        Symbolic Frechet differential of this field viewed as an expression
+        (trivial for a bare field; see Future.frechet_differential).
+        """
+        for var, pert in zip(variables, perturbations):
+            if self is var:
+                return pert
+        return 0
+
+
+def ScalarField(dist, *args, **kw):
+    return dist.Field(*args, **kw)
+
+
+def VectorField(dist, coordsys, *args, **kw):
+    return dist.VectorField(coordsys, *args, **kw)
+
+
+def TensorField(dist, coordsys, *args, **kw):
+    return dist.TensorField(coordsys, *args, **kw)
+
+
+class LockedField(Field):
+    """Field with locked layout (reference: core/field.py:952)."""
+
+    def lock_to_layouts(self, *layouts):
+        self._locked = tuple(layouts)
+
+    def lock_scales(self):
+        pass

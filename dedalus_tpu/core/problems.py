@@ -1,0 +1,292 @@
+"""
+Problem classes: IVP, LBVP, NLBVP, EVP (reference: dedalus/core/problems.py).
+
+Equations enter as strings (parsed with Python eval over a namespace of
+variables + operator parseables + the user's namespace; reference:
+core/problems.py:74-76) or as (LHS, RHS) operand tuples. Each equation is
+validated and split into matrix expressions:
+
+  IVP:   M.dt(X) + L.X = F(X,t)     (reference: core/problems.py:319-362)
+  LBVP:  L.X = F                    (:156)
+  EVP:   lam*M.X + L.X = 0          (:466)
+  NLBVP: G(X) = H(X), Newton via Frechet differentials (:242)
+"""
+
+import numpy as np
+
+from .field import Field, Operand
+from .future import Future
+from .operators import (parseables, TimeDerivative, ConvertNode, dt as dt_op)
+from .arithmetic import Add, ScalarMultiply, MultiplyFields, _union_domain, _is_scalar
+from .domain import Domain
+from ..tools.parsing import split_equation
+from ..tools.exceptions import UnsupportedEquationError, SymbolicParsingError
+
+
+def _flatten_terms(expr):
+    """Flatten an expression into additive terms."""
+    if isinstance(expr, Add):
+        out = []
+        for a in expr.args:
+            out.extend(_flatten_terms(a))
+        return out
+    return [expr]
+
+
+def _contains_marker(expr, marker):
+    if expr is marker:
+        return True
+    if isinstance(marker, type) and isinstance(expr, marker):
+        return True
+    if isinstance(expr, Future):
+        return any(_contains_marker(a, marker) for a in expr.args
+                   if isinstance(a, (Field, Future)))
+    return False
+
+
+def _strip_dt(expr):
+    """Replace dt(X) -> X; the result must contain no further dt."""
+    if isinstance(expr, TimeDerivative):
+        operand = expr.operand
+        if _contains_marker(operand, TimeDerivative):
+            raise UnsupportedEquationError("Nested time derivatives are not supported.")
+        return operand
+    if isinstance(expr, Future):
+        new_args = [(_strip_dt(a) if isinstance(a, (Field, Future)) else a)
+                    for a in expr.args]
+        return expr.rebuild(new_args)
+    return expr
+
+
+def _strip_linear_factor(expr, marker):
+    """Remove one linear occurrence of `marker` (a constant Field) from expr."""
+    if expr is marker:
+        raise UnsupportedEquationError(
+            "Eigenvalue must multiply variables, not appear alone.")
+    if isinstance(expr, ScalarMultiply):
+        return ScalarMultiply(expr.scalar, _strip_linear_factor(expr.operand, marker))
+    if isinstance(expr, MultiplyFields):
+        a, b = expr.args
+        if a is marker:
+            return b
+        if b is marker:
+            return a
+        if _contains_marker(a, marker):
+            return MultiplyFields(_strip_linear_factor(a, marker), b)
+        return MultiplyFields(a, _strip_linear_factor(b, marker))
+    if isinstance(expr, Future):
+        new_args = []
+        for arg in expr.args:
+            if isinstance(arg, (Field, Future)) and _contains_marker(arg, marker):
+                new_args.append(_strip_linear_factor(arg, marker))
+            else:
+                new_args.append(arg)
+        return expr.rebuild(new_args)
+    raise UnsupportedEquationError(f"Cannot strip eigenvalue from {expr!r}")
+
+
+class ProblemBase:
+    """Base problem (reference: core/problems.py:27 ProblemBase)."""
+
+    def __init__(self, variables, namespace=None, time="t"):
+        if not variables:
+            raise ValueError("Problems require at least one variable.")
+        self.variables = list(variables)
+        self.dist = variables[0].dist
+        self.equations = []
+        self.time_name = time
+        self._user_namespace = dict(namespace or {})
+        self.LHS_variables = self.variables
+
+    @property
+    def namespace(self):
+        ns = {}
+        ns.update(parseables)
+        ns["np"] = np
+        for var in self.variables:
+            if var.name:
+                ns[var.name] = var
+        for coord in self.dist.coords:
+            ns.setdefault(coord.name, coord)
+        ns.update(self._user_namespace)
+        return ns
+
+    def add_equation(self, equation, condition=None):
+        """Add an equation as a string or (LHS, RHS) tuple
+        (reference: core/problems.py:67 add_equation)."""
+        if condition is not None:
+            raise NotImplementedError("Per-group equation conditions are not "
+                                      "implemented yet.")
+        if isinstance(equation, str):
+            lhs_str, rhs_str = split_equation(equation)
+            ns = self.namespace
+            try:
+                lhs = eval(lhs_str, {}, ns)
+                rhs = eval(rhs_str, {}, ns)
+            except Exception as exc:
+                raise SymbolicParsingError(
+                    f"Failed to parse equation {equation!r}: {exc}") from exc
+        else:
+            lhs, rhs = equation
+        if not isinstance(lhs, (Field, Future)):
+            raise UnsupportedEquationError("Equation LHS must involve variables.")
+        eq = self._build_matrix_expressions(lhs, rhs)
+        eq["LHS_str"] = str(lhs)
+        self.equations.append(eq)
+        return eq
+
+    # -- helpers shared by problem types --
+
+    def _eq_domain(self, exprs):
+        operands = [e for e in exprs if isinstance(e, (Field, Future))]
+        domain = _union_domain(self.dist, operands)
+        tensorsigs = {tuple(op.tensorsig) for op in operands}
+        if len(tensorsigs) != 1:
+            raise UnsupportedEquationError("LHS terms have mismatched tensor signatures.")
+        return domain, next(iter(tensorsigs))
+
+    def _wrap(self, expr, domain):
+        if expr is None:
+            return None
+        if tuple(expr.domain.bases) == domain.bases:
+            return expr
+        return ConvertNode(expr, domain.bases)
+
+    def _wrap_rhs(self, rhs, domain, tensorsig):
+        if rhs is None or (_is_scalar(rhs) and rhs == 0):
+            return None
+        if _is_scalar(rhs):
+            if tensorsig:
+                raise UnsupportedEquationError("Scalar RHS for a tensor equation.")
+            const = self.dist.Field(name=f"const_{len(self.equations)}")
+            const["g"] = rhs
+            rhs = const
+        if tuple(rhs.tensorsig) != tuple(tensorsig):
+            raise UnsupportedEquationError("RHS tensor signature does not match LHS.")
+        return self._wrap(rhs, domain)
+
+    def build_solver(self, *args, **kw):
+        raise NotImplementedError
+
+
+class LBVP(ProblemBase):
+    """Linear boundary value problem: L.X = F (reference: core/problems.py:128)."""
+
+    def _build_matrix_expressions(self, lhs, rhs):
+        if _contains_marker(lhs, TimeDerivative):
+            raise UnsupportedEquationError("LBVPs cannot contain time derivatives.")
+        domain, tensorsig = self._eq_domain([lhs])
+        eq = {"domain": domain, "tensorsig": tensorsig,
+              "L": self._wrap(lhs, domain),
+              "F": self._wrap_rhs(rhs, domain, tensorsig)}
+        return eq
+
+    def build_solver(self, **kw):
+        from .solvers import LinearBoundaryValueSolver
+        return LinearBoundaryValueSolver(self, **kw)
+
+
+class IVP(ProblemBase):
+    """Initial value problem: M.dt(X) + L.X = F
+    (reference: core/problems.py:241 IVP)."""
+
+    def __init__(self, variables, namespace=None, time="t"):
+        super().__init__(variables, namespace=namespace, time=time)
+        self.time = self.dist.Field(name=time)
+        self._user_namespace.setdefault(time, self.time)
+        self.sim_time = 0.0
+
+    def _build_matrix_expressions(self, lhs, rhs):
+        terms = _flatten_terms(lhs)
+        m_terms, l_terms = [], []
+        for term in terms:
+            if _is_scalar(term):
+                if term != 0:
+                    raise UnsupportedEquationError("Constant terms belong on the RHS.")
+                continue
+            if _contains_marker(term, TimeDerivative):
+                m_terms.append(_strip_dt(term))
+            else:
+                l_terms.append(term)
+        M_expr = Add(*m_terms) if len(m_terms) > 1 else (m_terms[0] if m_terms else None)
+        L_expr = Add(*l_terms) if len(l_terms) > 1 else (l_terms[0] if l_terms else None)
+        domain, tensorsig = self._eq_domain([e for e in (M_expr, L_expr) if e is not None])
+        return {"domain": domain, "tensorsig": tensorsig,
+                "M": self._wrap(M_expr, domain),
+                "L": self._wrap(L_expr, domain),
+                "F": self._wrap_rhs(rhs, domain, tensorsig)}
+
+    def build_solver(self, timestepper, **kw):
+        from .solvers import InitialValueSolver
+        return InitialValueSolver(self, timestepper, **kw)
+
+
+class EVP(ProblemBase):
+    """Eigenvalue problem: lam*M.X + L.X = 0 (reference: core/problems.py:410)."""
+
+    def __init__(self, variables, eigenvalue=None, namespace=None, **kw):
+        super().__init__(variables, namespace=namespace, **kw)
+        if eigenvalue is None:
+            raise ValueError("EVP requires an eigenvalue field.")
+        self.eigenvalue = eigenvalue
+
+    def _build_matrix_expressions(self, lhs, rhs):
+        if not (_is_scalar(rhs) and rhs == 0):
+            raise UnsupportedEquationError("EVP equations must have zero RHS.")
+        terms = _flatten_terms(lhs)
+        m_terms, l_terms = [], []
+        for term in terms:
+            if _is_scalar(term):
+                continue
+            if _contains_marker(term, self.eigenvalue):
+                m_terms.append(_strip_linear_factor(term, self.eigenvalue))
+            else:
+                l_terms.append(term)
+        M_expr = Add(*m_terms) if len(m_terms) > 1 else (m_terms[0] if m_terms else None)
+        L_expr = Add(*l_terms) if len(l_terms) > 1 else (l_terms[0] if l_terms else None)
+        domain, tensorsig = self._eq_domain([e for e in (M_expr, L_expr) if e is not None])
+        return {"domain": domain, "tensorsig": tensorsig,
+                "M": self._wrap(M_expr, domain),
+                "L": self._wrap(L_expr, domain),
+                "F": None}
+
+    def build_solver(self, **kw):
+        from .solvers import EigenvalueSolver
+        return EigenvalueSolver(self, **kw)
+
+
+class NLBVP(ProblemBase):
+    """Nonlinear boundary value problem solved by Newton-Kantorovich
+    iteration (reference: core/problems.py:196 NLBVP)."""
+
+    def __init__(self, variables, namespace=None, **kw):
+        super().__init__(variables, namespace=namespace, **kw)
+        # Perturbation variables for the Newton linearization
+        self.perturbations = []
+        for var in self.variables:
+            pert = Field(var.dist, bases=var.domain.bases, tensorsig=var.tensorsig,
+                         name=f"d_{var.name}", dtype=var.dtype)
+            self.perturbations.append(pert)
+
+    def _build_matrix_expressions(self, lhs, rhs):
+        # Residual G = lhs - rhs; Newton solves dG.dX = -G
+        if _is_scalar(rhs) and rhs == 0:
+            residual = lhs
+        elif _is_scalar(rhs):
+            const = self.dist.Field(name=f"const_{len(self.equations)}")
+            const["g"] = rhs
+            residual = lhs - const
+        else:
+            residual = lhs - rhs
+        dG = residual.frechet_differential(self.variables, self.perturbations)
+        if _is_scalar(dG):
+            raise UnsupportedEquationError("Equation has no dependence on variables.")
+        domain, tensorsig = self._eq_domain([dG])
+        return {"domain": domain, "tensorsig": tensorsig,
+                "L": self._wrap(dG, domain),
+                "residual": residual,
+                "F": None}
+
+    def build_solver(self, **kw):
+        from .solvers import NonlinearBoundaryValueSolver
+        return NonlinearBoundaryValueSolver(self, **kw)

@@ -1,0 +1,397 @@
+"""
+Solvers (reference: dedalus/core/solvers.py).
+
+  InitialValueSolver        — IMEX timestepping, one jitted device step
+  LinearBoundaryValueSolver — batched pencil solve of L.X = F
+  NonlinearBoundaryValueSolver — Newton-Kantorovich iteration
+  EigenvalueSolver          — dense/sparse generalized eigensolves per pencil
+
+TPU-native design: the solver holds the state as ONE device array X of shape
+(G, S) (all pencils batched); fields are synchronized at step boundaries so
+user code sees reference-like Field semantics while the hot loop stays on
+device (reference hot loop anatomy: core/solvers.py:683-711 + SURVEY.md §3.2).
+"""
+
+import time as time_mod
+import logging
+import numpy as np
+import scipy.linalg
+import jax
+import jax.numpy as jnp
+
+from .subsystems import (PencilLayout, build_subproblems, build_matrices,
+                         gather_state, scatter_state, row_valid_masks)
+from .future import EvalContext, ev
+from . import timesteppers as timesteppers_mod
+from ..libraries.matsolvers import get_solver
+from ..tools.config import config
+
+logger = logging.getLogger(__name__)
+
+
+class SolverBase:
+    """Shared setup: pencil layout, subproblems, device matrices
+    (reference: core/solvers.py:31 SolverBase)."""
+
+    matrices = ("L",)
+
+    def __init__(self, problem, matsolver=None):
+        self.problem = problem
+        self.dist = problem.dist
+        self.variables = self.matrix_variables(problem)
+        if matsolver is None:
+            matsolver = config["linear algebra"].get("MATRIX_SOLVER", "BatchedLUFactorized")
+        self.matsolver = matsolver
+        self.layout = PencilLayout(self.dist, self.variables, problem.equations)
+        self.subproblems = build_subproblems(self.layout)
+        self._matrices = build_matrices(self.subproblems, problem.equations,
+                                        self.variables, names=self.matrices)
+        self.valid_row_mask = row_valid_masks(self.layout, problem.equations)
+
+    def matrix_variables(self, problem):
+        return problem.variables
+
+    @property
+    def pencil_shape(self):
+        S = sum(self.layout.slot_size(v.domain, v.tensorsig) for v in self.variables)
+        return (self.layout.n_groups, S)
+
+    @property
+    def pencil_dtype(self):
+        return self._matrices[self.matrices[-1]].dtype
+
+    @property
+    def state(self):
+        return self.problem.variables
+
+    # ---------------------------------------------------------------- fields
+
+    def gather_fields(self, fields=None):
+        fields = fields or self.variables
+        arrays = {v.name: v.coeff_data() for v in fields}
+        return gather_state(self.layout, fields, arrays)
+
+    def scatter_fields(self, X, fields=None):
+        fields = fields or self.variables
+        arrays = scatter_state(self.layout, fields, X)
+        for v in fields:
+            v.preset_coeff(arrays[v.name])
+
+    # ------------------------------------------------------------------ RHS
+
+    def build_rhs_evaluator(self, key="F", time_field=None):
+        problem = self.problem
+        layout = self.layout
+        variables = self.variables
+        equations = problem.equations
+        dim = self.dist.dim
+        dtype = self.pencil_dtype
+
+        def eval_F(X, t=None):
+            arrays = scatter_state(layout, variables, X)
+            subs = {var: arrays[var.name] for var in variables}
+            if time_field is not None:
+                subs[time_field] = jnp.reshape(jnp.asarray(t), (1,) * dim)
+            ctx = EvalContext(subs)
+            parts = []
+            for eq in equations:
+                expr = eq.get(key)
+                size = layout.slot_size(eq["domain"], eq["tensorsig"])
+                if expr is None:
+                    parts.append(jnp.zeros((layout.n_groups, size), dtype=dtype))
+                else:
+                    data = ev(expr, ctx, "c")
+                    parts.append(layout.gather(data, eq["domain"], eq["tensorsig"]))
+            return jnp.concatenate(parts, axis=1).astype(dtype)
+
+        return eval_F
+
+
+class InitialValueSolver(SolverBase):
+    """IVP solver (reference: core/solvers.py:503 InitialValueSolver)."""
+
+    matrices = ("M", "L")
+
+    def __init__(self, problem, timestepper, matsolver=None,
+                 enforce_real_cadence=100, warmup_iterations=10, **kw):
+        super().__init__(problem, matsolver=matsolver)
+        self.M_mat = jnp.asarray(self._matrices["M"])
+        self.L_mat = jnp.asarray(self._matrices["L"])
+        self.eval_F = self.build_rhs_evaluator("F", time_field=problem.time)
+        # timestepping state
+        self.sim_time = 0.0
+        self.initial_sim_time = 0.0
+        self.iteration = 0
+        self.initial_iteration = 0
+        self.stop_sim_time = np.inf
+        self.stop_wall_time = np.inf
+        self.stop_iteration = np.inf
+        self.warmup_iterations = warmup_iterations
+        self.enforce_real_cadence = enforce_real_cadence
+        self.start_time = self.init_time = time_mod.time()
+        self.warmup_time = None
+        self.X = self.gather_fields()
+        if isinstance(timestepper, str):
+            timestepper = timesteppers_mod.schemes[timestepper]
+        self.timestepper = timestepper(self)
+        from .evaluator import Evaluator
+        self.evaluator = Evaluator(self)
+        self.dt = None
+
+    @property
+    def proceed(self):
+        """Whether to keep iterating (reference: core/solvers.py:618)."""
+        if self.sim_time >= self.stop_sim_time:
+            logger.info("Simulation stop time reached.")
+            return False
+        if self.iteration >= self.stop_iteration:
+            logger.info("Simulation stop iteration reached.")
+            return False
+        if (time_mod.time() - self.start_time) >= self.stop_wall_time:
+            logger.info("Simulation stop wall time reached.")
+            return False
+        return True
+
+    def step(self, dt, wall_time=None):
+        """Advance the system by one timestep (reference: core/solvers.py:683)."""
+        dt = float(dt)
+        if not np.isfinite(dt):
+            raise ValueError("Invalid timestep.")
+        if self.iteration == self.warmup_iterations:
+            self.warmup_time = time_mod.time()
+        # pick up any user modifications of the state fields
+        self.X = self.gather_fields()
+        self.timestepper.step(dt)
+        self.scatter_fields(self.X)
+        self.problem.sim_time = self.sim_time
+        self.iteration += 1
+        self.dt = dt
+        self.evaluator.evaluate_scheduled(
+            iteration=self.iteration, wall_time=time_mod.time() - self.start_time,
+            sim_time=self.sim_time, timestep=dt)
+
+    def evolve(self, timestep_function=None, log_cadence=100):
+        """Run the main loop to completion (reference: core/solvers.py:713)."""
+        try:
+            while self.proceed:
+                dt = timestep_function() if timestep_function else self.dt
+                if dt is None:
+                    raise ValueError(
+                        "evolve() requires a timestep_function, or a prior "
+                        "solver.step(dt) to set the timestep.")
+                self.step(dt)
+                if self.iteration % log_cadence == 0:
+                    logger.info(f"Iteration={self.iteration}, Time={self.sim_time:.6e}, dt={dt:.6e}")
+        except Exception:
+            logger.error("Exception raised, triggering end of main loop.")
+            raise
+        finally:
+            self.log_stats()
+
+    def print_subproblem_ranks(self, **kw):
+        for sp in self.subproblems:
+            L = self._matrices["L"][sp.index]
+            M = self._matrices["M"][sp.index]
+            A = M + L
+            print(f"group {sp.group}: rank={np.linalg.matrix_rank(A)}/{A.shape[0]}, "
+                  f"cond={np.linalg.cond(A):.2e}")
+
+    def load_state(self, path, index=-1, allow_missing=False):
+        """Restore state from an HDF5 checkpoint
+        (reference: core/solvers.py:632 load_state)."""
+        import h5py
+        with h5py.File(path, "r") as f:
+            write = np.asarray(f["scales/write_number"])[index]
+            self.sim_time = self.initial_sim_time = float(np.asarray(f["scales/sim_time"])[index])
+            self.iteration = self.initial_iteration = int(np.asarray(f["scales/iteration"])[index])
+            self.dt = float(np.asarray(f["scales/timestep"])[index]) \
+                if "scales/timestep" in f else None
+            logger.info(f"Loading iteration: {self.iteration} (write {write})")
+            for var in self.state:
+                if var.name in f["tasks"]:
+                    var["g"] = np.asarray(f["tasks"][var.name][index])
+                elif not allow_missing:
+                    raise KeyError(f"State variable {var.name} not found in {path}")
+        self.X = self.gather_fields()
+        return write, self.dt
+
+    def log_stats(self, format=".4g"):
+        """Log run statistics including the reference's throughput metric
+        (reference: core/solvers.py:755-778 log_stats, modes-stages/cpu-sec)."""
+        log_time = time_mod.time()
+        total = log_time - self.init_time
+        logger.info(f"Final iteration: {self.iteration}")
+        logger.info(f"Final sim time: {self.sim_time}")
+        logger.info(f"Setup time (init - iter 0): {self.start_time - self.init_time:{format}} sec")
+        if self.iteration > self.warmup_iterations and self.warmup_time:
+            warmup = self.warmup_time - self.start_time
+            run = log_time - self.warmup_time
+            iters = self.iteration - self.warmup_iterations
+            logger.info(f"Warmup time (iter 0-{self.warmup_iterations}): {warmup:{format}} sec")
+            logger.info(f"Run time (iter {self.warmup_iterations}-end): {run:{format}} sec")
+            G, S = self.pencil_shape
+            modes = G * S
+            stages = self.timestepper.stages if hasattr(self.timestepper, "stages") else 1
+            rate = modes * stages * iters / run if run > 0 else 0.0
+            logger.info(f"Speed: {rate:.2e} mode-stages/sec")
+        else:
+            logger.info(f"Total time: {total:{format}} sec")
+
+
+class LinearBoundaryValueSolver(SolverBase):
+    """LBVP solver (reference: core/solvers.py:324)."""
+
+    matrices = ("L",)
+
+    def __init__(self, problem, matsolver=None, **kw):
+        super().__init__(problem, matsolver=matsolver)
+        self.L_mat = jnp.asarray(self._matrices["L"])
+        self.eval_F = self.build_rhs_evaluator("F")
+        Solver = get_solver(self.matsolver)
+        self._aux = Solver.factor(self.L_mat)
+        self._solve = jax.jit(Solver.solve)
+        self.iteration = 0
+
+    def solve(self):
+        """Solve L.X = F with current NCC/RHS fields
+        (reference: core/solvers.py:369)."""
+        X0 = self.gather_fields()
+        F = self.eval_F(X0) * jnp.asarray(self.valid_row_mask)
+        X = self._solve(self._aux, F)
+        self.scatter_fields(X)
+        self.iteration += 1
+        return self.state
+
+
+class NonlinearBoundaryValueSolver(SolverBase):
+    """Newton-Kantorovich NLBVP solver (reference: core/solvers.py:418)."""
+
+    matrices = ("L",)
+
+    def __init__(self, problem, matsolver=None, **kw):
+        # Matrices are in terms of the perturbation variables.
+        self._problem_ref = problem
+        super().__init__(problem, matsolver=matsolver)
+        self.iteration = 0
+        # residual expressions converted to equation domains
+        self.residual_exprs = [problem._wrap(eq["residual"], eq["domain"])
+                               for eq in problem.equations]
+
+    def matrix_variables(self, problem):
+        return problem.perturbations
+
+    @property
+    def state(self):
+        return self.problem.variables
+
+    def _eval_residual(self):
+        layout = self.layout
+        ctx = EvalContext()
+        parts = []
+        for eq, expr in zip(self.problem.equations, self.residual_exprs):
+            size = layout.slot_size(eq["domain"], eq["tensorsig"])
+            if expr is None:
+                parts.append(jnp.zeros((layout.n_groups, size)))
+            else:
+                data = ev(expr, ctx, "c")
+                parts.append(layout.gather(data, eq["domain"], eq["tensorsig"]))
+        F = jnp.concatenate(parts, axis=1).astype(self.pencil_dtype)
+        return F * jnp.asarray(self.valid_row_mask)
+
+    def newton_iteration(self, damping=1.0):
+        """One Newton step: solve dG.dX = -G, update variables
+        (reference: core/solvers.py:470)."""
+        # Rebuild Jacobian matrices around the current state (NCC data moves).
+        self._matrices = build_matrices(self.subproblems, self.problem.equations,
+                                        self.variables, names=("L",))
+        L = jnp.asarray(self._matrices["L"])
+        Solver = get_solver(self.matsolver)
+        aux = Solver.factor(L)
+        F = -self._eval_residual()
+        dX = Solver.solve(aux, F)
+        self._last_perturbation = dX
+        arrays = scatter_state(self.layout, self.variables, dX)
+        for var, pert in zip(self.problem.variables, self.variables):
+            var.preset_coeff(var.coeff_data() + damping * arrays[pert.name])
+        self.iteration += 1
+
+    def perturbation_norm(self, order=2):
+        """Norm of the last Newton update dX (reference convergence metric)."""
+        if getattr(self, "_last_perturbation", None) is None:
+            return np.inf
+        dX = np.asarray(self._last_perturbation)
+        if order == np.inf:
+            return np.max(np.abs(dX))
+        return np.sum(np.abs(dX) ** order) ** (1.0 / order)
+
+    def residual_norm(self, order=2):
+        data = np.asarray(self._eval_residual())
+        return np.sum(np.abs(data) ** order) ** (1.0 / order)
+
+
+class EigenvalueSolver(SolverBase):
+    """EVP solver: lam*M.X + L.X = 0 (reference: core/solvers.py:134)."""
+
+    matrices = ("M", "L")
+
+    def __init__(self, problem, matsolver=None, **kw):
+        super().__init__(problem, matsolver=matsolver)
+        self.eigenvalues = None
+        self.eigenvectors = None
+        self.eigenvalue_subproblem = None
+
+    def solve_dense(self, subproblem, left=False, normalize_left=True, **kw):
+        """Dense generalized eigensolve for one pencil
+        (reference: core/solvers.py:180 solve_dense)."""
+        sp_i = subproblem.index
+        L = np.asarray(self._matrices["L"][sp_i])
+        M = np.asarray(self._matrices["M"][sp_i])
+        out = scipy.linalg.eig(L, b=-M, left=left, **kw)
+        if left:
+            evals, evecs_left, evecs = out
+        else:
+            evals, evecs = out
+        # drop infinite eigenvalues from identity-closure/tau rows
+        finite = np.isfinite(evals)
+        self.eigenvalues = evals[finite]
+        self.eigenvectors = evecs[:, finite]
+        if left:
+            self.left_eigenvectors = evecs_left[:, finite]
+            if normalize_left:
+                norms = np.einsum("ij,ij->j", np.conj(self.left_eigenvectors),
+                                  -M @ self.eigenvectors)
+                safe = np.where(np.abs(norms) > 0, norms, 1.0)
+                self.left_eigenvectors = self.left_eigenvectors / np.conj(safe)
+        self.eigenvalue_subproblem = subproblem
+        return self.eigenvalues
+
+    def solve_sparse(self, subproblem, N, target, left=False, **kw):
+        """Sparse shift-invert eigensolve around `target`
+        (reference: core/solvers.py:225 solve_sparse)."""
+        from ..tools.array import scipy_sparse_eigs
+        import scipy.sparse as sps
+        sp_i = subproblem.index
+        L = sps.csr_matrix(np.asarray(self._matrices["L"][sp_i]))
+        M = sps.csr_matrix(np.asarray(self._matrices["M"][sp_i]))
+        out = scipy_sparse_eigs(A=L, B=-M, N=N, target=target, left=left, **kw)
+        if left:
+            self.eigenvalues, self.eigenvectors, self.left_eigenvalues, \
+                self.left_eigenvectors = out
+        else:
+            self.eigenvalues, self.eigenvectors = out
+        self.eigenvalue_subproblem = subproblem
+        return self.eigenvalues
+
+    def set_state(self, index, subproblem=None):
+        """Load eigenvector `index` into the state fields
+        (reference: core/solvers.py:296 set_state)."""
+        subproblem = subproblem or self.eigenvalue_subproblem
+        G, S = self.pencil_shape
+        X = np.zeros((G, S), dtype=np.complex128)
+        X[subproblem.index] = self.eigenvectors[:, index]
+        arrays = scatter_state(self.layout, self.variables, jnp.asarray(X))
+        for var in self.variables:
+            data = arrays[var.name]
+            if not np.iscomplexobj(np.asarray(var.data)):
+                data = data.real
+            var.preset_coeff(jnp.asarray(data).astype(var.data.dtype))

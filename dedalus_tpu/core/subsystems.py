@@ -1,0 +1,317 @@
+"""
+Pencil layout and subproblem matrix assembly
+(reference: dedalus/core/subsystems.py).
+
+TPU-native redesign: the reference enumerates per-rank "subsystems"
+(generalized pencils) and assembles one sparse matrix per subproblem, solved
+serially with SuperLU. Here ALL groups form one uniform batch:
+
+  * every variable occupies a fixed-size slot per group —
+    (ncomp, group_shape per separable axis, coupled size or 1) — so the
+    pencil matrices stack into a dense/banded (G, S, S) device array
+    (pencil index = MXU batch dimension);
+  * invalid slots (the reference's valid_modes masks, core/basis.py:1123)
+    are zeroed and closed with identity rows, keeping every group the same
+    shape instead of ragged per-group sizes;
+  * gather/scatter between field coefficient arrays and the (G, S) state
+    vector are pure jnp reshapes/transposes, fused into the jitted step
+    (reference: core/subsystems.py:336-367 gather_inputs/scatter_inputs).
+"""
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+
+from .field import Field
+from .domain import Domain
+from ..tools.general import is_complex_dtype
+
+
+class PencilLayout:
+    """Global pencil structure shared by all subproblems of a problem."""
+
+    def __init__(self, dist, variables, equations):
+        self.dist = dist
+        dim = dist.dim
+        sep_basis = [None] * dim
+        coupled_basis = [None] * dim
+        domains = [v.domain for v in variables] + [eq["domain"] for eq in equations]
+        for domain in domains:
+            for axis, basis in enumerate(domain.bases):
+                if basis is None:
+                    continue
+                if basis.separable:
+                    if sep_basis[axis] is None:
+                        sep_basis[axis] = basis
+                    elif sep_basis[axis] != basis:
+                        raise ValueError(f"Mismatched separable bases on axis {axis}")
+                else:
+                    cur = coupled_basis[axis]
+                    if cur is None or basis.k > cur.k:
+                        coupled_basis[axis] = basis
+        self.sep_axes = [ax for ax in range(dim) if sep_basis[ax] is not None]
+        self.sep_bases = {ax: sep_basis[ax] for ax in self.sep_axes}
+        self.sep_widths = {ax: sep_basis[ax].group_shape for ax in self.sep_axes}
+        self.coupled_axes = [ax for ax in range(dim) if coupled_basis[ax] is not None]
+        self.group_counts = [self.sep_bases[ax].n_groups for ax in self.sep_axes]
+        self.n_groups = int(np.prod(self.group_counts, dtype=int)) if self.sep_axes else 1
+
+    def groups(self):
+        """Iterate full-length per-axis group tuples."""
+        dim = self.dist.dim
+        if not self.sep_axes:
+            yield (None,) * dim
+            return
+        for multi in np.ndindex(*self.group_counts):
+            group = [None] * dim
+            for ax, g in zip(self.sep_axes, multi):
+                group[ax] = int(g)
+            yield tuple(group)
+
+    # ------------------------------------------------------------ slots
+
+    def slot_shape(self, domain, tensorsig):
+        """(ncomp, *per-axis slot sizes) — uniform across groups."""
+        tshape = tuple(cs.dim for cs in tensorsig)
+        ncomp = int(np.prod(tshape, dtype=int)) if tshape else 1
+        sizes = []
+        for axis, basis in enumerate(domain.bases):
+            if axis in self.sep_widths:
+                sizes.append(self.sep_widths[axis])
+            elif basis is None:
+                sizes.append(1)
+            else:
+                sizes.append(basis.size)
+        return (ncomp,) + tuple(sizes)
+
+    def slot_size(self, domain, tensorsig):
+        return int(np.prod(self.slot_shape(domain, tensorsig), dtype=int))
+
+    def valid_mask(self, domain, tensorsig, group):
+        """Validity of each slot entry for one group (bool, slot_shape)."""
+        shape = self.slot_shape(domain, tensorsig)
+        mask = np.ones(shape, dtype=bool)
+        pos = 1
+        for axis, basis in enumerate(domain.bases):
+            ax_len = shape[pos]
+            ax_mask = np.ones(ax_len, dtype=bool)
+            if axis in self.sep_widths:
+                g = group[axis]
+                if basis is None:
+                    # constant along separable axis: only (group 0, element 0)
+                    ax_mask[:] = False
+                    if g == 0:
+                        ax_mask[0] = True
+                else:
+                    ax_mask = basis.valid_elements()[g]
+            view = [np.newaxis] * len(shape)
+            view[pos] = slice(None)
+            mask = mask & ax_mask[tuple(view)]
+            pos += 1
+        return mask
+
+    # ------------------------------------------------- device gather/scatter
+
+    def gather(self, array, domain, tensorsig):
+        """
+        (tensor..., coeff...) device array -> (G, slot) with constant
+        separable axes zero-embedded at (group 0, element 0). Pure jnp.
+        """
+        tshape = tuple(cs.dim for cs in tensorsig)
+        tdim = len(tshape)
+        ncomp = int(np.prod(tshape, dtype=int)) if tshape else 1
+        data = array.reshape((ncomp,) + array.shape[tdim:])
+        # expand/embed separable axes
+        new_shape = [ncomp]
+        group_positions = []
+        pos = 1
+        for axis, basis in enumerate(domain.bases):
+            size = data.shape[1 + axis]
+            if axis in self.sep_widths:
+                gs = self.sep_widths[axis]
+                G = self.sep_bases[axis].n_groups
+                if basis is None:
+                    pad = [(0, 0)] * data.ndim
+                    pad[1 + axis] = (0, G * gs - size)
+                    data = jnp.pad(data, pad)
+                new_shape.extend([G, gs])
+                group_positions.append(pos)
+                pos += 2
+            else:
+                new_shape.append(size)
+                pos += 1
+        data = data.reshape(new_shape)
+        # move group axes to the front (in separable-axis order)
+        perm = group_positions + [i for i in range(data.ndim) if i not in group_positions]
+        data = jnp.transpose(data, perm)
+        G_total = self.n_groups
+        return data.reshape(G_total, -1)
+
+    def scatter(self, pencils, domain, tensorsig):
+        """(G, slot) -> (tensor..., coeff...); inverse of `gather`."""
+        tshape = tuple(cs.dim for cs in tensorsig)
+        ncomp = int(np.prod(tshape, dtype=int)) if tshape else 1
+        # Rebuild the transposed intermediate shape
+        group_dims = []
+        slot_dims = [ncomp]
+        for axis, basis in enumerate(domain.bases):
+            if axis in self.sep_widths:
+                group_dims.append(self.sep_bases[axis].n_groups)
+                slot_dims.append(self.sep_widths[axis])
+            elif basis is None:
+                slot_dims.append(1)
+            else:
+                slot_dims.append(basis.size)
+        data = pencils.reshape(group_dims + slot_dims)
+        nG = len(group_dims)
+        # inverse permutation: groups back next to their pair dims
+        perm = []
+        gi = 0
+        si = nG  # position of ncomp
+        perm.append(si)
+        si += 1
+        for axis, basis in enumerate(domain.bases):
+            if axis in self.sep_widths:
+                perm.append(gi)
+                perm.append(si)
+                gi += 1
+                si += 1
+            else:
+                perm.append(si)
+                si += 1
+        data = jnp.transpose(data, perm)
+        # merge (G, gs) pairs and slice off constant-axis embeddings
+        out_shape = []
+        slices = []
+        dims = list(data.shape)
+        di = 1
+        merged = [dims[0]]
+        for axis, basis in enumerate(domain.bases):
+            if axis in self.sep_widths:
+                merged.append(dims[di] * dims[di + 1])
+                di += 2
+            else:
+                merged.append(dims[di])
+                di += 1
+        data = data.reshape(merged)
+        for axis, basis in enumerate(domain.bases):
+            if axis in self.sep_widths and basis is None:
+                slices.append(slice(0, 1))
+            else:
+                slices.append(slice(None))
+        data = data[(slice(None),) + tuple(slices)]
+        return data.reshape(tshape + data.shape[1:])
+
+
+class Subproblem:
+    """One pencil group (reference: core/subsystems.py:234 Subproblem)."""
+
+    def __init__(self, layout, group, index):
+        self.layout = layout
+        self.group = group      # full-length per-axis tuple
+        self.index = index      # flat group index
+
+    def field_size(self, operand):
+        return self.layout.slot_size(operand.domain, operand.tensorsig)
+
+    def field_shape(self, operand):
+        return self.layout.slot_shape(operand.domain, operand.tensorsig)
+
+
+def build_subproblems(layout):
+    return [Subproblem(layout, group, i) for i, group in enumerate(layout.groups())]
+
+
+def build_matrices(subproblems, equations, variables, names=("M", "L")):
+    """
+    Assemble the batched pencil matrices for all subproblems.
+    Returns {name: np.ndarray (G, S, S)} with validity enforcement:
+    invalid rows/columns zeroed; identity closure rows added to the LAST
+    name in `names` (the 'L'-like matrix) to keep each group square
+    (reference: core/subsystems.py:493-598 build_matrices).
+    """
+    layout = subproblems[0].layout
+    var_sizes = [layout.slot_size(v.domain, v.tensorsig) for v in variables]
+    var_offsets = np.concatenate([[0], np.cumsum(var_sizes)])
+    S = int(var_offsets[-1])
+    eq_sizes = [layout.slot_size(eq["domain"], eq["tensorsig"]) for eq in equations]
+    R = int(np.sum(eq_sizes))
+    if R != S:
+        raise ValueError(f"Pencil system is not square: {R} equation rows for "
+                         f"{S} variable columns.")
+    complex_problem = any(is_complex_dtype(v.dtype) for v in variables)
+    dtype = np.complex128 if complex_problem else np.float64
+    G = len(subproblems)
+    out = {name: np.zeros((G, S, S), dtype=dtype) for name in names}
+
+    for sp_i, subproblem in enumerate(subproblems):
+        # validity masks
+        col_valid = np.concatenate([
+            layout.valid_mask(v.domain, v.tensorsig, subproblem.group).ravel()
+            for v in variables])
+        row_valid = np.concatenate([
+            layout.valid_mask(eq["domain"], eq["tensorsig"], subproblem.group).ravel()
+            for eq in equations])
+        if col_valid.sum() != row_valid.sum():
+            raise ValueError(
+                f"Invalid row/column mismatch in group {subproblem.group}: "
+                f"{row_valid.sum()} valid rows vs {col_valid.sum()} valid columns.")
+        for name in names:
+            mat = out[name][sp_i]
+            row0 = 0
+            for eq, esize in zip(equations, eq_sizes):
+                expr = eq.get(name)
+                if expr is not None and not (np.isscalar(expr) and expr == 0):
+                    from .operators import operand_expression_matrices
+                    mats = operand_expression_matrices(expr, subproblem, variables)
+                    for vi, var in enumerate(variables):
+                        if var in mats:
+                            block = mats[var]
+                            mat[row0:row0 + esize,
+                                var_offsets[vi]:var_offsets[vi + 1]] += \
+                                np.asarray(block.todense() if sp.issparse(block) else block)
+                row0 += esize
+            # validity enforcement
+            mat[~row_valid, :] = 0.0
+            mat[:, ~col_valid] = 0.0
+        # identity closure on the final (L-like) matrix
+        inv_rows = np.flatnonzero(~row_valid)
+        inv_cols = np.flatnonzero(~col_valid)
+        out[names[-1]][sp_i][inv_rows, inv_cols] = 1.0
+    return out
+
+
+def gather_state(layout, variables, arrays):
+    """Stack per-variable coeff arrays into the (G, S) state vector."""
+    parts = [layout.gather(arrays[v.name], v.domain, v.tensorsig) for v in variables]
+    return jnp.concatenate(parts, axis=1)
+
+
+def scatter_state(layout, variables, X):
+    """Split the (G, S) state vector back into per-variable coeff arrays."""
+    out = {}
+    offset = 0
+    for v in variables:
+        size = layout.slot_size(v.domain, v.tensorsig)
+        out[v.name] = layout.scatter(X[:, offset:offset + size], v.domain, v.tensorsig)
+        offset += size
+    return out
+
+
+def gather_rhs(layout, equations, eq_arrays, valid_masks):
+    """Stack per-equation F coeff arrays into the (G, S) RHS vector."""
+    parts = []
+    for eq, arr in zip(equations, eq_arrays):
+        parts.append(layout.gather(arr, eq["domain"], eq["tensorsig"]))
+    F = jnp.concatenate(parts, axis=1)
+    return F * valid_masks
+
+
+def row_valid_masks(layout, equations):
+    """(G, S) float mask of valid equation rows (host numpy)."""
+    masks = []
+    for i, group in enumerate(layout.groups()):
+        masks.append(np.concatenate([
+            layout.valid_mask(eq["domain"], eq["tensorsig"], group).ravel()
+            for eq in equations]))
+    return np.array(masks, dtype=np.float64)

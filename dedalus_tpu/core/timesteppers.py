@@ -1,0 +1,332 @@
+"""
+IMEX timesteppers (reference: dedalus/core/timesteppers.py).
+
+Schemes integrate M.dt(X) + L.X = F with implicit L and explicit F.
+
+Multistep form (reference: core/timesteppers.py:22 MultistepIMEX):
+    sum_j a_j M.X(n-j) + sum_j b_j L.X(n-j) = sum_{j>=1} c_j F(n-j)
+with variable-timestep coefficients. The SBDF family generates its
+coefficients from Lagrange derivative/extrapolation weights (equivalent to
+the reference's closed forms from Wang & Ruuth 2008, JCM 26).
+
+IMEX Runge-Kutta form (reference: core/timesteppers.py:486 RungeKuttaIMEX,
+tableaux from Ascher, Ruuth & Spiteri 1997):
+    M.X(i) - M.X(0) = dt * sum_j [ A[i,j] F(j) - H[i,j] L.X(j) ]
+
+Device design: each step is ONE jitted call (gather -> F evaluation with
+transforms -> batched LU solve -> scatter); the LHS factorization
+(a0*M + b0*L or M + dt*H[i,i]*L) is recomputed only when the leading
+coefficients change (reference: core/timesteppers.py:123-128,160-168).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..libraries.matsolvers import get_solver
+
+schemes = {}
+
+
+def add_scheme(cls):
+    schemes[cls.__name__] = cls
+    return cls
+
+
+def _lagrange_derivative_weights(nodes):
+    """Weights w: sum_j w_j p(nodes_j) = p'(0) for all deg < len(nodes)."""
+    n = len(nodes)
+    V = np.vander(np.asarray(nodes, dtype=float), n, increasing=True).T
+    d = np.zeros(n)
+    if n > 1:
+        d[1] = 1.0
+    return np.linalg.solve(V, d)
+
+
+def _lagrange_extrapolation_weights(nodes):
+    """Weights e: sum_j e_j p(nodes_j) = p(0)."""
+    n = len(nodes)
+    V = np.vander(np.asarray(nodes, dtype=float), n, increasing=True).T
+    d = np.zeros(n)
+    d[0] = 1.0
+    return np.linalg.solve(V, d)
+
+
+def _past_times(dt_hist, s):
+    """[0, -k0, -(k0+k1), ...] for s+1 time levels."""
+    times = [0.0]
+    acc = 0.0
+    for j in range(s):
+        acc += dt_hist[j]
+        times.append(-acc)
+    return times
+
+
+class MultistepIMEX:
+    """Base multistep IMEX integrator (reference: core/timesteppers.py:22)."""
+
+    steps = None
+    stages = 1
+
+    def __init__(self, solver):
+        self.solver = solver
+        G, S = solver.pencil_shape
+        s = self.steps
+        zeros = jnp.zeros((s, G, S), dtype=solver.pencil_dtype)
+        self.F_hist = zeros
+        self.MX_hist = zeros
+        self.LX_hist = zeros
+        self.dt_hist = []
+        self._lhs_key = None
+        self._lhs_aux = None
+        self.iteration = 0
+
+        M, L = solver.M_mat, solver.L_mat
+        eval_F = solver.eval_F
+        mask = jnp.asarray(solver.valid_row_mask)
+        Solver = get_solver(solver.matsolver)
+
+        @jax.jit
+        def _factor(a0, b0):
+            return Solver.factor(a0 * M + b0 * L)
+
+        @jax.jit
+        def _advance(X, t, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
+            Fn = eval_F(X, t) * mask
+            MXn = jnp.einsum("gij,gj->gi", M, X)
+            LXn = jnp.einsum("gij,gj->gi", L, X)
+            F_hist = jnp.concatenate([Fn[None], F_hist[:-1]])
+            MX_hist = jnp.concatenate([MXn[None], MX_hist[:-1]])
+            LX_hist = jnp.concatenate([LXn[None], LX_hist[:-1]])
+            RHS = (jnp.tensordot(c, F_hist, axes=1)
+                   - jnp.tensordot(a[1:], MX_hist, axes=1)
+                   - jnp.tensordot(b[1:], LX_hist, axes=1))
+            Xn = Solver.solve(lhs_aux, RHS)
+            return Xn, F_hist, MX_hist, LX_hist
+
+        self._factor = _factor
+        self._advance = _advance
+
+    def compute_coefficients(self, dt_hist, order):
+        """Return (a[0..order], b[0..order], c[1..order])."""
+        raise NotImplementedError
+
+    def step(self, dt, wall_time=None):
+        solver = self.solver
+        s = self.steps
+        self.dt_hist = [float(dt)] + self.dt_hist[:s - 1]
+        self.iteration += 1
+        order = min(s, self.iteration)
+        a, b, c = self.compute_coefficients(self.dt_hist, order)
+        a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+        b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+        c = np.concatenate([c, np.zeros(s - len(c))])
+        key = (round(float(a[0]), 14), round(float(b[0]), 14))
+        if key != self._lhs_key:
+            self._lhs_key = key
+            self._lhs_aux = self._factor(jnp.asarray(a[0]), jnp.asarray(b[0]))
+        X, self.F_hist, self.MX_hist, self.LX_hist = self._advance(
+            solver.X, jnp.asarray(solver.sim_time), self.F_hist, self.MX_hist,
+            self.LX_hist, jnp.asarray(a), jnp.asarray(b), jnp.asarray(c),
+            self._lhs_aux)
+        solver.X = X
+        solver.sim_time = float(solver.sim_time) + float(dt)
+
+
+@add_scheme
+class CNAB1(MultistepIMEX):
+    """Crank-Nicolson / Adams-Bashforth 1 (reference: core/timesteppers.py:179)."""
+    steps = 1
+
+    def compute_coefficients(self, dt_hist, order):
+        k0 = dt_hist[0]
+        return np.array([1/k0, -1/k0]), np.array([0.5, 0.5]), np.array([1.0])
+
+
+@add_scheme
+class SBDF1(MultistepIMEX):
+    """1st-order semi-implicit BDF / backward Euler (reference: :212)."""
+    steps = 1
+
+    def compute_coefficients(self, dt_hist, order):
+        k0 = dt_hist[0]
+        return np.array([1/k0, -1/k0]), np.array([1.0, 0.0]), np.array([1.0])
+
+
+class SBDFBase(MultistepIMEX):
+    """Variable-step SBDF via Lagrange weights."""
+
+    def compute_coefficients(self, dt_hist, order):
+        p = min(order, self.steps)
+        times = _past_times(dt_hist, p)
+        a = _lagrange_derivative_weights(times)
+        b = np.zeros(p + 1)
+        b[0] = 1.0
+        c = _lagrange_extrapolation_weights(times[1:])
+        return a, b, c
+
+
+@add_scheme
+class SBDF2(SBDFBase):
+    """2nd-order SBDF (reference: core/timesteppers.py:321)."""
+    steps = 2
+
+
+@add_scheme
+class SBDF3(SBDFBase):
+    """3rd-order SBDF (reference: core/timesteppers.py:398)."""
+    steps = 3
+
+
+@add_scheme
+class SBDF4(SBDFBase):
+    """4th-order SBDF (reference: core/timesteppers.py:439)."""
+    steps = 4
+
+
+@add_scheme
+class CNAB2(MultistepIMEX):
+    """Crank-Nicolson / Adams-Bashforth 2 (reference: :244)."""
+    steps = 2
+
+    def compute_coefficients(self, dt_hist, order):
+        if order == 1:
+            return CNAB1.compute_coefficients(self, dt_hist, order)
+        k0, k1 = dt_hist[0], dt_hist[1]
+        w = k0 / k1
+        a = np.array([1/k0, -1/k0, 0.0])
+        b = np.array([0.5, 0.5, 0.0])
+        c = np.array([1 + w/2, -w/2])
+        return a, b, c
+
+
+@add_scheme
+class MCNAB2(MultistepIMEX):
+    """Modified CNAB2 (Wang & Ruuth 2008; reference: :282)."""
+    steps = 2
+
+    def compute_coefficients(self, dt_hist, order):
+        if order == 1:
+            return CNAB1.compute_coefficients(self, dt_hist, order)
+        k0, k1 = dt_hist[0], dt_hist[1]
+        w = k0 / k1
+        a = np.array([1/k0, -1/k0, 0.0])
+        b = np.array([(8 + 1/w)/16, (7 - 1/w)/16, 1/16])  # Wang 2008 eqn 2.10
+        c = np.array([1 + w/2, -w/2])
+        return a, b, c
+
+
+@add_scheme
+class CNLF2(MultistepIMEX):
+    """Crank-Nicolson leapfrog (reference: core/timesteppers.py:359)."""
+    steps = 2
+
+    def compute_coefficients(self, dt_hist, order):
+        if order == 1:
+            return CNAB1.compute_coefficients(self, dt_hist, order)
+        k0, k1 = dt_hist[0], dt_hist[1]
+        w = k0 / k1
+        # Wang 2008 eqn 2.11 (variable-step leapfrog + wide Crank-Nicolson)
+        a = np.array([1/((1 + w)*k0), (w - 1)/k0, -w**2/((1 + w)*k0)])
+        b = np.array([1/(2*w), (1 - 1/w)/2, 0.5])
+        c = np.array([1.0, 0.0])
+        return a, b, c
+
+
+class RungeKuttaIMEX:
+    """IMEX Runge-Kutta base (reference: core/timesteppers.py:486)."""
+
+    stages = None
+    A = None  # explicit tableau (s+1, s+1)
+    H = None  # implicit tableau (s+1, s+1)
+    c = None  # stage times (s+1,)
+    steps = 1
+
+    def __init__(self, solver):
+        self.solver = solver
+        self.iteration = 0
+        self._lhs_key = None
+        self._lhs_aux = None
+
+        M, L = solver.M_mat, solver.L_mat
+        eval_F = solver.eval_F
+        mask = jnp.asarray(solver.valid_row_mask)
+        A = jnp.asarray(self.A)
+        H = jnp.asarray(self.H)
+        c = jnp.asarray(self.c)
+        s = self.stages
+        Solver = get_solver(solver.matsolver)
+
+        @jax.jit
+        def _factor(dt):
+            return [Solver.factor(M + dt * H[i, i] * L) for i in range(1, s + 1)]
+
+        @jax.jit
+        def _step(X0, t0, dt, lhs_auxs):
+            MX0 = jnp.einsum("gij,gj->gi", M, X0)
+            LXs = []
+            Fs = []
+            Xi = X0
+            for i in range(1, s + 1):
+                LXs.append(jnp.einsum("gij,gj->gi", L, Xi))
+                Fs.append(eval_F(Xi, t0 + c[i - 1] * dt) * mask)
+                RHS = MX0
+                for j in range(i):
+                    RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
+                Xi = Solver.solve(lhs_auxs[i - 1], RHS)
+            return Xi
+
+        self._factor = _factor
+        self._step = _step
+
+    def step(self, dt, wall_time=None):
+        solver = self.solver
+        key = round(float(dt), 14)
+        if key != self._lhs_key:
+            self._lhs_key = key
+            self._lhs_aux = self._factor(jnp.asarray(float(dt)))
+        solver.X = self._step(solver.X, jnp.asarray(solver.sim_time),
+                              jnp.asarray(float(dt)), self._lhs_aux)
+        solver.sim_time = float(solver.sim_time) + float(dt)
+        self.iteration += 1
+
+
+@add_scheme
+class RK111(RungeKuttaIMEX):
+    """1st-order 1-stage IMEX RK (reference: core/timesteppers.py:636)."""
+    stages = 1
+    A = np.array([[0., 0.], [1., 0.]])
+    H = np.array([[0., 0.], [0., 1.]])
+    c = np.array([0., 1.])
+
+
+@add_scheme
+class RK222(RungeKuttaIMEX):
+    """2nd-order 2-stage IMEX RK, ARS(2,2,2) (reference: :651)."""
+    stages = 2
+    _gamma = (2. - np.sqrt(2.)) / 2.
+    _delta = 1. - 1. / (2. * _gamma)
+    A = np.array([[0., 0., 0.],
+                  [_gamma, 0., 0.],
+                  [_delta, 1. - _delta, 0.]])
+    H = np.array([[0., 0., 0.],
+                  [0., _gamma, 0.],
+                  [0., 1. - _gamma, _gamma]])
+    c = np.array([0., _gamma, 1.])
+
+
+@add_scheme
+class RK443(RungeKuttaIMEX):
+    """3rd-order 4-stage IMEX RK, ARS(4,4,3) (reference: :671)."""
+    stages = 4
+    A = np.array([[0., 0., 0., 0., 0.],
+                  [1/2, 0., 0., 0., 0.],
+                  [11/18, 1/18, 0., 0., 0.],
+                  [5/6, -5/6, 1/2, 0., 0.],
+                  [1/4, 7/4, 3/4, -7/4, 0.]])
+    H = np.array([[0., 0., 0., 0., 0.],
+                  [0., 1/2, 0., 0., 0.],
+                  [0., 1/6, 1/2, 0., 0.],
+                  [0., -1/2, 1/2, 1/2, 0.],
+                  [0., 3/2, -3/2, 1/2, 1/2]])
+    c = np.array([0., 1/2, 2/3, 1/2, 1.])

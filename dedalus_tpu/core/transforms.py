@@ -1,0 +1,241 @@
+"""
+Spectral transform plans (reference: dedalus/core/transforms.py).
+
+Each plan converts one axis of an N-d array between coefficient and grid
+representations. Plans are registered per (basis class, library name) like
+the reference's `@register_transform` registry (core/transforms.py:27-32):
+
+  * 'matrix' — dense matrix-multiply transform (MMT). The test oracle, and
+    on TPU a genuinely fast path: an MMT is one batched matmul on the MXU.
+  * 'fft'    — jnp.fft fast path for Fourier bases; FFT-based DCT for
+    Chebyshev.
+
+All plan methods are pure jnp functions of their array argument (safe under
+jit/vmap); the transform matrices are host-built numpy constants closed over
+by the jitted step.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tools.array import apply_matrix_jax
+
+# Registry: {(basis_class_name, library): plan_class}
+transform_registry = {}
+
+
+def register_transform(basis_cls_name, name):
+    def wrapper(cls):
+        transform_registry[(basis_cls_name, name)] = cls
+        cls.library = name
+        return cls
+    return wrapper
+
+
+def get_plan(basis, scale, library=None):
+    lib = library or basis.library
+    key = (type(basis).__name__, lib)
+    # Fall back through base classes (e.g. ChebyshevT -> Jacobi)
+    cls = None
+    for klass in type(basis).__mro__:
+        cls = transform_registry.get((klass.__name__, lib))
+        if cls is not None:
+            break
+    if cls is None:
+        raise KeyError(f"No transform plan registered for {key}")
+    return cls(basis, scale)
+
+
+class TransformPlan:
+    """Base transform plan for one axis at one grid scale."""
+
+    def __init__(self, basis, scale):
+        self.basis = basis
+        self.scale = scale
+        self.N = basis.size
+        self.Ng = basis.grid_size(scale)
+
+
+class MatrixTransform(TransformPlan):
+    """Generic MMT plan: subclasses provide forward/backward matrices."""
+
+    def __init__(self, basis, scale):
+        super().__init__(basis, scale)
+        self.forward_mat = self.build_forward(basis, scale)    # (N, Ng)
+        self.backward_mat = self.build_backward(basis, scale)  # (Ng, N)
+
+    def forward(self, gdata, axis):
+        return apply_matrix_jax(jnp.asarray(self.forward_mat), gdata, axis)
+
+    def backward(self, cdata, axis):
+        return apply_matrix_jax(jnp.asarray(self.backward_mat), cdata, axis)
+
+
+@register_transform("Jacobi", "matrix")
+class JacobiMMT(MatrixTransform):
+    """
+    Jacobi MMT (reference: core/transforms.py:115 JacobiMMT).
+
+    Grid is always the (a0, b0) Gauss grid of the basis family; forward
+    projects onto (a0, b0) then applies the ultraspherical-style conversion
+    to the basis's derivative level (a, b) = (a0+k, b0+k).
+    """
+
+    @staticmethod
+    def build_forward(basis, scale):
+        from ..tools import jacobi
+        Ng = basis.grid_size(scale)
+        F = jacobi.forward_matrix(basis.size, basis.a0, basis.b0, Ng)
+        if basis.k > 0:
+            C = jacobi.conversion_matrix(basis.size, basis.a0, basis.b0, basis.k, basis.k)
+            F = C @ F
+        return F
+
+    @staticmethod
+    def build_backward(basis, scale):
+        from ..tools import jacobi
+        Ng = basis.grid_size(scale)
+        x = jacobi.build_grid(Ng, basis.a0, basis.b0)
+        return jacobi.build_polynomials(basis.size, basis.a, basis.b, x).T
+
+
+@register_transform("Jacobi", "fft")
+class JacobiAuto(JacobiMMT):
+    """
+    Placeholder fast path: Chebyshev DCT-via-FFT lands here later; MMT is
+    already MXU-native and is used in the meantime.
+    """
+
+
+@register_transform("RealFourier", "matrix")
+class RealFourierMMT(MatrixTransform):
+    """
+    Real Fourier MMT oracle (reference: core/transforms.py:388 RealFourierMMT).
+
+    Coefficient layout matches the reference's interleaved (cos, -sin) pairs:
+    c[2g] = cos-amplitude, c[2g+1] = minus-sin-amplitude of mode g
+    (reference: core/basis.py:1108 RealFourier, group_shape=(2,)).
+    """
+
+    @staticmethod
+    def build_forward(basis, scale):
+        Ng = basis.grid_size(scale)
+        N = basis.size
+        theta = 2 * np.pi * np.arange(Ng) / Ng
+        g = np.arange(N // 2)
+        F = np.zeros((N, Ng))
+        cosrows = np.cos(np.outer(g, theta)) * 2.0 / Ng
+        cosrows[0] /= 2.0
+        sinrows = -np.sin(np.outer(g, theta)) * 2.0 / Ng
+        sinrows[0] *= 0.0  # -sin(0x) mode is invalid
+        F[0::2] = cosrows
+        F[1::2] = sinrows
+        return F
+
+    @staticmethod
+    def build_backward(basis, scale):
+        Ng = basis.grid_size(scale)
+        N = basis.size
+        theta = 2 * np.pi * np.arange(Ng) / Ng
+        g = np.arange(N // 2)
+        B = np.zeros((Ng, N))
+        B[:, 0::2] = np.cos(np.outer(theta, g))
+        B[:, 1::2] = -np.sin(np.outer(theta, g))
+        B[:, 1] = 0.0
+        return B
+
+
+@register_transform("RealFourier", "fft")
+class RealFourierFFT(TransformPlan):
+    """
+    Real Fourier fast path via jnp.fft.rfft/irfft
+    (reference: core/transforms.py:513 ScipyRealFFT / :538 FFTWRealFFT).
+    """
+
+    def forward(self, gdata, axis):
+        N, Ng = self.N, self.Ng
+        data = jnp.moveaxis(gdata, axis, -1)
+        F = jnp.fft.rfft(data, axis=-1) / Ng
+        K = N // 2
+        F = F[..., :K]
+        cos = 2.0 * F.real
+        cos = cos.at[..., 0].divide(2.0)
+        msin = 2.0 * F.imag
+        msin = msin.at[..., 0].set(0.0)
+        out = jnp.stack([cos, msin], axis=-1).reshape(data.shape[:-1] + (N,))
+        return jnp.moveaxis(out, -1, axis)
+
+    def backward(self, cdata, axis):
+        N, Ng = self.N, self.Ng
+        data = jnp.moveaxis(cdata, axis, -1)
+        K = N // 2
+        pairs = data.reshape(data.shape[:-1] + (K, 2))
+        cos = pairs[..., 0]
+        msin = pairs[..., 1].at[..., 0].set(0.0)
+        F = (cos + 1j * msin) / 2.0
+        F = F.at[..., 0].multiply(2.0)
+        # pad spectrum to the grid's rfft length
+        pad = Ng // 2 + 1 - K
+        F = jnp.concatenate([F, jnp.zeros(F.shape[:-1] + (pad,), dtype=F.dtype)], axis=-1)
+        out = jnp.fft.irfft(F * Ng, n=Ng, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+
+@register_transform("ComplexFourier", "matrix")
+class ComplexFourierMMT(MatrixTransform):
+    """
+    Complex Fourier MMT oracle (reference: core/transforms.py:212).
+    Coefficients ordered by FFT wavenumber layout [0..K, (nyquist), -K..-1];
+    the Nyquist slot is invalid and masked to zero.
+    """
+
+    @staticmethod
+    def _wavenumbers(N):
+        return np.fft.fftfreq(N, d=1.0 / N).astype(int)
+
+    @staticmethod
+    def build_forward(basis, scale):
+        Ng = basis.grid_size(scale)
+        N = basis.size
+        theta = 2 * np.pi * np.arange(Ng) / Ng
+        k = ComplexFourierMMT._wavenumbers(N)
+        F = np.exp(-1j * np.outer(k, theta)) / Ng
+        F[N // 2] = 0.0  # Nyquist mode invalid
+        return F
+
+    @staticmethod
+    def build_backward(basis, scale):
+        Ng = basis.grid_size(scale)
+        N = basis.size
+        theta = 2 * np.pi * np.arange(Ng) / Ng
+        k = ComplexFourierMMT._wavenumbers(N)
+        B = np.exp(1j * np.outer(theta, k))
+        B[:, N // 2] = 0.0
+        return B
+
+
+@register_transform("ComplexFourier", "fft")
+class ComplexFourierFFT(TransformPlan):
+    """Complex Fourier fast path via jnp.fft (reference: core/transforms.py:271)."""
+
+    def forward(self, gdata, axis):
+        N, Ng = self.N, self.Ng
+        data = jnp.moveaxis(gdata, axis, -1)
+        F = jnp.fft.fft(data, axis=-1) / Ng
+        K = N // 2
+        # keep modes [0..K-1] and [-K..-1], zero the Nyquist slot
+        out = jnp.concatenate([F[..., :K],
+                               jnp.zeros(F.shape[:-1] + (1,), F.dtype),
+                               F[..., Ng - K + 1:]], axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    def backward(self, cdata, axis):
+        N, Ng = self.N, self.Ng
+        data = jnp.moveaxis(cdata, axis, -1)
+        K = N // 2
+        pos = data[..., :K]
+        neg = data[..., K + 1:]
+        mid = jnp.zeros(data.shape[:-1] + (Ng - N + 1,), data.dtype)
+        F = jnp.concatenate([pos, mid, neg], axis=-1)
+        out = jnp.fft.ifft(F * Ng, axis=-1)
+        return jnp.moveaxis(out, -1, axis)

@@ -1,0 +1,148 @@
+"""
+Flow diagnostics and adaptive timestep control
+(reference: dedalus/extras/flow_tools.py).
+"""
+
+import logging
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class GlobalArrayReducer:
+    """Global reductions over grid data (reference: extras/flow_tools.py:15).
+    Single-controller JAX arrays are already global; reductions are direct."""
+
+    def __init__(self, comm=None, dtype=np.float64):
+        self.dtype = dtype
+
+    def reduce_scalar(self, local_scalar, mpi_reduce_op=None):
+        return local_scalar
+
+    def global_min(self, data, empty=np.inf):
+        return np.min(data) if data.size else empty
+
+    def global_max(self, data, empty=-np.inf):
+        return np.max(data) if data.size else empty
+
+    def global_mean(self, data):
+        return np.mean(data)
+
+
+class GlobalFlowProperty:
+    """Scheduled scalar diagnostics of flow expressions
+    (reference: extras/flow_tools.py:64)."""
+
+    def __init__(self, solver, cadence=1):
+        self.solver = solver
+        self.cadence = cadence
+        self.reducer = GlobalArrayReducer()
+        self.properties = solver.evaluator.add_dictionary_handler(iter=cadence)
+
+    def add_property(self, property, name):
+        self.properties.add_task(property, name=name)
+
+    def min(self, name):
+        return self.reducer.global_min(self.properties[name])
+
+    def max(self, name):
+        return self.reducer.global_max(self.properties[name])
+
+    def grid_average(self, name):
+        return self.reducer.global_mean(self.properties[name])
+
+    def volume_integral(self, name):
+        # tasks are integrals already when requested via integ(...)
+        return np.sum(self.properties[name])
+
+
+class CFL:
+    """
+    Adaptive timestep from advective CFL frequencies
+    (reference: extras/flow_tools.py:139 CFL, core/operators.py:4306
+    AdvectiveCFL). Frequencies |u_i| / dx_i are computed on the grid and
+    reduced to a stable timestep with safety/threshold/bounds logic
+    (reference: extras/flow_tools.py:191 compute_timestep).
+    """
+
+    def __init__(self, solver, initial_dt, cadence=1, safety=1.0,
+                 max_dt=np.inf, min_dt=0.0, max_change=np.inf, min_change=0.0,
+                 threshold=0.0):
+        self.solver = solver
+        self.initial_dt = initial_dt
+        self.cadence = cadence
+        self.safety = safety
+        self.max_dt = max_dt
+        self.min_dt = min_dt
+        self.max_change = max_change
+        self.min_change = min_change
+        self.threshold = threshold
+        self.velocities = []
+        self.frequencies = []
+        self.current_dt = initial_dt
+
+    def add_velocity(self, velocity):
+        """Register a velocity vector field for CFL frequencies."""
+        self.velocities.append(velocity)
+
+    def add_frequency(self, freq):
+        """Register an additional frequency expression."""
+        self.frequencies.append(freq)
+
+    def _grid_spacings(self, domain):
+        """Per-axis grid spacing arrays (broadcastable), dealias grids."""
+        dist = self.solver.dist
+        spacings = []
+        for axis, basis in enumerate(domain.bases):
+            if basis is None:
+                spacings.append(None)
+                continue
+            grid = basis.global_grid(basis.dealias)
+            if grid.size > 1:
+                dx = np.gradient(grid)
+            else:
+                dx = np.array([np.inf])
+            shape = [1] * dist.dim
+            shape[axis] = dx.size
+            spacings.append(dx.reshape(shape))
+        return spacings
+
+    def compute_max_frequency(self):
+        freq_max = 0.0
+        for u in self.velocities:
+            cs = u.tensorsig[0]
+            u.change_scales(u.domain.dealias)
+            ug = np.asarray(u["g"])
+            spacings = self._grid_spacings(u.domain)
+            total = np.zeros(ug.shape[1:])
+            for i, coord in enumerate(cs.coords):
+                axis = u.dist.get_axis(coord)
+                if spacings[axis] is not None:
+                    total = total + np.abs(ug[i]) / spacings[axis]
+            if total.size:
+                freq_max = max(freq_max, np.max(total))
+        for fexpr in self.frequencies:
+            field = fexpr.evaluate()
+            freq_max = max(freq_max, np.max(np.abs(np.asarray(field["g"]))))
+        return freq_max
+
+    def compute_timestep(self):
+        iteration = self.solver.iteration
+        if iteration % self.cadence == 0:
+            freq_max = self.compute_max_frequency()
+            if freq_max == 0.0:
+                dt = self.max_dt
+            else:
+                dt = self.safety / freq_max
+            dt = min(dt, self.max_dt)
+            dt = max(dt, self.min_dt)
+            # bounded relative change with threshold hysteresis
+            if self.current_dt:
+                change = dt / self.current_dt
+                change = min(change, self.max_change)
+                change = max(change, self.min_change)
+                if abs(change - 1.0) > self.threshold:
+                    self.current_dt = self.current_dt * change
+            else:
+                self.current_dt = dt
+        return self.current_dt

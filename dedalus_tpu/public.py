@@ -1,0 +1,37 @@
+"""
+User-facing API: `import dedalus_tpu.public as d3`
+(reference: dedalus/public.py:4-14).
+"""
+
+from .core.coords import Coordinate, CartesianCoordinates
+from .core.distributor import Distributor
+from .core.domain import Domain
+from .core.basis import (Jacobi, ChebyshevT, ChebyshevU, ChebyshevV, Legendre,
+                         Ultraspherical, RealFourier, ComplexFourier, Fourier)
+from .core.field import Field, LockedField
+from .core.problems import IVP, LBVP, NLBVP, EVP
+from .core.operators import (
+    Differentiate, Convert, Interpolate, Integrate, Average, Lift, LiftTau,
+    Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents, Skew,
+    TimeDerivative, UnaryGridFunction, GeneralFunction, GridWrapper as Grid,
+    CoeffWrapper as Coeff, dt)
+from .core.arithmetic import Add, Multiply, DotProduct, CrossProduct, Power
+from .core.timesteppers import (schemes, CNAB1, SBDF1, CNAB2, MCNAB2, SBDF2,
+                                CNLF2, SBDF3, SBDF4, RK111, RK222, RK443)
+from .core.solvers import (InitialValueSolver, LinearBoundaryValueSolver,
+                           NonlinearBoundaryValueSolver, EigenvalueSolver)
+from .core.evaluator import Evaluator
+from .extras.flow_tools import CFL, GlobalFlowProperty, GlobalArrayReducer
+
+# lowercase operator aliases (reference: core/operators.py aliases)
+grad = Gradient
+div = Divergence
+lap = Laplacian
+curl = Curl
+trace = Trace
+transpose = TransposeComponents
+skew = Skew
+integ = Integrate
+ave = Average
+lift = Lift
+interp = Interpolate

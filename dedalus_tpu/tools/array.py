@@ -1,0 +1,147 @@
+"""
+Array helpers: host-side sparse utilities and device-side axis-wise matrix
+application (reference: dedalus/tools/array.py).
+
+Host functions use numpy/scipy.sparse and run only at problem-setup time.
+Device functions are pure jnp and safe to trace under jit.
+"""
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------- host side
+
+def kron(*factors):
+    """Sparse Kronecker product of several factors (reference: tools/array.py:325)."""
+    out = factors[0]
+    for f in factors[1:]:
+        out = sp.kron(out, f, format="csr")
+    return sp.csr_matrix(out)
+
+
+def sparsify(dense, cutoff=1e-14):
+    """
+    Convert a dense matrix to CSR, dropping entries below `cutoff` relative
+    to the max magnitude. Used to recover exact band structure from
+    quadrature-built matrices.
+    """
+    dense = np.asarray(dense)
+    scale = np.max(np.abs(dense)) if dense.size else 0.0
+    if scale == 0.0:
+        return sp.csr_matrix(dense.shape)
+    clipped = np.where(np.abs(dense) >= cutoff * scale, dense, 0.0)
+    return sp.csr_matrix(clipped)
+
+
+def perm_matrix(perm, M=None, source_index=False, dtype=None):
+    """
+    Sparse permutation matrix (reference: tools/array.py:356).
+
+    With ``source_index=False`` (default), ``perm[i]`` gives the source row
+    placed at destination row i: ``(P @ x)[i] = x[perm[i]]``.
+    """
+    perm = np.asarray(perm)
+    N = perm.size
+    if M is None:
+        M = N
+    data = np.ones(N, dtype=dtype or np.float64)
+    if source_index:
+        # perm[j] = destination of source j
+        return sp.csr_matrix((data, (perm, np.arange(N))), shape=(M, N))
+    return sp.csr_matrix((data, (np.arange(N), perm)), shape=(N, M))
+
+
+def interleave_matrices(matrices):
+    """
+    Combine identically-shaped matrices into a block matrix acting on
+    interleaved vectors (reference: tools/array.py:447). Entry (i, j) of each
+    input lands at (i*K + k, j*K + k) for input k of K.
+    """
+    K = len(matrices)
+    if K == 1:
+        return sp.csr_matrix(matrices[0])
+    rows, cols = matrices[0].shape
+    out = sp.lil_matrix((rows * K, cols * K))
+    for k, mat in enumerate(matrices):
+        coo = sp.coo_matrix(mat)
+        out[coo.row * K + k, coo.col * K + k] = coo.data
+    return sp.csr_matrix(out)
+
+
+def sparse_block_diag(blocks, shape=None):
+    """Sparse block-diagonal (reference: tools/array.py:300)."""
+    return sp.csr_matrix(sp.block_diag(blocks))
+
+
+def apply_matrix(matrix, array, axis, out=None):
+    """Host-side: contract `matrix` with `array` along `axis` (numpy)."""
+    matrix = np.asarray(matrix.todense()) if sp.issparse(matrix) else np.asarray(matrix)
+    moved = np.moveaxis(np.asarray(array), axis, -1)
+    result = np.moveaxis(moved @ matrix.T, -1, axis)
+    if out is not None:
+        out[...] = result
+        return out
+    return result
+
+
+def scipy_sparse_eigs(A, B, N, target, matsolver=None, left=False, **kw):
+    """
+    Shift-invert sparse eigensolve for the generalized problem
+    A.x = λ B.x around `target` (reference: tools/array.py:398-444).
+    """
+    import scipy.sparse.linalg as spla
+    A = sp.csc_matrix(A)
+    B = sp.csc_matrix(B)
+    C = A - target * B
+    solver = spla.factorized(C)
+
+    def matvec(x):
+        return solver(B @ x)
+
+    op = spla.LinearOperator(dtype=np.complex128, shape=A.shape, matvec=matvec)
+    evals, evecs = spla.eigs(op, k=N, which="LM", sigma=None, **kw)
+    # Rayleigh-quotient style un-shift: λ = target + 1/μ
+    evals = target + 1.0 / evals
+    if left:
+        solver_H = spla.factorized(C.conj().T)
+
+        def matvec_H(x):
+            return B.conj().T @ solver_H(x)
+
+        op_H = spla.LinearOperator(dtype=np.complex128, shape=A.shape, matvec=matvec_H)
+        evals_left, evecs_left = spla.eigs(op_H, k=N, which="LM", **kw)
+        evals_left = target + 1.0 / np.conj(evals_left)
+        return evals, evecs, evals_left, evecs_left
+    return evals, evecs
+
+
+def csr_to_banded(matrix, cutoff=1e-14):
+    """
+    Detect band structure of a sparse/dense matrix. Returns (lower, upper)
+    bandwidths such that all entries outside the band are (numerically) zero.
+    """
+    coo = sp.coo_matrix(sparsify(matrix.toarray() if sp.issparse(matrix) else matrix, cutoff))
+    if coo.nnz == 0:
+        return 0, 0
+    d = coo.col - coo.row
+    return int(max(0, -d.min())), int(max(0, d.max()))
+
+
+# -------------------------------------------------------------- device side
+
+def apply_matrix_jax(matrix, array, axis):
+    """
+    Device-side: contract ``matrix`` (m_out, m_in) with ``array`` along
+    ``axis``. Pure jnp; jit/vmap safe. Complex matrices acting on real
+    arrays promote (and vice versa).
+    """
+    arr = jnp.moveaxis(array, axis, -1)
+    out = jnp.matmul(arr, matrix.T)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def expand_pattern(pattern, array):
+    """Broadcast a static numpy mask/pattern against a traced array."""
+    return jnp.broadcast_to(jnp.asarray(pattern), array.shape)

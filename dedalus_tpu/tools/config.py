@@ -1,0 +1,18 @@
+"""
+Configuration cascade (reference: dedalus/tools/config.py:10-17).
+
+Reads package defaults, then user (~/.dedalus_tpu/dedalus_tpu.cfg), then
+local (./dedalus_tpu.cfg). Exposes a ConfigParser `config`.
+"""
+
+import os
+import pathlib
+from configparser import ConfigParser
+
+config = ConfigParser()
+config.optionxform = str  # preserve key case
+
+_here = pathlib.Path(__file__).parent.parent
+config.read(str(_here / "dedalus_tpu.cfg"))
+config.read(os.path.expanduser("~/.dedalus_tpu/dedalus_tpu.cfg"))
+config.read("dedalus_tpu.cfg")

@@ -1,0 +1,41 @@
+"""
+Multiclass constructor dispatch (reference: dedalus/tools/dispatch.py:10-62).
+
+`MultiClass` lets a parent class (e.g. Gradient) dispatch construction to the
+matching subclass (CartesianGradient vs SphericalGradient) via each subclass's
+`_check_args` classmethod. A subclass's `_preprocess_args` may rewrite the
+call; raising `SkipDispatchException(output)` short-circuits with `output`.
+"""
+
+from .exceptions import SkipDispatchException
+
+
+class MultiClass(type):
+
+    def __call__(cls, *args, **kw):
+        # Direct instantiation of a leaf class.
+        if not cls.__dict__.get("_dispatching", True):
+            return super().__call__(*args, **kw)
+        try:
+            args, kw = cls._preprocess_args(*args, **kw)
+        except SkipDispatchException as skip:
+            return skip.output
+        except AttributeError:
+            pass
+        # Find matching subclass (depth-first over subclass tree).
+        for subclass in cls._walk_subclasses():
+            if subclass.__dict__.get("_abstract", False):
+                continue
+            check = subclass.__dict__.get("_check_args")
+            if check is not None and check.__func__(subclass, *args, **kw):
+                return type.__call__(subclass, *args, **kw)
+        # No subclass matched: instantiate cls itself if concrete.
+        if cls.__dict__.get("_check_args") is None and not cls.__dict__.get("_abstract", False):
+            return type.__call__(cls, *args, **kw)
+        raise NotImplementedError(
+            f"No subclass of {cls.__name__} supports the given arguments: {args} {kw}")
+
+    def _walk_subclasses(cls):
+        for sub in cls.__subclasses__():
+            yield from sub._walk_subclasses()
+            yield sub

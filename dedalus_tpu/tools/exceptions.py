@@ -1,0 +1,31 @@
+"""
+Framework exception types (reference: dedalus/tools/exceptions.py).
+"""
+
+
+class DedalusError(Exception):
+    """Base class for framework errors."""
+
+
+class NonlinearOperatorError(DedalusError):
+    """Raised when a linear path receives a nonlinear operator."""
+
+
+class UndefinedParityError(DedalusError):
+    """Raised for operations with undefined parity."""
+
+
+class SymbolicParsingError(DedalusError):
+    """Raised when an equation string cannot be parsed."""
+
+
+class UnsupportedEquationError(DedalusError):
+    """Raised when an equation is structurally unsupported."""
+
+
+class SkipDispatchException(Exception):
+    """Control-flow exception to bypass multiclass dispatch with an output."""
+
+    def __init__(self, output):
+        self.output = output
+        super().__init__()

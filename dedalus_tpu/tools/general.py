@@ -1,0 +1,68 @@
+"""
+General-purpose helpers (reference: dedalus/tools/general.py).
+"""
+
+import collections.abc
+
+
+def unify(objects):
+    """Check that all objects in a collection are equal and return one."""
+    it = iter(objects)
+    first = next(it)
+    for obj in it:
+        if obj != first:
+            raise ValueError("Objects are not all equal.")
+    return first
+
+
+def unify_attributes(objects, attr, require=True):
+    """Unify an attribute across a collection of objects."""
+    attrs = []
+    for obj in objects:
+        try:
+            attrs.append(getattr(obj, attr))
+        except AttributeError:
+            if require:
+                raise
+    return unify(attrs)
+
+
+class OrderedSet(collections.abc.MutableSet):
+    """Set preserving insertion order (dict-backed)."""
+
+    def __init__(self, iterable=()):
+        self._d = dict.fromkeys(iterable)
+
+    def __contains__(self, item):
+        return item in self._d
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self):
+        return len(self._d)
+
+    def add(self, item):
+        self._d[item] = None
+
+    def discard(self, item):
+        self._d.pop(item, None)
+
+    def update(self, iterable):
+        for item in iterable:
+            self.add(item)
+
+
+def replace(data, selectors, replacement):
+    """Return a tuple with entries matching `selectors` replaced."""
+    return tuple(replacement if d in selectors else d for d in data)
+
+
+def is_real_dtype(dtype):
+    import numpy as np
+    return np.issubdtype(np.dtype(dtype), np.floating)
+
+
+def is_complex_dtype(dtype):
+    import numpy as np
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)

@@ -1,0 +1,23 @@
+"""
+Test configuration: force the CPU backend (the axon TPU platform is forced
+via env in this environment and rejects complex128) and expose a virtual
+8-device mesh for sharding tests.
+"""
+
+import os
+
+# Must be set before the backend initializes.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,151 @@
+"""
+Cartesian operator tests vs closed-form grid expressions
+(reference: dedalus/tests/test_cartesian_operators.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+@pytest.fixture
+def setup_2d():
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=32, bounds=(0, 2), dealias=3/2)
+    zb = d3.ChebyshevT(coords["z"], size=24, bounds=(0, 1), dealias=3/2)
+    x, z = dist.local_grids(xb, zb)
+    return coords, dist, xb, zb, x, z
+
+
+def test_gradient_scalar(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = np.sin(np.pi * x) * np.cos(3 * z)
+    g = d3.grad(f).evaluate()["g"]
+    assert np.allclose(g[0], np.pi * np.cos(np.pi * x) * np.cos(3 * z))
+    assert np.allclose(g[1], -3 * np.sin(np.pi * x) * np.sin(3 * z))
+
+
+def test_divergence_vector(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    u = dist.VectorField(coords, name="u", bases=(xb, zb))
+    ug = np.zeros((2, 32, 24))
+    ug[0] = np.sin(np.pi * x) * np.cos(z)
+    ug[1] = np.cos(np.pi * x) * z**2
+    u["g"] = ug
+    div = d3.div(u).evaluate()
+    div.change_scales(1)
+    exact = np.pi * np.cos(np.pi * x) * np.cos(z) + 2 * np.cos(np.pi * x) * z
+    assert np.allclose(div["g"], exact)
+
+
+def test_laplacian(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = np.sin(np.pi * x) * np.exp(z)
+    lap = d3.lap(f).evaluate()["g"]
+    exact = (1 - np.pi**2) * np.sin(np.pi * x) * np.exp(z)
+    assert np.allclose(lap, exact, atol=1e-8)
+
+
+def test_curl_2d(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    u = dist.VectorField(coords, name="u", bases=(xb, zb))
+    ug = np.zeros((2, 32, 24))
+    ug[0] = np.sin(np.pi * x) * z
+    ug[1] = np.cos(np.pi * x) * z**2
+    u["g"] = ug
+    curl = d3.curl(u).evaluate()["g"]
+    exact = -np.pi * np.sin(np.pi * x) * z**2 - np.sin(np.pi * x)
+    assert np.allclose(curl, exact)
+
+
+def test_trace_transpose_skew(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    u = dist.VectorField(coords, name="u", bases=(xb, zb))
+    ug = np.zeros((2, 32, 24))
+    ug[0] = np.sin(np.pi * x) * z
+    ug[1] = np.cos(np.pi * x) * z**2
+    u["g"] = ug
+    T = d3.grad(u)
+    tr = d3.trace(T).evaluate()["g"]
+    exact_tr = np.pi * np.cos(np.pi * x) * z + 2 * np.cos(np.pi * x) * z
+    assert np.allclose(tr, exact_tr)
+    Tt = d3.transpose(T).evaluate()["g"]
+    Tg = T.evaluate()["g"]
+    assert np.allclose(Tt, np.swapaxes(Tg, 0, 1))
+    sk = d3.skew(u).evaluate()["g"]
+    u1 = u.copy()
+    u1.change_scales(1)
+    assert np.allclose(sk[0], -np.asarray(u1["g"])[1])
+    assert np.allclose(sk[1], np.asarray(u1["g"])[0])
+
+
+def test_integrate_average_interpolate(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = (1 + np.cos(np.pi * x)) * z**2
+    # integral over x in [0,2] of (1+cos(pi x)) = 2; integral of z^2 = 1/3
+    total = np.asarray(d3.integ(f).evaluate()["g"]).ravel()[0]
+    assert np.allclose(total, 2 / 3)
+    avg = np.asarray(d3.ave(f).evaluate()["g"]).ravel()[0]
+    assert np.allclose(avg, 1 / 3)
+    fz = d3.Interpolate(f, coords["z"], 0.5).evaluate()["g"]
+    assert np.allclose(fz.ravel(), ((1 + np.cos(np.pi * x)) * 0.25).ravel())
+    fx = d3.Interpolate(f, coords["x"], 0.5).evaluate()["g"]
+    assert np.allclose(fx.ravel(), ((1 + np.cos(np.pi * 0.5)) * z**2).ravel())
+
+
+def test_dot_cross_products(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    u = dist.VectorField(coords, name="u", bases=(xb, zb))
+    v = dist.VectorField(coords, name="v", bases=(xb, zb))
+    ug = np.zeros((2, 32, 24)); vg = np.zeros((2, 32, 24))
+    ug[0] = np.sin(np.pi * x) * np.ones_like(z); ug[1] = z * np.ones_like(x)
+    vg[0] = np.cos(np.pi * x) * np.ones_like(z); vg[1] = z**2 * np.ones_like(x)
+    u["g"] = ug; v["g"] = vg
+    dp = (u @ v).evaluate()["g"]
+    exact = np.sin(np.pi * x) * np.cos(np.pi * x) + z**3
+    assert np.allclose(dp, exact)
+
+
+def test_ufunc(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = 1 + 0.5 * np.sin(np.pi * x) * z
+    out = np.exp(f).evaluate()["g"]
+    assert np.allclose(out, np.exp(1 + 0.5 * np.sin(np.pi * x) * z))
+
+
+def test_power(setup_2d):
+    coords, dist, xb, zb, x, z = setup_2d
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = 1 + 0.3 * np.cos(np.pi * x) * z
+    out = (f**2).evaluate()["g"]
+    assert np.allclose(out, (1 + 0.3 * np.cos(np.pi * x) * z) ** 2)
+
+
+def test_fourier_differentiate_1d():
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=64, bounds=(0, 3))
+    u = dist.Field(name="u", bases=xb)
+    x = dist.local_grid(xb)
+    k = 2 * np.pi / 3
+    u["g"] = np.sin(4 * k * x) + np.cos(7 * k * x)
+    du = d3.Differentiate(u, xc).evaluate()["g"]
+    exact = 4 * k * np.cos(4 * k * x) - 7 * k * np.sin(7 * k * x)
+    assert np.allclose(du, exact.ravel())
+
+
+def test_complex_fourier_differentiate():
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.complex128)
+    xb = d3.ComplexFourier(xc, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    x = dist.local_grid(xb)
+    u["g"] = np.exp(3j * x)
+    du = d3.Differentiate(u, xc).evaluate()["g"]
+    assert np.allclose(du, 3j * np.exp(3j * x).ravel())

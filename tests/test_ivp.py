@@ -1,0 +1,137 @@
+"""
+IVP integration tests (reference: dedalus/tests/test_ivp.py — heat equation
+vs exact solution for every registered timestepper).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.core.timesteppers import schemes
+
+
+@pytest.mark.parametrize("scheme", sorted(schemes))
+def test_heat_periodic(scheme):
+    """Decaying Fourier mode vs exact exponential
+    (reference: test_ivp.py:25 test_heat_periodic)."""
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = 0")
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(3 * x)
+    solver = problem.build_solver(scheme)
+    for _ in range(100):
+        solver.step(1e-3)
+    exact = np.exp(-9 * solver.sim_time) * np.sin(3 * x)
+    assert np.max(np.abs(u["g"] - exact.ravel())) < 2e-3
+
+
+@pytest.mark.parametrize("scheme", ["SBDF2", "RK222"])
+def test_heat_variable_dt(scheme):
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = 0")
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(3 * x)
+    solver = problem.build_solver(scheme)
+    for i in range(100):
+        solver.step(1e-3 if i % 2 else 7e-4)
+    exact = np.exp(-9 * solver.sim_time) * np.sin(3 * x)
+    assert np.max(np.abs(u["g"] - exact.ravel())) < 2e-3
+
+
+def test_kdv_burgers_mass_conservation():
+    """Nonlinear RHS path: conserved integral and stability
+    (reference example: examples/ivp_1d_kdv_burgers)."""
+    Lx = 10
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=128, bounds=(0, Lx), dealias=3/2)
+    u = dist.Field(name="u", bases=xb)
+    dx = lambda A: d3.Differentiate(A, xc)
+    a, b = 1e-4, 2e-4
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - a*dx(dx(u)) - b*dx(dx(dx(u))) = - u*dx(u)")
+    x = dist.local_grid(xb)
+    n = 20
+    u["g"] = np.log(1 + np.cosh(n)**2 / np.cosh(n * (x - 0.2 * Lx))**2) / (2 * n)
+    mass0 = np.sum(u["g"])
+    solver = problem.build_solver(d3.SBDF2)
+    for _ in range(200):
+        solver.step(2e-3)
+    assert np.all(np.isfinite(u["g"]))
+    assert np.allclose(np.sum(u["g"]), mass0)
+
+
+def test_advection_diffusion_exact():
+    """IVP with explicit nonlinearity evaluated but solution known:
+    traveling decaying wave via complex Fourier."""
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.complex128)
+    xb = d3.ComplexFourier(xc, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    c, nu = 1.5, 0.1
+    dx = lambda A: d3.Differentiate(A, xc)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) + c*dx(u) - nu*lap(u) = 0")
+    x = dist.local_grid(xb)
+    u["g"] = np.exp(2j * x)
+    solver = problem.build_solver(d3.RK443)
+    for _ in range(100):
+        solver.step(1e-3)
+    t = solver.sim_time
+    exact = np.exp(2j * (x - c * t)) * np.exp(-nu * 4 * t)
+    assert np.max(np.abs(u["g"] - exact.ravel())) < 1e-6
+
+
+def test_rayleigh_benard_smoke():
+    """Full RB stack: taus, NCC, Lift, BCs, gauge
+    (reference example: examples/ivp_2d_rayleigh_benard)."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 4), dealias=3/2)
+    zb = d3.ChebyshevT(coords["z"], size=8, bounds=(0, 1), dealias=3/2)
+    p = dist.Field(name="p", bases=(xb, zb))
+    b = dist.Field(name="b", bases=(xb, zb))
+    u = dist.VectorField(coords, name="u", bases=(xb, zb))
+    tau_p = dist.Field(name="tau_p")
+    tau_b1 = dist.Field(name="tau_b1", bases=xb)
+    tau_b2 = dist.Field(name="tau_b2", bases=xb)
+    tau_u1 = dist.VectorField(coords, name="tau_u1", bases=xb)
+    tau_u2 = dist.VectorField(coords, name="tau_u2", bases=xb)
+    kappa = nu = 2e-3
+    x, z = dist.local_grids(xb, zb)
+    ex, ez = coords.unit_vector_fields(dist)
+    lift_basis = zb.derivative_basis(1)
+    lift = lambda A: d3.Lift(A, lift_basis, -1)
+    grad_u = d3.grad(u) + ez * lift(tau_u1)
+    grad_b = d3.grad(b) + ez * lift(tau_b1)
+    problem = d3.IVP([p, b, u, tau_p, tau_b1, tau_b2, tau_u1, tau_u2],
+                     namespace=locals())
+    problem.add_equation("trace(grad_u) + tau_p = 0")
+    problem.add_equation("dt(b) - kappa*div(grad_b) + lift(tau_b2) = - u@grad(b)")
+    problem.add_equation("dt(u) - nu*div(grad_u) + grad(p) - b*ez + lift(tau_u2) = - u@grad(u)")
+    problem.add_equation("b(z=0) = 1")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("b(z=1) = 0")
+    problem.add_equation("u(z=1) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(d3.RK222)
+    b.fill_random("g", seed=42, distribution="normal", scale=1e-3)
+    b["g"] *= z * (1 - z)
+    b["g"] += 1 - z
+    for _ in range(10):
+        solver.step(0.02)
+    assert np.all(np.isfinite(b["g"]))
+    assert np.all(np.isfinite(u["g"]))
+    # boundary conditions hold
+    assert np.max(np.abs(d3.Interpolate(b, coords["z"], 0.0).evaluate()["g"] - 1)) < 1e-10
+    assert np.max(np.abs(d3.Interpolate(b, coords["z"], 1.0).evaluate()["g"])) < 1e-10
+    # incompressibility holds
+    assert np.max(np.abs(d3.trace(grad_u).evaluate()["g"])) < 1e-12
