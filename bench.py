@@ -1,0 +1,57 @@
+"""
+Benchmark: 2D Rayleigh-Benard IVP timesteps/sec on one chip
+(progression config 3 from BASELINE.md: Fourier x Chebyshev, banded-matsolve
+path, reference example: examples/ivp_2d_rayleigh_benard).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline estimate: the reference example (256x64, RK222+CFL, stop_sim_time=50)
+takes ~5 cpu-minutes on a 4-core workstation (reference docstring,
+examples/ivp_2d_rayleigh_benard/rayleigh_benard.py:6). With the example's
+adaptive dt averaging ~0.03, that is ~1700 steps / 300 s ~= 5.7 steps/sec.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+
+BASELINE_STEPS_PER_SEC = 5.7
+NX, NZ = 256, 64
+WARMUP = 10
+MEASURE = 50
+
+
+def main():
+    backend = jax.default_backend()
+    # TPU v5e: no c128, f64 emulated -> bench the f32 path on TPU, f64 on CPU.
+    dtype = np.float32 if backend != "cpu" else np.float64
+
+    sys.path.insert(0, ".")
+    from __graft_entry__ import _build_rb_solver
+
+    solver, b = _build_rb_solver(NX, NZ, dtype)
+    dt = 0.01
+    for _ in range(WARMUP):
+        solver.step(dt)
+    solver.X.block_until_ready()
+    t0 = time.time()
+    for _ in range(MEASURE):
+        solver.step(dt)
+    solver.X.block_until_ready()
+    elapsed = time.time() - t0
+    steps_per_sec = MEASURE / elapsed
+
+    assert np.all(np.isfinite(np.asarray(solver.X))), "non-finite state"
+    print(json.dumps({
+        "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec_{np.dtype(dtype).name}_{backend}",
+        "value": round(steps_per_sec, 3),
+        "unit": "steps/sec",
+        "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

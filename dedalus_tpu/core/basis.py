@@ -83,10 +83,15 @@ class Basis(metaclass=CachedClass):
     def transform_plan(self, scale, library=None):
         return get_plan(self, scale, library)
 
+    def _effective_library(self, library, dtype):
+        return library or self.library
+
     def forward_transform(self, gdata, axis, scale, library=None):
+        library = self._effective_library(library, gdata.dtype)
         return self.transform_plan(scale, library).forward(gdata, axis)
 
     def backward_transform(self, cdata, axis, scale, library=None):
+        library = self._effective_library(library, cdata.dtype)
         return self.transform_plan(scale, library).backward(cdata, axis)
 
     # --- group structure (separable axes); coupled bases override ---
@@ -246,6 +251,16 @@ class FourierBase(Basis):
 
     def derivative_basis(self, order=1):
         return self
+
+    def _effective_library(self, library, dtype):
+        library = library or self.library
+        if library == "fft" and np.dtype(dtype).itemsize == 8:
+            import jax
+            if jax.default_backend() in ("tpu", "axon"):
+                # TPU has no complex128: route 64-bit data through the
+                # real-valued MMT (a batched matmul on the MXU).
+                return "matrix"
+        return library
 
 
 class RealFourier(FourierBase):

