@@ -1,0 +1,150 @@
+"""
+Unit tests for the curvilinear special-function libraries
+(reference test pattern: dedalus/tests/test_transforms.py — fast-vs-matrix
+oracles; here: quadrature-assembled operators vs analytic identities).
+"""
+
+import numpy as np
+import pytest
+import scipy.integrate
+
+from dedalus_tpu.libraries import sphere, zernike, spin_intertwiners
+
+
+# ---------------------------------------------------------------- SWSH
+
+@pytest.mark.parametrize("m,s", [(0, 0), (3, 0), (2, 1), (-2, 1), (1, -2), (5, 2)])
+def test_swsh_orthonormality(m, s):
+    Lmax = 15
+    z, w = sphere.quadrature(Lmax + 2)
+    Y = sphere.harmonics(Lmax, m, s, z)
+    G = (Y * w) @ Y.T
+    assert np.allclose(G, np.eye(len(Y)), atol=1e-12)
+
+
+@pytest.mark.parametrize("m,s", [(0, 0), (3, 0), (2, 1), (-4, 0), (1, -1)])
+def test_swsh_laplacian_eigenvalues(m, s):
+    """D+D- + D-D+ is diagonal with eigenvalues -(l(l+1) - s^2)."""
+    Lmax = 15
+    Dp = sphere.ladder_matrix(Lmax, m, s, +1)
+    Dm = sphere.ladder_matrix(Lmax, m, s, -1)
+    lap = (sphere.ladder_matrix(Lmax, m, s + 1, -1) @ Dp
+           + sphere.ladder_matrix(Lmax, m, s - 1, +1) @ Dm)
+    ells = sphere.ell_range(Lmax, m, s)
+    expect = -(ells * (ells + 1) - s ** 2).astype(float)
+    d = np.diag(lap)
+    assert np.abs(lap - np.diag(d)).max() < 1e-10
+    # the top mode can lose content to truncation when lmin shifts
+    assert np.allclose(d[:-1], expect[:-1], atol=1e-9)
+
+
+def test_swsh_ladder_structure():
+    """D+ is diagonal in l with |entries| sqrt((l-s)(l+s+1)/2)."""
+    Lmax, m, s = 15, 2, 0
+    Dp = sphere.ladder_matrix(Lmax, m, s, +1)
+    in_ells = sphere.ell_range(Lmax, m, s)
+    out_ells = sphere.ell_range(Lmax, m, s + 1)
+    for i, lo in enumerate(out_ells):
+        for j, li in enumerate(in_ells):
+            v = Dp[i, j]
+            if lo == li:
+                assert abs(abs(v) - np.sqrt((li - s) * (li + s + 1) / 2)) < 1e-10
+            else:
+                assert abs(v) < 1e-10
+
+
+def test_swsh_cos_matrix():
+    """cos(theta) multiplication reproduces grid-space multiplication."""
+    Lmax, m, s = 12, 1, 0
+    z, w = sphere.quadrature(Lmax + 2)
+    Y = sphere.harmonics(Lmax, m, s, z)
+    rng = np.random.default_rng(0)
+    c = rng.standard_normal(len(Y))
+    f = c @ Y
+    C = sphere.cos_matrix(Lmax, m, s)
+    cf = (Y * w) @ (z * f)
+    assert np.allclose((C @ c)[:-1], cf[:-1], atol=1e-11)
+
+
+def test_swsh_transform_roundtrip():
+    Lmax, m, s = 20, 3, 1
+    F = sphere.forward_matrix(Lmax, m, s)
+    B = sphere.backward_matrix(Lmax, m, s)
+    assert np.allclose(F @ B, np.eye(F.shape[0]), atol=1e-11)
+
+
+# ---------------------------------------------------------------- Zernike
+
+@pytest.mark.parametrize("dim", [2, 3])
+@pytest.mark.parametrize("k,l", [(0, 0), (0, 1), (0, 3), (1, 2), (2, 5)])
+def test_zernike_orthonormality(dim, k, l):
+    N = 12
+    z, w = zernike.quadrature(dim, N + 4, k)
+    Q = zernike.polynomials(dim, N, k, l, z)
+    G = (Q * w) @ Q.T
+    assert np.allclose(G, np.eye(N), atol=1e-11)
+
+
+@pytest.mark.parametrize("dim", [2, 3])
+def test_zernike_ladders_on_explicit_function(dim):
+    """D+- of f = r^2(1-r^2) (an l=2 function) vs analytic results."""
+    N, mu = 10, 2
+    z0, w0 = zernike.quadrature(dim, N + 6, 0)
+    r0 = np.sqrt((1 + z0) / 2)
+    c = (zernike.polynomials(dim, N, 0, 2, z0) * w0) @ (r0**2 * (1 - r0**2))
+    z1, w1 = zernike.quadrature(dim, N + 6, 1)
+    r1 = np.sqrt((1 + z1) / 2)
+    df = 2 * r1 - 4 * r1 ** 3
+    f_over_r = r1 - r1 ** 3
+    Dp = zernike.ladder_matrix(dim, N, 0, 2, 3, mu, +1)
+    cg = (zernike.polynomials(dim, N, 1, 3, z1) * w1) @ ((df - mu * f_over_r) / np.sqrt(2))
+    assert np.allclose(Dp @ c, cg, atol=1e-11)
+    Dm = zernike.ladder_matrix(dim, N, 0, 2, 1, mu, -1)
+    ch = (zernike.polynomials(dim, N, 1, 1, z1) * w1) @ ((df + mu * f_over_r) / np.sqrt(2))
+    assert np.allclose(Dm @ c, ch, atol=1e-11)
+
+
+@pytest.mark.parametrize("dim", [2, 3])
+def test_zernike_conversion_and_integration(dim):
+    N = 10
+    z0, w0 = zernike.quadrature(dim, N + 6, 0)
+    r0 = np.sqrt((1 + z0) / 2)
+    f = r0 ** 2 * (1 - r0 ** 2)
+    c = (zernike.polynomials(dim, N, 0, 2, z0) * w0) @ f
+    # conversion k: 0 -> 1
+    z1, w1 = zernike.quadrature(dim, N + 6, 1)
+    r1 = np.sqrt((1 + z1) / 2)
+    c1 = (zernike.polynomials(dim, N, 1, 2, z1) * w1) @ (r1**2 * (1 - r1**2))
+    C = zernike.conversion_matrix(dim, N, 0, 2)
+    assert np.allclose(C @ c, c1, atol=1e-11)
+    # integration against r^{dim-1} dr
+    I = zernike.integration_row(dim, N, 0, 2)
+    val = scipy.integrate.quad(lambda r: r**2 * (1 - r**2) * r**(dim - 1), 0, 1)[0]
+    assert np.allclose(I @ c, val, atol=1e-12)
+
+
+def test_zernike_odd_l_integration_exact():
+    N = 8
+    zb, wb = zernike.quadrature(3, 20, 1)
+    rb = np.sqrt((1 + zb) / 2)
+    cb = (zernike.polynomials(3, N, 1, 3, zb) * wb) @ (rb**3 * (1 - rb**2))
+    I = zernike.integration_row(3, N, 1, 3)
+    val = scipy.integrate.quad(lambda r: r**3 * (1 - r**2) * r**2, 0, 1)[0]
+    assert np.allclose(I @ cb, val, atol=1e-12)
+
+
+# ---------------------------------------------------------------- intertwiners
+
+@pytest.mark.parametrize("rank", [1, 2])
+def test_intertwiner_orthogonality(rank):
+    for ell in range(rank, 6):
+        Q = spin_intertwiners.regularity_to_spin(ell, rank)
+        assert np.allclose(Q @ Q.T, np.eye(3 ** rank), atol=1e-12)
+
+
+@pytest.mark.parametrize("rank", [1, 2])
+def test_intertwiner_low_ell_restriction(rank):
+    for ell in range(rank):
+        Q = spin_intertwiners.regularity_to_spin(ell, rank)
+        v = spin_intertwiners.valid_regularities(ell, rank)
+        assert np.allclose(Q.T @ Q, np.diag(v.astype(float)), atol=1e-12)
