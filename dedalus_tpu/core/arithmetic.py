@@ -28,6 +28,7 @@ def _is_scalar(x):
 
 
 def _max_basis(bases):
+    from .polar import DiskBasis
     out = None
     for b in bases:
         if b is None:
@@ -37,6 +38,11 @@ def _max_basis(bases):
         elif isinstance(out, Jacobi) and isinstance(b, Jacobi):
             if (out.a0, out.b0, out.size, out.bounds) != (b.a0, b.b0, b.size, b.bounds):
                 raise ValueError(f"Incompatible Jacobi bases: {out} vs {b}")
+            if b.k > out.k:
+                out = b
+        elif isinstance(out, DiskBasis) and isinstance(b, DiskBasis):
+            if (out.shape, out.radius, out.alpha) != (b.shape, b.radius, b.alpha):
+                raise ValueError(f"Incompatible disk bases: {out} vs {b}")
             if b.k > out.k:
                 out = b
         elif out != b:
@@ -71,6 +77,8 @@ def _product_domain(dist, operands):
         merged = _max_basis(axis_bases)
         if len(axis_bases) > 1 and isinstance(merged, Jacobi):
             merged = merged.base_basis()
+        elif len(axis_bases) > 1 and getattr(merged, "k", 0) and hasattr(merged, "clone_with"):
+            merged = merged.clone_with(k=0)
         bases.append(merged)
     return Domain(dist, tuple(bases))
 

@@ -86,13 +86,51 @@ class Basis(metaclass=CachedClass):
     def _effective_library(self, library, dtype):
         return library or self.library
 
-    def forward_transform(self, gdata, axis, scale, library=None):
+    def forward_transform(self, gdata, axis, scale, library=None,
+                          tensorsig=(), sub_axis=0):
         library = self._effective_library(library, gdata.dtype)
         return self.transform_plan(scale, library).forward(gdata, axis)
 
-    def backward_transform(self, cdata, axis, scale, library=None):
+    def backward_transform(self, cdata, axis, scale, library=None,
+                           tensorsig=(), sub_axis=0):
         library = self._effective_library(library, cdata.dtype)
         return self.transform_plan(scale, library).backward(cdata, axis)
+
+    # --- multi-axis accessors (1D defaults; curvilinear bases override) ---
+
+    @property
+    def first_axis(self):
+        return self.coord.axis
+
+    def coeff_size(self, sub_axis):
+        return self.size
+
+    def sub_grid_size(self, sub_axis, scale):
+        return self.grid_size(scale)
+
+    def sub_separable(self, sub_axis):
+        return self.separable
+
+    def sub_group_shape(self, sub_axis):
+        return self.group_shape
+
+    def sub_n_groups(self, sub_axis):
+        return self.n_groups
+
+    def component_valid_mask(self, tensorsig, group, sep_widths):
+        """
+        Component-resolved validity over this basis's axes at one group:
+        bool array (ncomp, *per-axis slot sizes). 1D default broadcasts the
+        axis mask over components.
+        """
+        tshape = tuple(cs.dim for cs in tensorsig)
+        ncomp = int(np.prod(tshape, dtype=int)) if tshape else 1
+        axis = self.first_axis
+        if axis in sep_widths:
+            ax_mask = self.valid_elements()[group[axis]]
+        else:
+            ax_mask = self.valid_elements()
+        return np.broadcast_to(ax_mask[None], (ncomp,) + ax_mask.shape)
 
     # --- group structure (separable axes); coupled bases override ---
     separable = False

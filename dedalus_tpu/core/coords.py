@@ -91,3 +91,147 @@ class CartesianCoordinates(CoordinateSystem):
             ei["g"] = data.reshape((self.dim,) + (1,) * dist.dim)
             fields.append(ei)
         return tuple(fields)
+
+
+class AzimuthalCoordinate(Coordinate):
+    """Periodic azimuthal coordinate of a curvilinear system
+    (reference: core/coords.py AzimuthalCoordinate)."""
+
+
+class CurvilinearCoordinateSystem(CoordinateSystem):
+    """Base for curvilinear systems: defines spin/regularity intertwiners
+    (reference: core/coords.py CurvilinearCoordinateSystem)."""
+
+    def set_distributor(self, dist):
+        self.dist = dist
+        for coord in self.coords:
+            coord.dist = dist
+
+    def spin_weights(self, indices):
+        """Total spin weight of a flat tensor-component index tuple."""
+        raise NotImplementedError
+
+
+def _nkron(U, order):
+    out = np.array([[1.0]])
+    for _ in range(order):
+        out = np.kron(out, U)
+    return out
+
+
+class PolarCoordinates(CurvilinearCoordinateSystem):
+    """
+    Polar coordinates (azimuth, radius); spin ordering (-, +)
+    (reference: core/coords.py:255 PolarCoordinates).
+    """
+
+    spin_ordering = (-1, +1)
+    dim = 2
+    right_handed = True
+
+    def __init__(self, azimuth, radius):
+        self.names = (azimuth, radius)
+        self.azimuth = AzimuthalCoordinate(azimuth, cs=self)
+        self.radius = Coordinate(radius, cs=self)
+        self.coords = (self.azimuth, self.radius)
+        self.dist = None
+
+    def __repr__(self):
+        return f"PolarCoordinates{self.names}"
+
+    @classmethod
+    def U_forward(cls, order=1):
+        """Unitary coord->spin map: u[+-] = (u[r] +- 1j u[phi]) / sqrt(2)
+        (reference: core/coords.py:282 _U_forward). Rows ordered (-, +),
+        columns (azimuth, radius)."""
+        Ui = {+1: np.array([+1j, 1]) / np.sqrt(2),
+              -1: np.array([-1j, 1]) / np.sqrt(2)}
+        U = np.array([Ui[spin] for spin in cls.spin_ordering])
+        return _nkron(U, order)
+
+    @classmethod
+    def U_backward(cls, order=1):
+        return cls.U_forward(order).T.conj()
+
+
+class S2Coordinates(CurvilinearCoordinateSystem):
+    """
+    Two-sphere coordinates (azimuth, colatitude); spin ordering (-, +)
+    (reference: core/coords.py:201 S2Coordinates).
+    """
+
+    spin_ordering = (-1, +1)
+    dim = 2
+    right_handed = True
+
+    def __init__(self, azimuth, colatitude):
+        self.names = (azimuth, colatitude)
+        self.azimuth = AzimuthalCoordinate(azimuth, cs=self)
+        self.colatitude = Coordinate(colatitude, cs=self)
+        self.coords = (self.azimuth, self.colatitude)
+        self.dist = None
+
+    def __repr__(self):
+        return f"S2Coordinates{self.names}"
+
+    @classmethod
+    def U_forward(cls, order=1):
+        """u[+-] = (u[theta] +- 1j u[phi]) / sqrt(2)
+        (reference: core/coords.py:216)."""
+        Ui = {+1: np.array([+1j, 1]) / np.sqrt(2),
+              -1: np.array([-1j, 1]) / np.sqrt(2)}
+        U = np.array([Ui[spin] for spin in cls.spin_ordering])
+        return _nkron(U, order)
+
+    @classmethod
+    def U_backward(cls, order=1):
+        return cls.U_forward(order).T.conj()
+
+
+class SphericalCoordinates(CurvilinearCoordinateSystem):
+    """
+    Spherical coordinates (azimuth, colatitude, radius); spin and regularity
+    ordering (-, +, 0) (reference: core/coords.py:315 SphericalCoordinates).
+    """
+
+    spin_ordering = (-1, +1, 0)
+    reg_ordering = (-1, +1, 0)
+    dim = 3
+    right_handed = False
+
+    def __init__(self, azimuth, colatitude, radius):
+        self.names = (azimuth, colatitude, radius)
+        self.azimuth = AzimuthalCoordinate(azimuth, cs=self)
+        self.colatitude = Coordinate(colatitude, cs=self)
+        self.radius = Coordinate(radius, cs=self)
+        self.S2coordsys = S2Coordinates(azimuth, colatitude)
+        self.coords = (self.azimuth, self.colatitude, self.radius)
+        self.dist = None
+
+    def __repr__(self):
+        return f"SphericalCoordinates{self.names}"
+
+    @classmethod
+    def U_forward(cls, order=1):
+        """u[+-] = (u[theta] +- 1j u[phi]) / sqrt(2); u[0] = u[r]
+        (reference: core/coords.py:337)."""
+        Ui = {+1: np.array([+1j, 1, 0]) / np.sqrt(2),
+              -1: np.array([-1j, 1, 0]) / np.sqrt(2),
+              0:  np.array([0, 0, 1.0])}
+        U = np.array([Ui[spin] for spin in cls.spin_ordering])
+        return _nkron(U, order)
+
+    @classmethod
+    def U_backward(cls, order=1):
+        return cls.U_forward(order).T.conj()
+
+    @classmethod
+    def Q_backward(cls, ell, order):
+        """Regularity -> spin orthogonal map at harmonic degree ell
+        (reference: core/coords.py:359 _Q_backward)."""
+        from ..libraries.spin_intertwiners import regularity_to_spin
+        return regularity_to_spin(ell, order, cls.reg_ordering)
+
+    @classmethod
+    def Q_forward(cls, ell, order):
+        return cls.Q_backward(ell, order).T

@@ -1,0 +1,158 @@
+"""
+Shared curvilinear-basis machinery: spin weights, spin recombination, and
+group-batched (per-m) matrix application
+(reference: dedalus/core/basis.py:1561 SpinRecombinationBasis,
+dedalus/libraries/spin_recombination.pyx).
+
+Coefficient-space convention: fields whose tensor signature contains a
+curvilinear coordinate system store *spin components* (regularity components
+on the ball/shell) in coefficient layout; grid layout holds coordinate
+components. The rotation between them happens inside the basis transforms,
+exactly as the reference's forward/backward_spin_recombination
+(core/basis.py:1595-1663) — but here it is one small dense matmul fused by
+XLA instead of a Cython loop.
+
+Real-dtype representation: azimuthal coefficients are interleaved
+(cos, -sin) pairs; multiplication by i acts on a pair as the rotation
+J = [[0, -1], [1, 0]]. A complex matrix C acting on (tensor-component x m)
+data therefore becomes the real matrix Re(C) (x) I2 + Im(C) (x) J acting on
+(component, pair-slot) jointly.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tools.array import match_precision
+
+PAIR_J = np.array([[0.0, -1.0], [1.0, 0.0]])
+
+
+def component_spins(tensorsig, cs):
+    """
+    Total spin weight per flattened tensor component, counting only indices
+    whose coordinate system is (or contains) `cs`
+    (reference: core/basis.py spin_weights).
+    """
+    spins = [np.zeros(1, dtype=int)]
+    for tcs in tensorsig:
+        if _cs_match(tcs, cs):
+            s = np.array(tcs.spin_ordering)
+        else:
+            s = np.zeros(tcs.dim, dtype=int)
+        spins = [np.add.outer(sp, s).ravel() for sp in spins]
+    return spins[0]
+
+
+def _cs_match(tcs, cs):
+    """Does tensor-index coordinate system `tcs` rotate with basis cs?
+    Equality (not identity): cached bases may hold an equal twin of the
+    user's coordinate-system object."""
+    if tcs == cs:
+        return True
+    sub = getattr(tcs, "S2coordsys", None)
+    if sub is not None and sub == cs:
+        return True
+    sup = getattr(cs, "S2coordsys", None)
+    return sup is not None and sup == tcs
+
+
+def recombination_matrix(tensorsig, cs):
+    """Complex unitary (ncomp, ncomp): coordinate -> spin components, kron
+    over tensor indices (identity on non-curvilinear indices)."""
+    U = np.array([[1.0]])
+    for tcs in tensorsig:
+        if _cs_match(tcs, cs):
+            U = np.kron(U, tcs.U_forward(1))
+        else:
+            U = np.kron(U, np.eye(tcs.dim))
+    return U
+
+
+def real_pair_matrix(C):
+    """Real representation of complex matrix C on (component, pair) space:
+    Re(C) (x) I2 + Im(C) (x) J."""
+    return np.kron(C.real, np.eye(2)) + np.kron(C.imag, PAIR_J)
+
+
+def apply_component_pair_matrix(data, C, tdim, az_axis, real):
+    """
+    Apply a complex component-mixing matrix C to data with flattened tensor
+    components. For real dtype, C acts jointly on (components, azimuth pair
+    slots); for complex dtype, directly on components.
+
+    data: (*tshape_flattenable..., axes...) with tensor axes [0, tdim) and
+    the azimuth axis at tdim + az_axis.
+    """
+    tshape = data.shape[:tdim]
+    ncomp = int(np.prod(tshape, dtype=int)) if tdim else 1
+    spatial = data.shape[tdim:]
+    flat = data.reshape((ncomp,) + spatial)
+    if not real:
+        C = match_precision(jnp.asarray(C), data.dtype)
+        out = jnp.tensordot(C, flat, axes=(1, 0))
+    else:
+        R = match_precision(jnp.asarray(real_pair_matrix(C)), data.dtype)
+        # bring azimuth axis next to components, expose pair slot
+        a = 1 + az_axis
+        moved = jnp.moveaxis(flat, a, 1)  # (ncomp, Naz, rest...)
+        Naz = moved.shape[1]
+        pairs = moved.reshape((ncomp, Naz // 2, 2) + moved.shape[2:])
+        pairs = jnp.moveaxis(pairs, 2, 1)  # (ncomp, 2, M, rest...)
+        merged = pairs.reshape((ncomp * 2,) + pairs.shape[2:])
+        out = jnp.tensordot(R, merged, axes=(1, 0))
+        out = out.reshape((ncomp, 2) + out.shape[1:])
+        out = jnp.moveaxis(out, 1, 2)  # (ncomp, M, 2, rest...)
+        out = out.reshape((ncomp, Naz) + out.shape[3:])
+        out = jnp.moveaxis(out, 1, a)
+    return out.reshape(tshape + spatial)
+
+
+def apply_group_stack(data, stack, axis_groups, axis_target, group_width):
+    """
+    Apply per-group matrices along a coupled axis: out[..., g, ..., j, ...] =
+    stack[g, j, i] * data[..., g, ..., i, ...], where the group index g lives
+    on `axis_groups` (packed as G * group_width entries; the width slots
+    broadcast) and the matrix is applied along `axis_target`.
+
+    This is the zero-padded batched matmul that replaces the reference's
+    per-m Python loops (core/transforms.py:1260-1288) — on TPU a single MXU
+    einsum over the m batch.
+    """
+    stack = match_precision(jnp.asarray(stack), data.dtype)
+    G = stack.shape[0]
+    d = jnp.moveaxis(data, (axis_groups, axis_target), (-2, -1))
+    lead = d.shape[:-2]
+    d = d.reshape(lead + (G, group_width, d.shape[-1]))
+    out = jnp.einsum("gji,...gpi->...gpj", stack, d)
+    out = out.reshape(lead + (G * group_width, out.shape[-1]))
+    return jnp.moveaxis(out, (-2, -1), (axis_groups, axis_target))
+
+
+def embed_aligned(mat, nmin, size_out, size_in):
+    """Embed an operator matrix into right-aligned coefficient slots: slot n
+    carries mode (n - nmin); slots n < nmin are invalid (zero)."""
+    out = np.zeros((size_out, size_in), dtype=mat.dtype)
+    rows = min(mat.shape[0], size_out - nmin)
+    cols = min(mat.shape[1], size_in - nmin)
+    out[nmin:nmin + rows, nmin:nmin + cols] = mat[:rows, :cols]
+    return out
+
+
+def group_select_terms(tensorsig, cs, descr_for_spin, tensor_map=None):
+    """
+    Build operator terms for a spin-block-structured operator: for each
+    distinct total spin s of the input components, a term
+    (component selector, descriptors from descr_for_spin(s)).
+
+    tensor_map: optional (ncomp_out, ncomp_in) structure matrix; defaults to
+    the identity (spin-diagonal operators).
+    """
+    spins = component_spins(tensorsig, cs)
+    terms = []
+    for s in np.unique(spins):
+        sel = np.diag((spins == s).astype(float))
+        if tensor_map is not None:
+            sel = tensor_map @ sel
+        descrs = descr_for_spin(int(s))
+        terms.append((sel, descrs))
+    return terms

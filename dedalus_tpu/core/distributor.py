@@ -74,13 +74,16 @@ class Distributor:
             return tuple(full)
         if not isinstance(bases, (tuple, list)):
             bases = (bases,)
+        seen = set()
         for basis in bases:
-            if basis is None:
+            if basis is None or id(basis) in seen:
                 continue
+            seen.add(id(basis))
             axis = self.get_axis(basis.coord)
-            if full[axis] is not None:
-                raise ValueError(f"Multiple bases along axis {axis}")
-            full[axis] = basis
+            for sub in range(basis.dim):
+                if full[axis + sub] is not None:
+                    raise ValueError(f"Multiple bases along axis {axis + sub}")
+                full[axis + sub] = basis
         return tuple(full)
 
     def remedy_scales(self, scales):
@@ -100,8 +103,21 @@ class Distributor:
         return grid.reshape(shape)
 
     def local_grids(self, *bases, scales=None):
+        """Broadcast-shaped grids; multi-axis bases yield one grid per
+        sub-axis (e.g. `phi, r = dist.local_grids(disk)`)."""
         scales = self.remedy_scales(scales)
-        return tuple(self.local_grid(b, scales[self.get_axis(b.coord)]) for b in bases)
+        out = []
+        for b in bases:
+            first = self.get_axis(b.coord)
+            if b.dim == 1:
+                out.append(self.local_grid(b, scales[first]))
+            else:
+                grids = b.global_grids(tuple(scales[first:first + b.dim]))
+                for sub, grid in enumerate(grids):
+                    shape = [1] * self.dim
+                    shape[first + sub] = grid.size
+                    out.append(np.reshape(grid, shape))
+        return tuple(out)
 
     # ------------------------------------------------------------- sharding
 

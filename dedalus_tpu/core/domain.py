@@ -25,8 +25,14 @@ class Domain(metaclass=CachedClass):
 
     def get_basis(self, coord):
         for basis in self.bases:
-            if basis is not None and (basis.coord is coord or getattr(coord, "coords", None)
-                                      and basis.coord in coord.coords):
+            if basis is None:
+                continue
+            if basis.coord is coord:
+                return basis
+            if getattr(coord, "coords", None) and basis.coord in coord.coords:
+                return basis
+            cs = getattr(basis, "coordsystem", None)
+            if cs is not None and (coord is cs or coord in cs.coords):
                 return basis
         return None
 
@@ -40,16 +46,25 @@ class Domain(metaclass=CachedClass):
 
     @property
     def coeff_shape(self):
-        return tuple(1 if b is None else b.size for b in self.bases)
+        return tuple(1 if b is None else b.coeff_size(axis - b.first_axis)
+                     for axis, b in enumerate(self.bases))
 
     def grid_shape(self, scales):
         scales = self.dist.remedy_scales(scales)
-        return tuple(1 if b is None else b.grid_size(s)
-                     for b, s in zip(self.bases, scales))
+        return tuple(1 if b is None else b.sub_grid_size(axis - b.first_axis, s)
+                     for axis, (b, s) in enumerate(zip(self.bases, scales)))
 
     @property
     def dealias(self):
-        return tuple(1.0 if b is None else b.dealias for b in self.bases)
+        out = []
+        for axis, b in enumerate(self.bases):
+            if b is None:
+                out.append(1.0)
+            elif isinstance(b.dealias, tuple):
+                out.append(b.dealias[axis - b.first_axis])
+            else:
+                out.append(b.dealias)
+        return tuple(out)
 
     @property
     def coeff_dtype_is_complex(self):

@@ -22,21 +22,30 @@ from ..tools.general import is_complex_dtype
 # ------------------------------------------------------------------
 # Transform pipeline: pure jnp, safe inside jit.
 
-def transform_to_coeff(data, domain, scales, tdim, library=None):
-    """Full grid -> full coefficient transform across all axes."""
-    for axis in range(domain.dim - 1, -1, -1):
-        basis = domain.bases[axis]
-        if basis is not None:
-            data = basis.forward_transform(data, tdim + axis, scales[axis], library)
-    return data
-
-
-def transform_to_grid(data, domain, scales, tdim, library=None):
-    """Full coefficient -> full grid transform across all axes."""
+def transform_to_coeff(data, domain, scales, tdim, library=None, tensorsig=()):
+    """
+    Full grid -> full coefficient transform. First axis first, so curvilinear
+    azimuths are in coefficient (m) space before their m-dependent
+    colatitude/radial transforms run (reference layout-walk direction:
+    core/distributor.py:128-166).
+    """
     for axis in range(domain.dim):
         basis = domain.bases[axis]
         if basis is not None:
-            data = basis.backward_transform(data, tdim + axis, scales[axis], library)
+            data = basis.forward_transform(data, tdim + axis, scales[axis], library,
+                                           tensorsig=tensorsig,
+                                           sub_axis=axis - basis.first_axis)
+    return data
+
+
+def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
+    """Full coefficient -> full grid transform: last axis first."""
+    for axis in range(domain.dim - 1, -1, -1):
+        basis = domain.bases[axis]
+        if basis is not None:
+            data = basis.backward_transform(data, tdim + axis, scales[axis], library,
+                                            tensorsig=tensorsig,
+                                            sub_axis=axis - basis.first_axis)
     return data
 
 
@@ -221,7 +230,8 @@ class Field(Operand):
     def require_coeff_space(self):
         self._sync()
         if self.layout == "g":
-            self.data = transform_to_coeff(self.data, self.domain, self.scales, self.tdim)
+            self.data = transform_to_coeff(self.data, self.domain, self.scales,
+                                           self.tdim, tensorsig=self.tensorsig)
             self.layout = "c"
         return self.data
 
@@ -230,7 +240,8 @@ class Field(Operand):
         if scales is not None:
             self.change_scales(scales)
         if self.layout == "c":
-            self.data = transform_to_grid(self.data, self.domain, self.scales, self.tdim)
+            self.data = transform_to_grid(self.data, self.domain, self.scales,
+                                          self.tdim, tensorsig=self.tensorsig)
             self.layout = "g"
         return self.data
 

@@ -36,7 +36,8 @@ class EvalContext:
         if layout == "c":
             out = coeff
         else:
-            out = transform_to_grid(coeff, field.domain, field.domain.dealias, field.tdim)
+            out = transform_to_grid(coeff, field.domain, field.domain.dealias,
+                                    field.tdim, tensorsig=field.tensorsig)
         self.memo[key] = out
         return out
 
@@ -97,10 +98,12 @@ class Future(Operand):
             out = self.ev_impl(ctx)
         elif layout == "g":
             out = transform_to_grid(self.ev(ctx, "c"), self.domain,
-                                    self.domain.dealias, self.tdim)
+                                    self.domain.dealias, self.tdim,
+                                    tensorsig=self.tensorsig)
         else:
             out = transform_to_coeff(self.ev(ctx, "g"), self.domain,
-                                     self.domain.dealias, self.tdim)
+                                     self.domain.dealias, self.tdim,
+                                     tensorsig=self.tensorsig)
         ctx.memo[key] = out
         return out
 

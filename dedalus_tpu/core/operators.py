@@ -53,7 +53,7 @@ def tensor_identity(tshape):
     return sp.identity(n, format="csr")
 
 
-def _axis_identity(basis, sep_width=None):
+def _axis_identity(basis, sep_width=None, sub_axis=0):
     """
     Identity factor for an untouched axis. On problem-separable axes the
     uniform pencil slot width (`sep_width` = group_shape) is used even when
@@ -64,9 +64,9 @@ def _axis_identity(basis, sep_width=None):
         return sp.identity(sep_width, format="csr")
     if basis is None:
         return sp.identity(1, format="csr")
-    if basis.separable:
-        return sp.identity(basis.group_shape, format="csr")
-    return sp.identity(basis.size, format="csr")
+    if basis.sub_separable(sub_axis):
+        return sp.identity(basis.sub_group_shape(sub_axis), format="csr")
+    return sp.identity(basis.coeff_size(sub_axis), format="csr")
 
 
 def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subproblem):
@@ -85,14 +85,20 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
             factors = [sparsify(tensor_factor)]
         for axis, descr in enumerate(axis_descrs):
             basis = operand_domain.bases[axis]
+            sub = 0 if basis is None else axis - basis.first_axis
             if descr is None:
-                factors.append(_axis_identity(basis, sep_widths.get(axis)))
+                factors.append(_axis_identity(basis, sep_widths.get(axis), sub))
             else:
-                kind, data = descr
+                kind = descr[0]
                 if kind == "full":
-                    factors.append(sparsify(data))
+                    factors.append(sparsify(descr[1]))
                 elif kind == "blocks":
-                    factors.append(sparsify(data[group[axis]]))
+                    factors.append(sparsify(descr[1][group[axis]]))
+                elif kind == "gblocks":
+                    # per-group blocks on a coupled axis, group read from a
+                    # different (separable) axis
+                    _, group_axis, stack = descr
+                    factors.append(sparsify(stack[group[group_axis]]))
                 else:
                     raise ValueError(kind)
         mat = sparse_kron(*factors)
@@ -123,16 +129,22 @@ def apply_tensor_factor(data, factor, tshape_in, tshape_out):
 
 def apply_term(data, tensor_factor, axis_descrs, tshape_in, tshape_out, tdim_out):
     """Device-side application of one operator term to coeff data."""
+    from .curvilinear import apply_group_stack
     out = data
     tdim_in = len(tshape_in)
     for axis, descr in enumerate(axis_descrs):
         if descr is None:
             continue
-        kind, mat = descr
+        kind = descr[0]
         if kind == "full":
-            out = apply_matrix_jax(jnp.asarray(mat), out, tdim_in + axis)
+            out = apply_matrix_jax(jnp.asarray(descr[1]), out, tdim_in + axis)
         elif kind == "blocks":
-            out = apply_axis_blocks(out, mat, tdim_in + axis)
+            out = apply_axis_blocks(out, descr[1], tdim_in + axis)
+        elif kind == "gblocks":
+            _, group_axis, stack = descr
+            gaxis = tdim_in + group_axis
+            width = out.shape[gaxis] // stack.shape[0]
+            out = apply_group_stack(out, stack, gaxis, tdim_in + axis, width)
     if tensor_factor is not None:
         out = apply_tensor_factor(out, tensor_factor, tshape_in, tshape_out)
     elif tshape_in != tuple(tshape_out):
@@ -272,17 +284,45 @@ class ConvertNode(LinearOperator):
     def _axis_pairs(self):
         return zip(self.operand.domain.bases, self.target_bases)
 
+    def _build_terms(self, device):
+        """
+        Cross-combine per-basis conversion terms. Multi-axis bases may emit
+        several component-structured terms (e.g. per-spin conversion stacks);
+        1D bases emit a single descriptor.
+        """
+        dim = self.operand.domain.dim
+        base_descrs = [None] * dim
+        multi_terms = None
+        handled = set()
+        for axis, (b_in, b_out) in enumerate(self._axis_pairs()):
+            if b_in is not None and b_in.dim > 1 and b_in is b_out:
+                continue
+            if b_in is not None and b_in.dim > 1:
+                if id(b_in) in handled:
+                    continue
+                handled.add(id(b_in))
+                terms = b_in.conversion_terms(b_out, self.operand.tensorsig,
+                                              self.operand.tshape)
+                if multi_terms is not None:
+                    raise NotImplementedError("Multiple curvilinear conversions.")
+                multi_terms = terms
+            else:
+                base_descrs[axis] = _conversion_descr(b_in, b_out, device=device)
+        if multi_terms is None:
+            return [(None, base_descrs)]
+        out = []
+        for factor, dmap in multi_terms:
+            descrs = list(base_descrs)
+            for axis, d in dmap.items():
+                descrs[axis] = d
+            out.append((factor, descrs))
+        return out
+
     def terms(self):
-        descrs = []
-        for b_in, b_out in self._axis_pairs():
-            descrs.append(_conversion_descr(b_in, b_out, device=False))
-        return [(None, descrs)]
+        return self._build_terms(device=False)
 
     def device_terms(self):
-        descrs = []
-        for b_in, b_out in self._axis_pairs():
-            descrs.append(_conversion_descr(b_in, b_out, device=True))
-        return [(None, descrs)]
+        return self._build_terms(device=True)
 
 
 def _conversion_descr(b_in, b_out, device):
@@ -378,8 +418,15 @@ class InterpolateCartesian(LinearOperator):
 def Interpolate(operand, coord, position):
     if np.isscalar(operand):
         return operand
-    if operand.domain.get_basis(coord) is None:
+    basis = operand.domain.get_basis(coord)
+    if basis is None:
         return operand
+    from .polar import DiskBasis, PolarInterpolate
+    if isinstance(basis, DiskBasis):
+        from .coords import AzimuthalCoordinate
+        if isinstance(coord, AzimuthalCoordinate):
+            raise NotImplementedError("Azimuthal interpolation on the disk.")
+        return PolarInterpolate(operand, position)
     return InterpolateCartesian(operand, coord, position)
 
 
@@ -435,11 +482,15 @@ class IntegrateCartesian(LinearOperator):
 def Integrate(operand, coords=None):
     if np.isscalar(operand):
         return operand
+    from .polar import DiskBasis, PolarIntegrate
+    out = operand
+    curv = _curvilinear_basis(operand)
+    if curv is not None:
+        out = PolarIntegrate(out)
     if coords is None:
-        coords = [b.coord for b in operand.domain.bases if b is not None]
+        coords = [b.coord for b in out.domain.bases if b is not None]
     elif isinstance(coords, (Coordinate, CartesianCoordinates)):
         coords = getattr(coords, "coords", (coords,))
-    out = operand
     for coord in coords:
         if out.domain.get_basis(coord) is not None:
             out = IntegrateCartesian(out, coord)
@@ -502,8 +553,18 @@ class Lift(LinearOperator):
         return [(None, descrs)]
 
 
-LiftTau = Lift  # deprecated alias (reference: core/operators.py:4271)
-parseables["lift"] = Lift
+_CartesianLift = Lift
+
+
+def LiftFactory(operand, basis, n):
+    from .polar import DiskBasis, PolarLift
+    if isinstance(basis, DiskBasis):
+        return PolarLift(operand, basis, n)
+    return _CartesianLift(operand, basis, n)
+
+
+LiftTau = LiftFactory  # deprecated alias (reference: core/operators.py:4271)
+parseables["lift"] = LiftFactory
 
 
 # ----------------------------------------------------------------------
@@ -791,11 +852,23 @@ class CartesianCurl(CartesianVectorOperator):
         self._build_metadata_common(operand, cs, tensorsig)
 
 
+def _curvilinear_basis(operand):
+    from .polar import DiskBasis
+    for b in operand.domain.bases:
+        if isinstance(b, DiskBasis):
+            return b
+    return None
+
+
 @parseable("grad", "Gradient")
 def Gradient(operand, cs=None):
     if np.isscalar(operand):
         return 0
     cs = cs or operand.dist.coordsystems[0]
+    from .coords import PolarCoordinates
+    if isinstance(cs, PolarCoordinates):
+        from .polar import PolarGradient
+        return PolarGradient(operand, cs)
     return CartesianGradient(operand, cs)
 
 
@@ -803,6 +876,10 @@ def Gradient(operand, cs=None):
 def Divergence(operand, index=0):
     if np.isscalar(operand):
         return 0
+    from .coords import PolarCoordinates
+    if isinstance(operand.tensorsig[index], PolarCoordinates):
+        from .polar import PolarDivergence
+        return PolarDivergence(operand, index)
     return CartesianDivergence(operand, index)
 
 
@@ -810,6 +887,11 @@ def Divergence(operand, index=0):
 def Laplacian(operand, cs=None):
     if np.isscalar(operand):
         return 0
+    from .coords import PolarCoordinates
+    cs2 = cs or operand.dist.coordsystems[0]
+    if isinstance(cs2, PolarCoordinates):
+        from .polar import PolarLaplacian
+        return PolarLaplacian(operand, cs2)
     return CartesianLaplacian(operand, cs)
 
 
@@ -899,9 +981,28 @@ class Skew(LinearOperator):
         return [(np.kron(R, np.identity(rest)), [None] * operand.domain.dim)]
 
 
+def SkewFactory(operand):
+    if _curvilinear_basis(operand) is not None:
+        from .polar import PolarSkew
+        return PolarSkew(operand)
+    return Skew(operand)
+
+
+def Radial(operand, index=0):
+    from .polar import PolarComponent
+    return PolarComponent(operand, "radial")
+
+
+def Azimuthal(operand, index=0):
+    from .polar import PolarComponent
+    return PolarComponent(operand, "azimuthal")
+
+
 parseables["trace"] = parseables["Trace"] = Trace
 parseables["transpose"] = parseables["TransposeComponents"] = TransposeComponents
-parseables["skew"] = parseables["Skew"] = Skew
+parseables["skew"] = parseables["Skew"] = SkewFactory
+parseables["radial"] = Radial
+parseables["azimuthal"] = Azimuthal
 
 
 # ----------------------------------------------------------------------

@@ -33,27 +33,34 @@ class PencilLayout:
     def __init__(self, dist, variables, equations):
         self.dist = dist
         dim = dist.dim
-        sep_basis = [None] * dim
-        coupled_basis = [None] * dim
+        sep_basis = [None] * dim      # (basis, sub_axis)
+        coupled_basis = [None] * dim  # (basis, sub_axis)
         domains = [v.domain for v in variables] + [eq["domain"] for eq in equations]
         for domain in domains:
             for axis, basis in enumerate(domain.bases):
                 if basis is None:
                     continue
-                if basis.separable:
+                sub = axis - basis.first_axis
+                if basis.sub_separable(sub):
                     if sep_basis[axis] is None:
-                        sep_basis[axis] = basis
-                    elif sep_basis[axis] != basis:
-                        raise ValueError(f"Mismatched separable bases on axis {axis}")
+                        sep_basis[axis] = (basis, sub)
+                    else:
+                        cur, csub = sep_basis[axis]
+                        if (cur.sub_n_groups(csub) != basis.sub_n_groups(sub)
+                                or cur.sub_group_shape(csub) != basis.sub_group_shape(sub)):
+                            raise ValueError(f"Mismatched separable bases on axis {axis}")
                 else:
                     cur = coupled_basis[axis]
-                    if cur is None or basis.k > cur.k:
-                        coupled_basis[axis] = basis
+                    if cur is None or getattr(basis, "k", 0) > getattr(cur[0], "k", 0):
+                        coupled_basis[axis] = (basis, sub)
         self.sep_axes = [ax for ax in range(dim) if sep_basis[ax] is not None]
-        self.sep_bases = {ax: sep_basis[ax] for ax in self.sep_axes}
-        self.sep_widths = {ax: sep_basis[ax].group_shape for ax in self.sep_axes}
+        self.sep_bases = {ax: sep_basis[ax][0] for ax in self.sep_axes}
+        self.sep_widths = {ax: sep_basis[ax][0].sub_group_shape(sep_basis[ax][1])
+                           for ax in self.sep_axes}
         self.coupled_axes = [ax for ax in range(dim) if coupled_basis[ax] is not None]
-        self.group_counts = [self.sep_bases[ax].n_groups for ax in self.sep_axes]
+        self.group_counts = [sep_basis[ax][0].sub_n_groups(sep_basis[ax][1])
+                             for ax in self.sep_axes]
+        self.sep_n_groups = dict(zip(self.sep_axes, self.group_counts))
         self.n_groups = int(np.prod(self.group_counts, dtype=int)) if self.sep_axes else 1
 
     def groups(self):
@@ -81,33 +88,42 @@ class PencilLayout:
             elif basis is None:
                 sizes.append(1)
             else:
-                sizes.append(basis.size)
+                sizes.append(basis.coeff_size(axis - basis.first_axis))
         return (ncomp,) + tuple(sizes)
 
     def slot_size(self, domain, tensorsig):
         return int(np.prod(self.slot_shape(domain, tensorsig), dtype=int))
 
     def valid_mask(self, domain, tensorsig, group):
-        """Validity of each slot entry for one group (bool, slot_shape)."""
+        """
+        Validity of each slot entry for one group (bool, slot_shape).
+        Component-resolved: curvilinear bases mask per tensor component
+        (spin/regularity validity, reference: core/basis.py:1780,3183).
+        """
         shape = self.slot_shape(domain, tensorsig)
         mask = np.ones(shape, dtype=bool)
-        pos = 1
+        handled = set()
         for axis, basis in enumerate(domain.bases):
-            ax_len = shape[pos]
-            ax_mask = np.ones(ax_len, dtype=bool)
-            if axis in self.sep_widths:
-                g = group[axis]
-                if basis is None:
-                    # constant along separable axis: only (group 0, element 0)
+            if basis is None:
+                ax_len = shape[1 + axis]
+                ax_mask = np.ones(ax_len, dtype=bool)
+                if axis in self.sep_widths:
                     ax_mask[:] = False
-                    if g == 0:
+                    if group[axis] == 0:
                         ax_mask[0] = True
-                else:
-                    ax_mask = basis.valid_elements()[g]
-            view = [np.newaxis] * len(shape)
-            view[pos] = slice(None)
-            mask = mask & ax_mask[tuple(view)]
-            pos += 1
+                view = [np.newaxis] * len(shape)
+                view[1 + axis] = slice(None)
+                mask = mask & ax_mask[tuple(view)]
+            elif id(basis) not in handled:
+                handled.add(id(basis))
+                bmask = basis.component_valid_mask(tensorsig, group, self.sep_widths)
+                # bmask: (ncomp, *sizes over the basis's axes); place its
+                # dims at the basis's axes and broadcast over the rest
+                first = basis.first_axis
+                full = [bmask.shape[0]] + [1] * len(domain.bases)
+                for sub in range(basis.dim):
+                    full[1 + first + sub] = bmask.shape[1 + sub]
+                mask = mask & bmask.reshape(full)
         return mask
 
     # ------------------------------------------------- device gather/scatter
@@ -129,7 +145,7 @@ class PencilLayout:
             size = data.shape[1 + axis]
             if axis in self.sep_widths:
                 gs = self.sep_widths[axis]
-                G = self.sep_bases[axis].n_groups
+                G = self.sep_n_groups[axis]
                 if basis is None:
                     pad = [(0, 0)] * data.ndim
                     pad[1 + axis] = (0, G * gs - size)
@@ -156,12 +172,12 @@ class PencilLayout:
         slot_dims = [ncomp]
         for axis, basis in enumerate(domain.bases):
             if axis in self.sep_widths:
-                group_dims.append(self.sep_bases[axis].n_groups)
+                group_dims.append(self.sep_n_groups[axis])
                 slot_dims.append(self.sep_widths[axis])
             elif basis is None:
                 slot_dims.append(1)
             else:
-                slot_dims.append(basis.size)
+                slot_dims.append(basis.coeff_size(axis - basis.first_axis))
         data = pencils.reshape(group_dims + slot_dims)
         nG = len(group_dims)
         # inverse permutation: groups back next to their pair dims

@@ -3,16 +3,20 @@ User-facing API: `import dedalus_tpu.public as d3`
 (reference: dedalus/public.py:4-14).
 """
 
-from .core.coords import Coordinate, CartesianCoordinates
+from .core.coords import (Coordinate, CartesianCoordinates, PolarCoordinates,
+                          S2Coordinates, SphericalCoordinates)
 from .core.distributor import Distributor
 from .core.domain import Domain
 from .core.basis import (Jacobi, ChebyshevT, ChebyshevU, ChebyshevV, Legendre,
                          Ultraspherical, RealFourier, ComplexFourier, Fourier)
+from .core.polar import DiskBasis
 from .core.field import Field, LockedField
 from .core.problems import IVP, LBVP, NLBVP, EVP
 from .core.operators import (
-    Differentiate, Convert, Interpolate, Integrate, Average, Lift, LiftTau,
-    Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents, Skew,
+    Differentiate, Convert, Interpolate, Integrate, Average,
+    LiftFactory as Lift, LiftTau,
+    Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents,
+    SkewFactory as Skew, Radial, Azimuthal,
     TimeDerivative, UnaryGridFunction, GeneralFunction, GridWrapper as Grid,
     CoeffWrapper as Coeff, dt)
 from .core.arithmetic import Add, Multiply, DotProduct, CrossProduct, Power
@@ -35,3 +39,5 @@ integ = Integrate
 ave = Average
 lift = Lift
 interp = Interpolate
+radial = Radial
+azimuthal = Azimuthal

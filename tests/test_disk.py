@@ -1,0 +1,178 @@
+"""
+Disk basis tests: transforms, calculus operators vs closed forms, and LBVPs
+vs manufactured solutions
+(reference patterns: dedalus/tests/test_transforms.py:358 roundtrips,
+tests/test_polar_calculus.py, tests/test_lbvp.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+def make_disk(dtype, shape=(24, 16), radius=1.5, names=("phi", "r")):
+    cs = d3.PolarCoordinates(*names)
+    dist = d3.Distributor(cs, dtype=dtype)
+    disk = d3.DiskBasis(cs, shape=shape, dtype=dtype, radius=radius)
+    return cs, dist, disk
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_disk_scalar_roundtrip(dtype):
+    cs, dist, disk = make_disk(dtype)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = x ** 2 + 2 * x * y - y ** 2 + 3
+    g0 = np.array(f["g"])
+    f["c"] = f["c"]
+    assert np.abs(f["g"] - g0).max() < 1e-12
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_disk_vector_roundtrip(dtype):
+    cs, dist, disk = make_disk(dtype)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    ux = 2 * x * y
+    uy = x ** 2 - y ** 2 + 1
+    u = dist.VectorField(cs, name="u", bases=disk)
+    u["g"] = np.array([-np.sin(phi) * ux + np.cos(phi) * uy,
+                       np.cos(phi) * ux + np.sin(phi) * uy])
+    g0 = np.array(u["g"])
+    u["c"] = u["c"]
+    assert np.abs(u["g"] - g0).max() < 1e-12
+
+
+def test_disk_tensor_roundtrip():
+    cs, dist, disk = make_disk(np.float64)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    T = dist.TensorField(cs, name="T", bases=disk)
+    Tc = np.array([[x * y + 0 * r, x ** 2 + 0 * r],
+                   [y ** 2 + 0 * r, x + y + 0 * r]])
+    R = np.array([[-np.sin(phi) + 0 * r, np.cos(phi) + 0 * r],
+                  [np.cos(phi) + 0 * r, np.sin(phi) + 0 * r]])
+    T["g"] = np.einsum("ia...,ab...,jb...->ij...", R, Tc, R)
+    g0 = np.array(T["g"])
+    T["c"] = T["c"]
+    assert np.abs(T["g"] - g0).max() < 1e-11
+
+
+def test_disk_coeff_roundtrip_random():
+    """Valid random coefficients survive a grid roundtrip."""
+    cs, dist, disk = make_disk(np.float64, shape=(16, 12))
+    f = dist.Field(name="f", bases=disk)
+    rng = np.random.default_rng(0)
+    c = rng.standard_normal(f["c"].shape)
+    for g in range(8):
+        c[2 * g:2 * g + 2, :g // 2] = 0
+    c[1, :] = 0
+    f["c"] = c
+    f["g"] = f["g"]
+    assert np.abs(f["c"] - c).max() < 1e-11
+
+
+def test_disk_calculus():
+    """grad/div/lap/skew vs closed forms on polynomials."""
+    cs, dist, disk = make_disk(np.float64, radius=2.0)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = x ** 3 * y - y ** 2 + x
+    dfx = 3 * x ** 2 * y + 1
+    dfy = x ** 3 - 2 * y
+    gphi = -np.sin(phi) * dfx + np.cos(phi) * dfy
+    gr = np.cos(phi) * dfx + np.sin(phi) * dfy
+    g = d3.grad(f).evaluate()["g"]
+    assert np.abs(g[0] - gphi).max() < 1e-9
+    assert np.abs(g[1] - gr).max() < 1e-9
+    lap_analytic = 6 * x * y - 2
+    assert np.abs(d3.lap(f).evaluate()["g"] - lap_analytic).max() < 1e-7
+    assert np.abs(d3.div(d3.grad(f)).evaluate()["g"] - lap_analytic).max() < 1e-7
+    u = d3.grad(f)
+    sk = d3.skew(u).evaluate()["g"]
+    assert np.abs(sk[0] - gr).max() < 1e-9
+    assert np.abs(sk[1] + gphi).max() < 1e-9
+
+
+def test_disk_vector_laplacian_commutes_with_gradient():
+    cs, dist, disk = make_disk(np.float64)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = x ** 4 - 3 * x * y ** 2 + y
+    lap_grad = d3.lap(d3.grad(f)).evaluate()["g"]
+    grad_lap = d3.grad(d3.lap(f)).evaluate()["g"]
+    assert np.abs(lap_grad - grad_lap).max() < 1e-6
+
+
+def test_disk_interpolation_and_integration():
+    cs, dist, disk = make_disk(np.float64, radius=2.0)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = x ** 2 * y - y + 2
+    fR = f(r=2.0).evaluate()
+    phig = phi[:, 0]
+    xg, yg = 2 * np.cos(phig), 2 * np.sin(phig)
+    assert np.abs(fR["g"][:, 0] - (xg ** 2 * yg - yg + 2)).max() < 1e-10
+    total = float(d3.integ(f).evaluate()["g"].ravel()[0])
+    # odd terms integrate to zero over the disk; constant integrates to 2*area
+    assert abs(total - 2 * np.pi * 4) < 1e-10
+
+
+def test_disk_edge_components():
+    cs, dist, disk = make_disk(np.float64, radius=2.0)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = x ** 3 * y - y ** 2 + x
+    u = d3.grad(f)
+    uR = d3.Interpolate(u, cs.radius, 2.0)
+    phig = phi[:, 0]
+    dfx = 3 * (2 * np.cos(phig)) ** 2 * (2 * np.sin(phig)) + 1
+    dfy = (2 * np.cos(phig)) ** 3 - 2 * (2 * np.sin(phig))
+    expect_r = np.cos(phig) * dfx + np.sin(phig) * dfy
+    expect_a = -np.sin(phig) * dfx + np.cos(phig) * dfy
+    assert np.abs(d3.radial(uR).evaluate()["g"][:, 0] - expect_r).max() < 1e-9
+    assert np.abs(d3.azimuthal(uR).evaluate()["g"][:, 0] - expect_a).max() < 1e-9
+
+
+def test_disk_scalar_poisson_lbvp():
+    cs, dist, disk = make_disk(np.float64, radius=1.5)
+    R = 1.5
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    u = dist.Field(name="u", bases=disk)
+    tau = dist.Field(name="tau", bases=disk.edge)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = -12 * x * y  # lap of (R^2 - r^2) x y
+    lift = lambda A: d3.Lift(A, disk.derivative_basis(2), -1)
+    problem = d3.LBVP([u, tau], namespace=locals())
+    problem.add_equation("lap(u) + lift(tau) = f")
+    problem.add_equation("u(r=1.5) = 0")
+    problem.build_solver().solve()
+    assert np.abs(u["g"] - (R ** 2 - r ** 2) * x * y).max() < 1e-12
+
+
+def test_disk_vector_poisson_lbvp():
+    cs, dist, disk = make_disk(np.float64, radius=1.0)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    u = dist.VectorField(cs, name="u", bases=disk)
+    tau_u = dist.VectorField(cs, name="tau_u", bases=disk.edge)
+    F = dist.VectorField(cs, name="F", bases=disk)
+    fx, fy = 32 * x, 32 * y  # lap(grad((1-r^2)^2))
+    F["g"] = np.array([-np.sin(phi) * fx + np.cos(phi) * fy,
+                       np.cos(phi) * fx + np.sin(phi) * fy])
+    lift = lambda A: d3.Lift(A, disk.derivative_basis(2), -1)
+    problem = d3.LBVP([u, tau_u], namespace=locals())
+    problem.add_equation("lap(u) + lift(tau_u) = F")
+    problem.add_equation("u(r=1) = 0")
+    problem.build_solver().solve()
+    ex, ey = -4 * x * (1 - r ** 2), -4 * y * (1 - r ** 2)
+    expect = np.array([-np.sin(phi) * ex + np.cos(phi) * ey,
+                       np.cos(phi) * ex + np.sin(phi) * ey])
+    assert np.abs(u["g"] - expect).max() < 1e-12
