@@ -128,31 +128,66 @@ def apply_group_stack(data, stack, axis_groups, axis_target, group_width):
     return jnp.moveaxis(out, (-2, -1), (axis_groups, axis_target))
 
 
-def embed_aligned(mat, nmin, size_out, size_in):
-    """Embed an operator matrix into right-aligned coefficient slots: slot n
-    carries mode (n - nmin); slots n < nmin are invalid (zero)."""
-    out = np.zeros((size_out, size_in), dtype=mat.dtype)
-    rows = min(mat.shape[0], size_out - nmin)
-    cols = min(mat.shape[1], size_in - nmin)
-    out[nmin:nmin + rows, nmin:nmin + cols] = mat[:rows, :cols]
-    return out
-
-
-def group_select_terms(tensorsig, cs, descr_for_spin, tensor_map=None):
+class SpinBasisMixin:
     """
-    Build operator terms for a spin-block-structured operator: for each
-    distinct total spin s of the input components, a term
-    (component selector, descriptors from descr_for_spin(s)).
+    Shared machinery for 2D spin-weighted bases (disk, annulus, sphere):
+    azimuth (separable, Fourier) x coupled axis with m- and spin-dependent
+    matrix stacks (reference: core/basis.py:1561 SpinRecombinationBasis +
+    the per-m transform loops in core/transforms.py:1252,1343).
 
-    tensor_map: optional (ncomp_out, ncomp_in) structure matrix; defaults to
-    the identity (spin-diagonal operators).
+    Concrete bases provide: `cs`, `complex`, `azimuth_basis`,
+    `sub_group_shape(0)`, `radial_forward_stack(s, scale)` and
+    `radial_backward_stack(s, scale)` (G, out, in) stacks over the m groups.
     """
-    spins = component_spins(tensorsig, cs)
-    terms = []
-    for s in np.unique(spins):
-        sel = np.diag((spins == s).astype(float))
-        if tensor_map is not None:
-            sel = tensor_map @ sel
-        descrs = descr_for_spin(int(s))
-        terms.append((sel, descrs))
-    return terms
+
+    def forward_transform(self, gdata, axis, scale, library=None,
+                          tensorsig=(), sub_axis=0):
+        if sub_axis == 0:
+            return self.azimuth_basis.forward_transform(gdata, axis, scale, library)
+        tdim = len(tensorsig)
+        az_axis = axis - 1
+        out = gdata
+        spins = component_spins(tensorsig, self.cs)
+        if np.any(spins != 0):
+            U = recombination_matrix(tensorsig, self.cs)
+            out = apply_component_pair_matrix(out, U, tdim, az_axis - tdim,
+                                              real=not self.complex)
+        return self._apply_radial_stacks(
+            out, tdim, az_axis, axis, spins,
+            lambda s: self.radial_forward_stack(s, scale))
+
+    def backward_transform(self, cdata, axis, scale, library=None,
+                           tensorsig=(), sub_axis=0):
+        if sub_axis == 0:
+            return self.azimuth_basis.backward_transform(cdata, axis, scale, library)
+        tdim = len(tensorsig)
+        az_axis = axis - 1
+        spins = component_spins(tensorsig, self.cs)
+        out = self._apply_radial_stacks(
+            cdata, tdim, az_axis, axis, spins,
+            lambda s: self.radial_backward_stack(s, scale))
+        if np.any(spins != 0):
+            U = recombination_matrix(tensorsig, self.cs)
+            out = apply_component_pair_matrix(out, U.conj().T, tdim, az_axis - tdim,
+                                              real=not self.complex)
+        return out
+
+    def _apply_radial_stacks(self, data, tdim, az_axis, r_axis, spins, stack_fn):
+        """Apply per-spin group stacks along the coupled axis (batched over m)."""
+        tshape = data.shape[:tdim]
+        ncomp = int(np.prod(tshape, dtype=int)) if tdim else 1
+        flat = data.reshape((ncomp,) + data.shape[tdim:])
+        gs = self.sub_group_shape(0)
+        pieces = [None] * ncomp
+        for s in np.unique(spins):
+            stack = stack_fn(int(s))
+            idx = np.flatnonzero(spins == s)
+            sub = flat[idx]
+            sub = apply_group_stack(sub, stack, 1 + az_axis - tdim, 1 + r_axis - tdim, gs)
+            for j, i in enumerate(idx):
+                pieces[i] = sub[j]
+        out = jnp.stack(pieces, axis=0) if ncomp > 1 else pieces[0][None]
+        new_spatial = out.shape[1:]
+        return out.reshape(tshape + new_spatial)
+
+

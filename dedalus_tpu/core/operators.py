@@ -306,6 +306,10 @@ class ConvertNode(LinearOperator):
                 if multi_terms is not None:
                     raise NotImplementedError("Multiple curvilinear conversions.")
                 multi_terms = terms
+            elif b_in is None and b_out is not None and b_out.dim > 1:
+                # constant -> multi-axis (curvilinear) basis embedding
+                sub = axis - b_out.first_axis
+                base_descrs[axis] = b_out.constant_component_descr(sub, device)
             else:
                 base_descrs[axis] = _conversion_descr(b_in, b_out, device=device)
         if multi_terms is None:
@@ -421,11 +425,12 @@ def Interpolate(operand, coord, position):
     basis = operand.domain.get_basis(coord)
     if basis is None:
         return operand
-    from .polar import DiskBasis, PolarInterpolate
-    if isinstance(basis, DiskBasis):
+    from .polar import PolarInterpolate
+    from .curvilinear import SpinBasisMixin
+    if isinstance(basis, SpinBasisMixin):
         from .coords import AzimuthalCoordinate
         if isinstance(coord, AzimuthalCoordinate):
-            raise NotImplementedError("Azimuthal interpolation on the disk.")
+            raise NotImplementedError("Azimuthal interpolation on curvilinear bases.")
         return PolarInterpolate(operand, position)
     return InterpolateCartesian(operand, coord, position)
 
@@ -478,14 +483,25 @@ class IntegrateCartesian(LinearOperator):
         return [(None, descrs)]
 
 
+def _curv_selected(curv, coords):
+    """Does an explicit coords spec include the curvilinear system's axes?"""
+    if coords is None:
+        return True
+    specs = coords if isinstance(coords, (tuple, list)) else (coords,)
+    for spec in specs:
+        if spec is curv.coordsystem or spec in getattr(curv.coordsystem, "coords", ()):
+            return True
+    return False
+
+
 @parseable("integ", "Integrate")
 def Integrate(operand, coords=None):
     if np.isscalar(operand):
         return operand
-    from .polar import DiskBasis, PolarIntegrate
+    from .polar import PolarIntegrate
     out = operand
     curv = _curvilinear_basis(operand)
-    if curv is not None:
+    if curv is not None and _curv_selected(curv, coords):
         out = PolarIntegrate(out)
     if coords is None:
         coords = [b.coord for b in out.domain.bases if b is not None]
@@ -501,12 +517,17 @@ def Integrate(operand, coords=None):
 def Average(operand, coords=None):
     if np.isscalar(operand):
         return operand
-    if coords is None:
-        coords = [b.coord for b in operand.domain.bases if b is not None]
-    elif isinstance(coords, (Coordinate, CartesianCoordinates)):
-        coords = getattr(coords, "coords", (coords,))
     volume = 1.0
     out = operand
+    curv = _curvilinear_basis(operand)
+    if curv is not None and _curv_selected(curv, coords):
+        from .polar import PolarIntegrate
+        volume *= curv.volume
+        out = PolarIntegrate(out)
+    if coords is None:
+        coords = [b.coord for b in out.domain.bases if b is not None]
+    elif isinstance(coords, (Coordinate, CartesianCoordinates)):
+        coords = getattr(coords, "coords", (coords,))
     for coord in coords:
         basis = out.domain.get_basis(coord)
         if basis is not None:
@@ -853,11 +874,16 @@ class CartesianCurl(CartesianVectorOperator):
 
 
 def _curvilinear_basis(operand):
-    from .polar import DiskBasis
+    from .curvilinear import SpinBasisMixin
     for b in operand.domain.bases:
-        if isinstance(b, DiskBasis):
+        if isinstance(b, SpinBasisMixin):
             return b
     return None
+
+
+def _spin_cs(cs):
+    from .coords import PolarCoordinates, S2Coordinates
+    return isinstance(cs, (PolarCoordinates, S2Coordinates))
 
 
 @parseable("grad", "Gradient")
@@ -865,8 +891,7 @@ def Gradient(operand, cs=None):
     if np.isscalar(operand):
         return 0
     cs = cs or operand.dist.coordsystems[0]
-    from .coords import PolarCoordinates
-    if isinstance(cs, PolarCoordinates):
+    if _spin_cs(cs):
         from .polar import PolarGradient
         return PolarGradient(operand, cs)
     return CartesianGradient(operand, cs)
@@ -876,8 +901,7 @@ def Gradient(operand, cs=None):
 def Divergence(operand, index=0):
     if np.isscalar(operand):
         return 0
-    from .coords import PolarCoordinates
-    if isinstance(operand.tensorsig[index], PolarCoordinates):
+    if _spin_cs(operand.tensorsig[index]):
         from .polar import PolarDivergence
         return PolarDivergence(operand, index)
     return CartesianDivergence(operand, index)
@@ -887,9 +911,8 @@ def Divergence(operand, index=0):
 def Laplacian(operand, cs=None):
     if np.isscalar(operand):
         return 0
-    from .coords import PolarCoordinates
     cs2 = cs or operand.dist.coordsystems[0]
-    if isinstance(cs2, PolarCoordinates):
+    if _spin_cs(cs2):
         from .polar import PolarLaplacian
         return PolarLaplacian(operand, cs2)
     return CartesianLaplacian(operand, cs)
@@ -989,11 +1012,15 @@ def SkewFactory(operand):
 
 
 def Radial(operand, index=0):
+    if index != 0:
+        raise NotImplementedError("Component extraction only supports index=0.")
     from .polar import PolarComponent
     return PolarComponent(operand, "radial")
 
 
 def Azimuthal(operand, index=0):
+    if index != 0:
+        raise NotImplementedError("Component extraction only supports index=0.")
     from .polar import PolarComponent
     return PolarComponent(operand, "azimuthal")
 

@@ -29,11 +29,49 @@ from .basis import Basis, RealFourier, ComplexFourier, AffineCOV, Jacobi
 from .coords import PolarCoordinates
 from .curvilinear import (component_spins, recombination_matrix,
                           apply_component_pair_matrix, apply_group_stack,
-                          embed_aligned)
+                          SpinBasisMixin)
 from ..tools.general import is_complex_dtype
 
 
-class S1Basis(RealFourier):
+class S1SpinTransformMixin:
+    """Spin recombination around the parent Fourier transform, shared by the
+    real and complex circle bases (reference: core/basis.py:1798 S1_basis)."""
+
+    def _relevant(self, tensorsig):
+        from .curvilinear import _cs_match
+        return any(_cs_match(tcs, self.cs) for tcs in tensorsig)
+
+    @property
+    def _pair_real(self):
+        return not is_complex_dtype_basis(self)
+
+    def forward_transform(self, gdata, axis, scale, library=None,
+                          tensorsig=(), sub_axis=0):
+        out = super().forward_transform(gdata, axis, scale, library)
+        if self._relevant(tensorsig):
+            U = recombination_matrix(tensorsig, self.cs)
+            tdim = len(tensorsig)
+            out = apply_component_pair_matrix(out, U, tdim, axis - tdim,
+                                              real=self._pair_real)
+        return out
+
+    def backward_transform(self, cdata, axis, scale, library=None,
+                           tensorsig=(), sub_axis=0):
+        out = cdata
+        if self._relevant(tensorsig):
+            U = recombination_matrix(tensorsig, self.cs)
+            tdim = len(tensorsig)
+            out = apply_component_pair_matrix(out, U.conj().T, tdim, axis - tdim,
+                                              real=self._pair_real)
+        return super().backward_transform(out, axis, scale, library)
+
+
+def is_complex_dtype_basis(basis):
+    from .basis import ComplexFourier
+    return isinstance(basis, ComplexFourier)
+
+
+class S1Basis(S1SpinTransformMixin, RealFourier):
     """
     Circle basis: the azimuth basis / disk edge. Like RealFourier, but
     tensor components over the parent curvilinear coordinate system are
@@ -44,29 +82,6 @@ class S1Basis(RealFourier):
     def __init__(self, coord, size, bounds=(0, 2 * np.pi), dealias=1.0, library=None):
         super().__init__(coord, size, bounds=bounds, dealias=dealias, library=library)
         self.cs = coord.cs
-
-    def _relevant(self, tensorsig):
-        from .curvilinear import _cs_match
-        return any(_cs_match(tcs, self.cs) for tcs in tensorsig)
-
-    def forward_transform(self, gdata, axis, scale, library=None,
-                          tensorsig=(), sub_axis=0):
-        out = super().forward_transform(gdata, axis, scale, library)
-        if self._relevant(tensorsig):
-            U = recombination_matrix(tensorsig, self.cs)
-            tdim = len(tensorsig)
-            out = apply_component_pair_matrix(out, U, tdim, axis - tdim, real=True)
-        return out
-
-    def backward_transform(self, cdata, axis, scale, library=None,
-                           tensorsig=(), sub_axis=0):
-        out = cdata
-        if self._relevant(tensorsig):
-            U = recombination_matrix(tensorsig, self.cs)
-            tdim = len(tensorsig)
-            out = apply_component_pair_matrix(out, U.conj().T, tdim, axis - tdim,
-                                              real=True)
-        return super().backward_transform(out, axis, scale, library)
 
     def component_valid_mask(self, tensorsig, group, sep_widths):
         """Spin pairs carry complex data: all slots valid for tensors;
@@ -87,37 +102,15 @@ class S1Basis(RealFourier):
         return mask
 
 
-class S1ComplexBasis(ComplexFourier):
+class S1ComplexBasis(S1SpinTransformMixin, ComplexFourier):
     """Complex-dtype circle basis with spin storage for tensors."""
 
     def __init__(self, coord, size, bounds=(0, 2 * np.pi), dealias=1.0, library=None):
         super().__init__(coord, size, bounds=bounds, dealias=dealias, library=library)
         self.cs = coord.cs
 
-    def _relevant(self, tensorsig):
-        return S1Basis._relevant(self, tensorsig)
 
-    def forward_transform(self, gdata, axis, scale, library=None,
-                          tensorsig=(), sub_axis=0):
-        out = super().forward_transform(gdata, axis, scale, library)
-        if self._relevant(tensorsig):
-            U = recombination_matrix(tensorsig, self.cs)
-            tdim = len(tensorsig)
-            out = apply_component_pair_matrix(out, U, tdim, axis - tdim, real=False)
-        return out
-
-    def backward_transform(self, cdata, axis, scale, library=None,
-                           tensorsig=(), sub_axis=0):
-        out = cdata
-        if self._relevant(tensorsig):
-            U = recombination_matrix(tensorsig, self.cs)
-            tdim = len(tensorsig)
-            out = apply_component_pair_matrix(out, U.conj().T, tdim, axis - tdim,
-                                              real=False)
-        return super().backward_transform(out, axis, scale, library)
-
-
-class DiskBasis(Basis):
+class DiskBasis(SpinBasisMixin, Basis):
     """
     Full disk basis: Fourier azimuth x Zernike radius
     (reference: core/basis.py:2305 DiskBasis).
@@ -241,59 +234,6 @@ class DiskBasis(Basis):
             return mask
         raise NotImplementedError("Disk azimuth must be a pencil axis.")
 
-    # ------------------------------------------------------------ transforms
-
-    def forward_transform(self, gdata, axis, scale, library=None,
-                          tensorsig=(), sub_axis=0):
-        if sub_axis == 0:
-            return self.azimuth_basis.forward_transform(gdata, axis, scale, library)
-        tdim = len(tensorsig)
-        az_axis = axis - 1
-        out = gdata
-        spins = component_spins(tensorsig, self.cs)
-        if np.any(spins != 0):
-            U = recombination_matrix(tensorsig, self.cs)
-            out = apply_component_pair_matrix(out, U, tdim, az_axis - tdim,
-                                              real=not self.complex)
-        return self._apply_radial_stacks(
-            out, tdim, az_axis, axis, spins,
-            lambda s: self.radial_forward_stack(s, scale))
-
-    def backward_transform(self, cdata, axis, scale, library=None,
-                           tensorsig=(), sub_axis=0):
-        if sub_axis == 0:
-            return self.azimuth_basis.backward_transform(cdata, axis, scale, library)
-        tdim = len(tensorsig)
-        az_axis = axis - 1
-        spins = component_spins(tensorsig, self.cs)
-        out = self._apply_radial_stacks(
-            cdata, tdim, az_axis, axis, spins,
-            lambda s: self.radial_backward_stack(s, scale))
-        if np.any(spins != 0):
-            U = recombination_matrix(tensorsig, self.cs)
-            out = apply_component_pair_matrix(out, U.conj().T, tdim, az_axis - tdim,
-                                              real=not self.complex)
-        return out
-
-    def _apply_radial_stacks(self, data, tdim, az_axis, r_axis, spins, stack_fn):
-        """Apply per-spin group stacks along the radial axis (batched over m)."""
-        import jax.numpy as jnp
-        tshape = data.shape[:tdim]
-        ncomp = int(np.prod(tshape, dtype=int)) if tdim else 1
-        flat = data.reshape((ncomp,) + data.shape[tdim:])
-        gs = self.sub_group_shape(0)
-        pieces = [None] * ncomp
-        for s in np.unique(spins):
-            stack = stack_fn(int(s))
-            idx = np.flatnonzero(spins == s)
-            sub = flat[idx]
-            sub = apply_group_stack(sub, stack, 1 + az_axis - tdim, 1 + r_axis - tdim, gs)
-            for j, i in enumerate(idx):
-                pieces[i] = sub[j]
-        out = jnp.stack(pieces, axis=0) if ncomp > 1 else pieces[0][None]
-        new_spatial = out.shape[1:]
-        return out.reshape(tshape + new_spatial)
-
     # ------------------------------------------------- radial matrix stacks
 
     def _build_stack(self, build, rows, cols, align_rows=True, align_cols=True):
@@ -406,6 +346,21 @@ class DiskBasis(Basis):
         col[index, 0] = 1.0
         return col
 
+    def constant_component_descr(self, sub_axis, device):
+        """Descriptor embedding a constant into this basis along one of its
+        axes (reference: core/basis.py constant-mode conversions)."""
+        if sub_axis == 0:
+            if device:
+                col = np.zeros((self.Nphi, 1))
+                col[0, 0] = 1.0
+                return ("full", col)
+            return ("blocks", self.azimuth_basis.constant_blocks())
+        # radius: 1 = c * Q_0^{(k,0)} (the lowest mode is constant in r)
+        Q0 = zernike.polynomials(2, 1, self.k, 0, np.array([0.0]))[0, 0]
+        col = np.zeros((self.Nr, 1))
+        col[0, 0] = 1.0 / Q0
+        return ("full", col)
+
     # ---------------------------------------------------- conversion terms
 
     def conversion_terms(self, target, tensorsig, tshape):
@@ -477,14 +432,15 @@ def _expand_complex_terms(terms, az_axis, G, complex_dtype):
 
 
 class PolarSpinOperator(LinearOperator):
-    """Base for spin-structured operators over a disk/annulus basis."""
+    """Base for spin-structured operators over a disk/annulus/sphere basis
+    (any SpinBasisMixin basis exposing the stack interface)."""
 
     def _basis(self, operand=None):
         operand = operand or self.operand
         for b in operand.domain.bases:
-            if isinstance(b, DiskBasis):
+            if isinstance(b, SpinBasisMixin):
                 return b
-        raise ValueError("Operand has no polar basis.")
+        raise ValueError("Operand has no spin-weighted basis.")
 
     def _axes(self, basis):
         az = basis.first_axis

@@ -1,0 +1,97 @@
+"""
+Spherical rotating shallow water: an unstable mid-latitude jet develops
+barotropic instability (reference: examples/ivp_sphere_shallow_water/
+shallow_water.py, test case from Galewsky et al. 2004).
+
+Run: python examples/shallow_water.py
+"""
+
+import numpy as np
+import dedalus_tpu.public as d3
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters (reference: shallow_water.py:28-40)
+Nphi, Ntheta = 256, 128
+dealias = 3 / 2
+R = 6.37122e6          # meters
+Omega = 7.292e-5       # 1 / s
+nu = 1e5 * 32**2       # m^2/s (hyperdiffusion at ell = 32)
+g = 9.80616            # m / s^2
+H = 1e4                # m
+timestep = 600         # s
+stop_sim_time = 360 * 3600
+dtype = np.float64
+
+# Bases
+coords = d3.S2Coordinates('phi', 'theta')
+dist = d3.Distributor(coords, dtype=dtype)
+basis = d3.SphereBasis(coords, shape=(Nphi, Ntheta), dtype=dtype, radius=R,
+                       dealias=dealias)
+
+# Fields
+u = dist.VectorField(coords, name='u', bases=basis)
+h = dist.Field(name='h', bases=basis)
+
+# Substitutions
+zcross = lambda A: d3.MulCosine(d3.Skew(A))
+phi, theta = dist.local_grids(basis)
+lat = np.pi / 2 - theta + 0 * phi
+
+# Initial conditions: zonal jet (Galewsky et al. 2004)
+umax = 80 * R / (12 * 86400)
+lat0 = np.pi / 7
+lat1 = np.pi / 2 - lat0
+en = np.exp(-4 / (lat1 - lat0) ** 2)
+jet = (lat0 <= lat) * (lat <= lat1)
+u_jet = umax / en * np.exp(1 / ((lat[jet] - lat0) * (lat[jet] - lat1)))
+ug = np.zeros_like(np.broadcast_to(lat, (Nphi, Ntheta)))
+ug = np.array([ug, 0 * ug])
+ug[0][jet] = u_jet
+u['g'] = ug
+
+# Initial conditions: balanced height
+c = dist.Field(name='c')
+problem = d3.LBVP([h, c], namespace=locals())
+problem.add_equation("g*lap(h) + c = - div(u@grad(u) + 2*Omega*zcross(u))")
+problem.add_equation("ave(h) = 0")
+solver = problem.build_solver()
+solver.solve()
+
+# Initial conditions: perturbation
+lat2 = np.pi / 4
+hpert = 120
+alpha = 1 / 3
+beta = 1 / 15
+h['g'] += hpert * np.cos(lat) * np.exp(-(phi / alpha) ** 2) \
+    * np.exp(-((lat2 - lat) / beta) ** 2)
+
+# Problem (reference: shallow_water.py:63-66)
+problem = d3.IVP([u, h], namespace=locals())
+problem.add_equation(
+    "dt(u) + nu*lap(lap(u)) + g*grad(h) + 2*Omega*zcross(u) = - u@grad(u)")
+problem.add_equation("dt(h) + nu*lap(lap(h)) + H*div(u) = - div(u*h)")
+
+# Solver
+solver = problem.build_solver(d3.RK222)
+solver.stop_sim_time = stop_sim_time
+
+# Analysis
+snapshots = solver.evaluator.add_file_handler(
+    'snapshots_shallow_water', sim_dt=3600, max_writes=10)
+snapshots.add_task(h, name='height')
+snapshots.add_task(-d3.div(d3.Skew(u)), name='vorticity')
+
+# Main loop
+try:
+    logger.info('Starting main loop')
+    while solver.proceed:
+        solver.step(timestep)
+        if (solver.iteration - 1) % 10 == 0:
+            logger.info(f'Iteration={solver.iteration}, '
+                        f'Time={solver.sim_time:.3e}, dt={timestep:.3e}')
+except Exception:
+    logger.error('Exception raised, triggering end of main loop.')
+    raise
+finally:
+    solver.log_stats()

@@ -1,0 +1,211 @@
+"""
+Sphere (S2/SWSH) basis tests: transforms, calculus vs closed forms, EVP
+eigenvalues, and a shallow-water IVP with mass conservation
+(reference patterns: dedalus/tests/test_transforms.py:358
+test_sphere_roundtrip_noise, tests/test_sphere_calculus.py,
+examples/ivp_sphere_shallow_water/shallow_water.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+def make_sphere(dtype, shape=(16, 8), radius=1.0, dealias=(1, 1)):
+    cs = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(cs, dtype=dtype)
+    basis = d3.SphereBasis(cs, shape=shape, dtype=dtype, radius=radius,
+                           dealias=dealias)
+    return cs, dist, basis
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_sphere_scalar_roundtrip(dtype):
+    cs, dist, basis = make_sphere(dtype, radius=2.0)
+    phi, theta = dist.local_grids(basis)
+    x = np.sin(theta) * np.cos(phi)
+    y = np.sin(theta) * np.sin(phi)
+    z = np.cos(theta) + 0 * phi
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = x ** 2 + 2 * x * y - y * z + 3
+    g0 = np.array(f["g"])
+    f["c"] = f["c"]
+    assert np.abs(f["g"] - g0).max() < 1e-12
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_sphere_vector_roundtrip(dtype):
+    cs, dist, basis = make_sphere(dtype)
+    phi, theta = dist.local_grids(basis)
+    u = dist.VectorField(cs, name="u", bases=basis)
+    # grad of smooth scalars -> smooth (spin-regular) vector fields
+    # r*grad(sin(theta)cos(phi) - cos(theta)): components (u_phi, u_theta)
+    u["g"] = np.array([-np.sin(phi) + 0 * theta,
+                       np.cos(theta) * np.cos(phi) + np.sin(theta)])
+    g0 = np.array(u["g"])
+    u["c"] = u["c"]
+    assert np.abs(u["g"] - g0).max() < 1e-12
+
+
+def test_sphere_tensor_roundtrip():
+    cs, dist, basis = make_sphere(np.float64)
+    phi, theta = dist.local_grids(basis)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = np.cos(theta) * np.sin(theta) * np.cos(phi)
+    T = d3.grad(d3.grad(f)).evaluate()
+    g0 = np.array(T["g"])
+    T["c"] = T["c"]
+    assert np.abs(T["g"] - g0).max() < 1e-11
+
+
+def test_sphere_coeff_roundtrip_random():
+    """Valid random coefficients survive a grid roundtrip."""
+    cs, dist, basis = make_sphere(np.float64, shape=(16, 8))
+    f = dist.Field(name="f", bases=basis)
+    rng = np.random.default_rng(0)
+    c = rng.standard_normal(f["c"].shape)
+    # zero invalid slots: l < m, and the m=0 minus-sin slot
+    for g in range(8):
+        c[2 * g:2 * g + 2, :g] = 0
+    c[1, :] = 0
+    f["c"] = c
+    f["g"] = f["g"]
+    assert np.abs(f["c"] - c).max() < 1e-11
+
+
+def test_sphere_gradient():
+    """grad(cos theta) = -(sin theta)/r e_theta."""
+    cs, dist, basis = make_sphere(np.float64, radius=2.0)
+    phi, theta = dist.local_grids(basis)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = np.cos(theta) + 0 * phi
+    g = d3.grad(f).evaluate()
+    exact = np.array([0 * phi * theta, -np.sin(theta) / 2.0 + 0 * phi])
+    assert np.abs(g["g"] - exact).max() < 1e-13
+
+
+def test_sphere_laplacian_eigenfunctions():
+    """lap(Y_lm) = -l(l+1)/r^2 Y_lm for several (l, m)."""
+    cs, dist, basis = make_sphere(np.float64, shape=(24, 12), radius=1.5)
+    phi, theta = dist.local_grids(basis)
+    # Y_3^2 ~ sin^2(theta) cos(theta) cos(2 phi)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = np.sin(theta) ** 2 * np.cos(theta) * np.cos(2 * phi)
+    l = d3.lap(f).evaluate()
+    assert np.abs(l["g"] - (-12 / 1.5 ** 2) * np.array(f["g"])).max() < 1e-12
+    # div(grad(f)) == lap(f)
+    dg = d3.div(d3.grad(f)).evaluate()
+    assert np.abs(dg["g"] - l["g"]).max() < 1e-12
+
+
+def test_sphere_vector_laplacian():
+    """Spin-weighted vector Laplacian: on the spin +-1 components of
+    grad(Y_l), lap has eigenvalue -(l(l+1) - 1)/r^2."""
+    cs, dist, basis = make_sphere(np.float64, shape=(16, 8))
+    phi, theta = dist.local_grids(basis)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = np.cos(theta)
+    u = d3.grad(f)
+    lu = d3.lap(u).evaluate()
+    gu = np.array(u.evaluate()["g"])
+    assert np.abs(lu["g"] - (-1.0) * gu).max() < 1e-12
+
+
+def test_sphere_skew_and_mulcos():
+    """skew(u) = (u_theta, -u_phi) in (phi, theta) components;
+    MulCosine multiplies by cos(theta)."""
+    cs, dist, basis = make_sphere(np.float64)
+    phi, theta = dist.local_grids(basis)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = np.sin(theta) * np.cos(theta) * np.sin(phi)
+    u = d3.grad(f).evaluate()
+    ug = np.array(u["g"])
+    s = d3.Skew(u).evaluate()
+    exact = np.array([ug[1], -ug[0]])
+    assert np.abs(s["g"] - exact).max() < 1e-12
+    m = d3.MulCosine(u).evaluate()
+    assert np.abs(m["g"] - np.cos(theta) * ug).max() < 1e-12
+
+
+def test_sphere_interpolation_integration():
+    cs, dist, basis = make_sphere(np.float64, shape=(16, 8), radius=3.0)
+    phi, theta = dist.local_grids(basis)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = np.cos(theta) ** 2 + np.sin(theta) * np.cos(phi)
+    # interpolate onto colatitude ring
+    th0 = 1.1
+    ring = f(theta=th0).evaluate()
+    phis = basis.azimuth_grid(1.0)
+    exact = np.cos(th0) ** 2 + np.sin(th0) * np.cos(phis)
+    assert np.abs(np.asarray(ring["g"]).ravel() - exact).max() < 1e-12
+    # integral: cos^2 integrates to 4 pi r^2 / 3; the cos(phi) term drops
+    I = d3.integ(f).evaluate()
+    exact_I = 4 * np.pi * 9.0 / 3
+    assert abs(float(np.asarray(I["g"]).ravel()[0]) - exact_I) < 1e-10
+    A = d3.ave(f).evaluate()
+    assert abs(float(np.asarray(A["g"]).ravel()[0]) - 1 / 3) < 1e-12
+
+
+def test_integrate_coords_exclusion():
+    """Integrate/Average with explicit coords must not reduce over an
+    unselected curvilinear system (mixed disk x Jacobi domain)."""
+    pcs = d3.PolarCoordinates("phi", "r")
+    zc = d3.Coordinate("z")
+    dist = d3.Distributor((pcs, zc), dtype=np.float64)
+    disk = d3.DiskBasis(pcs, shape=(8, 6), dtype=np.float64, radius=1.0)
+    zbasis = d3.ChebyshevT(zc, size=8, bounds=(0, 2))
+    f = dist.Field(name="f", bases=(disk, zbasis))
+    f["g"] = 1.0
+    Iz = d3.Integrate(f, zc).evaluate()
+    # still defined on the disk, value = 2 everywhere
+    assert Iz.domain.get_basis(pcs.coords[0]) is not None
+    assert np.abs(np.asarray(Iz["g"]) - 2.0).max() < 1e-12
+    Az = d3.Average(f, zc).evaluate()
+    assert np.abs(np.asarray(Az["g"]) - 1.0).max() < 1e-12
+    Ifull = d3.Integrate(f).evaluate()
+    assert abs(float(np.asarray(Ifull["g"]).ravel()[0]) - 2 * np.pi) < 1e-12
+
+
+def test_sphere_laplacian_evp():
+    """EVP: lap(f) + lam/r^2 f = 0 -> lam = l(l+1) at each m group."""
+    cs, dist, basis = make_sphere(np.float64, shape=(8, 6), radius=2.0)
+    f = dist.Field(name="f", bases=basis)
+    lam = dist.Field(name="lam")
+    problem = d3.EVP([f], eigenvalue=lam, namespace=locals())
+    problem.add_equation("lap(f) + lam*f/4.0 = 0")
+    solver = problem.build_solver()
+    sp = solver.subproblems[1]  # m = 1
+    evals = np.sort(np.asarray(solver.solve_dense(sp)).real)
+    ells = np.arange(1, 6)
+    expected = np.sort(np.concatenate([ells * (ells + 1)] * 2))  # cos+sin pairs
+    assert np.abs(evals[:len(expected)] - expected).max() < 1e-8
+
+
+def test_sphere_shallow_water_ivp():
+    """Rotating shallow water: finite fields + mass conservation
+    (reference: examples/ivp_sphere_shallow_water/shallow_water.py)."""
+    Nphi, Ntheta = 32, 16
+    R, Omega, nu, g, H = 2.0, 0.5, 1e-4, 1.0, 1.0
+    cs = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    basis = d3.SphereBasis(cs, shape=(Nphi, Ntheta), dtype=np.float64,
+                           radius=R, dealias=(3 / 2, 3 / 2))
+    u = dist.VectorField(cs, name="u", bases=basis)
+    h = dist.Field(name="h", bases=basis)
+    zcross = lambda A: d3.MulCosine(d3.Skew(A))
+    problem = d3.IVP([u, h], namespace=locals())
+    problem.add_equation(
+        "dt(u) + nu*lap(lap(u)) + g*grad(h) + 2*Omega*zcross(u) = - u@grad(u)")
+    problem.add_equation(
+        "dt(h) + nu*lap(lap(h)) + H*div(u) = - div(u*h)")
+    solver = problem.build_solver(d3.RK222)
+    h.fill_random("g", seed=7, scale=1e-2)
+    u.fill_random("g", seed=8, scale=1e-3)
+    mass0 = float(np.asarray(d3.integ(h).evaluate()["g"]).ravel()[0])
+    for _ in range(10):
+        solver.step(0.05)
+    assert np.isfinite(np.asarray(h["g"])).all()
+    assert np.isfinite(np.asarray(u["g"])).all()
+    mass1 = float(np.asarray(d3.integ(h).evaluate()["g"]).ravel()[0])
+    assert abs(mass1 - mass0) < 1e-10
