@@ -28,7 +28,6 @@ def _is_scalar(x):
 
 
 def _max_basis(bases):
-    from .polar import DiskBasis
     out = None
     for b in bases:
         if b is None:
@@ -40,10 +39,10 @@ def _max_basis(bases):
                 raise ValueError(f"Incompatible Jacobi bases: {out} vs {b}")
             if b.k > out.k:
                 out = b
-        elif isinstance(out, DiskBasis) and isinstance(b, DiskBasis):
-            if (out.shape, out.radius, out.alpha) != (b.shape, b.radius, b.alpha):
-                raise ValueError(f"Incompatible disk bases: {out} vs {b}")
-            if b.k > out.k:
+        elif type(out) is type(b) and hasattr(out, "family_key"):
+            if out.family_key != b.family_key:
+                raise ValueError(f"Incompatible bases: {out} vs {b}")
+            if getattr(b, "k", 0) > getattr(out, "k", 0):
                 out = b
         elif out != b:
             raise ValueError(f"Incompatible bases along axis: {out} vs {b}")
@@ -241,14 +240,14 @@ class ProductBase(Future):
         descrs = []
         coeffs = np.asarray(ncc["c"])  # host transform of NCC data
         ccomp = coeffs[comp_index]
-        for axis in range(dist.dim):
+        axis = 0
+        while axis < dist.dim:
             nb = ncc.domain.bases[axis]
             ob = operand.domain.bases[axis]
             if nb is None:
                 descrs.append(None)  # constant along axis: scalar handled below
-            else:
-                assert isinstance(nb, Jacobi), \
-                    "LHS NCCs may only vary along coupled (Jacobi) axes."
+                axis += 1
+            elif isinstance(nb, Jacobi):
                 # collapse other axes of the coefficient array
                 ax_coeffs = np.moveaxis(ccomp, axis, -1)
                 assert ax_coeffs.size == ax_coeffs.shape[-1], \
@@ -259,6 +258,35 @@ class ProductBase(Future):
                 else:
                     M = ob.multiplication_matrix(ax_coeffs.ravel(), nb, dk_out=-ob.k)
                     descrs.append(("full", sparsify(M, 1e-12)))
+                axis += 1
+            elif nb.dim == 2 and hasattr(nb, "radial_multiplication_matrix"):
+                # Azimuthally-constant NCC over a polar-type basis: identity
+                # on the azimuth (m=0 only), a radial multiplication matrix on
+                # the coupled axis (reference: coupled-only NCC requirement,
+                # core/arithmetic.py:359 prep_nccs).
+                if ncc.tensorsig:
+                    raise NonlinearOperatorError(
+                        "Tensor-valued NCCs on curvilinear bases are not "
+                        "supported yet; only scalar NCCs.")
+                az_coeffs = np.moveaxis(ccomp, axis, 0)
+                tol = 1e-10 * max(np.abs(az_coeffs).max(), 1e-300)
+                if np.abs(az_coeffs[1:]).max() > tol:
+                    raise NonlinearOperatorError(
+                        "LHS coefficient fields on polar bases must be "
+                        "azimuthally constant (m=0 cosine only).")
+                radial_coeffs = np.moveaxis(ccomp, axis + 1, -1)[
+                    (0,) * (ccomp.ndim - 1)]
+                if ob is None:
+                    raise NonlinearOperatorError(
+                        "Embedding a polar NCC into a constant operand is "
+                        "not supported yet.")
+                M = ob.radial_multiplication_matrix(radial_coeffs, nb.k, k_out=0)
+                descrs.append(None)  # azimuth: identity per group
+                descrs.append(("full", sparsify(M, 1e-12)))
+                axis += 2
+            else:
+                raise NonlinearOperatorError(
+                    f"LHS NCCs may not vary along basis {nb!r}.")
         # fully-constant NCC: scalar multiplier
         if all(d is None for d in descrs):
             scalar = complex(ccomp.ravel()[0]) if np.iscomplexobj(ccomp) else float(ccomp.ravel()[0])
@@ -271,7 +299,9 @@ class ProductBase(Future):
         `tensor_factor_fn(comp_index, value_is_scalar)` returns the sparse
         tensor factor for that component.
         """
+        from .operators import _axis_identity
         operand_domain = operand.domain
+        sep_widths = subproblem.layout.sep_widths
         total = None
         comp_indices = list(np.ndindex(*ncc.tshape)) if ncc.tshape else [()]
         for comp in comp_indices:
@@ -280,12 +310,8 @@ class ProductBase(Future):
             for axis, descr in enumerate(descrs):
                 ob = operand_domain.bases[axis]
                 if descr is None:
-                    if ob is None:
-                        factors.append(sp.identity(1, format="csr"))
-                    elif ob.separable:
-                        factors.append(sp.identity(ob.group_shape, format="csr"))
-                    else:
-                        factors.append(sp.identity(ob.size, format="csr"))
+                    sub = 0 if ob is None else axis - ob.first_axis
+                    factors.append(_axis_identity(ob, sep_widths.get(axis), sub))
                 else:
                     factors.append(descr[1])
             mat = sparse_kron(*factors)

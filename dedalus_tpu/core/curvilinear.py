@@ -152,9 +152,8 @@ class SpinBasisMixin:
             U = recombination_matrix(tensorsig, self.cs)
             out = apply_component_pair_matrix(out, U, tdim, az_axis - tdim,
                                               real=not self.complex)
-        return self._apply_radial_stacks(
-            out, tdim, az_axis, axis, spins,
-            lambda s: self.radial_forward_stack(s, scale))
+        return self._radial_apply(out, tdim, az_axis, axis, spins, scale,
+                                  forward=True)
 
     def backward_transform(self, cdata, axis, scale, library=None,
                            tensorsig=(), sub_axis=0):
@@ -163,14 +162,24 @@ class SpinBasisMixin:
         tdim = len(tensorsig)
         az_axis = axis - 1
         spins = component_spins(tensorsig, self.cs)
-        out = self._apply_radial_stacks(
-            cdata, tdim, az_axis, axis, spins,
-            lambda s: self.radial_backward_stack(s, scale))
+        out = self._radial_apply(cdata, tdim, az_axis, axis, spins, scale,
+                                 forward=False)
         if np.any(spins != 0):
             U = recombination_matrix(tensorsig, self.cs)
             out = apply_component_pair_matrix(out, U.conj().T, tdim, az_axis - tdim,
                                               real=not self.complex)
         return out
+
+    def _radial_apply(self, data, tdim, az_axis, r_axis, spins, scale, forward):
+        """Coupled-axis transform hook: default applies per-spin, per-m
+        stacks; bases with m/spin-independent transforms override this with a
+        single matmul."""
+        if forward:
+            stack_fn = lambda s: self.radial_forward_stack(s, scale)
+        else:
+            stack_fn = lambda s: self.radial_backward_stack(s, scale)
+        return self._apply_radial_stacks(data, tdim, az_axis, r_axis, spins,
+                                         stack_fn)
 
     def _apply_radial_stacks(self, data, tdim, az_axis, r_axis, spins, stack_fn):
         """Apply per-spin group stacks along the coupled axis (batched over m)."""

@@ -488,10 +488,19 @@ def _curv_selected(curv, coords):
     if coords is None:
         return True
     specs = coords if isinstance(coords, (tuple, list)) else (coords,)
-    for spec in specs:
-        if spec is curv.coordsystem or spec in getattr(curv.coordsystem, "coords", ()):
-            return True
-    return False
+    cs_coords = getattr(curv.coordsystem, "coords", ())
+    selected = [spec for spec in specs
+                if spec is curv.coordsystem or spec in cs_coords]
+    if not selected:
+        return False
+    # Partial reductions over a coupled 2D basis (e.g. azimuth-only on a
+    # sphere) are not supported; reject rather than silently reduce both axes.
+    full = any(spec is curv.coordsystem for spec in selected)
+    if not full and len([s for s in selected if s in cs_coords]) < len(cs_coords):
+        raise NotImplementedError(
+            f"Partial integration over a single coordinate of {curv!r} is "
+            "not supported; integrate over the full coordinate system.")
+    return True
 
 
 @parseable("integ", "Integrate")
@@ -578,8 +587,8 @@ _CartesianLift = Lift
 
 
 def LiftFactory(operand, basis, n):
-    from .polar import DiskBasis, PolarLift
-    if isinstance(basis, DiskBasis):
+    from .polar import DiskBasis, AnnulusBasis, PolarLift
+    if isinstance(basis, (DiskBasis, AnnulusBasis)):
         return PolarLift(operand, basis, n)
     return _CartesianLift(operand, basis, n)
 

@@ -132,11 +132,6 @@ class SphereBasis(SpinBasisMixin, Basis):
         z, _ = swsh.quadrature(Ng - 1)
         return np.arccos(z)
 
-    def global_grid_spacing(self, sub_axis, scale=1.0):
-        grids = self.global_grids((scale, scale))
-        g = grids[sub_axis]
-        return np.gradient(g)
-
     # ---------------------------------------------------------- validity
 
     def component_valid_mask(self, tensorsig, group, sep_widths):
@@ -237,12 +232,6 @@ class SphereBasis(SpinBasisMixin, Basis):
             self.Ntheta, self.Ntheta,
             row_off=lambda m: self._lmin(m, s),
             col_off=lambda m: self._lmin(m, s))
-
-    @CachedMethod
-    def conversion_stack(self, s, dk):
-        """Identity: SWSH spaces need no k-conversion."""
-        ms = self.group_m()
-        return np.tile(np.eye(self.Ntheta), (len(ms), 1, 1))
 
     @CachedMethod
     def interpolation_stack(self, s, position):

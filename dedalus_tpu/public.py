@@ -9,7 +9,7 @@ from .core.distributor import Distributor
 from .core.domain import Domain
 from .core.basis import (Jacobi, ChebyshevT, ChebyshevU, ChebyshevV, Legendre,
                          Ultraspherical, RealFourier, ComplexFourier, Fourier)
-from .core.polar import DiskBasis
+from .core.polar import DiskBasis, AnnulusBasis
 from .core.sphere import SphereBasis, MulCosine
 from .core.field import Field, LockedField
 from .core.problems import IVP, LBVP, NLBVP, EVP
