@@ -259,31 +259,33 @@ class ProductBase(Future):
                     M = ob.multiplication_matrix(ax_coeffs.ravel(), nb, dk_out=-ob.k)
                     descrs.append(("full", sparsify(M, 1e-12)))
                 axis += 1
-            elif nb.dim == 2 and hasattr(nb, "radial_multiplication_matrix"):
-                # Azimuthally-constant NCC over a polar-type basis: identity
-                # on the azimuth (m=0 only), a radial multiplication matrix on
-                # the coupled axis (reference: coupled-only NCC requirement,
-                # core/arithmetic.py:359 prep_nccs).
+            elif nb.dim in (2, 3) and hasattr(nb, "radial_multiplication_matrix"):
+                # Angularly-constant NCC over a polar/spherical basis:
+                # identity on the angular axes (m=0 [, ell=0] only), a radial
+                # multiplication matrix on the coupled axis (reference:
+                # coupled-only NCC requirement, core/arithmetic.py:359).
                 if ncc.tensorsig:
                     raise NonlinearOperatorError(
                         "Tensor-valued NCCs on curvilinear bases are not "
                         "supported yet; only scalar NCCs.")
-                az_coeffs = np.moveaxis(ccomp, axis, 0)
-                tol = 1e-10 * max(np.abs(az_coeffs).max(), 1e-300)
-                if np.abs(az_coeffs[1:]).max() > tol:
+                r_axis = axis + nb.dim - 1
+                moved = np.moveaxis(ccomp, r_axis, -1)
+                tol = 1e-10 * max(np.abs(ccomp).max(), 1e-300)
+                non_const = moved.reshape(-1, moved.shape[-1])[1:]
+                if non_const.size and np.abs(non_const).max() > tol:
                     raise NonlinearOperatorError(
-                        "LHS coefficient fields on polar bases must be "
-                        "azimuthally constant (m=0 cosine only).")
-                radial_coeffs = np.moveaxis(ccomp, axis + 1, -1)[
-                    (0,) * (ccomp.ndim - 1)]
+                        "LHS coefficient fields on curvilinear bases must be "
+                        "angularly constant (lowest angular mode only).")
+                radial_coeffs = moved.reshape(-1, moved.shape[-1])[0] \
+                    * getattr(nb, "constant_angular_mode_value", 1.0)
                 if ob is None:
                     raise NonlinearOperatorError(
-                        "Embedding a polar NCC into a constant operand is "
-                        "not supported yet.")
+                        "Embedding a curvilinear NCC into a constant operand "
+                        "is not supported yet.")
                 M = ob.radial_multiplication_matrix(radial_coeffs, nb.k, k_out=0)
-                descrs.append(None)  # azimuth: identity per group
+                descrs.extend([None] * (nb.dim - 1))  # angular identities
                 descrs.append(("full", sparsify(M, 1e-12)))
-                axis += 2
+                axis += nb.dim
             else:
                 raise NonlinearOperatorError(
                     f"LHS NCCs may not vary along basis {nb!r}.")
@@ -292,6 +294,74 @@ class ProductBase(Future):
             scalar = complex(ccomp.ravel()[0]) if np.iscomplexobj(ccomp) else float(ccomp.ravel()[0])
             return scalar, descrs
         return None, descrs
+
+    def _spherical_regularity_basis(self, operand):
+        for b in operand.domain.bases:
+            if b is not None and getattr(b, "regularity", False):
+                return b
+        return None
+
+    def _spherical_tensor_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
+        """
+        Pencil matrix for multiplication by a radially-directed,
+        angularly-constant tensor NCC (e.g. er, r*er) over a shell/ball
+        basis: per-(m, ell) group, kron of the Q-intertwined component
+        coupling with the radial multiplication matrix
+        (reference: core/arithmetic.py:559 Gamma machinery, restricted to
+        the radial-NCC case used by the shell/ball examples).
+        """
+        from .spherical3d import q_stack, spherical_rank
+        basis = self._spherical_regularity_basis(operand)
+        ncc_basis = self._spherical_regularity_basis(ncc)
+        if basis is None or ncc_basis is None:
+            raise NonlinearOperatorError(
+                "Tensor NCCs require shell/ball bases on both factors.")
+        rank_n = spherical_rank(ncc.tensorsig, basis.cs)
+        rank_in = spherical_rank(operand.tensorsig, basis.cs)
+        ncomp_n = 3 ** rank_n
+        radial_flat = ncomp_n - 1  # flat index of (2, ..., 2)
+        cache = getattr(self, "_sph_ncc_cache", None)
+        if cache is None:
+            # Validate: only the all-radial component, angularly constant.
+            grid = np.asarray(ncc["g"])
+            flat = grid.reshape((ncomp_n,) + grid.shape[rank_n:])
+            tol = 1e-10 * max(np.abs(flat).max(), 1e-300)
+            for c in range(ncomp_n):
+                if c != radial_flat and np.abs(flat[c]).max() > tol:
+                    raise NonlinearOperatorError(
+                        "LHS tensor NCCs on spherical bases must have only "
+                        "radial components (e.g. f(r)*er).")
+            profile = flat[radial_flat]
+            if np.abs(profile - profile[:1, :1, :]).max() > tol:
+                raise NonlinearOperatorError(
+                    "LHS tensor NCCs on spherical bases must be angularly "
+                    "constant.")
+            profile_coeffs = ncc_basis._radial_forward_matrix(1.0) @ profile[0, 0]
+            M_f = basis.radial_multiplication_matrix(profile_coeffs,
+                                                     ncc_basis.k, k_out=0)
+            cache = self._sph_ncc_cache = sparsify(M_f, 1e-12)
+        M_f = cache
+        # Component coupling at this group's ell: C = Q_out^T P Q_in with
+        # P placing the radial NCC slot.
+        layout = subproblem.layout
+        az_axis = basis.first_axis
+        colat_axis = az_axis + 1
+        ell = subproblem.group[colat_axis]
+        ncomp_in = 3 ** rank_in
+        rank_out = rank_n + rank_in
+        e_col = np.zeros((ncomp_n, 1))
+        e_col[radial_flat, 0] = 1.0
+        if ncc_index == 0:
+            P = np.kron(e_col, np.identity(ncomp_in))
+        else:
+            P = np.kron(np.identity(ncomp_in), e_col)
+        Q_in = q_stack(basis.Ntheta, rank_in)[ell]
+        Q_out = q_stack(basis.Ntheta, rank_out)[ell]
+        C = Q_out.T @ P @ Q_in
+        gs = layout.sep_widths[az_axis]
+        return sparse_kron(sparsify(C, 1e-12),
+                           sp.identity(gs, format="csr"),
+                           M_f)
 
     def _assemble_ncc_matrix(self, subproblem, ncc, operand, tensor_factor_fn):
         """
@@ -345,6 +415,11 @@ class MultiplyFields(ProductBase):
 
     def expression_matrices(self, subproblem, vars, **kw):
         ncc_index, ncc, operand = self._split_ncc(vars)
+        if ncc.tensorsig and self._spherical_regularity_basis(ncc) is not None:
+            M = self._spherical_tensor_ncc_matrix(subproblem, ncc, operand,
+                                                  ncc_index)
+            op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
+            return {var: M @ mat for var, mat in op_mats.items()}
         ncomp_op = int(np.prod([cs.dim for cs in operand.tensorsig], dtype=int)) \
             if operand.tensorsig else 1
         ncomp_ncc_shape = ncc.tshape

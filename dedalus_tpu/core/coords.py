@@ -170,6 +170,10 @@ class S2Coordinates(CurvilinearCoordinateSystem):
         self.colatitude = Coordinate(colatitude, cs=self)
         self.coords = (self.azimuth, self.colatitude)
         self.dist = None
+        # Set when this S2 is the angular part of SphericalCoordinates:
+        # sphere bases then sit inside 3D problems with the colatitude as a
+        # separable (ell-group) axis.
+        self.radius_coord = None
 
     def __repr__(self):
         return f"S2Coordinates{self.names}"
@@ -201,10 +205,13 @@ class SphericalCoordinates(CurvilinearCoordinateSystem):
 
     def __init__(self, azimuth, colatitude, radius):
         self.names = (azimuth, colatitude, radius)
-        self.azimuth = AzimuthalCoordinate(azimuth, cs=self)
-        self.colatitude = Coordinate(colatitude, cs=self)
-        self.radius = Coordinate(radius, cs=self)
+        # Share the angular coordinate objects with the embedded S2 system so
+        # sphere bases built from S2coordsys see the distributor-assigned axes.
         self.S2coordsys = S2Coordinates(azimuth, colatitude)
+        self.azimuth = self.S2coordsys.azimuth
+        self.colatitude = self.S2coordsys.colatitude
+        self.radius = Coordinate(radius, cs=self)
+        self.S2coordsys.radius_coord = self.radius
         self.coords = (self.azimuth, self.colatitude, self.radius)
         self.dist = None
 

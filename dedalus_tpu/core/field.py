@@ -49,6 +49,81 @@ def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
     return data
 
 
+class _FieldDataView(np.ndarray):
+    """
+    Host ndarray tied to a Field layout: item assignment writes the whole
+    array back into the field, emulating the reference's live data views
+    (reference: core/field.py:561 __getitem__ returning self.data).
+    """
+
+    def __new__(cls, arr, field, layout):
+        obj = np.asarray(arr).view(cls)
+        obj._field = field
+        obj._field_layout = layout
+        # Shared mutable cell tracking the field version this view mirrors:
+        # all slices of this view share it, so sequential writes through any
+        # of them stay valid while external field mutations invalidate all.
+        obj._view_version = [field._version]
+        return obj
+
+    def __array_finalize__(self, obj):
+        # Memory-sharing views (slices) keep the backref so
+        # `u['g'][2][...] = v` lands in the field; fresh arrays produced by
+        # ufuncs drop it so `w = u['g']*2; w[0] = ...` does not.
+        self._field = None
+        self._field_layout = None
+        self._view_version = None
+        if obj is not None and getattr(obj, "_field", None) is not None:
+            try:
+                shared = np.shares_memory(self, obj)
+            except Exception:
+                shared = False
+            if shared:
+                self._field = obj._field
+                self._field_layout = obj._field_layout
+                self._view_version = obj._view_version
+
+    def _writeback(self):
+        field, layout = self._field, self._field_layout
+        if field is None:
+            return
+        if field._version != self._view_version[0]:
+            raise RuntimeError(
+                "Writing through a stale field data view: the field was "
+                "modified after this view was taken. Re-read the data "
+                f"(field['{layout}']) and apply the mutation to the fresh "
+                "view.")
+        root = self
+        while isinstance(root.base, np.ndarray):
+            root = root.base
+        field[layout] = np.asarray(root)
+        self._view_version[0] = field._version
+
+    def __setitem__(self, key, value):
+        np.ndarray.__setitem__(self, key, value)
+        self._writeback()
+
+    def __iadd__(self, other):
+        out = np.ndarray.__iadd__(self, other)
+        self._writeback()
+        return out
+
+    def __isub__(self, other):
+        out = np.ndarray.__isub__(self, other)
+        self._writeback()
+        return out
+
+    def __imul__(self, other):
+        out = np.ndarray.__imul__(self, other)
+        self._writeback()
+        return out
+
+    def __itruediv__(self, other):
+        out = np.ndarray.__itruediv__(self, other)
+        self._writeback()
+        return out
+
+
 class Operand:
     """Base class for everything that can appear in symbolic expressions."""
 
@@ -258,12 +333,16 @@ class Field(Operand):
             self.require_grid_space()
 
     def __getitem__(self, layout):
-        # Return a writable host copy: augmented assignment (u['g'] *= ...)
-        # round-trips through __setitem__ with this array.
+        # Return a host view that writes back on item assignment, so the
+        # reference idiom `u['g'][2] = ...` works (reference fields expose
+        # their live buffers; here device arrays are immutable, so the view
+        # pushes mutations back through __setitem__).
         if layout in ("c", 0, "coeff"):
-            return np.array(self.require_coeff_space())
+            return _FieldDataView(np.array(self.require_coeff_space()),
+                                  self, "c")
         elif layout in ("g", 1, "grid"):
-            return np.array(self.require_grid_space())
+            return _FieldDataView(np.array(self.require_grid_space()),
+                                  self, "g")
         raise KeyError(f"Unknown layout: {layout}")
 
     def __setitem__(self, layout, value):

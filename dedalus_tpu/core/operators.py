@@ -425,6 +425,12 @@ def Interpolate(operand, coord, position):
     basis = operand.domain.get_basis(coord)
     if basis is None:
         return operand
+    if getattr(basis, "regularity", False):
+        from .spherical3d import SphericalInterpolate
+        if coord != basis.coordsystem.radius:
+            raise NotImplementedError(
+                "Only radial interpolation is supported on shell/ball bases.")
+        return SphericalInterpolate(operand, position)
     from .polar import PolarInterpolate
     from .curvilinear import SpinBasisMixin
     if isinstance(basis, SpinBasisMixin):
@@ -507,11 +513,10 @@ def _curv_selected(curv, coords):
 def Integrate(operand, coords=None):
     if np.isscalar(operand):
         return operand
-    from .polar import PolarIntegrate
     out = operand
     curv = _curvilinear_basis(operand)
     if curv is not None and _curv_selected(curv, coords):
-        out = PolarIntegrate(out)
+        out = _curv_integrate(out, curv)
     if coords is None:
         coords = [b.coord for b in out.domain.bases if b is not None]
     elif isinstance(coords, (Coordinate, CartesianCoordinates)):
@@ -530,9 +535,8 @@ def Average(operand, coords=None):
     out = operand
     curv = _curvilinear_basis(operand)
     if curv is not None and _curv_selected(curv, coords):
-        from .polar import PolarIntegrate
         volume *= curv.volume
-        out = PolarIntegrate(out)
+        out = _curv_integrate(out, curv)
     if coords is None:
         coords = [b.coord for b in out.domain.bases if b is not None]
     elif isinstance(coords, (Coordinate, CartesianCoordinates)):
@@ -588,6 +592,9 @@ _CartesianLift = Lift
 
 def LiftFactory(operand, basis, n):
     from .polar import DiskBasis, AnnulusBasis, PolarLift
+    if getattr(basis, "regularity", False):
+        from .spherical3d import SphericalLift
+        return SphericalLift(operand, basis, n)
     if isinstance(basis, (DiskBasis, AnnulusBasis)):
         return PolarLift(operand, basis, n)
     return _CartesianLift(operand, basis, n)
@@ -885,9 +892,17 @@ class CartesianCurl(CartesianVectorOperator):
 def _curvilinear_basis(operand):
     from .curvilinear import SpinBasisMixin
     for b in operand.domain.bases:
-        if isinstance(b, SpinBasisMixin):
+        if isinstance(b, SpinBasisMixin) or getattr(b, "regularity", False):
             return b
     return None
+
+
+def _curv_integrate(operand, curv):
+    if getattr(curv, "regularity", False):
+        from .spherical3d import SphericalIntegrate
+        return SphericalIntegrate(operand)
+    from .polar import PolarIntegrate
+    return PolarIntegrate(operand)
 
 
 def _spin_cs(cs):
@@ -895,11 +910,19 @@ def _spin_cs(cs):
     return isinstance(cs, (PolarCoordinates, S2Coordinates))
 
 
+def _spherical_cs(cs):
+    from .coords import SphericalCoordinates
+    return isinstance(cs, SphericalCoordinates)
+
+
 @parseable("grad", "Gradient")
 def Gradient(operand, cs=None):
     if np.isscalar(operand):
         return 0
     cs = cs or operand.dist.coordsystems[0]
+    if _spherical_cs(cs):
+        from .spherical3d import SphericalGradient
+        return SphericalGradient(operand, cs)
     if _spin_cs(cs):
         from .polar import PolarGradient
         return PolarGradient(operand, cs)
@@ -910,6 +933,9 @@ def Gradient(operand, cs=None):
 def Divergence(operand, index=0):
     if np.isscalar(operand):
         return 0
+    if _spherical_cs(operand.tensorsig[index]):
+        from .spherical3d import SphericalDivergence
+        return SphericalDivergence(operand, index)
     if _spin_cs(operand.tensorsig[index]):
         from .polar import PolarDivergence
         return PolarDivergence(operand, index)
@@ -921,6 +947,9 @@ def Laplacian(operand, cs=None):
     if np.isscalar(operand):
         return 0
     cs2 = cs or operand.dist.coordsystems[0]
+    if _spherical_cs(cs2):
+        from .spherical3d import SphericalLaplacian
+        return SphericalLaplacian(operand, cs2)
     if _spin_cs(cs2):
         from .polar import PolarLaplacian
         return PolarLaplacian(operand, cs2)
@@ -931,14 +960,19 @@ def Laplacian(operand, cs=None):
 def Curl(operand):
     if np.isscalar(operand):
         return 0
+    if operand.tensorsig and _spherical_cs(operand.tensorsig[0]):
+        from .spherical3d import SphericalCurl
+        return SphericalCurl(operand)
     return CartesianCurl(operand)
 
 
 # ----------------------------------------------------------------------
 # Tensor-index operators
 
-class Trace(LinearOperator):
-    """Contract the first two tensor indices (reference: core/operators.py:1693)."""
+class TraceOperator(LinearOperator):
+    """Contract the first two tensor indices with the coordinate delta
+    (valid for Cartesian component storage;
+    reference: core/operators.py:1693)."""
 
     name = "Trace"
 
@@ -1014,7 +1048,8 @@ class Skew(LinearOperator):
 
 
 def SkewFactory(operand):
-    if _curvilinear_basis(operand) is not None:
+    from .curvilinear import SpinBasisMixin
+    if any(isinstance(b, SpinBasisMixin) for b in operand.domain.bases):
         from .polar import PolarSkew
         return PolarSkew(operand)
     return Skew(operand)
@@ -1023,6 +1058,9 @@ def SkewFactory(operand):
 def Radial(operand, index=0):
     if index != 0:
         raise NotImplementedError("Component extraction only supports index=0.")
+    if _spherical_cs(operand.tensorsig[0]):
+        from .spherical3d import SphericalComponent
+        return SphericalComponent(operand, "radial")
     from .polar import PolarComponent
     return PolarComponent(operand, "radial")
 
@@ -1034,11 +1072,43 @@ def Azimuthal(operand, index=0):
     return PolarComponent(operand, "azimuthal")
 
 
+def Trace(operand):
+    """Trace factory: dispatches on the storage frame of the contracted
+    indices (coordinate / spin / regularity components)."""
+    if np.isscalar(operand):
+        return 0
+    ts = operand.tensorsig
+    if len(ts) >= 2 and _spherical_cs(ts[0]):
+        from .spherical3d import (SphericalTrace, SphericalSpinTrace,
+                                  spherical_basis_of)
+        if spherical_basis_of(operand) is not None:
+            return SphericalTrace(operand)
+        # S2 boundary fields store 3D spin components: constant spin metric.
+        return SphericalSpinTrace(operand)
+    if len(ts) >= 2 and _spin_cs(ts[0]):
+        from .curvilinear import SpinBasisMixin
+        from .polar import SpinTrace
+        if any(isinstance(b, SpinBasisMixin) for b in operand.domain.bases):
+            return SpinTrace(operand)
+    return TraceOperator(operand)
+
+
+def Angular(operand, index=0):
+    if index != 0:
+        raise NotImplementedError("Component extraction only supports index=0.")
+    if _spherical_cs(operand.tensorsig[0]):
+        from .spherical3d import SphericalComponent
+        return SphericalComponent(operand, "angular")
+    from .polar import PolarComponent
+    return PolarComponent(operand, "azimuthal")
+
+
 parseables["trace"] = parseables["Trace"] = Trace
 parseables["transpose"] = parseables["TransposeComponents"] = TransposeComponents
 parseables["skew"] = parseables["Skew"] = SkewFactory
 parseables["radial"] = Radial
 parseables["azimuthal"] = Azimuthal
+parseables["angular"] = Angular
 
 
 # ----------------------------------------------------------------------

@@ -21,13 +21,12 @@ TPU-native design:
 """
 
 import numpy as np
-import jax.numpy as jnp
 
 from ..tools.cache import CachedClass, CachedMethod
 from ..libraries import zernike
 from ..tools import jacobi as jacobi_tools
-from ..tools.array import apply_matrix_jax
 from .basis import Basis, RealFourier, ComplexFourier, AffineCOV, Jacobi
+from .weighted_jacobi import WeightedJacobiRadial
 from .coords import PolarCoordinates
 from .curvilinear import (component_spins, recombination_matrix,
                           apply_component_pair_matrix, apply_group_stack,
@@ -392,7 +391,7 @@ class DiskBasis(SpinBasisMixin, Basis):
         return terms
 
 
-class AnnulusBasis(SpinBasisMixin, Basis):
+class AnnulusBasis(SpinBasisMixin, WeightedJacobiRadial, Basis):
     """
     Annulus basis: Fourier azimuth x weighted-Jacobi radius on [Ri, Ro]
     (reference: dedalus/core/basis.py:2011 AnnulusBasis and the shell radial
@@ -401,14 +400,16 @@ class AnnulusBasis(SpinBasisMixin, Basis):
     TPU-native design: level-k fields carry a hidden (dR/r)^k grid prefactor,
     so the spin ladders D_{+-} = (1/sqrt(2))(d/dr -+ (m+s)/r) map level k to
     level k+1 with polynomial-exact matrices (the reference's weighted shell
-    spaces). All per-m radial operators decompose as A - ds*(m+s)*B with
-    m-independent A, B, so the full (G, Nr, Nr) stacks assemble without per-m
-    quadrature; application is one batched MXU matmul over the m groups. The
-    radial transform itself is m- and spin-independent: a single dense matmul
-    (the m-loop of the reference, core/basis.py:2190-2210, disappears).
+    spaces; see core/weighted_jacobi.py). All per-m radial operators
+    decompose as A - ds*(m+s)*B with m-independent A, B, so the full
+    (G, Nr, Nr) stacks assemble without per-m quadrature; application is one
+    batched MXU matmul over the m groups. The radial transform itself is m-
+    and spin-independent: a single dense matmul (the m-loop of the
+    reference, core/basis.py:2190-2210, disappears).
     """
 
     dim = 2
+    radial_sub_axis = 1
 
     def __init__(self, coordsystem, shape, dtype=np.float64, radii=(1.0, 2.0),
                  k=0, alpha=(-0.5, -0.5), dealias=(1, 1), azimuth_library=None,
@@ -460,14 +461,6 @@ class AnnulusBasis(SpinBasisMixin, Basis):
         return self.coordsystem.first_axis
 
     @property
-    def a_k(self):
-        return self.alpha[0] + self.k
-
-    @property
-    def b_k(self):
-        return self.alpha[1] + self.k
-
-    @property
     def family_key(self):
         return (type(self).__name__, self.shape, self.radii, self.alpha,
                 self.dtype)
@@ -517,14 +510,6 @@ class AnnulusBasis(SpinBasisMixin, Basis):
         Ng = self.sub_grid_size(0, scale)
         return 2 * np.pi * np.arange(Ng) / Ng
 
-    def radial_grid(self, scale=1.0):
-        z = self._z_grid(scale)
-        return self.radial_COV.problem_coord(z)
-
-    def _z_grid(self, scale=1.0):
-        Ng = self.sub_grid_size(1, scale)
-        return jacobi_tools.build_grid(Ng, self.alpha[0], self.alpha[1])
-
     # ---------------------------------------------------------- validity
 
     def component_valid_mask(self, tensorsig, group, sep_widths):
@@ -546,43 +531,11 @@ class AnnulusBasis(SpinBasisMixin, Basis):
         raise NotImplementedError("Annulus azimuth must be a pencil axis.")
 
     # -------------------------------------------------- radial transforms
-    # The radial transform is m- and spin-independent: override the mixin's
-    # stack application with a single matrix along the radial axis.
-
-    @CachedMethod
-    def _radial_forward_matrix(self, scale=1.0):
-        """(Nr, Ngr): grid values -> level-k coefficients. Projects onto the
-        base (alpha) polynomials then applies the banded base->k conversion,
-        with the (r/dR)^k weight folded into the quadrature columns."""
-        Ngr = self.sub_grid_size(1, scale)
-        a0, b0 = self.alpha
-        F = jacobi_tools.forward_matrix(self.Nr, a0, b0, Ngr)
-        if self.k:
-            r = self.radial_grid(scale)
-            F = F * (r / self.dR) ** self.k
-            C = jacobi_tools.conversion_matrix(self.Nr, a0, b0, self.k, self.k)
-            F = C @ F
-        return F
-
-    @CachedMethod
-    def _radial_backward_matrix(self, scale=1.0):
-        """(Ngr, Nr): level-k coefficients -> grid values."""
-        z = self._z_grid(scale)
-        P = jacobi_tools.build_polynomials(self.Nr, self.a_k, self.b_k, z)
-        B = P.T
-        if self.k:
-            r = self.radial_grid(scale)
-            B = B * ((self.dR / r) ** self.k)[:, None]
-        return B
 
     def _radial_apply(self, data, tdim, az_axis, r_axis, spins, scale, forward):
         """The annulus radial transform is m- and spin-independent: one dense
         matmul along the radial axis (no per-m batching needed)."""
-        if forward:
-            M = self._radial_forward_matrix(scale)
-        else:
-            M = self._radial_backward_matrix(scale)
-        return apply_matrix_jax(jnp.asarray(M), data, r_axis)
+        return self._radial_matmul(data, r_axis, scale, forward)
 
     # ------------------------------------------------- radial matrix stacks
 
@@ -594,28 +547,6 @@ class AnnulusBasis(SpinBasisMixin, Basis):
         if self.complex:
             out[self.Nphi // 2] = 0.0
         return out
-
-    @CachedMethod
-    def _ladder_parts(self):
-        """
-        m-independent pieces of the spin ladder at this level: on the
-        polynomial part g of a level-k field,
-            D_ds f = (dR/r)^{k+1} [ (z+rho) g' - (k + ds*(m+s)) g ] / (sqrt(2) dR)
-        Returns (A, B) with A = proj[(z+rho) g' - k g], B = proj[g], both
-        (Nr, Nr) maps into the level-(k+1) polynomials (exact by quadrature).
-        """
-        N = self.Nr
-        a, b = self.a_k, self.b_k
-        Nq = N + 8
-        z = jacobi_tools.build_grid(Nq, a + 1, b + 1)
-        w = jacobi_tools.build_weights(Nq, a + 1, b + 1)
-        P = jacobi_tools.build_polynomials(N, a, b, z)
-        dP = jacobi_tools.build_polynomial_derivatives(N, a, b, z)
-        Pout = jacobi_tools.build_polynomials(N, a + 1, b + 1, z)
-        W = Pout * w
-        A = W @ ((z + self.rho) * dP - self.k * P).T
-        B = W @ P.T
-        return A, B
 
     @CachedMethod
     def ladder_stack(self, s, ds):
@@ -631,27 +562,6 @@ class AnnulusBasis(SpinBasisMixin, Basis):
         return stack
 
     @CachedMethod
-    def _conversion_matrix_single(self):
-        """(Nr, Nr): level k -> k+1 identity-conversion E (exact)."""
-        N = self.Nr
-        a, b = self.a_k, self.b_k
-        Nq = N + 8
-        z = jacobi_tools.build_grid(Nq, a + 1, b + 1)
-        w = jacobi_tools.build_weights(Nq, a + 1, b + 1)
-        P = jacobi_tools.build_polynomials(N, a, b, z)
-        Pout = jacobi_tools.build_polynomials(N, a + 1, b + 1, z)
-        return (Pout * w) @ (((z + self.rho) / 2) * P).T
-
-    def _conversion_matrix_total(self, dk):
-        """(Nr, Nr): level k -> k+dk."""
-        M = np.eye(self.Nr)
-        basis = self
-        for _ in range(int(dk)):
-            M = basis._conversion_matrix_single() @ M
-            basis = basis.clone_with(k=basis.k + 1)
-        return M
-
-    @CachedMethod
     def laplacian_stack(self, s):
         """(G, Nr, Nr): spin-weighted Laplacian, k -> k+2."""
         up = self.ladder_stack(s, +1)
@@ -663,24 +573,13 @@ class AnnulusBasis(SpinBasisMixin, Basis):
     def interpolation_stack(self, s, position):
         """(G, 1, Nr): evaluate spin-s components at problem radius
         `position`."""
-        z0 = self.radial_COV.native_coord(position)
-        row = jacobi_tools.build_polynomials(self.Nr, self.a_k, self.b_k,
-                                             np.array([float(z0)]))[:, 0]
-        row = row * (self.dR / float(position)) ** self.k
-        return self._tile(row[None, :])
+        return self._tile(self.radial_interpolation_row(position))
 
     @CachedMethod
     def integration_row(self):
         """(1, Nr): radial integral against r dr for the (m=0, s=0) group,
-        in problem units. Rational for k >= 2 but smooth on the annulus, so
-        a generous Legendre rule is spectrally exact."""
-        from scipy import special
-        Nq = self.Nr + self.k + 64
-        z, w = special.roots_legendre(Nq)
-        P = jacobi_tools.build_polynomials(self.Nr, self.a_k, self.b_k, z)
-        vals = (2.0 / (z + self.rho)) ** self.k * (z + self.rho)
-        row = (P * (w * vals)) @ np.ones(Nq)
-        return row[None, :] * (self.dR / 2) ** 2
+        in problem units."""
+        return self.radial_integration_row(power=1)
 
     def lift_column(self, index):
         col = np.zeros((self.Nr, 1))
@@ -696,14 +595,7 @@ class AnnulusBasis(SpinBasisMixin, Basis):
                 col[0, 0] = 1.0
                 return ("full", col)
             return ("blocks", self.azimuth_basis.constant_blocks())
-        # radius: 1 = (dR/r)^k ((z+rho)/2)^k -> project the polynomial part
-        a, b = self.a_k, self.b_k
-        Nq = self.Nr + self.k + 4
-        z = jacobi_tools.build_grid(Nq, a, b)
-        w = jacobi_tools.build_weights(Nq, a, b)
-        P = jacobi_tools.build_polynomials(self.Nr, a, b, z)
-        col = (P * w) @ ((z + self.rho) / 2) ** self.k
-        return ("full", col[:, None])
+        return ("full", self.radial_constant_column())
 
     # ---------------------------------------------------- conversion terms
 
@@ -720,32 +612,6 @@ class AnnulusBasis(SpinBasisMixin, Basis):
             raise ValueError("Cannot convert to lower k.")
         r_axis = self.first_axis + 1
         return [(None, {r_axis: ("full", self._conversion_matrix_total(dk))})]
-
-    # ------------------------------------------------------- NCC products
-
-    def radial_multiplication_matrix(self, f_radial_coeffs, f_k, k_out=0):
-        """
-        (Nr, Nr): maps level-`self.k` radial coefficients of u to
-        level-`k_out` coefficients of (f*u), for an azimuthally-constant NCC
-        f with level-`f_k` radial coefficients. Assembled as
-        transform->pointwise multiply->transform by quadrature
-        (reference: core/basis.py:2293 _last_axis_component_ncc_matrix,
-        Clenshaw replaced by direct quadrature).
-        """
-        a0, b0 = self.alpha
-        f_radial_coeffs = np.asarray(f_radial_coeffs, dtype=np.float64)
-        Nf = f_radial_coeffs.shape[-1]
-        Nq = self.Nr + Nf + self.k + int(abs(k_out)) + 32
-        z = jacobi_tools.build_grid(Nq, a0 + k_out, b0 + k_out)
-        w = jacobi_tools.build_weights(Nq, a0 + k_out, b0 + k_out)
-        rr = (z + self.rho) / 2  # r/dR
-        fvals = (f_radial_coeffs @ jacobi_tools.build_polynomials(
-            Nf, a0 + f_k, b0 + f_k, z)) * rr ** (-f_k)
-        U = jacobi_tools.build_polynomials(self.Nr, self.a_k, self.b_k, z) \
-            * rr ** (k_out - self.k)
-        Pout = jacobi_tools.build_polynomials(self.Nr, a0 + k_out, b0 + k_out, z)
-        return (Pout * w) @ (fvals * U).T
-
 
 # ======================================================================
 # Polar calculus operators
@@ -1073,6 +939,36 @@ class PolarSkew(PolarSpinOperator):
         dim = operand.domain.dim
         raw = [(factor, [None] * dim)]
         return _expand_complex_terms(raw, az, basis.sub_n_groups(0), basis.complex)
+
+
+class SpinTrace(PolarSpinOperator):
+    """Trace of the two leading indices in 2D spin components: the spin
+    metric contracts (-,+) and (+,-) (reference: core/operators.py:1693
+    Trace with spin storage)."""
+
+    name = "Trace"
+    natural_layout = "g"
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        if len(operand.tensorsig) < 2 or operand.tensorsig[0] != operand.tensorsig[1]:
+            raise ValueError("Trace requires two equal leading indices.")
+        self.domain = operand.domain
+        self.tensorsig = tuple(operand.tensorsig[2:])
+        self.dtype = operand.dtype
+
+    def terms(self):
+        operand = self.operand
+        rest = int(np.prod(operand.tshape[2:], dtype=int)) \
+            if operand.tshape[2:] else 1
+        # spin ordering (-, +): metric pairs (-,+) and (+,-)
+        row = np.array([[0.0, 1.0, 1.0, 0.0]])
+        factor = np.kron(row, np.identity(rest))
+        return [(factor, [None] * operand.domain.dim)]
+
+    def ev_impl(self, ctx):
+        data = ev(self.operand, ctx, "g")
+        return data[0, 0] + data[1, 1]
 
 
 class PolarComponent(LinearOperator):

@@ -83,7 +83,12 @@ class SphereBasis(SpinBasisMixin, Basis):
         return int(np.ceil(scale * self.shape[sub_axis]))
 
     def sub_separable(self, sub_axis):
-        return sub_axis == 0
+        if sub_axis == 0:
+            return True
+        # Inside a 3D spherical problem (shell/ball) every operator is
+        # ell-diagonal, so the colatitude is a separable (ell-group) axis;
+        # in standalone S2 problems it is the coupled pencil axis.
+        return self.cs.radius_coord is not None
 
     def sub_group_shape(self, sub_axis):
         if sub_axis == 0:
@@ -93,6 +98,8 @@ class SphereBasis(SpinBasisMixin, Basis):
     def sub_n_groups(self, sub_axis):
         if sub_axis == 0:
             return self.Nphi if self.complex else self.Nphi // 2
+        if self.sub_separable(sub_axis):
+            return self.Ntheta  # ell groups in 3D problems
         return 1
 
     @CachedMethod
@@ -135,26 +142,32 @@ class SphereBasis(SpinBasisMixin, Basis):
     # ---------------------------------------------------------- validity
 
     def component_valid_mask(self, tensorsig, group, sep_widths):
-        """(ncomp, gs_az, Ntheta) at one m group: slot l valid iff
-        l >= lmin(m, s_component) (reference: core/basis.py:2770)."""
+        """(ncomp, gs_az, Ntheta) at one m group — or (ncomp, gs_az, 1) at
+        one (m, ell) group when the colatitude is separable (3D problems):
+        slot l valid iff l >= lmin(m, s_component)
+        (reference: core/basis.py:2770)."""
         spins = component_spins(tensorsig, self.cs)
         ncomp = len(spins)
         az_axis = self.first_axis
+        colat_axis = az_axis + 1
         gs = self.sub_group_shape(0)
         ms = self.group_m()
-        if az_axis in sep_widths:
-            g = group[az_axis]
-            m = ms[g]
-            mask = np.ones((ncomp, gs, self.Ntheta), dtype=bool)
-            ell = np.arange(self.Ntheta)
-            for c, s in enumerate(spins):
-                mask[c] &= (ell >= self._lmin(m, s))[None, :]
-            if self.complex and g == self.Nphi // 2:
-                mask[:] = False  # Nyquist
-            if (not self.complex) and (not tensorsig) and m == 0:
-                mask[:, 1, :] = False  # minus-sin slot of m=0 for scalars
-            return mask
-        raise NotImplementedError("Sphere azimuth must be a pencil axis.")
+        if az_axis not in sep_widths:
+            raise NotImplementedError("Sphere azimuth must be a pencil axis.")
+        g = group[az_axis]
+        m = ms[g]
+        if colat_axis in sep_widths:
+            ells = np.array([group[colat_axis]])
+        else:
+            ells = np.arange(self.Ntheta)
+        mask = np.ones((ncomp, gs, ells.size), dtype=bool)
+        for c, s in enumerate(spins):
+            mask[c] &= (ells >= self._lmin(m, s))[None, :]
+        if self.complex and g == self.Nphi // 2:
+            mask[:] = False  # Nyquist
+        if (not self.complex) and (not tensorsig) and m == 0:
+            mask[:, 1, :] = False  # minus-sin slot of m=0 for scalars
+        return mask
 
     # ------------------------------------------- colatitude matrix stacks
 

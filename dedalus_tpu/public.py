@@ -11,13 +11,14 @@ from .core.basis import (Jacobi, ChebyshevT, ChebyshevU, ChebyshevV, Legendre,
                          Ultraspherical, RealFourier, ComplexFourier, Fourier)
 from .core.polar import DiskBasis, AnnulusBasis
 from .core.sphere import SphereBasis, MulCosine
+from .core.spherical3d import ShellBasis
 from .core.field import Field, LockedField
 from .core.problems import IVP, LBVP, NLBVP, EVP
 from .core.operators import (
     Differentiate, Convert, Interpolate, Integrate, Average,
     LiftFactory as Lift, LiftTau,
     Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents,
-    SkewFactory as Skew, Radial, Azimuthal,
+    SkewFactory as Skew, Radial, Azimuthal, Angular,
     TimeDerivative, UnaryGridFunction, GeneralFunction, GridWrapper as Grid,
     CoeffWrapper as Coeff, dt)
 from .core.arithmetic import Add, Multiply, DotProduct, CrossProduct, Power
@@ -42,3 +43,4 @@ lift = Lift
 interp = Interpolate
 radial = Radial
 azimuthal = Azimuthal
+angular = Angular

@@ -1,0 +1,95 @@
+"""
+Boussinesq convection in a spherical shell (first-order tau formulation)
+(reference example: examples/ivp_shell_convection/shell_convection.py).
+
+Non-dimensionalized with the shell thickness and freefall time:
+    kappa = (Rayleigh * Prandtl)**(-1/2)
+    nu = (Rayleigh / Prandtl)**(-1/2)
+
+Run directly: python examples/shell_convection.py [--quick]
+"""
+
+import sys
+import logging
+import numpy as np
+
+import dedalus_tpu.public as d3
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger(__name__)
+
+# Parameters (reference: shell_convection.py:44-50; reduced default size)
+quick = "--quick" in sys.argv
+Ri, Ro = 14.0, 15.0
+Nphi, Ntheta, Nr = (16, 8, 6) if quick else (96, 48, 6)
+Rayleigh = 3500
+Prandtl = 1
+dealias = 3 / 2
+stop_iteration = 20 if quick else 400
+timestep = 0.05
+dtype = np.float64
+
+# Bases
+coords = d3.SphericalCoordinates("phi", "theta", "r")
+dist = d3.Distributor(coords, dtype=dtype)
+shell = d3.ShellBasis(coords, shape=(Nphi, Ntheta, Nr), radii=(Ri, Ro),
+                      dealias=dealias, dtype=dtype)
+sphere = shell.outer_surface
+
+# Fields
+p = dist.Field(name="p", bases=shell)
+b = dist.Field(name="b", bases=shell)
+u = dist.VectorField(coords, name="u", bases=shell)
+tau_p = dist.Field(name="tau_p")
+tau_b1 = dist.Field(name="tau_b1", bases=sphere)
+tau_b2 = dist.Field(name="tau_b2", bases=sphere)
+tau_u1 = dist.VectorField(coords, name="tau_u1", bases=sphere)
+tau_u2 = dist.VectorField(coords, name="tau_u2", bases=sphere)
+
+# Substitutions
+kappa = (Rayleigh * Prandtl) ** (-1 / 2)
+nu = (Rayleigh / Prandtl) ** (-1 / 2)
+phi, theta, r = dist.local_grids(shell)
+er = dist.VectorField(coords, name="er", bases=shell)
+er["g"][2] = 1.0
+rvec = dist.VectorField(coords, name="rvec", bases=shell)
+rvec["g"][2] = np.broadcast_to(np.asarray(r), np.asarray(er["g"])[2].shape)
+lift_basis = shell.derivative_basis(1)
+lift = lambda A: d3.Lift(A, lift_basis, -1)
+grad_u = d3.grad(u) + rvec * lift(tau_u1)  # First-order reduction
+grad_b = d3.grad(b) + rvec * lift(tau_b1)
+
+# Problem (reference: shell_convection.py:76-87)
+problem = d3.IVP([p, b, u, tau_p, tau_b1, tau_b2, tau_u1, tau_u2],
+                 namespace=locals())
+problem.add_equation("trace(grad_u) + tau_p = 0")
+problem.add_equation("dt(b) - kappa*div(grad_b) + lift(tau_b2) = - u@grad(b)")
+problem.add_equation("dt(u) - nu*div(grad_u) + grad(p) - b*er + lift(tau_u2) = - u@grad(u)")
+problem.add_equation("b(r=Ri) = 1")
+problem.add_equation("u(r=Ri) = 0")
+problem.add_equation("b(r=Ro) = 0")
+problem.add_equation("u(r=Ro) = 0")
+problem.add_equation("integ(p) = 0")
+
+# Solver
+solver = problem.build_solver(d3.SBDF2)
+solver.stop_iteration = stop_iteration
+
+# Initial conditions: conductive profile + noise
+b.fill_random("g", seed=42, distribution="normal", scale=1e-3)
+b["g"] += (Ri - Ri * Ro / np.asarray(r)) / (Ri - Ro)
+
+# Analysis
+flow = d3.GlobalFlowProperty(solver, cadence=10)
+flow.add_property(u @ u, name="u2")
+
+# Main loop
+try:
+    while solver.proceed:
+        solver.step(timestep)
+        if solver.iteration % 10 == 0:
+            max_u2 = flow.max("u2")
+            logger.info(f"Iteration={solver.iteration}, Time={solver.sim_time:.3f}, "
+                        f"max(u2)={max_u2:.3e}")
+finally:
+    solver.log_stats()

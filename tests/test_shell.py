@@ -1,0 +1,298 @@
+"""
+Spherical-shell basis tests: transforms, regularity-component calculus vs
+closed forms, NCC products, LBVPs, and a diffusion IVP
+(reference patterns: dedalus/tests/test_transforms.py,
+tests/test_spherical_calculus.py, tests/test_spherical_operators.py,
+tests/test_lbvp.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+RI, RO = 1.0, 2.0
+
+
+def make_shell(dtype, shape=(12, 8, 12), radii=(RI, RO), dealias=1):
+    cs = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(cs, dtype=dtype)
+    shell = d3.ShellBasis(cs, shape=shape, dtype=dtype, radii=radii,
+                          dealias=dealias)
+    return cs, dist, shell
+
+
+def xyz(phi, theta, r):
+    return (r * np.sin(theta) * np.cos(phi),
+            r * np.sin(theta) * np.sin(phi),
+            r * np.cos(theta))
+
+
+def cartesian_vector_to_spherical(phi, theta, vx, vy, vz):
+    """Coordinate components (phi, theta, r) of a Cartesian vector field."""
+    v_phi = -np.sin(phi) * vx + np.cos(phi) * vy
+    v_theta = (np.cos(theta) * np.cos(phi) * vx
+               + np.cos(theta) * np.sin(phi) * vy - np.sin(theta) * vz)
+    v_r = (np.sin(theta) * np.cos(phi) * vx
+           + np.sin(theta) * np.sin(phi) * vy + np.cos(theta) * vz)
+    return v_phi, v_theta, v_r
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("k", [0, 1])
+def test_shell_scalar_roundtrip(dtype, k):
+    cs, dist, shell = make_shell(dtype)
+    shell = shell.clone_with(k=k)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * y + z ** 2 + x + 3 / r
+    g0 = np.array(f["g"])
+    f["c"] = f["c"]
+    assert np.abs(f["g"] - g0).max() < 1e-11
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_shell_vector_roundtrip(dtype):
+    cs, dist, shell = make_shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    vp, vt, vr = cartesian_vector_to_spherical(phi, theta, y + 1, x, 2 * z)
+    u = dist.VectorField(cs, name="u", bases=shell)
+    u["g"] = np.array([vp + 0 * r, vt + 0 * r, vr + 0 * r])
+    g0 = np.array(u["g"])
+    u["c"] = u["c"]
+    assert np.abs(u["g"] - g0).max() < 1e-11
+
+
+def test_shell_tensor_roundtrip():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * y * z + z ** 3
+    T = d3.grad(d3.grad(f)).evaluate()
+    g0 = np.array(T["g"])
+    T["c"] = T["c"]
+    assert np.abs(T["g"] - g0).max() < 1e-10
+
+
+def test_shell_gradient():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * y + z ** 2 + x + 3
+    vp, vt, vr = cartesian_vector_to_spherical(phi, theta, y + 1, x, 2 * z)
+    g = d3.grad(f).evaluate()["g"]
+    assert np.abs(g[0] - vp).max() < 1e-11
+    assert np.abs(g[1] - vt).max() < 1e-11
+    assert np.abs(g[2] - vr).max() < 1e-11
+
+
+def test_shell_laplacian_divergence_curl():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * y + z ** 2 + x + 3
+    assert np.abs(d3.lap(f).evaluate()["g"] - 2.0).max() < 1e-9
+    assert np.abs(d3.div(d3.grad(f)).evaluate()["g"] - 2.0).max() < 1e-9
+    assert np.abs(d3.curl(d3.grad(f)).evaluate()["g"]).max() < 1e-9
+
+
+def test_shell_curl_of_rotation():
+    """curl of the rigid rotation u = Omega x r is 2 Omega."""
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    # u = z_hat x r = (-y, x, 0)
+    vp, vt, vr = cartesian_vector_to_spherical(phi, theta, -y, x, 0 * z)
+    u = dist.VectorField(cs, name="u", bases=shell)
+    u["g"] = np.array([vp, vt, vr + 0 * x])
+    c = d3.curl(u).evaluate()["g"]
+    wp, wt, wr = cartesian_vector_to_spherical(phi, theta, 0 * x, 0 * x,
+                                               2 + 0 * x)
+    assert np.abs(c[0] - wp).max() < 1e-10
+    assert np.abs(c[1] - wt).max() < 1e-10
+    assert np.abs(c[2] - wr).max() < 1e-10
+
+
+def test_shell_trace_vs_laplacian():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * y * z + z ** 3
+    lap = d3.lap(f).evaluate()["g"]
+    tr = d3.trace(d3.grad(d3.grad(f))).evaluate()["g"]
+    assert np.abs(tr - lap).max() < 1e-9
+
+
+def test_shell_trace_lhs_matrix():
+    """The coefficient-space trace matrix (Q-intertwined spin metric) agrees
+    with the laplacian identity trace(grad(grad(f))) == lap(f)."""
+    cs, dist, shell = make_shell(np.float64, dealias=3 / 2)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * z + np.asarray(r) ** 3
+    f2 = dist.Field(name="f2", bases=shell)
+    s = dist.Field(name="s", bases=shell)
+    problem = d3.LBVP([f2, s], namespace=locals())
+    problem.add_equation("s - trace(grad(grad(f2))) = 0")
+    problem.add_equation("f2 = f")
+    problem.build_solver().solve()
+    lap = d3.lap(f).evaluate()["g"]
+    assert np.abs(np.asarray(s["g"]) - np.asarray(lap)).max() < 1e-9
+
+
+def test_shell_vector_ncc():
+    """Radial vector NCCs (b*er, rvec*b) assemble exact LHS matrices."""
+    cs, dist, shell = make_shell(np.float64, shape=(8, 6, 8), dealias=3 / 2)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    er = dist.VectorField(cs, name="er", bases=shell)
+    er["g"][2] = 1.0
+    bvar = dist.Field(name="bvar", bases=shell)
+    w = dist.VectorField(cs, name="w", bases=shell)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * z + np.asarray(r) ** 2
+    problem = d3.LBVP([bvar, w], namespace=locals())
+    problem.add_equation("w - bvar*er = 0")
+    problem.add_equation("bvar = f")
+    problem.build_solver().solve()
+    expect = np.zeros_like(np.asarray(w["g"]))
+    expect[2] = np.asarray(f["g"])
+    assert np.abs(np.asarray(w["g"]) - expect).max() < 1e-12
+
+
+def test_field_view_writeback():
+    """u['g'][comp] = ... writes through to the field; derived arrays don't."""
+    cs, dist, shell = make_shell(np.float64, shape=(4, 3, 4))
+    u = dist.VectorField(cs, name="u", bases=shell)
+    u["g"][2] = 1.0
+    assert np.abs(np.asarray(u["g"])[2] - 1.0).max() < 1e-15
+    assert np.abs(np.asarray(u["g"])[0]).max() < 1e-15
+    t = dist.Field(name="t", bases=shell)
+    t["g"] = 3.0
+    w = t["g"] * 2
+    w[0] = 99.0
+    assert np.abs(np.asarray(t["g"]) - 3.0).max() < 1e-15
+    t["g"] += 1.0
+    assert np.abs(np.asarray(t["g"]) - 4.0).max() < 1e-15
+
+
+def test_shell_interpolation_and_components():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = x * y + z ** 2 + x + 3
+    phig, thetag = phi[:, :, 0], theta[:, :, 0]
+    for r0 in (RI, RO):
+        xo, yo, zo = xyz(phig, thetag, r0)
+        fo = f(r=r0).evaluate()["g"]
+        assert np.abs(fo[:, :, 0] - (xo * yo + zo ** 2 + xo + 3)).max() < 1e-11
+    u = d3.grad(f)
+    uo = u(r=RO).evaluate()
+    xo, yo, zo = xyz(phig, thetag, RO)
+    vp, vt, vr = cartesian_vector_to_spherical(phig, thetag, yo + 1, xo, 2 * zo)
+    assert np.abs(d3.radial(uo).evaluate()["g"][:, :, 0] - vr).max() < 1e-10
+    ang = d3.angular(uo).evaluate()["g"]
+    assert np.abs(ang[0][:, :, 0] - vp).max() < 1e-10
+    assert np.abs(ang[1][:, :, 0] - vt).max() < 1e-10
+
+
+def test_shell_integration():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = z ** 2 + 3 + x  # odd x integrates to zero
+    total = float(d3.integ(f).evaluate()["g"].ravel()[0])
+    exact = 4 * np.pi / 3 * ((RO ** 5 - RI ** 5) / 5 + 3 * (RO ** 3 - RI ** 3))
+    assert abs(total - exact) < 1e-11
+    ave = float(d3.ave(f).evaluate()["g"].ravel()[0])
+    assert abs(ave - exact / shell.volume) < 1e-12
+
+
+def test_shell_ncc_lhs_vs_rhs():
+    cs, dist, shell = make_shell(np.float64, shape=(8, 6, 10), dealias=3 / 2)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    ncc = dist.Field(name="ncc", bases=shell)
+    ncc["g"] = r ** 2 + 1 / r
+    v = dist.Field(name="v", bases=shell)
+    w = dist.Field(name="w", bases=shell)
+    problem = d3.LBVP([v], namespace=locals())
+    problem.add_equation("ncc*v = ncc*w")
+    w["g"] = x * z + r
+    problem.build_solver().solve()
+    assert np.abs(v["g"] - w["g"]).max() < 1e-12
+
+
+def test_shell_scalar_poisson_lbvp():
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    u = dist.Field(name="u", bases=shell)
+    t1 = dist.Field(name="t1", bases=shell.S2_basis(RO))
+    t2 = dist.Field(name="t2", bases=shell.S2_basis(RI))
+    six = dist.Field(name="six", bases=shell)
+    six["g"] = 6.0
+    lift_basis = shell.derivative_basis(2)
+    lift = lambda A, n: d3.Lift(A, lift_basis, n)
+    problem = d3.LBVP([u, t1, t2], namespace={**locals(), "RI": RI, "RO": RO})
+    problem.add_equation("lap(u) + lift(t1, -1) + lift(t2, -2) = six")
+    problem.add_equation("u(r=RI) = RI**2")
+    problem.add_equation("u(r=RO) = RO**2")
+    problem.build_solver().solve()
+    assert np.abs(u["g"] - r ** 2).max() < 1e-12
+
+
+def test_shell_vector_lbvp():
+    """lap(u) = 0 for u = grad(xyz) with exact boundary data."""
+    cs, dist, shell = make_shell(np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    h = dist.Field(name="h", bases=shell)
+    h["g"] = x * y * z
+    u_exact = d3.grad(h).evaluate()
+    u = dist.VectorField(cs, name="u", bases=shell)
+    tu1 = dist.VectorField(cs, name="tu1", bases=shell.S2_basis(RO))
+    tu2 = dist.VectorField(cs, name="tu2", bases=shell.S2_basis(RI))
+    bco = u_exact(r=RO).evaluate()
+    bci = u_exact(r=RI).evaluate()
+    lift_basis = shell.derivative_basis(2)
+    lift = lambda A, n: d3.Lift(A, lift_basis, n)
+    problem = d3.LBVP([u, tu1, tu2], namespace={**locals(), "RI": RI, "RO": RO})
+    problem.add_equation("lap(u) + lift(tu1, -1) + lift(tu2, -2) = 0")
+    problem.add_equation("u(r=RI) = bci")
+    problem.add_equation("u(r=RO) = bco")
+    problem.build_solver().solve()
+    assert np.abs(u["g"] - u_exact["g"]).max() < 1e-11
+
+
+def test_shell_diffusion_ivp():
+    cs, dist, shell = make_shell(np.float64, shape=(8, 6, 10), dealias=3 / 2)
+    phi, theta, r = dist.local_grids(shell)
+    x, y, z = xyz(phi, theta, r)
+    u = dist.Field(name="u", bases=shell)
+    t1 = dist.Field(name="t1", bases=shell.S2_basis(RO))
+    t2 = dist.Field(name="t2", bases=shell.S2_basis(RI))
+    lift_basis = shell.derivative_basis(2)
+    lift = lambda A, n: d3.Lift(A, lift_basis, n)
+    problem = d3.IVP([u, t1, t2], namespace={**locals(), "RI": RI, "RO": RO})
+    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+    problem.add_equation("u(r=RI) = 0")
+    problem.add_equation("u(r=RO) = 0")
+    solver = problem.build_solver(d3.RK222)
+    u["g"] = np.sin(np.pi * (r - RI)) * (1 + 0.3 * x / r)
+    E0 = float(d3.integ(u * u).evaluate()["g"].ravel()[0])
+    for _ in range(40):
+        solver.step(2e-3)
+    E1 = float(d3.integ(u * u).evaluate()["g"].ravel()[0])
+    assert np.isfinite(E1)
+    assert E1 < E0
+    assert np.abs(u(r=RI).evaluate()["g"]).max() < 1e-12
+    assert np.abs(u(r=RO).evaluate()["g"]).max() < 1e-12
