@@ -301,21 +301,22 @@ class ProductBase(Future):
                 return b
         return None
 
-    def _spherical_tensor_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
+    def _spherical_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
         """
         Pencil matrix for multiplication by a radially-directed,
-        angularly-constant tensor NCC (e.g. er, r*er) over a shell/ball
-        basis: per-(m, ell) group, kron of the Q-intertwined component
-        coupling with the radial multiplication matrix
-        (reference: core/arithmetic.py:559 Gamma machinery, restricted to
-        the radial-NCC case used by the shell/ball examples).
+        angularly-constant NCC (f(r), f(r)*er, f(r)*er*er, ...) over a
+        shell/ball basis: per-(m, ell) group, the Q-intertwined component
+        coupling kron'd with per-(ell, regularity) radial multiplication
+        matrices (reference: core/arithmetic.py:559 Gamma machinery +
+        core/basis.py:4101 ball NCC matrices, restricted to the radial-NCC
+        case used by the shell/ball examples).
         """
-        from .spherical3d import q_stack, spherical_rank
+        from .spherical3d import q_stack, spherical_rank, reg_totals
         basis = self._spherical_regularity_basis(operand)
         ncc_basis = self._spherical_regularity_basis(ncc)
         if basis is None or ncc_basis is None:
             raise NonlinearOperatorError(
-                "Tensor NCCs require shell/ball bases on both factors.")
+                "Curvilinear NCCs require shell/ball bases on both factors.")
         rank_n = spherical_rank(ncc.tensorsig, basis.cs)
         rank_in = spherical_rank(operand.tensorsig, basis.cs)
         ncomp_n = 3 ** rank_n
@@ -334,21 +335,22 @@ class ProductBase(Future):
             profile = flat[radial_flat]
             if np.abs(profile - profile[:1, :1, :]).max() > tol:
                 raise NonlinearOperatorError(
-                    "LHS tensor NCCs on spherical bases must be angularly "
-                    "constant.")
-            profile_coeffs = ncc_basis._radial_forward_matrix(1.0) @ profile[0, 0]
-            M_f = basis.radial_multiplication_matrix(profile_coeffs,
-                                                     ncc_basis.k, k_out=0)
-            cache = self._sph_ncc_cache = sparsify(M_f, 1e-12)
-        M_f = cache
-        # Component coupling at this group's ell: C = Q_out^T P Q_in with
-        # P placing the radial NCC slot.
+                    "LHS NCCs on spherical bases must be angularly constant.")
+            profile_coeffs = ncc_basis.scalar_radial_coeffs(profile[0, 0],
+                                                            l_env=rank_n)
+            cache = self._sph_ncc_cache = {"coeffs": profile_coeffs}
+        profile_coeffs = cache["coeffs"]
+
         layout = subproblem.layout
         az_axis = basis.first_axis
         colat_axis = az_axis + 1
         ell = subproblem.group[colat_axis]
         ncomp_in = 3 ** rank_in
         rank_out = rank_n + rank_in
+        totals_in = reg_totals(rank_in)
+        totals_out = reg_totals(rank_out)
+        # Component coupling at this ell: C = Q_out^T P Q_in with P placing
+        # the radial NCC slot in spin space.
         e_col = np.zeros((ncomp_n, 1))
         e_col[radial_flat, 0] = 1.0
         if ncc_index == 0:
@@ -359,9 +361,24 @@ class ProductBase(Future):
         Q_out = q_stack(basis.Ntheta, rank_out)[ell]
         C = Q_out.T @ P @ Q_in
         gs = layout.sep_widths[az_axis]
-        return sparse_kron(sparsify(C, 1e-12),
-                           sp.identity(gs, format="csr"),
-                           M_f)
+        I_gs = sp.identity(gs, format="csr")
+        Nr = basis.Nr
+        total = sp.csr_matrix((3 ** rank_out * gs * Nr, ncomp_in * gs * Nr))
+        for i in range(3 ** rank_out):
+            for j in range(ncomp_in):
+                if abs(C[i, j]) < 1e-12:
+                    continue
+                key = (int(totals_in[j]), int(totals_out[i]), int(ell))
+                M = cache.get(key)
+                if M is None:
+                    M = sparsify(basis.ncc_radial_matrix(
+                        profile_coeffs, ncc_basis.k, totals_in[j],
+                        totals_out[i], ell, k_out=0, l_env=rank_n), 1e-12)
+                    cache[key] = M
+                sel = sp.csr_matrix(
+                    (np.ones(1), ([i], [j])), shape=(3 ** rank_out, ncomp_in))
+                total = total + C[i, j] * sparse_kron(sel, I_gs, M)
+        return total
 
     def _assemble_ncc_matrix(self, subproblem, ncc, operand, tensor_factor_fn):
         """
@@ -415,9 +432,9 @@ class MultiplyFields(ProductBase):
 
     def expression_matrices(self, subproblem, vars, **kw):
         ncc_index, ncc, operand = self._split_ncc(vars)
-        if ncc.tensorsig and self._spherical_regularity_basis(ncc) is not None:
-            M = self._spherical_tensor_ncc_matrix(subproblem, ncc, operand,
-                                                  ncc_index)
+        if self._spherical_regularity_basis(ncc) is not None:
+            M = self._spherical_ncc_matrix(subproblem, ncc, operand,
+                                           ncc_index)
             op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
             return {var: M @ mat for var, mat in op_mats.items()}
         ncomp_op = int(np.prod([cs.dim for cs in operand.tensorsig], dtype=int)) \
@@ -538,7 +555,12 @@ class CrossProduct(Future):
         a, b = self.args
         da = ev(a, ctx, "g")
         db = ev(b, ctx, "g")
-        return jnp.cross(da, db, axisa=0, axisb=0, axisc=0)
+        out = jnp.cross(da, db, axisa=0, axisb=0, axisc=0)
+        # Left-handed component orderings (spherical (phi, theta, r)) flip
+        # the orientation (reference: core/coords.py right_handed flags).
+        if not getattr(a.tensorsig[-1], "right_handed", True):
+            out = -out
+        return out
 
 
 class Power(Future):

@@ -60,10 +60,11 @@ class _FieldDataView(np.ndarray):
         obj = np.asarray(arr).view(cls)
         obj._field = field
         obj._field_layout = layout
-        # Shared mutable cell tracking the field version this view mirrors:
-        # all slices of this view share it, so sequential writes through any
-        # of them stay valid while external field mutations invalidate all.
-        obj._view_version = [field._version]
+        # Shared mutable cell tracking the field data epoch this view
+        # mirrors: all slices of this view share it, so sequential writes
+        # through any of them stay valid while external data changes (user
+        # mutation OR solver updates) invalidate all.
+        obj._view_version = [field._data_epoch]
         return obj
 
     def __array_finalize__(self, obj):
@@ -87,41 +88,38 @@ class _FieldDataView(np.ndarray):
         field, layout = self._field, self._field_layout
         if field is None:
             return
-        if field._version != self._view_version[0]:
+        if field._data_epoch != self._view_version[0]:
             raise RuntimeError(
-                "Writing through a stale field data view: the field was "
-                "modified after this view was taken. Re-read the data "
-                f"(field['{layout}']) and apply the mutation to the fresh "
-                "view.")
+                "Writing through a stale field data view: the field's data "
+                "changed (user assignment or solver step) after this view "
+                f"was taken. Re-read the data (field['{layout}']) and apply "
+                "the mutation to the fresh view.")
         root = self
         while isinstance(root.base, np.ndarray):
             root = root.base
         field[layout] = np.asarray(root)
-        self._view_version[0] = field._version
+        self._view_version[0] = field._data_epoch
 
     def __setitem__(self, key, value):
         np.ndarray.__setitem__(self, key, value)
         self._writeback()
 
-    def __iadd__(self, other):
-        out = np.ndarray.__iadd__(self, other)
-        self._writeback()
-        return out
 
-    def __isub__(self, other):
-        out = np.ndarray.__isub__(self, other)
-        self._writeback()
-        return out
+def _inplace_with_writeback(name):
+    base_op = getattr(np.ndarray, name)
 
-    def __imul__(self, other):
-        out = np.ndarray.__imul__(self, other)
+    def op(self, other):
+        out = base_op(self, other)
         self._writeback()
         return out
+    op.__name__ = name
+    return op
 
-    def __itruediv__(self, other):
-        out = np.ndarray.__itruediv__(self, other)
-        self._writeback()
-        return out
+
+for _name in ("__iadd__", "__isub__", "__imul__", "__itruediv__",
+              "__ifloordiv__", "__imod__", "__ipow__", "__iand__",
+              "__ior__", "__ixor__", "__ilshift__", "__irshift__"):
+    setattr(_FieldDataView, _name, _inplace_with_writeback(_name))
 
 
 class Operand:
@@ -257,10 +255,13 @@ class Field(Operand):
         self.scales = dist.remedy_scales(1)
         self.layout = "c"
         self.data = jnp.zeros(self.coeff_shape, dtype=self.coeff_dtype)
-        # Solver synchronization: `_version` counts user mutations; `_pull`
+        # Solver synchronization: `_version` counts user mutations;
+        # `_data_epoch` counts ALL data changes (including solver updates,
+        # for data-view staleness detection); `_pull`
         # is a deferred fetch installed by solvers after a step so field data
         # is only scattered from the device state when actually accessed.
         self._version = 0
+        self._data_epoch = 0
         self._pull = None
 
     # ---- shapes & dtypes ----
@@ -358,6 +359,7 @@ class Field(Operand):
         # Only after validation: discard pending solver data, count mutation.
         self._pull = None
         self._version += 1
+        self._data_epoch += 1
         self.layout = new_layout
         self.data = data
 
@@ -369,13 +371,21 @@ class Field(Operand):
 
     def preset_coeff(self, array):
         """Install device coefficient data directly (solver scatter).
-        Does not count as a user mutation (no version bump); the grid-scale
-        selection is preserved (coefficient data is scale-independent)."""
+        Does not count as a user mutation (no version bump, but existing
+        data views become stale); the grid-scale selection is preserved
+        (coefficient data is scale-independent)."""
         self.data = array
         self.layout = "c"
+        self._data_epoch += 1
 
     def mark_modified(self):
         self._version += 1
+
+    def install_pull(self, pull):
+        """Install a lazy solver-data pull; any outstanding data views
+        become stale immediately (the field's data is now solver-owned)."""
+        self._pull = pull
+        self._data_epoch += 1
 
     # ---- utilities ----
 

@@ -1056,16 +1056,19 @@ def SkewFactory(operand):
 
 
 def Radial(operand, index=0):
+    if _spherical_cs(operand.tensorsig[index]):
+        from .spherical3d import SphericalComponent
+        return SphericalComponent(operand, "radial", index)
     if index != 0:
         raise NotImplementedError("Component extraction only supports index=0.")
-    if _spherical_cs(operand.tensorsig[0]):
-        from .spherical3d import SphericalComponent
-        return SphericalComponent(operand, "radial")
     from .polar import PolarComponent
     return PolarComponent(operand, "radial")
 
 
 def Azimuthal(operand, index=0):
+    if _spherical_cs(operand.tensorsig[index]):
+        from .spherical3d import SphericalComponent
+        return SphericalComponent(operand, "azimuthal", index)
     if index != 0:
         raise NotImplementedError("Component extraction only supports index=0.")
     from .polar import PolarComponent
@@ -1094,11 +1097,11 @@ def Trace(operand):
 
 
 def Angular(operand, index=0):
+    if _spherical_cs(operand.tensorsig[index]):
+        from .spherical3d import SphericalComponent
+        return SphericalComponent(operand, "angular", index)
     if index != 0:
         raise NotImplementedError("Component extraction only supports index=0.")
-    if _spherical_cs(operand.tensorsig[0]):
-        from .spherical3d import SphericalComponent
-        return SphericalComponent(operand, "angular")
     from .polar import PolarComponent
     return PolarComponent(operand, "azimuthal")
 

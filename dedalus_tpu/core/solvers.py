@@ -119,7 +119,7 @@ class SolverBase:
             return pull
 
         for v in variables:
-            v._pull = make_pull(v)
+            v.install_pull(make_pull(v))
 
     def snapshot_versions(self):
         self._field_versions = {v.name: v._version for v in self.variables}

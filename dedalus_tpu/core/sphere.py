@@ -165,8 +165,10 @@ class SphereBasis(SpinBasisMixin, Basis):
             mask[c] &= (ells >= self._lmin(m, s))[None, :]
         if self.complex and g == self.Nphi // 2:
             mask[:] = False  # Nyquist
-        if (not self.complex) and (not tensorsig) and m == 0:
-            mask[:, 1, :] = False  # minus-sin slot of m=0 for scalars
+        if (not self.complex) and len(tensorsig) <= 1:
+            # Drop msin slots at ell == 0 for real scalars and vectors; m == 0
+            # symmetry is NOT imposed at ell > 0 (reference: core/basis.py:3206)
+            mask[:, 1, ells == 0] = False
         return mask
 
     # ------------------------------------------- colatitude matrix stacks

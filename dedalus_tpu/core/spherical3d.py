@@ -32,6 +32,7 @@ from ..tools.cache import CachedMethod, cached_function
 from ..tools import jacobi as jacobi_tools
 from ..tools.array import match_precision
 from ..libraries import sphere as swsh
+from ..libraries import zernike
 from ..libraries.spin_intertwiners import (regularity_to_spin,
                                            valid_regularities)
 from .basis import Basis, AffineCOV
@@ -247,8 +248,10 @@ class ShellBasis(WeightedJacobiRadial, Basis):
                                (ncomp, gs, 1, self.Nr)).copy()
         if self.complex and group[az_axis] == self.Nphi // 2:
             mask[:] = False  # Nyquist
-        if (not self.complex) and (not tensorsig) and m == 0:
-            mask[:, 1, :, :] = False  # minus-sin slot of m=0 for scalars
+        if (not self.complex) and rank <= 1 and ell == 0:
+            # Drop msin slots at ell == 0 for real scalars and vectors
+            # (reference: core/basis.py:4301)
+            mask[:, 1, :, :] = False
         return mask
 
     # ----------------------------------------------------------- transforms
@@ -328,6 +331,24 @@ class ShellBasis(WeightedJacobiRadial, Basis):
         col[index, 0] = 1.0
         return col
 
+    @CachedMethod
+    def interp_stack(self, regtotal, position):
+        """(Ntheta, 1, Nr): boundary evaluation rows (ell-independent on the
+        shell; per-ell on the ball)."""
+        return np.tile(self.radial_interpolation_row(position),
+                       (self.Ntheta, 1, 1))
+
+    def scalar_radial_coeffs(self, profile_grid_values, l_env=0):
+        """Level-k radial coefficients of a radial profile on the scale-1
+        grid (the envelope degree is irrelevant on the shell)."""
+        return self._radial_forward_matrix(1.0) @ profile_grid_values
+
+    def ncc_radial_matrix(self, f_radial_coeffs, f_k, R_in, R_out, ell,
+                          k_out=0, l_env=0):
+        """Radial NCC multiplication on the shell is independent of ell and
+        regularity (no origin singularity): one quadrature matrix."""
+        return self.radial_multiplication_matrix(f_radial_coeffs, f_k, k_out)
+
     @property
     def constant_angular_mode_value(self):
         """Grid value of the lowest angular mode (Y_00 for SWSH): the factor
@@ -368,6 +389,459 @@ class ShellBasis(WeightedJacobiRadial, Basis):
             raise ValueError("Cannot convert to lower k.")
         r_axis = self.first_axis + 2
         return [(None, {r_axis: ("full", self._conversion_matrix_total(dk))})]
+
+
+# ----------------------------------------------------------------------
+# Ball basis
+
+class BallBasis(Basis):
+    """
+    Solid-ball basis: SWSH angular x generalized-Zernike radius
+    (reference: dedalus/core/basis.py:4568 BallBasis, :3920 BallRadialBasis).
+
+    TPU-native design mirrors ShellBasis, with two differences rooted in the
+    origin regularity:
+      * each regularity component expands in Zernike polynomials at
+        generalized degree l = ell + regtotal, so the radial transforms and
+        operator matrices are (Ntheta, Nr, Nr) stacks over the ell groups
+        applied as ONE batched matmul (the reference loops per ell:
+        core/transforms.py:1451 BallRadialTransform);
+      * triangular truncation: radial slot n at harmonic degree ell is valid
+        for n >= nmin(ell) = ell // 2, enforced as masking on rectangular
+        arrays (reference: core/basis.py:4086 _nmin).
+    """
+
+    dim = 3
+    radial_sub_axis = 2
+    regularity = True
+
+    def __init__(self, coordsystem, shape, dtype=np.float64, radius=1.0,
+                 k=0, alpha=0, dealias=(1, 1, 1), azimuth_library=None,
+                 colatitude_library=None, radius_library=None):
+        if not isinstance(coordsystem, SphericalCoordinates):
+            raise ValueError("Ball coordsys must be SphericalCoordinates.")
+        self.coordsystem = self.cs = coordsystem
+        self.coord = coordsystem.coords[0]
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.radius = float(radius)
+        self.k = int(k)
+        self.alpha = float(alpha)
+        if np.isscalar(dealias):
+            dealias = (dealias,) * 3
+        self.dealias = tuple(map(float, dealias))
+        self.volume = 4 / 3 * np.pi * radius ** 3
+        self.radial_COV = AffineCOV((0.0, 1.0), (0.0, radius))
+        Nphi, Ntheta, Nr = self.shape
+        self.Nphi, self.Ntheta, self.Nr = Nphi, Ntheta, Nr
+        self.Lmax = Ntheta - 1
+        self.complex = is_complex_dtype(self.dtype)
+        self.sphere_basis = SphereBasis(
+            coordsystem.S2coordsys, (Nphi, Ntheta), dtype=dtype,
+            radius=radius, dealias=self.dealias[:2],
+            azimuth_library=azimuth_library,
+            colatitude_library=colatitude_library)
+        self.azimuth_basis = self.sphere_basis.azimuth_basis
+        self.radius_library = radius_library
+        self.surface = self.S2_basis(radius)
+
+    def __repr__(self):
+        return f"BallBasis({self.shape}, radius={self.radius}, k={self.k})"
+
+    def S2_basis(self, radius=None):
+        if radius is None:
+            radius = self.radius
+        return SphereBasis(
+            self.coordsystem.S2coordsys, (self.Nphi, self.Ntheta),
+            dtype=self.dtype, radius=radius, dealias=self.dealias[:2])
+
+    # ------------------------------------------------------------ structure
+
+    @property
+    def first_axis(self):
+        return self.coordsystem.first_axis
+
+    @property
+    def family_key(self):
+        return (type(self).__name__, self.shape, self.radius, self.alpha,
+                self.dtype)
+
+    @property
+    def a_k(self):
+        """Absolute Zernike weight parameter."""
+        return self.alpha + self.k
+
+    @staticmethod
+    def _nmin(ell):
+        return int(ell) // 2
+
+    def coeff_size(self, sub_axis):
+        return self.shape[sub_axis]
+
+    def sub_grid_size(self, sub_axis, scale):
+        return int(np.ceil(scale * self.shape[sub_axis]))
+
+    def sub_separable(self, sub_axis):
+        return sub_axis in (0, 1)
+
+    def sub_group_shape(self, sub_axis):
+        if sub_axis == 0:
+            return 1 if self.complex else 2
+        return 1
+
+    def sub_n_groups(self, sub_axis):
+        if sub_axis == 0:
+            return self.Nphi if self.complex else self.Nphi // 2
+        if sub_axis == 1:
+            return self.Ntheta
+        return 1
+
+    def group_m(self):
+        return self.sphere_basis.group_m()
+
+    def clone_with(self, **changes):
+        args = dict(coordsystem=self.coordsystem, shape=self.shape,
+                    dtype=self.dtype, radius=self.radius, k=self.k,
+                    alpha=self.alpha, dealias=self.dealias)
+        args.update(changes)
+        return BallBasis(**args)
+
+    def derivative_basis(self, order=1):
+        return self.clone_with(k=self.k + order)
+
+    # --------------------------------------------------------------- grids
+
+    def radial_grid(self, scale=1.0):
+        Ng = self.sub_grid_size(2, scale)
+        return self.radius * zernike.grid(3, Ng, self.alpha)
+
+    def global_grids(self, scales=(1, 1, 1)):
+        return (self.sphere_basis.azimuth_grid(scales[0]),
+                self.sphere_basis.colatitude_grid(scales[1]),
+                self.radial_grid(scales[2]))
+
+    # ---------------------------------------------------------- validity
+
+    def component_valid_mask(self, tensorsig, group, sep_widths):
+        """(ncomp, gs_az, 1, Nr): regularity validity at (m, ell) plus the
+        radial triangular truncation n >= nmin(ell)."""
+        rank = spherical_rank(tensorsig, self.cs)
+        ncomp = 3 ** rank
+        az_axis = self.first_axis
+        colat_axis = az_axis + 1
+        gs = self.sub_group_shape(0)
+        if az_axis not in sep_widths or colat_axis not in sep_widths:
+            raise NotImplementedError(
+                "Ball angular axes must be pencil (group) axes.")
+        ms = self.group_m()
+        m = ms[group[az_axis]]
+        ell = group[colat_axis]
+        comp_ok = valid_regularities(ell, rank) & (ell >= abs(m))
+        n = np.arange(self.Nr)
+        n_ok = n >= self._nmin(ell)
+        mask = comp_ok[:, None, None, None] & n_ok[None, None, None, :]
+        mask = np.broadcast_to(mask, (ncomp, gs, 1, self.Nr)).copy()
+        if self.complex and group[az_axis] == self.Nphi // 2:
+            mask[:] = False  # Nyquist
+        if (not self.complex) and rank <= 1 and ell == 0:
+            # Drop msin slots at ell == 0 for real scalars and vectors
+            # (reference: core/basis.py:4301)
+            mask[:, 1, :, :] = False
+        return mask
+
+    # ------------------------------------------------- radial matrix stacks
+    # (Ntheta, rows, cols) stacks over the ell groups; slot dimensions are
+    # right-aligned at nmin(ell).
+
+    def _build_ell_stack(self, build, rows, cols, align_rows=True,
+                         align_cols=True):
+        out = np.zeros((self.Ntheta, rows, cols))
+        for ell in range(self.Ntheta):
+            nmin = self._nmin(ell)
+            n = self.Nr - nmin
+            if n <= 0:
+                continue
+            mat = build(ell, n)
+            if mat.size == 0:
+                continue
+            r0 = nmin if align_rows else 0
+            c0 = nmin if align_cols else 0
+            out[ell, r0:r0 + mat.shape[0], c0:c0 + mat.shape[1]] = mat
+        return out
+
+    @CachedMethod
+    def radial_forward_stack(self, regtotal, scale=1.0):
+        """(Ntheta, Nr, Ngr): grid -> aligned Zernike coefficients at
+        l = ell + regtotal (reference: core/transforms.py:1451)."""
+        Ngr = self.sub_grid_size(2, scale)
+        z, w = zernike.quadrature(3, Ngr, self.alpha)
+        extra = ((1 - z) / 2) ** self.k if self.k else 1.0
+
+        def build(ell, n):
+            l = ell + int(regtotal)
+            if l < 0:
+                return np.zeros((n, Ngr))
+            Q = zernike.polynomials(3, n, self.a_k, l, z)
+            Q = Q * w * extra
+            dN = l // 2
+            Q[max(Ngr - dN, 0):] = 0
+            return Q
+        return self._build_ell_stack(build, self.Nr, Ngr, align_cols=False)
+
+    @CachedMethod
+    def radial_backward_stack(self, regtotal, scale=1.0):
+        """(Ntheta, Ngr, Nr): coefficients -> grid values."""
+        Ngr = self.sub_grid_size(2, scale)
+        z, _ = zernike.quadrature(3, Ngr, self.alpha)
+
+        def build(ell, n):
+            l = ell + int(regtotal)
+            if l < 0:
+                return np.zeros((Ngr, n))
+            Q = zernike.polynomials(3, n, self.a_k, l, z)
+            dN = l // 2
+            Q[max(Ngr - dN, 0):] = 0
+            return Q.T
+        return self._build_ell_stack(build, Ngr, self.Nr, align_rows=False)
+
+    @CachedMethod
+    def dplus_stack(self, regtotal):
+        """D+ = d/dr - l/r at l = ell + regtotal, k -> k+1, problem units."""
+        def build(ell, n):
+            l = ell + int(regtotal)
+            if l < 0:
+                return np.zeros((n, n))
+            M = zernike.ladder_matrix(3, n, self.a_k, l, l + 1, l, +1)
+            return np.sqrt(2) * M / self.radius
+        return self._build_ell_stack(build, self.Nr, self.Nr)
+
+    @CachedMethod
+    def dminus_stack(self, regtotal):
+        """D- = d/dr + (l+1)/r at l = ell + regtotal, k -> k+1."""
+        def build(ell, n):
+            l = ell + int(regtotal)
+            if l < 1:
+                # l = 0: D- output degree -1 does not exist
+                return np.zeros((n, n))
+            M = zernike.ladder_matrix(3, n, self.a_k, l, l - 1, -(l + 1), +1)
+            return np.sqrt(2) * M / self.radius
+        return self._build_ell_stack(build, self.Nr, self.Nr)
+
+    @CachedMethod
+    def laplacian_reg_stack(self, regtotal):
+        """L = D-(l+1) @ D+(l), k -> k+2."""
+        up = self.dplus_stack(regtotal)
+        k1 = self.clone_with(k=self.k + 1)
+
+        def build_down(ell, n):
+            l = ell + int(regtotal)
+            if l < 0:
+                return np.zeros((n, n))
+            M = zernike.ladder_matrix(3, n, k1.a_k, l + 1, l, -(l + 2), +1)
+            return np.sqrt(2) * M / self.radius
+        down = self._build_ell_stack(build_down, self.Nr, self.Nr)
+        return np.einsum("gij,gjk->gik", down, up)
+
+    @CachedMethod
+    def interp_stack(self, regtotal, position):
+        """(Ntheta, 1, Nr): evaluate regtotal components at problem radius
+        `position`."""
+        r0 = self.radial_COV.native_coord(position)
+
+        def build(ell, n):
+            l = ell + int(regtotal)
+            if l < 0:
+                return np.zeros((1, n))
+            return zernike.interpolation_row(3, n, self.a_k, l, r0)
+        return self._build_ell_stack(build, 1, self.Nr, align_rows=False)
+
+    def lift_column(self, index):
+        col = np.zeros((self.Nr, 1))
+        col[index, 0] = 1.0
+        return col
+
+    @property
+    def constant_angular_mode_value(self):
+        return float(swsh.harmonics(self.Lmax, 0, 0, np.array([0.5]))[0, 0])
+
+    @CachedMethod
+    def radial_integration_row(self, power=2):
+        """(1, Nr): integral against r^power dr for the (m=0, ell=0,
+        regtotal=0) group, in problem units. Gauss-Jacobi with the r^(power-1)
+        envelope folded into the weight, exact for any power > 0."""
+        if power == 2:
+            row = zernike.integration_row(3, self.Nr, self.a_k, 0)
+        else:
+            # int_0^1 Q_n(r) r^p dr = (1/4) int Q_n(z) ((1+z)/2)^((p-1)/2) dz
+            b_env = (power - 1) / 2
+            Nq = self.Nr + self.k + 4
+            z = jacobi_tools.build_grid(Nq, 0, b_env)
+            w = jacobi_tools.build_weights(Nq, 0, b_env)
+            Q = zernike.polynomials(3, self.Nr, self.a_k, 0, z)
+            row = ((Q * w) @ np.ones(Nq))[None, :] / 4
+        return row * self.radius ** (power + 1)
+
+    def radial_constant_column(self):
+        """(Nr, 1): level-k coefficients of the constant 1 at l = 0."""
+        Ngr = self.Nr + self.k + 2
+        z, w = zernike.quadrature(3, Ngr, self.alpha)
+        extra = ((1 - z) / 2) ** self.k if self.k else 1.0
+        Q = zernike.polynomials(3, self.Nr, self.a_k, 0, z)
+        col = (Q * w * extra) @ np.ones(Ngr)
+        return col[:, None]
+
+    def constant_component_descr(self, sub_axis, device):
+        if sub_axis == 0:
+            if device:
+                col = np.zeros((self.Nphi, 1))
+                col[0, 0] = 1.0
+                return ("full", col)
+            return ("blocks", self.azimuth_basis.constant_blocks())
+        if sub_axis == 1:
+            Y00 = self.constant_angular_mode_value
+            col = np.zeros((self.Ntheta, 1))
+            col[0, 0] = 1.0 / Y00
+            if device:
+                return ("full", col)
+            blocks = np.zeros((self.Ntheta, 1, 1))
+            blocks[0, 0, 0] = 1.0 / Y00
+            return ("blocks", blocks)
+        return ("full", self.radial_constant_column())
+
+    # ----------------------------------------------------------- transforms
+
+    def forward_transform(self, gdata, axis, scale, library=None,
+                          tensorsig=(), sub_axis=0):
+        if sub_axis in (0, 1):
+            return self.sphere_basis.forward_transform(
+                gdata, axis, scale, library, tensorsig=tensorsig,
+                sub_axis=sub_axis)
+        tdim = len(tensorsig)
+        rank = spherical_rank(tensorsig, self.cs)
+        out = gdata
+        if rank:
+            stack = q_stack(self.Ntheta, rank)
+            out = apply_regularity_recombination(out, tdim, axis - 1, stack,
+                                                 forward=True)
+        return self._radial_reg_apply(out, tdim, axis, rank, scale,
+                                      forward=True)
+
+    def backward_transform(self, cdata, axis, scale, library=None,
+                           tensorsig=(), sub_axis=0):
+        if sub_axis in (0, 1):
+            return self.sphere_basis.backward_transform(
+                cdata, axis, scale, library, tensorsig=tensorsig,
+                sub_axis=sub_axis)
+        tdim = len(tensorsig)
+        rank = spherical_rank(tensorsig, self.cs)
+        out = self._radial_reg_apply(cdata, tdim, axis, rank, scale,
+                                     forward=False)
+        if rank:
+            stack = q_stack(self.Ntheta, rank)
+            out = apply_regularity_recombination(out, tdim, axis - 1, stack,
+                                                 forward=False)
+        return out
+
+    def _radial_reg_apply(self, data, tdim, r_axis, rank, scale, forward):
+        """Apply per-regtotal radial stacks, batched over the ell axis
+        (group axis = colatitude, width 1)."""
+        from .curvilinear import apply_group_stack
+        totals = reg_totals(rank)
+        ncomp = 3 ** rank
+        tshape = data.shape[:tdim]
+        flat = data.reshape((ncomp,) + data.shape[tdim:])
+        colat_axis = r_axis - 1
+        pieces = [None] * ncomp
+        for R in np.unique(totals):
+            if forward:
+                stack = self.radial_forward_stack(int(R), scale)
+            else:
+                stack = self.radial_backward_stack(int(R), scale)
+            idx = np.flatnonzero(totals == R)
+            sub = flat[idx]
+            sub = apply_group_stack(sub, stack, 1 + colat_axis - tdim,
+                                    1 + r_axis - tdim, 1)
+            for j, i in enumerate(idx):
+                pieces[i] = sub[j]
+        out = jnp.stack(pieces, axis=0) if ncomp > 1 else pieces[0][None]
+        return out.reshape(tshape + out.shape[1:])
+
+    # ---------------------------------------------------- conversion terms
+
+    def conversion_terms(self, target, tensorsig, tshape):
+        """k -> k+dk conversion: per-(ell, regtotal) Zernike connection
+        stacks (reference: core/basis.py:4057 conversion_matrix)."""
+        if not isinstance(target, BallBasis) or target.shape != self.shape \
+                or target.radius != self.radius:
+            raise ValueError(f"No conversion from {self} to {target}.")
+        dk = target.k - self.k
+        if dk == 0:
+            return [(None, {})]
+        if dk < 0:
+            raise ValueError("Cannot convert to lower k.")
+        rank = spherical_rank(tensorsig, self.cs)
+        totals = reg_totals(rank)
+        ncomp = 3 ** rank
+        colat = self.first_axis + 1
+        r_axis = self.first_axis + 2
+        terms = []
+        for R in np.unique(totals):
+            sel = np.diag((totals == R).astype(float)) if ncomp > 1 else None
+            stack = self.conversion_reg_stack(int(R), int(dk))
+            terms.append((sel, {r_axis: ("gblocks", colat, stack)}))
+        return terms
+
+    @CachedMethod
+    def conversion_reg_stack(self, regtotal, dk):
+        def build(ell, n):
+            l = ell + int(regtotal)
+            if l < 0:
+                return np.zeros((n, n))
+            M = np.eye(n)
+            for dki in range(dk):
+                M = zernike.conversion_matrix(3, n, self.a_k + dki, l) @ M
+            return M
+        return self._build_ell_stack(build, self.Nr, self.Nr)
+
+    # ------------------------------------------------------- NCC products
+
+    def scalar_radial_coeffs(self, profile_grid_values, l_env=0):
+        """Project a radial profile (on the scale-1 grid) onto Zernike
+        coefficients at envelope degree l_env (the all-radial component of a
+        rank-r NCC carries an r^r envelope, so odd profiles like r*er stay
+        exact; reference: core/basis.py:4110 b_ncc = regtotal + 1/2)."""
+        profile = np.asarray(profile_grid_values, dtype=np.float64)
+        Ngr = profile.shape[-1]
+        z, w = zernike.quadrature(3, Ngr, self.alpha)
+        extra = ((1 - z) / 2) ** self.k if self.k else 1.0
+        Q = zernike.polynomials(3, self.Nr, self.a_k, l_env, z)
+        return (Q * (w * extra)) @ profile
+
+    def ncc_radial_matrix(self, f_radial_coeffs, f_k, R_in, R_out, ell,
+                          k_out=0, l_env=0):
+        """(Nr, Nr): per-(ell, regularity) multiplication by the radial NCC
+        with level-f_k l=0 coefficients, mapping regtotal R_in components at
+        harmonic ell to R_out components at level k_out
+        (reference: core/basis.py:4101 _last_axis_component_ncc_matrix)."""
+        nmin = self._nmin(ell)
+        n = self.Nr - nmin
+        l_in = ell + int(R_in)
+        l_out = ell + int(R_out)
+        if n <= 0 or l_in < 0 or l_out < 0:
+            return np.zeros((self.Nr, self.Nr))
+        f_coeffs = np.asarray(f_radial_coeffs, dtype=np.float64)
+        Nf = f_coeffs.shape[-1]
+        a_f = self.alpha + f_k
+
+        def values(z):
+            fvals = f_coeffs @ zernike.polynomials(3, Nf, a_f, l_env, z)
+            return fvals * zernike.polynomials(3, n, self.a_k, l_in, z)
+
+        M = zernike._project(3, n, self.alpha + k_out, l_out, values, n,
+                             extra=Nf + 16)
+        out = np.zeros((self.Nr, self.Nr))
+        out[nmin:, nmin:] = M
+        return out
 
 
 # ----------------------------------------------------------------------
@@ -712,8 +1186,8 @@ class SphericalInterpolate(SphericalEllOperator):
         az, colat, rad = self._axes(basis)
         rank = spherical_rank(operand.tensorsig, basis.cs)
         ncomp = 3 ** rank
+        totals = reg_totals(rank)
         dim = operand.domain.dim
-        row = basis.radial_interpolation_row(self.position)
         Q = q_stack(basis.Ntheta, rank)  # (L, ncomp, ncomp) reg->spin
         terms = []
         for i in range(ncomp):
@@ -722,10 +1196,11 @@ class SphericalInterpolate(SphericalEllOperator):
                     continue
                 factor = np.zeros((ncomp, ncomp))
                 factor[i, j] = 1.0
-                blocks = Q[:, i, j].reshape(-1, 1, 1)
+                # fold the per-ell Q scalar into the per-ell radial rows
+                rows = basis.interp_stack(int(totals[j]), self.position)
+                stack = Q[:, i, j, None, None] * rows
                 descrs = [None] * dim
-                descrs[colat] = ("blocks", blocks)
-                descrs[rad] = ("full", row)
+                descrs[rad] = ("gblocks", colat, stack)
                 terms.append((factor if ncomp > 1 else None, descrs))
         return terms
 
@@ -853,17 +1328,16 @@ class SphericalComponent(LinearOperator):
     name = "Comp"
 
     def __init__(self, operand, which, index=0):
-        if index != 0:
-            raise NotImplementedError("Component extraction only on index 0.")
         self.which = which  # 'radial' | 'angular'
+        self.index = index
         super().__init__(operand)
 
     def rebuild(self, new_args):
-        return SphericalComponent(new_args[0], self.which)
+        return SphericalComponent(new_args[0], self.which, self.index)
 
     def _build_metadata(self):
         operand = self.args[0]
-        cs = operand.tensorsig[0]
+        cs = operand.tensorsig[self.index]
         if not isinstance(cs, SphericalCoordinates):
             raise ValueError("Component extraction needs a spherical index.")
         for b in operand.domain.bases:
@@ -874,24 +1348,51 @@ class SphericalComponent(LinearOperator):
                     "apply it to boundary (S2) fields or on the RHS.")
         self.cs = cs
         self.domain = operand.domain
-        if self.which == "radial":
-            self.tensorsig = tuple(operand.tensorsig[1:])
+        ts = list(operand.tensorsig)
+        if self.which in ("radial", "azimuthal"):
+            ts.pop(self.index)
         else:
-            self.tensorsig = (cs.S2coordsys,) + tuple(operand.tensorsig[1:])
+            ts[self.index] = cs.S2coordsys
+        self.tensorsig = tuple(ts)
         self.dtype = operand.dtype
 
     def _factor(self):
-        rest = int(np.prod([c.dim for c in self.operand.tensorsig[1:]],
-                           dtype=int)) if self.operand.tensorsig[1:] else 1
+        before = int(np.prod([c.dim for c in self.operand.tensorsig[:self.index]],
+                             dtype=int)) if self.index else 1
+        after_sig = self.operand.tensorsig[self.index + 1:]
+        after = int(np.prod([c.dim for c in after_sig], dtype=int)) \
+            if after_sig else 1
         if self.which == "radial":
             row = np.array([[0.0, 0.0, 1.0]])  # spin/coordinate index 2
         else:
             row = np.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
-        return np.kron(row, np.identity(rest))
+        return np.kron(np.kron(np.identity(before), row), np.identity(after))
 
     def terms(self):
+        if self.which == "azimuthal":
+            # u_phi alone is not a smooth spin-weighted scalar: spin-(+-1)
+            # SWSH coefficients cannot map to scalar SWSH coefficients with
+            # a constant matrix. Grid-space (RHS) use only.
+            raise ValueError(
+                "Azimuthal extraction on spherical fields has no "
+                "coefficient-space matrix; use angular()/radial() in "
+                "boundary conditions, or azimuthal() on the RHS.")
         dim = self.operand.domain.dim
         return [(self._factor(), [None] * dim)]
+
+    def ev_impl(self, ctx):
+        if self.which == "azimuthal":
+            # NOTE: u_phi of a smooth vector is not a smooth scalar on S2;
+            # storing the result in a scalar field projects it onto scalar
+            # SWSH with only algebraic convergence. Pointwise use only.
+            data = ev(self.operand, ctx, "g")
+            index = [slice(None)] * self.index + [0]
+            return data[tuple(index)]
+        return super().ev_impl(ctx)
+
+    @property
+    def natural_layout(self):
+        return "g" if self.which == "azimuthal" else "c"
 
 
 # ----------------------------------------------------------------------

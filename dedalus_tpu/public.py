@@ -11,7 +11,7 @@ from .core.basis import (Jacobi, ChebyshevT, ChebyshevU, ChebyshevV, Legendre,
                          Ultraspherical, RealFourier, ComplexFourier, Fourier)
 from .core.polar import DiskBasis, AnnulusBasis
 from .core.sphere import SphereBasis, MulCosine
-from .core.spherical3d import ShellBasis
+from .core.spherical3d import ShellBasis, BallBasis
 from .core.field import Field, LockedField
 from .core.problems import IVP, LBVP, NLBVP, EVP
 from .core.operators import (
@@ -30,6 +30,9 @@ from .core.evaluator import Evaluator
 from .extras.flow_tools import CFL, GlobalFlowProperty, GlobalArrayReducer
 
 # lowercase operator aliases (reference: core/operators.py aliases)
+cross = CrossProduct
+dot = DotProduct
+trans = TransposeComponents
 grad = Gradient
 div = Divergence
 lap = Laplacian

@@ -1,0 +1,82 @@
+"""
+Internally-heated Boussinesq convection in a full ball with stress-free
+boundary conditions (reference example:
+examples/ivp_ball_internally_heated_convection/internally_heated_convection.py).
+
+Run directly: python examples/internally_heated_convection.py [--quick]
+"""
+
+import sys
+import logging
+import numpy as np
+
+import dedalus_tpu.public as d3
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger(__name__)
+
+# Parameters (reference: internally_heated_convection.py:44-52; reduced size)
+quick = "--quick" in sys.argv
+Nphi, Ntheta, Nr = (16, 8, 12) if quick else (64, 32, 48)
+Rayleigh = 1e4
+Prandtl = 1
+dealias = 3 / 2
+stop_iteration = 20 if quick else 400
+timestep = 0.01
+dtype = np.float64
+
+# Bases
+coords = d3.SphericalCoordinates("phi", "theta", "r")
+dist = d3.Distributor(coords, dtype=dtype)
+ball = d3.BallBasis(coords, shape=(Nphi, Ntheta, Nr), radius=1,
+                    dealias=dealias, dtype=dtype)
+sphere = ball.surface
+
+# Fields
+u = dist.VectorField(coords, name="u", bases=ball)
+p = dist.Field(name="p", bases=ball)
+T = dist.Field(name="T", bases=ball)
+tau_p = dist.Field(name="tau_p")
+tau_u = dist.VectorField(coords, name="tau_u", bases=sphere)
+tau_T = dist.Field(name="tau_T", bases=sphere)
+
+# Substitutions
+phi, theta, r = dist.local_grids(ball)
+r_vec = dist.VectorField(coords, name="r_vec", bases=ball)
+r_vec["g"][2] = np.broadcast_to(np.asarray(r), np.asarray(r_vec["g"])[2].shape)
+T_source = 6
+kappa = (Rayleigh * Prandtl) ** (-1 / 2)
+nu = (Rayleigh / Prandtl) ** (-1 / 2)
+lift = lambda A: d3.Lift(A, ball, -1)
+strain_rate = d3.grad(u) + d3.trans(d3.grad(u))
+shear_stress = d3.angular(d3.radial(strain_rate(r=1), index=1))
+
+# Problem (reference: internally_heated_convection.py:79-88)
+problem = d3.IVP([p, u, T, tau_p, tau_u, tau_T], namespace=locals())
+problem.add_equation("div(u) + tau_p = 0")
+problem.add_equation("dt(u) - nu*lap(u) + grad(p) - r_vec*T + lift(tau_u) = - cross(curl(u),u)")
+problem.add_equation("dt(T) - kappa*lap(T) + lift(tau_T) = - u@grad(T) + kappa*T_source")
+problem.add_equation("shear_stress = 0")  # stress free
+problem.add_equation("radial(u(r=1)) = 0")  # no penetration
+problem.add_equation("T(r=1) = 0")
+problem.add_equation("integ(p) = 0")  # pressure gauge
+
+# Solver
+solver = problem.build_solver(d3.SBDF2)
+solver.stop_iteration = stop_iteration
+
+# Initial conditions
+T.fill_random("g", seed=42, distribution="normal", scale=0.01)
+T["g"] += 1 - np.asarray(r) ** 2  # conductive profile for T_source = 6
+
+# Main loop
+flow = d3.GlobalFlowProperty(solver, cadence=10)
+flow.add_property(u @ u, name="u2")
+try:
+    while solver.proceed:
+        solver.step(timestep)
+        if solver.iteration % 10 == 0:
+            logger.info(f"Iteration={solver.iteration}, Time={solver.sim_time:.3f}, "
+                        f"max(u2)={flow.max('u2'):.3e}")
+finally:
+    solver.log_stats()

@@ -1,0 +1,242 @@
+"""
+Ball basis tests: transforms, regularity calculus, NCCs, LBVPs, diffusion
+eigenvalue, and the stress-free boundary-condition machinery
+(reference patterns: dedalus/tests/test_transforms.py,
+tests/test_spherical_calculus.py, tests/test_ivp.py:56 ball diffusion,
+tests/ball_diffusion_analytical_eigenvalues.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+R = 1.5
+
+
+def make_ball(dtype, shape=(12, 8, 10), radius=R, dealias=1):
+    cs = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(cs, dtype=dtype)
+    ball = d3.BallBasis(cs, shape=shape, dtype=dtype, radius=radius,
+                        dealias=dealias)
+    return cs, dist, ball
+
+
+def xyz(phi, theta, r):
+    return (r * np.sin(theta) * np.cos(phi),
+            r * np.sin(theta) * np.sin(phi),
+            r * np.cos(theta))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_ball_scalar_roundtrip(dtype):
+    cs, dist, ball = make_ball(dtype)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=ball)
+    f["g"] = x * y + z ** 2 + x + 3
+    g0 = np.array(f["g"])
+    f["c"] = f["c"]
+    assert np.abs(f["g"] - g0).max() < 1e-12
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_ball_vector_roundtrip(dtype):
+    cs, dist, ball = make_ball(dtype)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=ball)
+    f["g"] = x * y * z + z ** 3 + x
+    u = d3.grad(f).evaluate()
+    g0 = np.array(u["g"])
+    u["c"] = u["c"]
+    assert np.abs(u["g"] - g0).max() < 1e-11
+
+
+def test_ball_calculus():
+    cs, dist, ball = make_ball(np.float64)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=ball)
+    f["g"] = x * y + z ** 2 + x + 3
+    assert np.abs(d3.lap(f).evaluate()["g"] - 2.0).max() < 1e-9
+    assert np.abs(d3.div(d3.grad(f)).evaluate()["g"] - 2.0).max() < 1e-9
+    assert np.abs(d3.curl(d3.grad(f)).evaluate()["g"]).max() < 1e-9
+    # curl of rigid rotation u = z_hat x r is 2 z_hat
+    vxc, vyc, vzc = -y, x, 0 * z
+    u = dist.VectorField(cs, name="u", bases=ball)
+    u["g"] = np.array([
+        -np.sin(phi) * vxc + np.cos(phi) * vyc,
+        np.cos(theta) * np.cos(phi) * vxc + np.cos(theta) * np.sin(phi) * vyc
+        - np.sin(theta) * vzc,
+        np.sin(theta) * np.cos(phi) * vxc + np.sin(theta) * np.sin(phi) * vyc
+        + np.cos(theta) * vzc])
+    c = d3.curl(u).evaluate()["g"]
+    expect_theta = -np.sin(theta) * 2 + 0 * x
+    expect_r = np.cos(theta) * 2 + 0 * x
+    assert np.abs(c[0]).max() < 1e-10
+    assert np.abs(c[1] - expect_theta).max() < 1e-10
+    assert np.abs(c[2] - expect_r).max() < 1e-10
+
+
+def test_ball_cross_product_orientation():
+    """cross() respects the left-handed (phi, theta, r) component ordering."""
+    cs, dist, ball = make_ball(np.float64)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    # u = x_hat, v = y_hat -> u x v = z_hat
+    zero = 0 * (phi + theta + r)
+    u = dist.VectorField(cs, name="u", bases=ball)
+    v = dist.VectorField(cs, name="v", bases=ball)
+    u["g"] = np.array([-np.sin(phi) + zero,
+                       np.cos(theta) * np.cos(phi) + zero,
+                       np.sin(theta) * np.cos(phi) + zero])
+    v["g"] = np.array([np.cos(phi) + zero,
+                       np.cos(theta) * np.sin(phi) + zero,
+                       np.sin(theta) * np.sin(phi) + zero])
+    w = d3.cross(u, v).evaluate()["g"]
+    expect = np.array([zero, -np.sin(theta) + zero, np.cos(theta) + zero])
+    assert np.abs(w - expect).max() < 1e-12
+
+
+def test_ball_interpolation_and_integration():
+    cs, dist, ball = make_ball(np.float64)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    f = dist.Field(name="f", bases=ball)
+    f["g"] = x * y + z ** 2 + x + 3
+    phig, thetag = phi[:, :, 0], theta[:, :, 0]
+    xo, yo, zo = xyz(phig, thetag, R)
+    fo = f(r=R).evaluate()["g"]
+    assert np.abs(fo[:, :, 0] - (xo * yo + zo ** 2 + xo + 3)).max() < 1e-11
+    total = float(d3.integ(f).evaluate()["g"].ravel()[0])
+    exact = 4 * np.pi / 3 * R ** 3 * 3 + 4 * np.pi / 3 * R ** 5 / 5
+    assert abs(total - exact) < 1e-11
+
+
+def test_ball_ncc():
+    cs, dist, ball = make_ball(np.float64, shape=(8, 6, 12), dealias=3 / 2)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    ncc = dist.Field(name="ncc", bases=ball)
+    ncc["g"] = np.asarray(r) ** 2 + 1
+    v = dist.Field(name="v", bases=ball)
+    w = dist.Field(name="w", bases=ball)
+    problem = d3.LBVP([v], namespace=locals())
+    problem.add_equation("ncc*v = ncc*w")
+    w["g"] = x * z + np.asarray(r) ** 2
+    problem.build_solver().solve()
+    assert np.abs(np.asarray(v["g"]) - np.asarray(w["g"])).max() < 1e-12
+
+
+def test_ball_rvec_ncc():
+    cs, dist, ball = make_ball(np.float64, shape=(8, 6, 12), dealias=3 / 2)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    rvec = dist.VectorField(cs, name="rvec", bases=ball)
+    rvec["g"][2] = np.broadcast_to(np.asarray(r),
+                                   np.asarray(rvec["g"])[2].shape)
+    v = dist.Field(name="v", bases=ball)
+    w = dist.VectorField(cs, name="w", bases=ball)
+    f = dist.Field(name="f", bases=ball)
+    f["g"] = x * z + np.asarray(r) ** 2
+    problem = d3.LBVP([v, w], namespace=locals())
+    problem.add_equation("w - rvec*v = 0")
+    problem.add_equation("v = f")
+    problem.build_solver().solve()
+    expect = np.zeros_like(np.asarray(w["g"]))
+    expect[2] = np.asarray(f["g"]) * np.asarray(r)
+    assert np.abs(np.asarray(w["g"]) - expect).max() < 1e-12
+
+
+def test_ball_scalar_poisson_lbvp():
+    cs, dist, ball = make_ball(np.float64, shape=(8, 6, 12))
+    phi, theta, r = dist.local_grids(ball)
+    u = dist.Field(name="u", bases=ball)
+    t1 = dist.Field(name="t1", bases=ball.surface)
+    six = dist.Field(name="six", bases=ball)
+    six["g"] = 6.0
+    lift = lambda A, n: d3.Lift(A, ball.derivative_basis(2), n)
+    problem = d3.LBVP([u, t1], namespace={**locals(), "R": R})
+    problem.add_equation("lap(u) + lift(t1, -1) = six")
+    problem.add_equation("u(r=R) = R**2")
+    problem.build_solver().solve()
+    assert np.abs(np.asarray(u["g"]) - np.asarray(r) ** 2).max() < 1e-12
+
+
+def test_ball_diffusion_bessel_rate():
+    """Lowest diffusion decay rate in the unit ball is (pi/R)^2 (first zero
+    of j_0; reference: tests/ball_diffusion_analytical_eigenvalues.py)."""
+    cs, dist, ball = make_ball(np.float64, shape=(4, 4, 16), radius=1.0)
+    phi, theta, r = dist.local_grids(ball)
+    u = dist.Field(name="u", bases=ball)
+    t1 = dist.Field(name="t1", bases=ball.surface)
+    lift = lambda A, n: d3.Lift(A, ball.derivative_basis(2), n)
+    problem = d3.IVP([u, t1], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1, -1) = 0")
+    problem.add_equation("u(r=1.0) = 0")
+    solver = problem.build_solver(d3.SBDF2)
+    u["g"] = np.sinc(np.asarray(r))  # j_0(pi r)
+    E0 = float(d3.integ(u * u).evaluate()["g"].ravel()[0])
+    n, dt_ = 400, 5e-5
+    for _ in range(n):
+        solver.step(dt_)
+    E1 = float(d3.integ(u * u).evaluate()["g"].ravel()[0])
+    rate = -np.log(E1 / E0) / (2 * n * dt_)
+    assert abs(rate - np.pi ** 2) < 1e-2
+
+
+def test_ball_vector_diffusion_smoke():
+    """Ball vector diffusion IVP stays finite with exact BCs
+    (reference: tests/test_ivp.py:56)."""
+    cs, dist, ball = make_ball(np.float64, shape=(8, 6, 10), radius=1.0,
+                               dealias=3 / 2)
+    phi, theta, r = dist.local_grids(ball)
+    x, y, z = xyz(phi, theta, r)
+    u = dist.VectorField(cs, name="u", bases=ball)
+    t1 = dist.VectorField(cs, name="t1", bases=ball.surface)
+    lift = lambda A, n: d3.Lift(A, ball.derivative_basis(2), n)
+    problem = d3.IVP([u, t1], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1, -1) = - u@grad(u)")
+    problem.add_equation("u(r=1.0) = 0")
+    solver = problem.build_solver(d3.RK222)
+    h = dist.Field(name="h", bases=ball)
+    h["g"] = (1 - np.asarray(r) ** 2) ** 2 * (1 + 0.2 * x)
+    u["g"] = np.asarray(d3.grad(h).evaluate()["g"])
+    for _ in range(20):
+        solver.step(1e-3)
+    # NOTE: check BCs before reading u['g'] -- a grid read roundtrips through
+    # the quadrature-limited transforms, truncating the top nmin(ell) radial
+    # modes (reference truncation: core/transforms.py:1408-1417).
+    assert np.abs(u(r=1.0).evaluate()["g"]).max() < 1e-10
+    assert np.all(np.isfinite(np.asarray(u["g"])))
+
+
+def test_ball_stress_free_setup():
+    """Stress-free BC machinery: transpose, index-1 radial extraction,
+    angular extraction on boundary tensors (reference:
+    examples/ivp_ball_internally_heated_convection)."""
+    cs, dist, ball = make_ball(np.float64, shape=(8, 6, 10), radius=1.0,
+                               dealias=3 / 2)
+    phi, theta, r = dist.local_grids(ball)
+    u = dist.VectorField(cs, name="u", bases=ball)
+    p = dist.Field(name="p", bases=ball)
+    tau_p = dist.Field(name="tau_p")
+    tau_u = dist.VectorField(cs, name="tau_u", bases=ball.surface)
+    lift = lambda A: d3.Lift(A, ball, -1)
+    strain_rate = d3.grad(u) + d3.trans(d3.grad(u))
+    shear_stress = d3.angular(d3.radial(strain_rate(r=1.0), index=1))
+    problem = d3.IVP([p, u, tau_p, tau_u], namespace=locals())
+    problem.add_equation("div(u) + tau_p = 0")
+    problem.add_equation("dt(u) - lap(u) + grad(p) + lift(tau_u) = - u@grad(u)")
+    problem.add_equation("shear_stress = 0")
+    problem.add_equation("radial(u(r=1.0)) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(d3.RK222)
+    u.fill_random("g", seed=7, distribution="normal", scale=1e-3)
+    for _ in range(10):
+        solver.step(1e-3)
+    # no-penetration holds (check before any lossy grid read)
+    ur = d3.radial(u(r=1.0)).evaluate()["g"]
+    assert np.abs(ur).max() < 1e-10
+    assert np.all(np.isfinite(np.asarray(u["g"])))
