@@ -1,0 +1,8 @@
+"""
+Distributed execution over JAX device meshes
+(reference: dedalus/core/transposes.pyx + dedalus/core/distributor.py layout
+chain — the MPI pencil machinery replaced by XLA collectives over ICI/DCN).
+"""
+
+from .transposes import all_to_all_transpose, DistributedPencilPipeline
+from .sharding import distribute_solver, pencil_sharding
