@@ -1090,8 +1090,12 @@ def Trace(operand):
         return SphericalSpinTrace(operand)
     if len(ts) >= 2 and _spin_cs(ts[0]):
         from .curvilinear import SpinBasisMixin
-        from .polar import SpinTrace
-        if any(isinstance(b, SpinBasisMixin) for b in operand.domain.bases):
+        from .polar import SpinTrace, S1SpinTransformMixin
+        # Disk/annulus interiors AND their S1 edge bases store spin
+        # components, so the trace contracts the spin metric (-,+)+(+,-),
+        # not the coordinate delta.
+        if any(isinstance(b, (SpinBasisMixin, S1SpinTransformMixin))
+               for b in operand.domain.bases):
             return SpinTrace(operand)
     return TraceOperator(operand)
 

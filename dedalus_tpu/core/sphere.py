@@ -39,12 +39,20 @@ class SphereBasis(SpinBasisMixin, Basis):
     dim = 2
 
     def __init__(self, coordsystem, shape, dtype=np.float64, radius=1.0,
-                 dealias=(1, 1), azimuth_library=None, colatitude_library=None):
+                 dealias=(1, 1), azimuth_library=None, colatitude_library=None,
+                 ell_separable=False):
         if isinstance(coordsystem, SphericalCoordinates):
             coordsystem = coordsystem.S2coordsys
         if not isinstance(coordsystem, S2Coordinates):
             raise ValueError("Sphere coordsys must be S2Coordinates.")
         self.coordsystem = self.cs = coordsystem
+        # Separability of the colatitude axis is a property of the PROBLEM,
+        # not the coordinate system: inside a 3D shell/ball problem every
+        # operator is ell-diagonal (ell is a group axis), while a standalone
+        # S2 problem couples ell (e.g. MulCosine NCCs) even when built on an
+        # embedded SphericalCoordinates.S2coordsys. Shell/Ball constructors
+        # pass ell_separable=True explicitly for their boundary bases.
+        self.ell_separable = bool(ell_separable)
         self.coord = coordsystem.coords[0]
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
@@ -85,10 +93,7 @@ class SphereBasis(SpinBasisMixin, Basis):
     def sub_separable(self, sub_axis):
         if sub_axis == 0:
             return True
-        # Inside a 3D spherical problem (shell/ball) every operator is
-        # ell-diagonal, so the colatitude is a separable (ell-group) axis;
-        # in standalone S2 problems it is the coupled pencil axis.
-        return self.cs.radius_coord is not None
+        return self.ell_separable
 
     def sub_group_shape(self, sub_axis):
         if sub_axis == 0:
@@ -115,7 +120,8 @@ class SphereBasis(SpinBasisMixin, Basis):
 
     def clone_with(self, **changes):
         args = dict(coordsystem=self.coordsystem, shape=self.shape,
-                    dtype=self.dtype, radius=self.radius, dealias=self.dealias)
+                    dtype=self.dtype, radius=self.radius, dealias=self.dealias,
+                    ell_separable=self.ell_separable)
         args.update(changes)
         return SphereBasis(**args)
 

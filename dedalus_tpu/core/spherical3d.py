@@ -156,7 +156,7 @@ class ShellBasis(WeightedJacobiRadial, Basis):
             coordsystem.S2coordsys, (Nphi, Ntheta), dtype=dtype,
             radius=radii[1], dealias=self.dealias[:2],
             azimuth_library=azimuth_library,
-            colatitude_library=colatitude_library)
+            colatitude_library=colatitude_library, ell_separable=True)
         self.azimuth_basis = self.sphere_basis.azimuth_basis
         self.radius_library = radius_library
         self.inner_surface = self.S2_basis(radii[0])
@@ -172,7 +172,8 @@ class ShellBasis(WeightedJacobiRadial, Basis):
             radius = self.radii[1]
         return SphereBasis(
             self.coordsystem.S2coordsys, (self.Nphi, self.Ntheta),
-            dtype=self.dtype, radius=radius, dealias=self.dealias[:2])
+            dtype=self.dtype, radius=radius, dealias=self.dealias[:2],
+            ell_separable=True)
 
     # ------------------------------------------------------------ structure
 
@@ -440,7 +441,7 @@ class BallBasis(Basis):
             coordsystem.S2coordsys, (Nphi, Ntheta), dtype=dtype,
             radius=radius, dealias=self.dealias[:2],
             azimuth_library=azimuth_library,
-            colatitude_library=colatitude_library)
+            colatitude_library=colatitude_library, ell_separable=True)
         self.azimuth_basis = self.sphere_basis.azimuth_basis
         self.radius_library = radius_library
         self.surface = self.S2_basis(radius)
@@ -453,7 +454,8 @@ class BallBasis(Basis):
             radius = self.radius
         return SphereBasis(
             self.coordsystem.S2coordsys, (self.Nphi, self.Ntheta),
-            dtype=self.dtype, radius=radius, dealias=self.dealias[:2])
+            dtype=self.dtype, radius=radius, dealias=self.dealias[:2],
+            ell_separable=True)
 
     # ------------------------------------------------------------ structure
 

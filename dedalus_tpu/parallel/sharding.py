@@ -37,8 +37,8 @@ def distribute_solver(solver, mesh=None, axis_name=None):
     n = mesh.shape[axis_name]
     if G % n:
         raise ValueError(
-            f"Pencil count {G} does not divide mesh axis {axis_name!r} "
-            f"(size {n}); choose resolutions with G % n == 0.")
+            f"Mesh axis {axis_name!r} (size {n}) does not divide pencil "
+            f"count {G}; choose resolutions with G % n == 0.")
     s2 = pencil_sharding(mesh, 2, axis_name)
     s3 = pencil_sharding(mesh, 3, axis_name)
     hist_sharding = NamedSharding(mesh, P(None, axis_name, None))
