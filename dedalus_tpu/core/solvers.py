@@ -20,11 +20,14 @@ import jax
 import jax.numpy as jnp
 
 from .subsystems import (PencilLayout, build_subproblems, build_matrices,
-                         gather_state, scatter_state, row_valid_masks)
+                         assemble_group_coos, MatrixStructure,
+                         build_banded_arrays, gather_state, scatter_state,
+                         row_valid_masks)
 from .future import EvalContext, ev
 from . import timesteppers as timesteppers_mod
-from ..libraries.matsolvers import get_solver
+from ..libraries import pencilops
 from ..tools.config import config
+from ..tools.general import is_complex_dtype
 
 logger = logging.getLogger(__name__)
 
@@ -41,25 +44,161 @@ class SolverBase:
         self.variables = self.matrix_variables(problem)
         if matsolver is None:
             matsolver = config["linear algebra"].get("MATRIX_SOLVER", "auto")
-        if matsolver == "auto":
-            # TPU: triangular solves are sequential (slow); a precomputed
-            # batched inverse makes every solve one MXU matmul (~65x faster
-            # on v5e). TPU LuDecomposition only implements F32/C64, so
-            # 64-bit problems factor in 32-bit + iterative refinement.
-            # Elsewhere (CPU/GPU): LU is accurate and fast.
-            if jax.default_backend() in ("tpu", "axon"):
-                small = all(np.dtype(v.dtype) in (np.dtype(np.float32),
-                                                  np.dtype(np.complex64))
-                            for v in self.variables)
-                matsolver = "BatchedInverse" if small else "BatchedInverseRefined"
-            else:
-                matsolver = "BatchedLUFactorized"
         self.matsolver = matsolver
         self.layout = PencilLayout(self.dist, self.variables, problem.equations)
         self.subproblems = build_subproblems(self.layout)
-        self._matrices = build_matrices(self.subproblems, problem.equations,
-                                        self.variables, names=self.matrices)
+        self._build_pencil_system()
         self.valid_row_mask = row_valid_masks(self.layout, problem.equations)
+
+    def _build_pencil_system(self):
+        """
+        Assemble the pencil matrices and pick the device representation:
+        dense (G, S, S) for small systems, banded-interior + Schur border
+        for large single-coupled-axis systems (reference: ScipyBanded +
+        Woodbury, libraries/matsolvers.py:186-194,285-316). Sets
+        self._matrices (host arrays), self.ops, self.structure.
+        """
+        names = self.matrices
+        G, S = self.pencil_shape
+        dense_bytes = G * S * S * np.dtype(self.pencil_dtype).itemsize
+        spec = self.matsolver if isinstance(self.matsolver, str) else ""
+        forced = spec.lower() if spec.lower() in ("banded", "dense") else None
+        cutoff_bytes = int(config["linear algebra"].get(
+            "BANDED_CUTOFF_BYTES", str(1 << 30)))
+        # An explicitly named dense matsolver (or solver class) is always
+        # honored; only 'auto' lets the size heuristic pick the banded path.
+        auto = isinstance(self.matsolver, str) and spec.lower() == "auto"
+        try_banded = (forced == "banded"
+                      or (auto and dense_bytes > cutoff_bytes))
+        self.structure = None
+        if try_banded:
+            result = self._try_banded(names, S)
+            if result is True:
+                return
+            if forced == "banded":
+                raise ValueError("Banded solve forced but not applicable: "
+                                 f"{self._banded_reason}")
+            logger.info(f"Banded path not applicable ({self._banded_reason}); "
+                        f"using dense ({dense_bytes / 1e9:.2f} GB)")
+            # reuse the already-assembled COO matrices for the dense fallback
+            self._matrices = self._densify_coo_store(result, names, S)
+        else:
+            self._matrices = build_matrices(
+                self.subproblems, self.problem.equations, self.variables,
+                names=names)
+        self.ops = pencilops.DenseOps(self._dense_matsolver())
+
+    def _densify_coo_store(self, store, names, S):
+        """Scatter (coo_store, masks) from a failed banded attempt into the
+        dense (G, S, S) arrays, applying the enumeration-order closure the
+        dense path uses."""
+        coo_store, masks = store
+        cplx = any(is_complex_dtype(v.dtype) for v in self.variables)
+        dtype = np.complex128 if cplx else np.float64
+        G = len(coo_store)
+        out = {name: np.zeros((G, S, S), dtype=dtype) for name in names}
+        for g, (coos, (row_valid, col_valid)) in enumerate(zip(coo_store, masks)):
+            for name in names:
+                rows, cols, vals = coos[name]
+                out[name][g][rows, cols] = vals
+            inv_rows = np.flatnonzero(~row_valid)
+            inv_cols = np.flatnonzero(~col_valid)
+            out[names[-1]][g][inv_rows, inv_cols] = 1.0
+        return out
+
+    def _try_banded(self, names, S):
+        """
+        Attempt the banded + pinned representation: assemble real
+        (pre-closure) entries per group, run the structural analysis, place
+        the validity closure on the matched diagonal, and extract banded
+        storage. Returns True on success (with self._matrices and self.ops
+        set), else (coo_store, masks) for the dense fallback, with
+        self._banded_reason set.
+        """
+        from .subsystems import PatternAccumulator, compute_group_closure
+        # Relative drop tolerance for the PATTERN only (band detection /
+        # matching); stored matrix values are never filtered, so the banded
+        # and dense paths solve the same operator up to sub-tol out-of-band
+        # entries dropped at fill time.
+        tol = float(config["linear algebra"].get("BAND_DETECT_CUTOFF", "1e-14"))
+        equations = self.problem.equations
+        coo_store = []
+        masks = []
+        acc = PatternAccumulator(S)
+        scale = 0.0
+        for sp in self.subproblems:
+            coos, row_valid, col_valid = assemble_group_coos(
+                sp, equations, self.variables, names, closure=False)
+            coo_store.append(coos)
+            masks.append((row_valid, col_valid))
+            scale = max(scale, max((np.abs(v).max() if len(v) else 0.0
+                                    for _, _, v in coos.values()), default=0.0))
+        tol_abs = tol * (scale or 1.0)
+        for coos, (row_valid, col_valid) in zip(coo_store, masks):
+            pat = {k: (r[np.abs(v) > tol_abs], c[np.abs(v) > tol_abs],
+                       v[np.abs(v) > tol_abs]) for k, (r, c, v) in coos.items()}
+            acc.add_group(pat, row_valid, col_valid)
+        structure = MatrixStructure(self.layout, self.variables, equations)
+        row_valid_all = np.array([m[0] for m in masks])
+        col_valid_all = np.array([m[1] for m in masks])
+        structure.finalize(acc.union, acc.qualified(), row_valid_all,
+                           col_valid_all, vmax=acc.vmax)
+        if not structure.ok:
+            self._banded_reason = structure.reason
+            return (coo_store, masks)
+        # validity closure aligned with the matching
+        last = names[-1]
+        closures = []
+        for coos, (row_valid, col_valid) in zip(coo_store, masks):
+            closure = compute_group_closure(structure, row_valid, col_valid)
+            if closure is None:
+                self._banded_reason = "validity closure misaligned with matching"
+                return (coo_store, masks)
+            closures.append(closure)
+        for coos, closure in zip(coo_store, closures):
+            rows, cols, vals = coos[last]
+            coos[last] = (np.concatenate([rows, closure[0]]),
+                          np.concatenate([cols, closure[1]]),
+                          np.concatenate([vals, np.ones(len(closure[0]))]))
+        host_dtype = (np.complex128 if is_complex_dtype(self.pencil_dtype)
+                      else np.float64)
+        try:
+            self._matrices = build_banded_arrays(coo_store, structure, names,
+                                                 host_dtype, drop_tol=tol_abs)
+        except ValueError as exc:
+            # strip the closure entries we appended before falling back
+            for coos, closure in zip(coo_store, closures):
+                rows, cols, vals = coos[last]
+                n = len(closure[0])
+                coos[last] = (rows[:-n] if n else rows,
+                              cols[:-n] if n else cols,
+                              vals[:-n] if n else vals)
+            self._banded_reason = str(exc)
+            return (coo_store, masks)
+        self.structure = structure
+        self.ops = pencilops.BandedOps(structure)
+        logger.info(
+            f"Pencil system: banded path (S={structure.S}, "
+            f"pins={structure.t_pins}, kl={structure.kl}, "
+            f"ku={structure.ku}, q={structure.q})")
+        return True
+
+    def _dense_matsolver(self):
+        """Resolve the dense batched matsolver name (config MATRIX_SOLVER)."""
+        spec = self.matsolver
+        if not isinstance(spec, str) or spec.lower() not in ("auto", "banded", "dense"):
+            return spec
+        # TPU: triangular solves are sequential (slow); a precomputed
+        # batched inverse makes every solve one MXU matmul (~65x faster
+        # on v5e). TPU LuDecomposition only implements F32/C64, so
+        # 64-bit problems factor in 32-bit + iterative refinement.
+        # Elsewhere (CPU/GPU): LU is accurate and fast.
+        if jax.default_backend() in ("tpu", "axon"):
+            small = all(np.dtype(v.dtype) in (np.dtype(np.float32),
+                                              np.dtype(np.complex64))
+                        for v in self.variables)
+            return "BatchedInverse" if small else "BatchedInverseRefined"
+        return "BatchedLUFactorized"
 
     def matrix_variables(self, problem):
         return problem.variables
@@ -72,12 +211,12 @@ class SolverBase:
     @property
     def pencil_dtype(self):
         """Device working dtype: 32-bit when every variable is 32-bit."""
-        host = self._matrices[self.matrices[-1]].dtype
+        cplx = any(is_complex_dtype(v.dtype) for v in self.variables)
         bits32 = all(np.dtype(v.dtype) in (np.dtype(np.float32), np.dtype(np.complex64))
                      for v in self.variables)
-        if bits32:
-            return np.dtype(np.complex64) if host == np.complex128 else np.dtype(np.float32)
-        return host
+        if cplx:
+            return np.dtype(np.complex64) if bits32 else np.dtype(np.complex128)
+        return np.dtype(np.float32) if bits32 else np.dtype(np.float64)
 
     @property
     def real_dtype(self):
@@ -169,8 +308,8 @@ class InitialValueSolver(SolverBase):
     def __init__(self, problem, timestepper, matsolver=None,
                  enforce_real_cadence=100, warmup_iterations=10, **kw):
         super().__init__(problem, matsolver=matsolver)
-        self.M_mat = jnp.asarray(self._matrices["M"], dtype=self.pencil_dtype)
-        self.L_mat = jnp.asarray(self._matrices["L"], dtype=self.pencil_dtype)
+        self.M_mat = self.ops.to_device(self._matrices["M"], self.pencil_dtype)
+        self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F", time_field=problem.time)
         # timestepping state
         self.sim_time = 0.0
@@ -246,8 +385,8 @@ class InitialValueSolver(SolverBase):
 
     def print_subproblem_ranks(self, **kw):
         for sp in self.subproblems:
-            L = self._matrices["L"][sp.index]
-            M = self._matrices["M"][sp.index]
+            L = self.ops.densify_host(self._matrices["L"], sp.index)
+            M = self.ops.densify_host(self._matrices["M"], sp.index)
             A = M + L
             print(f"group {sp.group}: rank={np.linalg.matrix_rank(A)}/{A.shape[0]}, "
                   f"cond={np.linalg.cond(A):.2e}")
@@ -301,11 +440,10 @@ class LinearBoundaryValueSolver(SolverBase):
 
     def __init__(self, problem, matsolver=None, **kw):
         super().__init__(problem, matsolver=matsolver)
-        self.L_mat = jnp.asarray(self._matrices["L"], dtype=self.pencil_dtype)
+        self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F")
-        Solver = get_solver(self.matsolver)
-        self._aux = Solver.factor(self.L_mat)
-        self._solve = jax.jit(Solver.solve)
+        self._aux = self.ops.factor(self.L_mat)
+        self._solve = jax.jit(self.ops.solve)
         self.iteration = 0
 
     def solve(self):
@@ -358,14 +496,13 @@ class NonlinearBoundaryValueSolver(SolverBase):
     def newton_iteration(self, damping=1.0):
         """One Newton step: solve dG.dX = -G, update variables
         (reference: core/solvers.py:470)."""
-        # Rebuild Jacobian matrices around the current state (NCC data moves).
-        self._matrices = build_matrices(self.subproblems, self.problem.equations,
-                                        self.variables, names=("L",))
-        L = jnp.asarray(self._matrices["L"])
-        Solver = get_solver(self.matsolver)
-        aux = Solver.factor(L)
+        # Rebuild Jacobian matrices around the current state (NCC data moves;
+        # the structural path is re-selected since the pattern can change).
+        self._build_pencil_system()
+        L = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
+        aux = self.ops.factor(L)
         F = -self._eval_residual()
-        dX = Solver.solve(aux, F)
+        dX = self.ops.solve(aux, F)
         self._last_perturbation = dX
         arrays = scatter_state(self.layout, self.variables, dX)
         for var, pert in zip(self.problem.variables, self.variables):
@@ -402,8 +539,8 @@ class EigenvalueSolver(SolverBase):
         """Dense generalized eigensolve for one pencil
         (reference: core/solvers.py:180 solve_dense)."""
         sp_i = subproblem.index
-        L = np.asarray(self._matrices["L"][sp_i])
-        M = np.asarray(self._matrices["M"][sp_i])
+        L = self.ops.densify_host(self._matrices["L"], sp_i)
+        M = self.ops.densify_host(self._matrices["M"], sp_i)
         out = scipy.linalg.eig(L, b=-M, left=left, **kw)
         if left:
             evals, evecs_left, evecs = out
@@ -429,8 +566,8 @@ class EigenvalueSolver(SolverBase):
         from ..tools.array import scipy_sparse_eigs
         import scipy.sparse as sps
         sp_i = subproblem.index
-        L = sps.csr_matrix(np.asarray(self._matrices["L"][sp_i]))
-        M = sps.csr_matrix(np.asarray(self._matrices["M"][sp_i]))
+        L = sps.csr_matrix(self.ops.densify_host(self._matrices["L"], sp_i))
+        M = sps.csr_matrix(self.ops.densify_host(self._matrices["M"], sp_i))
         out = scipy_sparse_eigs(A=L, B=-M, N=N, target=target, left=left, **kw)
         if left:
             self.eigenvalues, self.eigenvectors, self.left_eigenvalues, \

@@ -238,15 +238,7 @@ def build_subproblems(layout):
     return [Subproblem(layout, group, i) for i, group in enumerate(layout.groups())]
 
 
-def build_matrices(subproblems, equations, variables, names=("M", "L")):
-    """
-    Assemble the batched pencil matrices for all subproblems.
-    Returns {name: np.ndarray (G, S, S)} with validity enforcement:
-    invalid rows/columns zeroed; identity closure rows added to the LAST
-    name in `names` (the 'L'-like matrix) to keep each group square
-    (reference: core/subsystems.py:493-598 build_matrices).
-    """
-    layout = subproblems[0].layout
+def _system_sizes(layout, equations, variables):
     var_sizes = [layout.slot_size(v.domain, v.tensorsig) for v in variables]
     var_offsets = np.concatenate([[0], np.cumsum(var_sizes)])
     S = int(var_offsets[-1])
@@ -255,45 +247,434 @@ def build_matrices(subproblems, equations, variables, names=("M", "L")):
     if R != S:
         raise ValueError(f"Pencil system is not square: {R} equation rows for "
                          f"{S} variable columns.")
+    return var_offsets, eq_sizes, S
+
+
+def assemble_group_coo(subproblem, equations, variables, name,
+                       eq_sizes, var_offsets):
+    """
+    Assemble one group's matrix `name` in COO form (rows, cols, vals),
+    with validity enforcement (invalid rows/columns dropped) and — for
+    name == '__closure__' entries handled by the caller. Returns
+    (rows, cols, vals, row_valid, col_valid).
+    """
+    layout = subproblem.layout
+    rows_l, cols_l, vals_l = [], [], []
+    row0 = 0
+    for eq, esize in zip(equations, eq_sizes):
+        expr = eq.get(name)
+        if expr is not None and not (np.isscalar(expr) and expr == 0):
+            from .operators import operand_expression_matrices
+            mats = operand_expression_matrices(expr, subproblem, variables)
+            for vi, var in enumerate(variables):
+                if var in mats:
+                    block = mats[var]
+                    coo = sp.coo_matrix(block)
+                    rows_l.append(coo.row + row0)
+                    cols_l.append(coo.col + var_offsets[vi])
+                    vals_l.append(coo.data)
+        row0 += esize
+    if rows_l:
+        rows = np.concatenate(rows_l)
+        cols = np.concatenate(cols_l)
+        vals = np.concatenate(vals_l)
+    else:
+        rows = np.zeros(0, dtype=int)
+        cols = np.zeros(0, dtype=int)
+        vals = np.zeros(0)
+    # validity enforcement
+    col_valid = np.concatenate([
+        layout.valid_mask(v.domain, v.tensorsig, subproblem.group).ravel()
+        for v in variables])
+    row_valid = np.concatenate([
+        layout.valid_mask(eq["domain"], eq["tensorsig"], subproblem.group).ravel()
+        for eq in equations])
+    if col_valid.sum() != row_valid.sum():
+        raise ValueError(
+            f"Invalid row/column mismatch in group {subproblem.group}: "
+            f"{row_valid.sum()} valid rows vs {col_valid.sum()} valid columns.")
+    keep = row_valid[rows] & col_valid[cols]
+    return rows[keep], cols[keep], vals[keep], row_valid, col_valid
+
+
+def assemble_group_coos(subproblem, equations, variables, names, closure=True):
+    """
+    All matrices of one group in COO form (duplicates summed). With
+    closure=True, identity closure of invalid slots is added to the last
+    name in enumeration-pair order (the dense path's convention).
+    Returns ({name: (rows, cols, vals)}, row_valid, col_valid).
+    """
+    layout = subproblem.layout
+    var_offsets, eq_sizes, S = _system_sizes(layout, equations, variables)
+    out = {}
+    row_valid = col_valid = None
+    for name in names:
+        rows, cols, vals, row_valid, col_valid = assemble_group_coo(
+            subproblem, equations, variables, name, eq_sizes, var_offsets)
+        if closure and name == names[-1]:
+            inv_rows = np.flatnonzero(~row_valid)
+            inv_cols = np.flatnonzero(~col_valid)
+            rows = np.concatenate([rows, inv_rows])
+            cols = np.concatenate([cols, inv_cols])
+            vals = np.concatenate([vals, np.ones(len(inv_rows))])
+        # sum duplicate entries so downstream scatters can assign
+        mat = sp.csr_matrix((vals, (rows, cols)), shape=(S, S))
+        mat.sum_duplicates()
+        coo = mat.tocoo()
+        out[name] = (coo.row, coo.col, coo.data)
+    return out, row_valid, col_valid
+
+
+def build_matrices(subproblems, equations, variables, names=("M", "L")):
+    """
+    Assemble the batched dense pencil matrices for all subproblems.
+    Returns {name: np.ndarray (G, S, S)} with validity enforcement:
+    invalid rows/columns zeroed; identity closure rows added to the LAST
+    name in `names` (the 'L'-like matrix) to keep each group square
+    (reference: core/subsystems.py:493-598 build_matrices).
+    """
+    layout = subproblems[0].layout
+    _, _, S = _system_sizes(layout, equations, variables)
     complex_problem = any(is_complex_dtype(v.dtype) for v in variables)
     dtype = np.complex128 if complex_problem else np.float64
     G = len(subproblems)
     out = {name: np.zeros((G, S, S), dtype=dtype) for name in names}
-
     for sp_i, subproblem in enumerate(subproblems):
-        # validity masks
-        col_valid = np.concatenate([
-            layout.valid_mask(v.domain, v.tensorsig, subproblem.group).ravel()
-            for v in variables])
-        row_valid = np.concatenate([
-            layout.valid_mask(eq["domain"], eq["tensorsig"], subproblem.group).ravel()
-            for eq in equations])
-        if col_valid.sum() != row_valid.sum():
-            raise ValueError(
-                f"Invalid row/column mismatch in group {subproblem.group}: "
-                f"{row_valid.sum()} valid rows vs {col_valid.sum()} valid columns.")
+        coos, _, _ = assemble_group_coos(subproblem, equations, variables, names)
         for name in names:
-            mat = out[name][sp_i]
-            row0 = 0
-            for eq, esize in zip(equations, eq_sizes):
-                expr = eq.get(name)
-                if expr is not None and not (np.isscalar(expr) and expr == 0):
-                    from .operators import operand_expression_matrices
-                    mats = operand_expression_matrices(expr, subproblem, variables)
-                    for vi, var in enumerate(variables):
-                        if var in mats:
-                            block = mats[var]
-                            mat[row0:row0 + esize,
-                                var_offsets[vi]:var_offsets[vi + 1]] += \
-                                np.asarray(block.todense() if sp.issparse(block) else block)
-                row0 += esize
-            # validity enforcement
-            mat[~row_valid, :] = 0.0
-            mat[:, ~col_valid] = 0.0
-        # identity closure on the final (L-like) matrix
-        inv_rows = np.flatnonzero(~row_valid)
-        inv_cols = np.flatnonzero(~col_valid)
-        out[names[-1]][sp_i][inv_rows, inv_cols] = 1.0
+            rows, cols, vals = coos[name]
+            out[name][sp_i][rows, cols] = vals
+    return out
+
+
+class MatrixStructure:
+    """
+    Structural analysis of the pencil system enabling the banded + pinned
+    Woodbury device solve (reference: the pre_left/pre_right
+    bandwidth-minimizing permutations, core/subsystems.py:556-598,610-674,
+    and the Woodbury bordered solve, libraries/matsolvers.py:285-316).
+
+    The permutation interleaves all coupled-axis modes (mode-major:
+    Modes > Equations/Variables > Components, matching the reference's
+    interleave_components ordering). A maximum bipartite matching between
+    coupled-equation rows and ALL columns — on the "qualified" pattern of
+    entries present in every group where their row/column is valid —
+    assigns each matched row the position of its matched column, making
+    every banded diagonal structurally nonzero in every group. Dense rows
+    (BCs, gauges) and unmatched rows are replaced by identity "pin" rows
+    at leftover column positions, with their true content restored by a
+    rank-t Woodbury correction. Pinning the low-mode coefficients removes
+    the exponentially ill-conditioned null directions a boundary-row
+    Schur complement would create (the pinned matrix's condition number
+    matches the full tau system's).
+    """
+
+    def __init__(self, layout, variables, equations):
+        self.layout = layout
+        self.ok = (len(layout.coupled_axes) == 1)
+        self.reason = None if self.ok else "not exactly one coupled axis"
+        if not self.ok:
+            return
+        caxis = layout.coupled_axes[0]
+        var_offsets, eq_sizes, S = _system_sizes(layout, equations, variables)
+        self.S = S
+
+        def base_order(items):
+            """items: [(domain, tensorsig)] -> (by_mode, uncoupled) indices."""
+            by_mode = None
+            uncoupled = []
+            offset = 0
+            for domain, tsig in items:
+                shape = layout.slot_shape(domain, tsig)
+                n_slots = int(np.prod(shape))
+                basis = domain.bases[caxis]
+                if basis is None:
+                    uncoupled.extend(range(offset, offset + n_slots))
+                else:
+                    Nc = shape[1 + caxis]
+                    if by_mode is None:
+                        by_mode = [[] for _ in range(Nc)]
+                    elif len(by_mode) != Nc:
+                        self.ok = False
+                        self.reason = "mismatched coupled sizes"
+                        return None, None
+                    idx = np.arange(n_slots).reshape(shape)
+                    idx = np.moveaxis(idx, 1 + caxis, 0).reshape(Nc, -1)
+                    for m in range(Nc):
+                        by_mode[m].extend((offset + idx[m]).tolist())
+                offset += n_slots
+            return by_mode, uncoupled
+
+        cols_by_mode, cols_unc = base_order(
+            [(v.domain, v.tensorsig) for v in variables])
+        rows_by_mode, rows_unc = base_order(
+            [(eq["domain"], eq["tensorsig"]) for eq in equations])
+        if not self.ok:
+            return
+        if cols_by_mode is None or rows_by_mode is None:
+            self.ok = False
+            self.reason = "no coupled-extent slots"
+            return
+        self._rows_int = np.array([i for m in rows_by_mode for i in m])
+        self._rows_unc = np.array(rows_unc, dtype=int)
+        self.n_modes = len(rows_by_mode)
+        self._cols_by_mode = cols_by_mode
+        self._cols_unc = np.array(cols_unc, dtype=int)
+        self._row_mode = -np.ones(S, dtype=int)
+        for m, rows in enumerate(rows_by_mode):
+            self._row_mode[rows] = m
+
+    def finalize(self, union_pat, qual_pat, row_valid_all, col_valid_all,
+                 vmax=None, band_cutoff=0.5, min_blocks=2):
+        """
+        Complete the structure from sparsity patterns (scipy bool CSR, SxS,
+        original ordering) and per-group validity masks (G, S). Sets
+        self.ok; on success defines row_perm, pinned rows, and band sizes.
+        """
+        if not self.ok:
+            return self
+        S = self.S
+        # Place each uncoupled (tau) column at the mode of the rows that
+        # reference it, so tau entries stay near the diagonal (the
+        # reference's tau_left placement generalized per-column).
+        pu_all = sp.coo_matrix(union_pat)
+        col_key = {}
+        for c in self._cols_unc:
+            modes = self._row_mode[pu_all.row[pu_all.col == c]]
+            modes = modes[modes >= 0]
+            col_key[int(c)] = int(np.median(modes)) if len(modes) \
+                else self.n_modes - 1
+        unc_by_mode = [[] for _ in range(self.n_modes)]
+        for c in self._cols_unc:
+            unc_by_mode[col_key[int(c)]].append(int(c))
+        self.col_perm = np.array(
+            [c for m in range(self.n_modes)
+             for c in list(self._cols_by_mode[m]) + unc_by_mode[m]],
+            dtype=int)
+        pos_col = np.argsort(self.col_perm)
+        # Stage A: greedy structural matching of coupled-equation rows to
+        # columns. Rows are processed from the highest mode down, each
+        # taking its highest-OFFSET significant qualified candidate (within
+        # a mode window): aligning on the principal part (highest
+        # derivative) makes the banded elimination a stable downward
+        # coefficient recurrence — lower-offset terms (k^2, mass) act as
+        # bounded perturbations — while aligning on a lower-offset term
+        # leaves the principal term as an unstable upward forcing (the
+        # exponentially ill-conditioned truncations measured in testing).
+        # Top-down greed leaves the unmatched (pinned) columns at LOW
+        # modes, where coefficient-pinning is well-conditioned — the
+        # homogeneous solutions a boundary-row replacement must suppress
+        # have O(1) low coefficients but exponentially small high ones.
+        qual_r = qual_pat[self._rows_int][:, self.col_perm]
+        if vmax is not None:
+            qual_r = vmax[self._rows_int][:, self.col_perm].multiply(qual_r)
+        Q = sp.coo_matrix(qual_r)
+        window = 16 * max(8, len(self._rows_int) // self.n_modes)
+        near = np.abs(Q.col - Q.row) <= window
+        Qr = sp.csr_matrix((Q.data[near], (Q.row[near], Q.col[near])),
+                           shape=Q.shape)
+        nr = len(self._rows_int)
+        match = -np.ones(nr, dtype=int)
+        col_taken = np.zeros(S, dtype=bool)
+        indptr, indices, data = Qr.indptr, Qr.indices, Qr.data
+        for i in range(nr - 1, -1, -1):
+            cand = indices[indptr[i]:indptr[i + 1]]
+            w = data[indptr[i]:indptr[i + 1]]
+            free = ~col_taken[cand]
+            if free.any():
+                cand, w = cand[free], w[free]
+                sig = w >= 1e-10 * w.max()
+                c = cand[sig].max()
+                match[i] = c
+                col_taken[c] = True
+        row_pos = -np.ones(S, dtype=int)     # orig row index -> position
+        row_pos[self._rows_int] = match       # position = matched col position
+        # leftover rows pair with leftover positions by validity signature
+        # (so validity closure stays aligned with the pinning)
+        left_rows = np.concatenate([self._rows_int[match < 0], self._rows_unc])
+        filled = np.zeros(S, dtype=bool)
+        filled[match[match >= 0]] = True
+        left_positions = np.flatnonzero(~filled)
+        if len(left_rows) != len(left_positions):
+            self.ok = False
+            self.reason = "matching bookkeeping mismatch"
+            return self
+        row_sig = {r: row_valid_all[:, r].tobytes() for r in left_rows}
+        col_sig = {p: col_valid_all[:, self.col_perm[p]].tobytes()
+                   for p in left_positions}
+        from collections import defaultdict
+        by_sig_rows = defaultdict(list)
+        by_sig_pos = defaultdict(list)
+        for r in left_rows:
+            by_sig_rows[row_sig[r]].append(int(r))
+        for p in left_positions:
+            by_sig_pos[col_sig[p]].append(int(p))
+        if set(by_sig_rows) != set(by_sig_pos) or any(
+                len(by_sig_rows[s]) != len(by_sig_pos[s]) for s in by_sig_rows):
+            self.ok = False
+            self.reason = "validity signatures of pins do not pair"
+            return self
+        pinned_rows = []
+        pinned_positions = []
+        for sig in by_sig_rows:
+            rs = sorted(by_sig_rows[sig])
+            ps = sorted(by_sig_pos[sig])
+            pinned_rows.extend(rs)
+            pinned_positions.extend(ps)
+        order = np.argsort(pinned_positions)
+        self.pinned_rows = np.array(pinned_rows, dtype=int)[order]
+        self.pinned_positions = np.array(pinned_positions, dtype=int)[order]
+        row_pos[self.pinned_rows] = self.pinned_positions
+        if (row_pos < 0).any():
+            self.ok = False
+            self.reason = "row placement incomplete"
+            return self
+        self.row_pos = row_pos                      # orig row -> position
+        self.row_perm = np.argsort(row_pos)         # position -> orig row
+        self.n_interior = S
+        self.t_pins = len(self.pinned_rows)
+        # validity alignment of matched rows (guaranteed by the qualified
+        # pattern: entry present wherever either endpoint is valid)
+        matched = np.ones(S, dtype=bool)
+        matched[self.pinned_rows] = False
+        mrows = np.flatnonzero(matched)
+        if not np.array_equal(row_valid_all[:, mrows],
+                              col_valid_all[:, self.col_perm[row_pos[mrows]]]):
+            self.ok = False
+            self.reason = "validity misalignment on matched rows"
+            return self
+        # band extent from union pattern of matched (true-banded) rows
+        pu = sp.coo_matrix(union_pat)
+        keep = matched[pu.row]
+        pr, pc = row_pos[pu.row[keep]], pos_col[pu.col[keep]]
+        if len(pr) == 0:
+            self.ok = False
+            self.reason = "empty banded pattern"
+            return self
+        d = pc - pr
+        self.kl = int(max(-d.min(), 0))
+        self.ku = int(max(d.max(), 0))
+        nd = self.kl + self.ku + 1
+        q = max(self.kl, self.ku, 1)
+        self.q = int(-(-q // 8) * 8) if q > 8 else max(q, 1)
+        self.NB = -(-S // self.q)
+        # nd caps: relative (structure isn't really banded) and absolute
+        # (the matvec unrolls nd slice-mul-adds into the jitted step, and
+        # block size q tracks the band, so very wide bands lose to dense)
+        if nd > band_cutoff * S or nd > 384 or self.NB < min_blocks:
+            self.ok = False
+            self.reason = f"band too wide ({nd} diagonals for S={S})"
+        if self.t_pins > max(64, 0.25 * S):
+            self.ok = False
+            self.reason = f"too many pinned rows ({self.t_pins} of {S})"
+        return self
+
+
+class PatternAccumulator:
+    """
+    Accumulates per-group sparsity evidence for the structural analysis:
+    `union` of all real entries (band extent), and entry counts + per-row
+    validity counts yielding the "qualified" pattern — entries present in
+    every group where their row is valid — which is what the no-pivot
+    block LU needs on its diagonal.
+    """
+
+    def __init__(self, S):
+        self.S = S
+        self.union = None
+        self.count = None
+        self.vmax = None
+        self.n_row_valid = np.zeros(S, dtype=np.int64)
+        self.n_col_valid = np.zeros(S, dtype=np.int64)
+
+    def add_group(self, coos, row_valid, col_valid):
+        rows = np.concatenate([c[0] for c in coos.values()])
+        cols = np.concatenate([c[1] for c in coos.values()])
+        vals = np.concatenate([np.abs(c[2]) for c in coos.values()])
+        pat = sp.csr_matrix((np.ones(len(rows), dtype=np.int64), (rows, cols)),
+                            shape=(self.S, self.S))
+        pat.sum_duplicates()
+        pat.data[:] = 1
+        vm = sp.csr_matrix((vals, (rows, cols)), shape=(self.S, self.S))
+        if self.union is None:
+            self.union = pat.astype(bool)
+            self.count = pat
+            self.vmax = vm
+        else:
+            self.union = (self.union + pat.astype(bool)).astype(bool)
+            self.count = self.count + pat
+            self.vmax = self.vmax.maximum(vm)
+        self.n_row_valid += row_valid
+        self.n_col_valid += col_valid
+
+    def qualified(self):
+        """Entries present in every group where their row is valid AND in
+        every group where their column is valid — safe no-pivot diagonals
+        whose validity closure aligns with the matching."""
+        coo = self.count.tocoo()
+        keep = ((coo.data >= self.n_row_valid[coo.row])
+                & (coo.data >= self.n_col_valid[coo.col]))
+        return sp.csr_matrix(
+            (np.ones(keep.sum(), dtype=bool), (coo.row[keep], coo.col[keep])),
+            shape=(self.S, self.S))
+
+
+def compute_group_closure(structure, row_valid, col_valid):
+    """
+    Identity-closure placement for one group's invalid slots, aligned with
+    the structure: every invalid row closes at the column whose position it
+    occupies (its matched column, or its pin column), which is a diagonal
+    entry of the permuted system. The structure's signature pairing
+    guarantees that column is invalid in exactly the same groups.
+    Returns (rows, cols).
+    """
+    st = structure
+    inv_rows = np.flatnonzero(~row_valid)
+    cols = st.col_perm[st.row_pos[inv_rows]]
+    if col_valid[cols].any():
+        return None  # should not happen given finalize's signature checks
+    return inv_rows, cols
+
+
+def build_banded_arrays(coo_store, structure, names, dtype, drop_tol=0.0):
+    """
+    Scatter per-group COO matrices into banded + pinned-row storage:
+    matched rows' entries go to the (G, D, n_pad) diagonal bands at their
+    positions; pinned rows' true content goes to Vt (G, t, n_pad) for the
+    Woodbury correction (the identity pins themselves are injected at
+    factor time, not stored, so the per-name arrays represent the TRUE
+    matrices and matvec needs no special casing).
+    Returns {name: {"bands": ..., "Vt": ...}}.
+    """
+    st = structure
+    G = len(coo_store)
+    n_pad = st.NB * st.q
+    nd = st.kl + st.ku + 1
+    pos_col = np.argsort(st.col_perm)
+    pin_index = -np.ones(st.S, dtype=int)
+    pin_index[st.pinned_rows] = np.arange(st.t_pins)
+    out = {}
+    for name in names:
+        bands = np.zeros((G, nd, n_pad), dtype=dtype)
+        Vt = np.zeros((G, st.t_pins, n_pad), dtype=dtype)
+        for g in range(G):
+            rows, cols, vals = coo_store[g][name]
+            pi = pin_index[rows]
+            pr, pc = st.row_pos[rows], pos_col[cols]
+            mb = pi < 0               # entries of banded (non-pinned) rows
+            mv = ~mb                  # entries of pinned rows
+            d = pc - pr + st.kl
+            oob = mb & ((d < 0) | (d >= nd))
+            if oob.any():
+                # sub-tolerance out-of-band entries (excluded from the
+                # detected pattern) are dropped; anything larger is a
+                # genuine structure violation
+                if (np.abs(vals[oob]) > drop_tol).any():
+                    raise ValueError("Entry outside detected band")
+                mb = mb & ~oob
+            bands[g][d[mb], pr[mb]] = vals[mb]
+            Vt[g][pi[mv], pc[mv]] = vals[mv]
+        out[name] = {"bands": bands, "Vt": Vt}
     return out
 
 

@@ -23,8 +23,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..libraries.matsolvers import get_solver
-
 schemes = {}
 
 
@@ -83,26 +81,26 @@ class MultistepIMEX:
 
         eval_F = solver.eval_F
         mask = jnp.asarray(solver.valid_row_mask, dtype=solver.real_dtype)
-        Solver = get_solver(solver.matsolver)
+        ops = solver.ops
 
         # M and L are explicit arguments (not closure constants) so the
         # compiled HLO stays small and the arrays live as device buffers.
         @jax.jit
         def _factor(M, L, a0, b0):
-            return Solver.factor(a0 * M + b0 * L)
+            return ops.factor(ops.lincomb(a0, M, b0, L))
 
         @jax.jit
         def _advance(M, L, X, t, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
             Fn = eval_F(X, t) * mask
-            MXn = jnp.einsum("gij,gj->gi", M, X)
-            LXn = jnp.einsum("gij,gj->gi", L, X)
+            MXn = ops.matvec(M, X)
+            LXn = ops.matvec(L, X)
             F_hist = jnp.concatenate([Fn[None], F_hist[:-1]])
             MX_hist = jnp.concatenate([MXn[None], MX_hist[:-1]])
             LX_hist = jnp.concatenate([LXn[None], LX_hist[:-1]])
             RHS = (jnp.tensordot(c, F_hist, axes=1)
                    - jnp.tensordot(a[1:], MX_hist, axes=1)
                    - jnp.tensordot(b[1:], LX_hist, axes=1))
-            Xn = Solver.solve(lhs_aux, RHS)
+            Xn = ops.solve(lhs_aux, RHS)
             return Xn, F_hist, MX_hist, LX_hist
 
         self._factor = _factor
@@ -260,27 +258,36 @@ class RungeKuttaIMEX:
         H = jnp.asarray(self.H, dtype=rd)
         c = jnp.asarray(self.c, dtype=rd)
         s = self.stages
-        Solver = get_solver(solver.matsolver)
+        ops = solver.ops
+        one = jnp.asarray(1.0, dtype=rd)
 
         # M and L are explicit arguments (not closure constants): keeps the
         # compiled HLO small and shares one device buffer across calls.
+        # Stages with equal implicit diagonal coefficients H[i,i] share one
+        # factorization (all ARS tableaux here have constant diagonals, so
+        # typically a single LHS factor serves every stage).
+        H_diag = [float(self.H[i, i]) for i in range(1, s + 1)]
+        uniq = sorted(set(H_diag))
+        stage_slot = [uniq.index(h) for h in H_diag]
+
         @jax.jit
         def _factor(M, L, dt):
-            return [Solver.factor(M + dt * H[i, i] * L) for i in range(1, s + 1)]
+            auxs = [ops.factor(ops.lincomb(one, M, dt * h, L)) for h in uniq]
+            return [auxs[j] for j in stage_slot]
 
         @jax.jit
         def _step(M, L, X0, t0, dt, lhs_auxs):
-            MX0 = jnp.einsum("gij,gj->gi", M, X0)
+            MX0 = ops.matvec(M, X0)
             LXs = []
             Fs = []
             Xi = X0
             for i in range(1, s + 1):
-                LXs.append(jnp.einsum("gij,gj->gi", L, Xi))
+                LXs.append(ops.matvec(L, Xi))
                 Fs.append(eval_F(Xi, t0 + c[i - 1] * dt) * mask)
                 RHS = MX0
                 for j in range(i):
                     RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
-                Xi = Solver.solve(lhs_auxs[i - 1], RHS)
+                Xi = ops.solve(lhs_auxs[i - 1], RHS)
             return Xi
 
         self._factor = _factor

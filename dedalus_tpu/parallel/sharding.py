@@ -40,11 +40,14 @@ def distribute_solver(solver, mesh=None, axis_name=None):
             f"Mesh axis {axis_name!r} (size {n}) does not divide pencil "
             f"count {G}; choose resolutions with G % n == 0.")
     s2 = pencil_sharding(mesh, 2, axis_name)
-    s3 = pencil_sharding(mesh, 3, axis_name)
     hist_sharding = NamedSharding(mesh, P(None, axis_name, None))
     solver.X = jax.device_put(solver.X, s2)
-    solver.M_mat = jax.device_put(solver.M_mat, s3)
-    solver.L_mat = jax.device_put(solver.L_mat, s3)
+    # M/L are pytrees whose every leaf leads with the pencil-group axis
+    # (dense (G,S,S), or banded {bands,U,V,C} arrays).
+    shard_leaf = lambda a: jax.device_put(
+        a, pencil_sharding(mesh, a.ndim, axis_name))
+    solver.M_mat = jax.tree.map(shard_leaf, solver.M_mat)
+    solver.L_mat = jax.tree.map(shard_leaf, solver.L_mat)
     ts = solver.timestepper
     for name in ("F_hist", "MX_hist", "LX_hist"):
         if hasattr(ts, name):

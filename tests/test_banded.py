@@ -1,0 +1,154 @@
+"""
+Banded + pinned-Woodbury pencil solve vs the dense reference path
+(reference test pattern: dual-implementation oracle,
+/root/reference/dedalus/tests/test_transforms.py — here the oracle is the
+dense (G,S,S) batched solve).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import dedalus_tpu.public as d3
+
+
+def build_rb(Nx, Nz, matsolver=None, timestepper=None):
+    Lx, Lz = 4.0, 1.0
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xbasis = d3.RealFourier(coords["x"], size=Nx, bounds=(0, Lx), dealias=3/2)
+    zbasis = d3.ChebyshevT(coords["z"], size=Nz, bounds=(0, Lz), dealias=3/2)
+    p = dist.Field(name="p", bases=(xbasis, zbasis))
+    b = dist.Field(name="b", bases=(xbasis, zbasis))
+    u = dist.VectorField(coords, name="u", bases=(xbasis, zbasis))
+    tau_p = dist.Field(name="tau_p")
+    tau_b1 = dist.Field(name="tau_b1", bases=xbasis)
+    tau_b2 = dist.Field(name="tau_b2", bases=xbasis)
+    tau_u1 = dist.VectorField(coords, name="tau_u1", bases=xbasis)
+    tau_u2 = dist.VectorField(coords, name="tau_u2", bases=xbasis)
+    kappa = nu = 2.0e-6 ** 0.5
+    x, z = dist.local_grids(xbasis, zbasis)
+    ex, ez = coords.unit_vector_fields(dist)
+    lift_basis = zbasis.derivative_basis(1)
+    lift = lambda A: d3.Lift(A, lift_basis, -1)
+    grad_u = d3.grad(u) + ez*lift(tau_u1)
+    grad_b = d3.grad(b) + ez*lift(tau_b1)
+    problem = d3.IVP([p, b, u, tau_p, tau_b1, tau_b2, tau_u1, tau_u2],
+                     namespace=locals())
+    problem.add_equation("trace(grad_u) + tau_p = 0")
+    problem.add_equation("dt(b) - kappa*div(grad_b) + lift(tau_b2) = - u@grad(b)")
+    problem.add_equation("dt(u) - nu*div(grad_u) + grad(p) - b*ez + lift(tau_u2) = - u@grad(u)")
+    problem.add_equation("b(z=0) = Lz")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("b(z=Lz) = 0")
+    problem.add_equation("u(z=Lz) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(timestepper or d3.RK222, matsolver=matsolver)
+    b.fill_random("g", seed=42, distribution="normal", scale=1e-3)
+    b["g"] += (Lz - z)
+    return solver
+
+
+@pytest.mark.parametrize("timestepper", [d3.RK222, d3.SBDF2])
+def test_rb_banded_matches_dense(timestepper):
+    sd = build_rb(16, 64, timestepper=timestepper)
+    sb = build_rb(16, 64, matsolver="banded", timestepper=timestepper)
+    assert sd.ops.kind == "dense"
+    assert sb.ops.kind == "banded"
+    for _ in range(5):
+        sd.step(0.01)
+        sb.step(0.01)
+    Xd, Xb = np.asarray(sd.X), np.asarray(sb.X)
+    assert np.isfinite(Xd).all()
+    assert np.abs(Xd - Xb).max() < 1e-11
+
+
+def test_rb_banded_structure_scales():
+    """Pins and bandwidth must be resolution-independent: storage is
+    O(G * S * band), enabling the RB 2048x1024 target (VERDICT item 2)."""
+    stats = []
+    for Nz in (64, 256):
+        s = build_rb(8, Nz, matsolver="banded")
+        st = s.structure
+        stats.append((st.t_pins, st.kl, st.ku))
+    assert stats[0] == stats[1]
+    # storage for M+L at Nz=256 stays far below dense G*S^2
+    s = build_rb(8, 256, matsolver="banded")
+    nbytes = sum(a.nbytes for n in ("M", "L") for a in s._matrices[n].values())
+    G, S = s.pencil_shape
+    assert nbytes < 0.1 * (2 * G * S * S * 8)
+
+
+def test_rb_banded_matvec_matches_densified():
+    s = build_rb(8, 32, matsolver="banded")
+    G, S = s.pencil_shape
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((G, S))
+    for name, mat in (("M", s.M_mat), ("L", s.L_mat)):
+        y = np.asarray(s.ops.matvec(mat, jnp.asarray(x)))
+        for g in range(G):
+            A = s.ops.densify_host(s._matrices[name], g)
+            assert np.abs(y[g] - A @ x[g]).max() < 1e-10
+
+
+def build_poisson(matsolver=None):
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 2*np.pi))
+    zb = d3.ChebyshevT(coords["z"], size=64, bounds=(0, 1))
+    u = dist.Field(name="u", bases=(xb, zb))
+    tau1 = dist.Field(name="tau1", bases=xb)
+    tau2 = dist.Field(name="tau2", bases=xb)
+    f = dist.Field(name="f", bases=(xb, zb))
+    x, z = dist.local_grids(xb, zb)
+    f["g"] = np.sin(2*x)*np.cos(np.pi*z)
+    lift_basis = zb.derivative_basis(1)
+    lift = lambda A, n: d3.Lift(A, lift_basis, n)
+    problem = d3.LBVP([u, tau1, tau2], namespace=locals())
+    problem.add_equation("lap(u) + lift(tau1,-1) + lift(tau2,-2) = f")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("u(z=1) = 0")
+    solver = problem.build_solver(matsolver=matsolver)
+    solver.solve()
+    return np.asarray(u["g"])
+
+
+def test_lbvp_banded_matches_dense():
+    """The pure-elliptic LBVP is the hard case: a boundary-row Schur
+    complement is exponentially ill-conditioned here; the pinned Woodbury
+    form must still solve it to near machine precision."""
+    ud = build_poisson()
+    ub = build_poisson(matsolver="banded")
+    assert np.abs(ud).max() > 1e-3
+    assert np.abs(ud - ub).max() < 1e-12
+
+
+def build_ball(matsolver=None):
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    ball = d3.BallBasis(coords, shape=(8, 4, 16), radius=1.0)
+    u = dist.Field(name="u", bases=ball)
+    tau = dist.Field(name="tau", bases=ball.surface)
+    lift = lambda A: d3.Lift(A, ball, -1)
+    problem = d3.IVP([u, tau], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(tau) = 0")
+    problem.add_equation("u(r=1) = 0")
+    solver = problem.build_solver(d3.SBDF2, matsolver=matsolver)
+    u.fill_random("g", seed=7, scale=1e-2)
+    for _ in range(5):
+        solver.step(1e-3)
+    return np.asarray(solver.X)
+
+
+def test_ball_banded_matches_dense():
+    """Curvilinear (per-ell coupled radial) pencils on the banded path."""
+    Xd = build_ball()
+    Xb = build_ball(matsolver="banded")
+    assert np.isfinite(Xd).all()
+    assert np.abs(Xd).max() > 1e-6
+    assert np.abs(Xd - Xb).max() < 1e-12
+
+
+def test_auto_selects_dense_for_small():
+    s = build_rb(8, 16)
+    assert s.ops.kind == "dense"
