@@ -159,7 +159,10 @@ class Jacobi(Basis):
 
     def __init__(self, coord, size, bounds, a, b, a0=None, b0=None,
                  dealias=1.0, library=None, k=None):
-        super().__init__(coord, size, bounds, dealias=dealias, library=library or "matrix")
+        # default library comes from config DEFAULT_LIBRARY; the 'fft' plan
+        # is the DCT fast path for Chebyshev grids and falls back to the
+        # MMT internally for other Jacobi families
+        super().__init__(coord, size, bounds, dealias=dealias, library=library)
         if a0 is None:
             a0 = a
         if b0 is None:

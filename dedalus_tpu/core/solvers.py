@@ -330,6 +330,7 @@ class InitialValueSolver(SolverBase):
         from .evaluator import Evaluator
         self.evaluator = Evaluator(self)
         self.dt = None
+        self._project_state = None
 
     @property
     def proceed(self):
@@ -345,6 +346,35 @@ class InitialValueSolver(SolverBase):
             return False
         return True
 
+    def enforce_hermitian_symmetry(self):
+        """
+        Re-project the state through a dealiased grid roundtrip
+        (reference: core/solvers.py:675-692 enforce_hermitian_symmetry).
+        Real-dtype storage makes Hermitian drift structurally impossible
+        here (RealFourier keeps real arrays end-to-end), but the roundtrip
+        still projects accumulated drift out of non-representable modes
+        (curvilinear triangular truncation, Nyquist slots).
+        """
+        if self._project_state is None:
+            from .field import transform_to_grid, transform_to_coeff
+            layout, variables = self.layout, self.variables
+
+            @jax.jit
+            def project(X):
+                arrays = scatter_state(layout, variables, X)
+                out = {}
+                for v in variables:
+                    scales = tuple(v.domain.dealias)
+                    tdim = len(v.tensorsig)
+                    g = transform_to_grid(arrays[v.name], v.domain, scales,
+                                          tdim, tensorsig=v.tensorsig)
+                    out[v.name] = transform_to_coeff(g, v.domain, scales, tdim,
+                                                     tensorsig=v.tensorsig)
+                return gather_state(layout, variables, out)
+
+            self._project_state = project
+        self.X = self._project_state(self.X)
+
     def step(self, dt, wall_time=None):
         """Advance the system by one timestep (reference: core/solvers.py:683)."""
         dt = float(dt)
@@ -355,6 +385,12 @@ class InitialValueSolver(SolverBase):
         # pick up user modifications of the state fields (version-tracked)
         if self.fields_dirty():
             self.X = self.gather_fields()
+        # Hermitian/valid-mode re-projection cadence (reference:
+        # core/solvers.py:688-692 — enforced for timestepper.steps
+        # consecutive iterations so the multistep history stays consistent)
+        if self.enforce_real_cadence:
+            if self.iteration % self.enforce_real_cadence < self.timestepper.steps:
+                self.enforce_hermitian_symmetry()
         self.timestepper.step(dt)
         self.defer_scatter(self.X)
         self.snapshot_versions()

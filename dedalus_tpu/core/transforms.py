@@ -99,12 +99,153 @@ class JacobiMMT(MatrixTransform):
         return jacobi.build_polynomials(basis.size, basis.a, basis.b, x).T
 
 
+def _dct2(x):
+    """
+    Unnormalized DCT-II along the last axis with explicit dtype control:
+    y_n = 2 sum_j x_j cos(pi n (2j+1) / (2N)), via Makhoul's single
+    length-N FFT of the even/odd reordering. jax.scipy.fft.dct is avoided
+    because its internal padding promotes f32 inputs to f64 under x64,
+    and TPU backends have no f64 FFT kernels.
+    """
+    if jnp.iscomplexobj(x):
+        # Makhoul's Re() identity only holds for real input: transform the
+        # real and imaginary parts separately
+        return _dct2(x.real) + 1j * _dct2(x.imag)
+    N = x.shape[-1]
+    cdt = jnp.complex64 if x.dtype == jnp.float32 else jnp.complex128
+    v = jnp.concatenate([x[..., 0::2], x[..., 1::2][..., ::-1]], axis=-1)
+    V = jnp.fft.fft(v.astype(cdt), axis=-1)
+    n = np.arange(N)
+    phase = jnp.asarray(np.exp(-1j * np.pi * n / (2 * N)), dtype=cdt)
+    return 2.0 * (phase * V).real.astype(x.dtype)
+
+
+def _idct2(y):
+    """
+    Inverse of _dct2 (up to the factor 2N): x_j such that
+    _dct2(x) = y; equivalently a DCT-III evaluation
+    x_j = y_0/(2N) + (1/N) sum_{n>=1} y_n cos(pi n (2j+1)/(2N)).
+    """
+    if jnp.iscomplexobj(y):
+        return _idct2(y.real) + 1j * _idct2(y.imag)
+    N = y.shape[-1]
+    cdt = jnp.complex64 if y.dtype == jnp.float32 else jnp.complex128
+    n = np.arange(N)
+    phase = jnp.asarray(np.exp(1j * np.pi * n / (2 * N)) / 2, dtype=cdt)
+    yrev = jnp.concatenate([jnp.zeros_like(y[..., :1]), y[..., 1:][..., ::-1]],
+                           axis=-1)
+    W = phase * (y.astype(cdt) - 1j * yrev.astype(cdt))
+    v = jnp.fft.ifft(W, axis=-1).real.astype(y.dtype)
+    half = (N + 1) // 2
+    x = jnp.zeros_like(v)
+    x = x.at[..., 0::2].set(v[..., :half])
+    x = x.at[..., 1::2].set(v[..., half:][..., ::-1])
+    return x
+
+
 @register_transform("Jacobi", "fft")
-class JacobiAuto(JacobiMMT):
+class FastChebyshevTransform(TransformPlan):
     """
-    Placeholder fast path: Chebyshev DCT-via-FFT lands here later; MMT is
-    already MXU-native and is used in the meantime.
+    O(N log N) Chebyshev transform via DCT with ultraspherical conversion
+    (reference: core/transforms.py:801-890 FastChebyshevTransform).
+
+    Applies to the Chebyshev grid family (a0 = b0 = -1/2):
+      forward : flip grid -> DCT-II -> classical->orthonormal rescale ->
+                truncate -> banded conversion to level k (vectorized
+                diagonal shifts, offsets 0, 2, .., 2k)
+      backward: inverse conversion k -> 0 solved level-by-level; each
+                2-diagonal upper-triangular level telescopes into a
+                strided reversed CUMSUM (no sequential scan on device) ->
+                rescale -> zero-pad -> DCT-III -> flip.
+    The cumsum chain weights are prefix products of the conversion
+    diagonal ratios, checked at build time for overflow; non-Chebyshev
+    families (no DCT grid) and unstable chains fall back to the MMT,
+    which is itself MXU-native.
     """
+
+    def __init__(self, basis, scale):
+        super().__init__(basis, scale)
+        self.cheb = (basis.a0 == -0.5 and basis.b0 == -0.5)
+        self._mmt = None
+        # no DCT grid for non-Chebyshev families; coarse scales (Ng < N)
+        # need the rectangular MMT
+        if not self.cheb or self.Ng < self.N:
+            self._mmt = JacobiMMT(basis, scale)
+            return
+        from ..tools import jacobi as jt
+        N, Ng, k = self.N, self.Ng, basis.k
+        self.k = k
+        # orthonormal P_n = r_n * cos(n theta): r_0 = 1/sqrt(pi), else sqrt(2/pi)
+        r = np.full(N, np.sqrt(2.0 / np.pi))
+        r[0] = 1.0 / np.sqrt(np.pi)
+        self.rescale = r
+        # per-level conversion diagonals (a0+l, b0+l) -> (a0+l+1, b0+l+1)
+        self.levels = []
+        stable = True
+        for l in range(k):
+            C = np.asarray(jt.conversion_matrix(N, basis.a0 + l, basis.b0 + l, 1, 1))
+            d0 = np.diagonal(C).copy()
+            d2 = np.zeros(N)
+            d2[:N - 2] = np.diagonal(C, 2)
+            # chain prefix products H_n (parity-strided) for the cumsum
+            # inverse: u_n = (1/H_n) * revcumsum_parity(H * v/d0), with
+            # H_{n+2} = H_n * (-d2_n / d0_n)
+            rho = -d2 / d0
+            H = np.ones(N)
+            for n in range(2, N):
+                H[n] = H[n - 2] * rho[n - 2]
+            if not np.all(np.isfinite(H)) or np.abs(H).max() > 1e280 or \
+                    np.abs(H[H != 0]).min() < 1e-280:
+                stable = False
+            self.levels.append((d0, d2, H))
+        if not stable:
+            self._mmt = JacobiMMT(basis, scale)
+
+    @staticmethod
+    def _revcumsum_parity(x):
+        """Reversed cumulative sum along the last axis within each parity
+        chain (stride-2): out[n] = sum_{m >= n, m = n mod 2} x[m]."""
+        n = x.shape[-1]
+        if n % 2:
+            x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+        pairs = x.reshape(x.shape[:-1] + (-1, 2))
+        acc = jnp.cumsum(pairs[..., ::-1, :], axis=-2)[..., ::-1, :]
+        return acc.reshape(x.shape[:-1] + (-1,))[..., :n]
+
+    def forward(self, gdata, axis):
+        if self._mmt is not None:
+            return self._mmt.forward(gdata, axis)
+        N, Ng = self.N, self.Ng
+        data = jnp.moveaxis(gdata, axis, -1)[..., ::-1]
+        dt = data.dtype
+        y = _dct2(data)                                # y_n = 2 sum g cos(n th)
+        chat = y / Ng
+        chat = chat.at[..., 0].divide(2.0)
+        # constants cast to the data dtype: f32 data must not promote to
+        # f64 (TPU backends have no f64 FFT kernels)
+        u = chat[..., :N] / jnp.asarray(self.rescale, dtype=dt)
+        for d0, d2, H in self.levels:
+            v = jnp.asarray(d0, dtype=dt) * u
+            v = v.at[..., :N - 2].add(jnp.asarray(d2[:N - 2], dtype=dt)
+                                      * u[..., 2:])
+            u = v
+        return jnp.moveaxis(u, -1, axis)
+
+    def backward(self, cdata, axis):
+        if self._mmt is not None:
+            return self._mmt.backward(cdata, axis)
+        N, Ng = self.N, self.Ng
+        u = jnp.moveaxis(cdata, axis, -1)
+        dt = u.dtype
+        for d0, d2, H in reversed(self.levels):
+            Hj = jnp.asarray(H, dtype=dt)
+            u = self._revcumsum_parity(Hj * u / jnp.asarray(d0, dtype=dt)) / Hj
+        chat = u * jnp.asarray(self.rescale, dtype=dt)
+        chat = jnp.pad(chat, [(0, 0)] * (chat.ndim - 1) + [(0, Ng - N)])
+        # _idct2(y)_j = y_0/(2Ng) + (1/Ng) sum_n y_n cos(n th_j)
+        chat = chat.at[..., 0].multiply(2.0)
+        g = _idct2(chat * Ng)
+        return jnp.moveaxis(g[..., ::-1], -1, axis)
 
 
 @register_transform("RealFourier", "matrix")

@@ -107,3 +107,69 @@ def test_jacobi_derivative_level_transforms(rng):
     c = np.asarray(zb2.forward_transform(jnp.asarray(f), 0, 1.0))
     g = np.asarray(zb2.backward_transform(jnp.asarray(c), 0, 1.0))
     assert np.allclose(g, f)
+
+
+@pytest.mark.parametrize("N", [8, 64, 256])
+@pytest.mark.parametrize("k", [0, 1, 2])
+@pytest.mark.parametrize("scale", [1.0, 1.5])
+def test_fast_chebyshev_vs_mmt(N, k, scale, rng):
+    """DCT fast path vs the MMT oracle (reference pattern:
+    tests/test_transforms.py fast-vs-matrix checks; math reference:
+    core/transforms.py:801-890 FastChebyshevTransform)."""
+    import dedalus_tpu.public as d3
+    from dedalus_tpu.core import transforms as tr
+    coords = d3.CartesianCoordinates("z")
+    d3.Distributor(coords, dtype=np.float64)
+    zb = d3.ChebyshevT(coords["z"], size=N, bounds=(0, 1)).derivative_basis(k)
+    mmt = tr.get_plan(zb, scale, "matrix")
+    fft = tr.get_plan(zb, scale, "fft")
+    assert fft._mmt is None  # really the DCT path
+    Ng = zb.grid_size(scale)
+    g = rng.standard_normal((3, Ng))
+    cm = np.asarray(mmt.forward(jnp.asarray(g), 1))
+    cf = np.asarray(fft.forward(jnp.asarray(g), 1))
+    assert np.abs(cm - cf).max() < 1e-11 * max(1, np.abs(cm).max())
+    c = rng.standard_normal((3, N))
+    gm = np.asarray(mmt.backward(jnp.asarray(c), 1))
+    gf = np.asarray(fft.backward(jnp.asarray(c), 1))
+    assert np.abs(gm - gf).max() < 1e-11 * max(1, np.abs(gm).max())
+
+
+def test_legendre_fft_falls_back_to_mmt(rng):
+    """Non-Chebyshev Jacobi grids have no DCT; the fft plan must still be
+    correct by falling back to the MMT."""
+    import dedalus_tpu.public as d3
+    from dedalus_tpu.core import transforms as tr
+    coords = d3.CartesianCoordinates("z")
+    d3.Distributor(coords, dtype=np.float64)
+    zb = d3.Legendre(coords["z"], size=32, bounds=(0, 1))
+    fft = tr.get_plan(zb, 1.0, "fft")
+    assert fft._mmt is not None
+    g = rng.standard_normal(32)
+    c = np.asarray(fft.forward(jnp.asarray(g), 0))
+    g2 = np.asarray(fft.backward(jnp.asarray(c), 0))
+    assert np.abs(g - g2).max() < 1e-12
+
+
+def test_fast_chebyshev_complex_and_coarse(rng):
+    """Complex data must survive the DCT path (real/imag split), and
+    coarse scales (Ng < N) must route to the rectangular MMT."""
+    import dedalus_tpu.public as d3
+    from dedalus_tpu.core import transforms as tr
+    coords = d3.CartesianCoordinates("z")
+    d3.Distributor(coords, dtype=np.complex128)
+    zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1))
+    mmt = tr.get_plan(zb, 1.0, "matrix")
+    fft = tr.get_plan(zb, 1.0, "fft")
+    g = rng.standard_normal(16) + 1j * rng.standard_normal(16)
+    cm = np.asarray(mmt.forward(jnp.asarray(g), 0))
+    cf = np.asarray(fft.forward(jnp.asarray(g), 0))
+    assert np.abs(cm - cf).max() < 1e-13
+    gm = np.asarray(mmt.backward(jnp.asarray(cm), 0))
+    gf = np.asarray(fft.backward(jnp.asarray(cm), 0))
+    assert np.abs(gm - gf).max() < 1e-13
+    coarse = tr.get_plan(zb, 0.5, "fft")
+    assert coarse._mmt is not None
+    c = rng.standard_normal(16)
+    out = np.asarray(coarse.backward(jnp.asarray(c), 0))
+    assert out.shape == (8,)
