@@ -135,6 +135,18 @@ class Add(Future):
                 out[var] = out.get(var) + mat if var in out else mat
         return out
 
+    def frechet_differential(self, variables, perturbations):
+        # d(a + b) = da + db: the generic multilinear rule (rebuild with one
+        # differentiated arg, siblings kept) would wrongly retain the
+        # undifferentiated residual terms for a linear node.
+        out = 0
+        for a in self.args:
+            if isinstance(a, (Field, Future)):
+                d = a.frechet_differential(variables, perturbations)
+                if not (_is_scalar(d) and d == 0):
+                    out = out + d
+        return out
+
 
 class ScalarMultiply(Future):
     """Multiplication by a scalar constant: linear, layout-agnostic."""
@@ -321,7 +333,19 @@ class ProductBase(Future):
         rank_in = spherical_rank(operand.tensorsig, basis.cs)
         ncomp_n = 3 ** rank_n
         radial_flat = ncomp_n - 1  # flat index of (2, ..., 2)
+        # Cache radial multiplication stacks across groups of ONE assembly
+        # sweep, invalidated when any field feeding the NCC changes (NLBVP
+        # Jacobian rebuilds re-evaluate the NCC around the moving state;
+        # a stale cache froze the Newton iteration's Jacobian).
+        ncc_src = self.args[ncc_index]
+        if isinstance(ncc_src, Field):
+            version = ((id(ncc_src), ncc_src._version),)
+        else:
+            version = tuple(sorted((id(a), a._version)
+                                   for a in ncc_src.atoms(Field)))
         cache = getattr(self, "_sph_ncc_cache", None)
+        if cache is not None and cache.get("version") != version:
+            cache = None
         if cache is None:
             # Validate: only the all-radial component, angularly constant.
             grid = np.asarray(ncc["g"])
@@ -338,7 +362,8 @@ class ProductBase(Future):
                     "LHS NCCs on spherical bases must be angularly constant.")
             profile_coeffs = ncc_basis.scalar_radial_coeffs(profile[0, 0],
                                                             l_env=rank_n)
-            cache = self._sph_ncc_cache = {"coeffs": profile_coeffs}
+            cache = self._sph_ncc_cache = {"coeffs": profile_coeffs,
+                                           "version": version}
         profile_coeffs = cache["coeffs"]
 
         layout = subproblem.layout

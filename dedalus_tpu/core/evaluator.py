@@ -161,9 +161,24 @@ class FileHandler(Handler):
         self.writes_in_set = 0
         os.makedirs(self.base_path, exist_ok=True)
         if self.mode == "append":
-            existing = sorted(self.base_path.glob(f"{self.base_path.name}_s*.h5"))
+            # continue set and write numbering from existing output
+            # (reference: core/evaluator.py:415-438 append-mode bookkeeping)
+            def set_number(p):
+                tail = p.stem.rsplit("_s", 1)[1]
+                return int(tail) if tail.isdigit() else None
+            existing = sorted(
+                (p for p in self.base_path.glob(f"{self.base_path.name}_s*.h5")
+                 if set_number(p) is not None), key=set_number)
             if existing:
-                self.set_num = len(existing)
+                import h5py
+                self.set_num = set_number(existing[-1])
+                # scan back past empty/partial sets (e.g. from a crashed
+                # run) so write_number stays globally unique
+                for path in reversed(existing):
+                    with h5py.File(path, "r") as f:
+                        if "scales/write_number" in f and len(f["scales/write_number"]):
+                            self.write_num = int(np.asarray(f["scales/write_number"])[-1])
+                            break
 
     def _new_file(self):
         import h5py

@@ -1,0 +1,76 @@
+"""
+CFL and flow-tools tests (reference: dedalus/tests/test_cfl.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.extras.flow_tools import CFL, GlobalFlowProperty
+
+
+def build_advection(vx=2.0, vz=0.5, Nx=32, Nz=16):
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=Nx, bounds=(0, 2 * np.pi))
+    zb = d3.RealFourier(coords["z"], size=Nz, bounds=(0, 1))
+    u = dist.VectorField(coords, name="u", bases=(xb, zb))
+    s = dist.Field(name="s", bases=(xb, zb))
+    problem = d3.IVP([s, u], namespace={})
+    problem.add_equation((d3.dt(s), 0))
+    problem.add_equation((d3.dt(u), 0))
+    solver = problem.build_solver(d3.SBDF1)
+    u["g"] = np.array([[[vx]], [[vz]]]) * np.ones((2, Nx, Nz))
+    return solver, u, coords
+
+
+def test_cfl_uniform_advection():
+    """dt = safety / max(sum_i |u_i| / dx_i) for uniform velocity
+    (reference: extras/flow_tools.py:191 compute_timestep)."""
+    vx, vz, Nx, Nz = 2.0, 0.5, 32, 16
+    solver, u, coords = build_advection(vx, vz, Nx, Nz)
+    cfl = CFL(solver, initial_dt=1.0, safety=0.4, threshold=0.0)
+    cfl.add_velocity(u)
+    dt = cfl.compute_timestep()
+    dx = 2 * np.pi / Nx   # bases built at dealias=1
+    dz = 1.0 / Nz
+    expected = 0.4 / (vx / dx + vz / dz)
+    assert abs(dt - expected) / expected < 0.05
+
+
+def test_cfl_bounds_and_threshold():
+    solver, u, coords = build_advection(2.0, 0.0)
+    # max_dt bound binds for tiny velocity
+    u["g"] *= 1e-8
+    cfl = CFL(solver, initial_dt=1.0, safety=0.5, max_dt=0.25)
+    cfl.add_velocity(u)
+    assert cfl.compute_timestep() == 0.25
+    # threshold suppresses small changes
+    solver2, u2, _ = build_advection(2.0, 0.0)
+    cfl2 = CFL(solver2, initial_dt=1.0, safety=0.5, threshold=0.5)
+    cfl2.add_velocity(u2)
+    dt1 = cfl2.compute_timestep()
+    u2["g"] *= 1.2   # < 50% change in frequency
+    u2.mark_modified()
+    solver2.iteration += 1
+    cfl2.cadence = 1
+    dt2 = cfl2.compute_timestep()
+    assert dt2 == dt1
+
+
+def test_cfl_min_max_change():
+    solver, u, coords = build_advection(2.0, 0.0)
+    cfl = CFL(solver, initial_dt=1e-4, safety=0.5, max_change=1.5)
+    cfl.add_velocity(u)
+    dt = cfl.compute_timestep()
+    assert abs(dt - 1.5e-4) < 1e-12
+
+
+def test_global_flow_property():
+    solver, u, coords = build_advection(3.0, 0.0)
+    flow = GlobalFlowProperty(solver, cadence=1)
+    flow.add_property(u @ u, name="u2")
+    solver.step(1e-3)
+    assert abs(flow.max("u2") - 9.0) < 1e-8
+    assert abs(flow.min("u2") - 9.0) < 1e-8
+    assert abs(flow.grid_average("u2") - 9.0) < 1e-8

@@ -1,0 +1,79 @@
+"""
+EVP tests against analytic spectra (reference: dedalus/tests/test_evp.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+def build_waves(N=32, L=1.0):
+    """u_xx = -lam*u with Dirichlet BCs: lam_k = (k pi / L)^2."""
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=np.complex128)
+    xb = d3.ChebyshevT(coords["x"], size=N, bounds=(0, L))
+    u = dist.Field(name="u", bases=xb)
+    t1 = dist.Field(name="t1")
+    t2 = dist.Field(name="t2")
+    lam = dist.Field(name="lam")
+    lift = lambda A, n: d3.Lift(A, xb.derivative_basis(1), n)
+    problem = d3.EVP([u, t1, t2], eigenvalue=lam, namespace=locals())
+    problem.add_equation("lap(u) + lam*u + lift(t1,-1) + lift(t2,-2) = 0")
+    problem.add_equation("u(x=0) = 0")
+    problem.add_equation(f"u(x={L}) = 0")
+    return problem.build_solver(), L
+
+
+def test_waves_dense_eigenvalues():
+    """Dense solve recovers the Dirichlet Laplacian spectrum
+    (reference: tests/test_evp.py waves tests)."""
+    solver, L = build_waves()
+    evals = solver.solve_dense(solver.subproblems[0])
+    evals = np.sort(evals.real)
+    exact = ((np.arange(1, 9) * np.pi / L) ** 2)
+    # low eigenvalues resolved to high accuracy
+    assert np.allclose(evals[:8], exact, rtol=1e-8)
+
+
+def test_waves_dense_left_biorthonormality():
+    """Left eigenvectors normalized against -M (reference:
+    core/solvers.py:180 solve_dense(left=True) biorthonormalization)."""
+    solver, L = build_waves(N=24)
+    sp = solver.subproblems[0]
+    solver.solve_dense(sp, left=True)
+    M = solver.ops.densify_host(solver._matrices["M"], sp.index)
+    right = solver.eigenvectors
+    left = solver.left_eigenvectors
+    B = np.conj(left).T @ (-M) @ right
+    # modes with distinct eigenvalues are biorthonormal
+    n = min(8, B.shape[0])
+    assert np.allclose(B[:n, :n], np.eye(n), atol=1e-8)
+
+
+def test_waves_sparse_target():
+    """Sparse shift-invert finds eigenvalues near the target
+    (reference: core/solvers.py:225 solve_sparse)."""
+    solver, L = build_waves()
+    target = (3 * np.pi / L) ** 2
+    evals = solver.solve_sparse(solver.subproblems[0], N=3, target=target + 1.0)
+    found = np.sort(np.abs(evals.real))
+    assert np.any(np.abs(found - target) < 1e-6 * target)
+
+
+def test_evp_set_state():
+    """set_state loads an eigenmode into the state fields
+    (reference: core/solvers.py:296 set_state)."""
+    solver, L = build_waves()
+    solver.solve_dense(solver.subproblems[0])
+    order = np.argsort(solver.eigenvalues.real)
+    solver.set_state(int(order[0]))
+    u = solver.problem.variables[0]
+    x = np.linspace(0, L, 64)[1:-1]
+    # mode shape ~ sin(pi x / L) up to complex scale
+    from dedalus_tpu.core.operators import Interpolate
+    g = np.asarray(u["g"]).ravel()
+    grid = u.domain.bases[0].global_grid(1.0)
+    ref = np.sin(np.pi * grid / L)
+    scale = g[np.argmax(np.abs(g))] / ref[np.argmax(np.abs(g))]
+    assert np.allclose(g, scale * ref, atol=1e-8 * abs(scale))

@@ -1,0 +1,70 @@
+"""
+NLBVP tests (reference: dedalus/tests/test_nlbvp.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+@pytest.mark.parametrize("dealias", [1, 1.5])
+def test_sin_jacobi(dealias):
+    """Find cos(x) from the nonlinear ODE dx(u)^2 + u^2 = 1, u(0) = 1
+    (reference: tests/test_nlbvp.py:14 test_sin_jacobi)."""
+    # tolerance matches the reference: the root is degenerate (v = sin x is
+    # a null direction of the Jacobian at u = cos x compatible with the BC),
+    # so Newton converges linearly at rate 1/2 here, not quadratically
+    N = 12
+    tolerance = 1e-6
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.ChebyshevT(coords["x"], size=N, bounds=(0, 1), dealias=dealias)
+    x, = dist.local_grids(xb)
+    u = dist.Field(name="u", bases=xb)
+    tau = dist.Field(name="tau")
+    dx = lambda A: d3.Differentiate(A, coords["x"])
+    lift = lambda A: d3.Lift(A, xb.derivative_basis(1), -1)
+    problem = d3.NLBVP([u, tau], namespace=locals())
+    problem.add_equation("dx(u)**2 + u**2 + lift(tau) = 1")
+    problem.add_equation("u(x=0) = 1")
+    solver = problem.build_solver()
+    u["g"] = 1 - x / 2
+    error = np.inf
+    while error > tolerance:
+        solver.newton_iteration()
+        error = solver.perturbation_norm()
+        assert solver.iteration <= 20
+    assert np.allclose(np.asarray(u["g"]), np.cos(x))
+
+
+def test_lane_emden():
+    """Lane-Emden n=3 stellar structure on the ball: lap(f) = -f^3 with
+    floating amplitude; the recovered radius R = f(0)^((n-1)/2) matches
+    Boyd's reference value (reference: tests/test_nlbvp.py:92
+    test_lane_emden_floating_amp, R_ref[3.0] = 6.896848619376960)."""
+    n = 3.0
+    Nr = 64
+    tolerance = 1e-8
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    ball = d3.BallBasis(coords, shape=(4, 2, Nr), radius=1.0, dealias=2)
+    phi, theta, r = dist.local_grids(ball)
+    f = dist.Field(name="f", bases=ball)
+    tau = dist.Field(name="tau", bases=ball.surface)
+    lift = lambda A: d3.Lift(A, ball, -1)
+    problem = d3.NLBVP([f, tau], namespace=locals())
+    problem.add_equation("lap(f) + lift(tau) = - f**3")
+    problem.add_equation("f(r=1) = 0")
+    solver = problem.build_solver()
+    f["g"] = 5 * np.cos(np.pi / 2 * r) ** 2
+    error = np.inf
+    iters = 0
+    while error > tolerance and iters < 30:
+        solver.newton_iteration()
+        error = solver.perturbation_norm()
+        iters += 1
+    assert error < tolerance
+    f0 = np.asarray(d3.Interpolate(f, coords["r"], 0.0).evaluate()["g"]).ravel()[0]
+    R = f0 ** ((n - 1) / 2)
+    assert abs(R - 6.896848619376960) < 1e-5

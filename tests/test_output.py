@@ -1,0 +1,100 @@
+"""
+Output subsystem tests: FileHandler schema, set splitting, append-mode
+continuation, and checkpoint/restart equivalence
+(reference: dedalus/tests/test_output.py, core/evaluator.py:369-438).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+def build_heat(dtype=np.float64):
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=dtype)
+    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    problem = d3.IVP([u], namespace={})
+    problem.add_equation((d3.dt(u) - 0.1 * d3.lap(u), 0))
+    solver = problem.build_solver(d3.SBDF1)
+    x, = dist.local_grids(xb)
+    u["g"] = np.cos(x) + 0.5 * np.cos(3 * x)
+    return solver, u, x
+
+
+def test_filehandler_schema_and_sets(tmp_path):
+    import h5py
+    solver, u, x = build_heat()
+    out = tmp_path / "snaps"
+    handler = solver.evaluator.add_file_handler(out, iter=2, max_writes=2)
+    handler.add_task(u, name="u")
+    handler.add_task(d3.lap(u), name="lap_u")
+    for _ in range(10):
+        solver.step(1e-3)
+    files = sorted(out.glob("snaps_s*.h5"))
+    # 6 writes (first-step initial write at iter 1, then 2,4,6,8,10)
+    # at 2 writes/set -> 3 sets
+    assert len(files) == 3
+    with h5py.File(files[0], "r") as f:
+        assert "tasks/u" in f and "tasks/lap_u" in f
+        assert f["tasks/u"].shape == (2, 16)
+        for key in ("sim_time", "iteration", "write_number", "timestep",
+                    "wall_time"):
+            assert f"scales/{key}" in f
+        assert list(np.asarray(f["scales/write_number"])) == [1, 2]
+    with h5py.File(files[-1], "r") as f:
+        assert np.asarray(f["scales/write_number"])[-1] == 6
+
+
+def test_filehandler_append_continues_numbering(tmp_path):
+    import h5py
+    out = tmp_path / "snaps"
+    solver, u, x = build_heat()
+    h = solver.evaluator.add_file_handler(out, iter=1, max_writes=3)
+    h.add_task(u, name="u")
+    for _ in range(3):
+        solver.step(1e-3)
+    # second run in append mode continues set and write numbering
+    solver2, u2, _ = build_heat()
+    h2 = solver2.evaluator.add_file_handler(out, iter=1, max_writes=3,
+                                            mode="append")
+    h2.add_task(u2, name="u")
+    for _ in range(2):
+        solver2.step(1e-3)
+    files = sorted(out.glob("snaps_s*.h5"))
+    assert len(files) == 2
+    with h5py.File(files[1], "r") as f:
+        assert list(np.asarray(f["scales/write_number"])) == [4, 5]
+
+
+def test_checkpoint_restart_equivalence(tmp_path):
+    """load_state restores sim_time/iteration/fields so a restarted run
+    reproduces an uninterrupted one (reference: core/solvers.py:632)."""
+    out = tmp_path / "ckpt"
+    dt = 1e-3
+    # uninterrupted run: 10 steps
+    s1, u1, x = build_heat()
+    for _ in range(10):
+        s1.step(dt)
+    X_ref = np.asarray(s1.X)
+    # checkpointed run: 5 steps, write, restart into a fresh solver, 5 more
+    s2, u2, _ = build_heat()
+    h = s2.evaluator.add_file_handler(out, iter=5)
+    h.add_tasks(s2.state, layout="g")
+    for _ in range(5):
+        s2.step(dt)
+    s2.evaluator.evaluate_handlers([h], iteration=s2.iteration,
+                                   sim_time=s2.sim_time, timestep=dt)
+    s3, u3, _ = build_heat()
+    files = sorted(out.glob("ckpt_s*.h5"))
+    write, dt_loaded = s3.load_state(files[-1])
+    assert s3.iteration == 5
+    assert abs(s3.sim_time - 5 * dt) < 1e-12
+    assert dt_loaded == dt
+    for _ in range(5):
+        s3.step(dt)
+    X_restart = np.asarray(s3.X)
+    # SBDF1 carries one step of history; restart matches to history-startup
+    # accuracy for a single-step scheme: exact here
+    assert np.abs(X_restart - X_ref).max() < 1e-12
