@@ -125,9 +125,31 @@ class Distributor:
     def process_index(self):
         return jax.process_index()
 
-    def coeff_sharding(self, domain):
-        """NamedSharding for coefficient-layout arrays (None if no mesh)."""
-        return None
+    def _layout_sharding(self, shift, tensorsig):
+        """Mesh axis r shards spatial dim r + shift; tensor dims unsharded."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        if self.mesh is None:
+            return None
+        R = len(self.mesh.axis_names)
+        if R >= self.dim:
+            raise ValueError(f"Mesh rank {R} must be below the domain "
+                             f"dimension {self.dim}.")
+        dim_to_axis = {r + shift: self.mesh.axis_names[r] for r in range(R)}
+        spec = ([None] * len(tensorsig)
+                + [dim_to_axis.get(d) for d in range(self.dim)])
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
 
-    def grid_sharding(self, domain):
-        return None
+    def coeff_sharding(self, tensorsig=()):
+        """
+        NamedSharding for full-coefficient arrays: mesh axis r shards
+        spatial dim r (the reference's coeff-space block distribution of
+        the first R axes, core/distributor.py:59-74). None without a mesh.
+        """
+        return self._layout_sharding(0, tensorsig)
+
+    def grid_sharding(self, tensorsig=()):
+        """
+        NamedSharding for full-grid arrays: mesh axis r shards spatial dim
+        r+1 — the post-transpose-walk layout of the reference chain
+        (core/distributor.py:128-166)."""
+        return self._layout_sharding(1, tensorsig)

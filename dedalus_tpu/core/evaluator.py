@@ -179,6 +179,14 @@ class FileHandler(Handler):
                         if "scales/write_number" in f and len(f["scales/write_number"]):
                             self.write_num = int(np.asarray(f["scales/write_number"])[-1])
                             break
+                # resume the last set if it still has room, instead of
+                # opening a fresh under-filled set on every restart
+                with h5py.File(existing[-1], "r") as f:
+                    writes = (len(f["scales/write_number"])
+                              if "scales/write_number" in f else 0)
+                if writes < self.max_writes:
+                    self.current_file = str(existing[-1])
+                    self.writes_in_set = writes
 
     def _new_file(self):
         import h5py

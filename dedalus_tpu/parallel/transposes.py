@@ -4,12 +4,13 @@ Pencil redistribution via lax.all_to_all inside shard_map
 AlltoallvTranspose — the hand-written MPI pack/unpack loops become one XLA
 collective; the pack/unpack reshapes fuse into neighboring ops).
 
-A D-dimensional state on an R-dimensional device mesh keeps the first R axes
-block-distributed in coefficient space. Transforming an axis requires it to
-be device-local, so the layout walk alternates local transforms with these
-all-to-all transposes — exactly the reference's Transform/Transpose ladder
-(core/distributor.py:128-166), but compiled: under jit, XLA schedules the
-collective on the ICI and overlaps it with local compute where possible.
+A D-dimensional state on an R-dimensional device mesh keeps the first R
+axes block-distributed in coefficient space. Transforming an axis requires
+it to be device-local, so the layout walk alternates local transforms with
+these all-to-all transposes — exactly the reference's Transform/Transpose
+ladder (core/distributor.py:128-166), but compiled: under jit, XLA
+schedules the collective on the ICI and overlaps it with local compute
+where possible.
 """
 
 from functools import partial
@@ -18,28 +19,33 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def all_to_all_transpose(data, axis_in, axis_out, mesh, axis_name):
+def all_to_all_transpose(data, axis_in, axis_out, mesh, axis_name,
+                         layout=None):
     """
     Redistribute `data` from block-sharded along `axis_in` to block-sharded
-    along `axis_out` (both global axis indices), preserving the global array.
+    along `axis_out` (both global axis indices), preserving the global
+    array. `layout` maps OTHER array dims to mesh axis names that stay
+    sharded throughout (the multi-axis-mesh case: only `axis_name` moves).
 
     Equivalent to the reference's pencil transpose
-    (core/transposes.pyx:336-355 Alltoallv + split/combine loops): each
-    device exchanges tiles so that the formerly-distributed axis becomes
-    local and vice versa.
+    (core/transposes.pyx:336-355 Alltoallv + split/combine loops over one
+    mesh-axis subcommunicator, core/distributor.py:702-713).
     """
+    layout = dict(layout or {})
     n = mesh.shape[axis_name]
+    # local block divisibility: the out axis is split n-ways on top of any
+    # existing sharding of other dims
     if data.shape[axis_out] % n:
         raise ValueError(
             f"Axis {axis_out} (size {data.shape[axis_out]}) must divide the "
             f"mesh axis {axis_name!r} (size {n}).")
-    in_spec = [None] * data.ndim
+    in_spec = [layout.get(d) for d in range(data.ndim)]
+    out_spec = list(in_spec)
     in_spec[axis_in] = axis_name
-    out_spec = [None] * data.ndim
     out_spec[axis_out] = axis_name
 
     @partial(shard_map, mesh=mesh, in_specs=P(*in_spec), out_specs=P(*out_spec))
@@ -53,40 +59,80 @@ def all_to_all_transpose(data, axis_in, axis_out, mesh, axis_name):
 class DistributedPencilPipeline:
     """
     Distributed full-coefficient <-> full-grid transform pipeline for a
-    2D separable-x-coupled domain (e.g. Fourier x Chebyshev), with the x
-    axis block-distributed over a 1D mesh.
+    D-dimensional domain over an R-dimensional device mesh (R < D): mesh
+    axis r shards array dim r in coefficient space and array dim r+1 in
+    grid space (the reference's block "pencil" decomposition,
+    core/distributor.py:59-74).
 
-    Walk (mirroring the reference layout chain, core/distributor.py:128):
-      coeff (kx sharded, z local)
-        -> local z transform                       [Transform]
-        -> all_to_all: shard z, localize kx        [Transpose]
-        -> local x transform                       [Transform]
-      grid (x local, z sharded)
-
-    Each step is jnp inside one jit; the collective rides the ICI.
+    to_grid walk (mirroring the reference layout chain, :128-166):
+      for axis = D-1 .. R:  local backward transform      [Transform]
+      for r   = R-1 .. 0:   all_to_all mesh axis r: dim r -> dim r+1
+                            then local backward transform of dim r
+                                                          [Transpose+Transform]
+    to_coeff reverses the walk. Each step is jnp inside one jit; the
+    collectives ride the ICI. Tensor components (leading dims) are never
+    distributed.
     """
 
-    def __init__(self, domain, mesh, axis_name="x"):
+    def __init__(self, domain, mesh, axis_names=None):
         self.domain = domain
         self.mesh = mesh
-        self.axis_name = axis_name
-        if domain.dim != 2:
-            raise NotImplementedError("Pipeline implemented for 2D domains.")
-        self.xbasis, self.zbasis = domain.bases
+        if isinstance(axis_names, str):
+            axis_names = (axis_names,)
+        self.axis_names = tuple(axis_names or mesh.axis_names)
+        self.R = len(self.axis_names)
+        self.D = domain.dim
+        if self.R >= self.D:
+            raise ValueError(f"Mesh rank {self.R} must be below the domain "
+                             f"dimension {self.D}.")
+        for axis in range(self.D):
+            if domain.bases[axis] is None:
+                raise ValueError("Pipeline requires a basis on every axis.")
 
-    def to_grid(self, cdata, scales=(1.0, 1.0)):
+    def _transform(self, data, axis, scales, tensorsig, forward):
+        basis = self.domain.bases[axis]
+        fn = basis.forward_transform if forward else basis.backward_transform
+        return fn(data, len(tensorsig) + axis, scales[axis],
+                  tensorsig=tensorsig, sub_axis=axis - basis.first_axis)
+
+    def coeff_layout(self, tdim=0):
+        """{array dim: mesh axis} for full-coefficient arrays."""
+        return {tdim + r: self.axis_names[r] for r in range(self.R)}
+
+    def grid_layout(self, tdim=0):
+        """{array dim: mesh axis} for full-grid arrays."""
+        return {tdim + r + 1: self.axis_names[r] for r in range(self.R)}
+
+    def to_grid(self, cdata, scales=None, tensorsig=()):
         """Full coefficient -> full grid, sharded end-to-end."""
-        domain = self.domain
-        # z transform is local (axis 1 local while kx is sharded)
-        out = self.zbasis.backward_transform(cdata, 1, scales[1])
-        # kx -> x requires locality: transpose shards to the (larger) z axis
-        out = all_to_all_transpose(out, 0, 1, self.mesh, self.axis_name)
-        out = self.xbasis.backward_transform(out, 0, scales[0])
+        scales = scales or (1.0,) * self.D
+        D, R = self.D, self.R
+        tdim = len(tensorsig)
+        out = cdata
+        for axis in range(D - 1, R - 1, -1):
+            out = self._transform(out, axis, scales, tensorsig, forward=False)
+        layout = self.coeff_layout(tdim)
+        for r in range(R - 1, -1, -1):
+            del layout[tdim + r]
+            out = all_to_all_transpose(out, tdim + r, tdim + r + 1, self.mesh,
+                                       self.axis_names[r], layout=layout)
+            layout[tdim + r + 1] = self.axis_names[r]
+            out = self._transform(out, r, scales, tensorsig, forward=False)
         return out
 
-    def to_coeff(self, gdata, scales=(1.0, 1.0)):
+    def to_coeff(self, gdata, scales=None, tensorsig=()):
         """Full grid -> full coefficient, sharded end-to-end."""
-        out = self.xbasis.forward_transform(gdata, 0, scales[0])
-        out = all_to_all_transpose(out, 1, 0, self.mesh, self.axis_name)
-        out = self.zbasis.forward_transform(out, 1, scales[1])
+        scales = scales or (1.0,) * self.D
+        D, R = self.D, self.R
+        tdim = len(tensorsig)
+        out = gdata
+        layout = self.grid_layout(tdim)
+        for r in range(R):
+            out = self._transform(out, r, scales, tensorsig, forward=True)
+            del layout[tdim + r + 1]
+            out = all_to_all_transpose(out, tdim + r + 1, tdim + r, self.mesh,
+                                       self.axis_names[r], layout=layout)
+            layout[tdim + r] = self.axis_names[r]
+        for axis in range(R, D):
+            out = self._transform(out, axis, scales, tensorsig, forward=True)
         return out

@@ -98,3 +98,23 @@ def test_checkpoint_restart_equivalence(tmp_path):
     # SBDF1 carries one step of history; restart matches to history-startup
     # accuracy for a single-step scheme: exact here
     assert np.abs(X_restart - X_ref).max() < 1e-12
+
+
+def test_filehandler_append_resumes_partial_set(tmp_path):
+    import h5py
+    out = tmp_path / "snaps"
+    solver, u, x = build_heat()
+    h = solver.evaluator.add_file_handler(out, iter=1, max_writes=5)
+    h.add_task(u, name="u")
+    for _ in range(2):
+        solver.step(1e-3)
+    solver2, u2, _ = build_heat()
+    h2 = solver2.evaluator.add_file_handler(out, iter=1, max_writes=5,
+                                            mode="append")
+    h2.add_task(u2, name="u")
+    for _ in range(2):
+        solver2.step(1e-3)
+    files = sorted(out.glob("snaps_s*.h5"))
+    assert len(files) == 1   # resumed into the partially-filled set
+    with h5py.File(files[0], "r") as f:
+        assert list(np.asarray(f["scales/write_number"])) == [1, 2, 3, 4]
