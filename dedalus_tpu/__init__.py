@@ -27,3 +27,7 @@ __version__ = "0.1.0"
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+from .tools.logging import setup_logging
+
+setup_logging()

@@ -1,0 +1,51 @@
+"""
+Command-line interface (reference: dedalus/__main__.py:1-45):
+
+    python -m dedalus_tpu test          # run the test suite
+    python -m dedalus_tpu bench         # run the benchmark (bench.py)
+    python -m dedalus_tpu get_config    # print the resolved configuration
+    python -m dedalus_tpu get_examples  # print the examples directory
+"""
+
+import pathlib
+import sys
+
+
+def test():
+    import pytest
+    root = pathlib.Path(__file__).parent.parent
+    sys.exit(pytest.main([str(root / "tests"), "-q"]))
+
+
+def bench():
+    import runpy
+    root = pathlib.Path(__file__).parent.parent
+    bench_path = root / "bench.py"
+    if not bench_path.exists():
+        print("bench.py not found next to the package", file=sys.stderr)
+        sys.exit(1)
+    runpy.run_path(str(bench_path), run_name="__main__")
+
+
+def get_config():
+    from .tools.config import config
+    config.write(sys.stdout)
+
+
+def get_examples():
+    root = pathlib.Path(__file__).parent.parent / "examples"
+    print(root)
+
+
+def main():
+    commands = {"test": test, "bench": bench, "get_config": get_config,
+                "get_examples": get_examples}
+    if len(sys.argv) < 2 or sys.argv[1] not in commands:
+        print(f"usage: python -m dedalus_tpu [{'|'.join(commands)}]",
+              file=sys.stderr)
+        sys.exit(2)
+    commands[sys.argv[1]]()
+
+
+if __name__ == "__main__":
+    main()

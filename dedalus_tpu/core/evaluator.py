@@ -152,9 +152,11 @@ class FileHandler(Handler):
 
     def __init__(self, solver, base_path, max_writes=np.inf, mode=None, **kw):
         super().__init__(solver, **kw)
+        from ..tools.config import config
         self.base_path = pathlib.Path(base_path)
         self.max_writes = max_writes
-        self.mode = mode or "overwrite"
+        self.mode = mode or config["analysis"].get("FILEHANDLER_MODE_DEFAULT",
+                                                   "overwrite")
         self.set_num = 0
         self.write_num = 0
         self.current_file = None
@@ -163,15 +165,11 @@ class FileHandler(Handler):
         if self.mode == "append":
             # continue set and write numbering from existing output
             # (reference: core/evaluator.py:415-438 append-mode bookkeeping)
-            def set_number(p):
-                tail = p.stem.rsplit("_s", 1)[1]
-                return int(tail) if tail.isdigit() else None
-            existing = sorted(
-                (p for p in self.base_path.glob(f"{self.base_path.name}_s*.h5")
-                 if set_number(p) is not None), key=set_number)
+            from ..tools.post import get_assigned_sets
+            existing = get_assigned_sets(self.base_path)
             if existing:
                 import h5py
-                self.set_num = set_number(existing[-1])
+                self.set_num = int(existing[-1].stem.rsplit("_s", 1)[1])
                 # scan back past empty/partial sets (e.g. from a crashed
                 # run) so write_number stays globally unique
                 for path in reversed(existing):

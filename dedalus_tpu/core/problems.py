@@ -17,7 +17,8 @@ import numpy as np
 from .field import Field, Operand
 from .future import Future
 from .operators import (parseables, TimeDerivative, ConvertNode, dt as dt_op)
-from .arithmetic import Add, ScalarMultiply, MultiplyFields, _union_domain, _is_scalar
+from .arithmetic import (Add, Multiply, ScalarMultiply, MultiplyFields,
+                         _union_domain, _is_scalar)
 from .domain import Domain
 from ..tools.parsing import split_equation
 from ..tools.exceptions import UnsupportedEquationError, SymbolicParsingError
@@ -112,11 +113,16 @@ class ProblemBase:
         return ns
 
     def add_equation(self, equation, condition=None):
-        """Add an equation as a string or (LHS, RHS) tuple
-        (reference: core/problems.py:67 add_equation)."""
-        if condition is not None:
-            raise NotImplementedError("Per-group equation conditions are not "
-                                      "implemented yet.")
+        """
+        Add an equation as a string or (LHS, RHS) tuple
+        (reference: core/problems.py:67 add_equation).
+
+        `condition` is a per-group guard evaluated over separable group
+        indices named 'n' + coordinate name (e.g. "nx != 0"): the equation
+        only enters pencil groups satisfying it. Conditioned equations with
+        matching (bases, tensor signature) share one row block, exactly one
+        active per group (reference: core/subsystems.py:527-541).
+        """
         if isinstance(equation, str):
             lhs_str, rhs_str = split_equation(equation)
             ns = self.namespace
@@ -132,6 +138,7 @@ class ProblemBase:
             raise UnsupportedEquationError("Equation LHS must involve variables.")
         eq = self._build_matrix_expressions(lhs, rhs)
         eq["LHS_str"] = str(lhs)
+        eq["condition"] = condition
         self.equations.append(eq)
         return eq
 
@@ -219,6 +226,49 @@ class IVP(ProblemBase):
     def build_solver(self, timestepper, **kw):
         from .solvers import InitialValueSolver
         return InitialValueSolver(self, timestepper, **kw)
+
+    def build_EVP(self, eigenvalue=None, perturbations=None, **kw):
+        """
+        Convert this IVP into an EVP linearized about the CURRENT variable
+        values (reference: core/problems.py:364 build_EVP):
+            M.dt(X) + L.X = F(X)   ->   lam*M.X1 + L.X1 - F'(X0).X1 = 0
+        NCC data in the linearized operators reads the IVP variables, so
+        set the background state on them before solving.
+        """
+        variables = self.variables
+        if eigenvalue is None:
+            eigenvalue = self.dist.Field(name="lam")
+        if perturbations is None:
+            perturbations = []
+            for var in variables:
+                pert = Field(var.dist, bases=var.domain.bases,
+                             tensorsig=var.tensorsig,
+                             name=f"d_{var.name}", dtype=var.dtype)
+                perturbations.append(pert)
+        evp = EVP(perturbations, eigenvalue=eigenvalue)
+        for eq in self.equations:
+            terms = []
+            M_expr, L_expr, F_expr = eq.get("M"), eq.get("L"), eq.get("F")
+            if M_expr is not None:
+                sub = M_expr
+                for var, pert in zip(variables, perturbations):
+                    sub = sub.replace(var, pert)
+                terms.append(Multiply(eigenvalue, sub))
+            if L_expr is not None:
+                sub = L_expr
+                for var, pert in zip(variables, perturbations):
+                    sub = sub.replace(var, pert)
+                terms.append(sub)
+            if F_expr is not None:
+                if _contains_marker(F_expr, self.time):
+                    raise UnsupportedEquationError(
+                        "Cannot convert a time-dependent IVP to an EVP.")
+                dF = F_expr.frechet_differential(variables, perturbations)
+                if not (np.isscalar(dF) and dF == 0):
+                    terms.append(ScalarMultiply(-1.0, dF))
+            lhs = Add(*terms) if len(terms) > 1 else terms[0]
+            evp.add_equation((lhs, 0), condition=eq.get("condition"))
+        return evp
 
 
 class EVP(ProblemBase):

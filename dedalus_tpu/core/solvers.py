@@ -12,6 +12,8 @@ user code sees reference-like Field semantics while the hot loop stays on
 device (reference hot loop anatomy: core/solvers.py:683-711 + SURVEY.md §3.2).
 """
 
+import os
+import pathlib
 import time as time_mod
 import logging
 import numpy as np
@@ -22,7 +24,8 @@ import jax.numpy as jnp
 from .subsystems import (PencilLayout, build_subproblems, build_matrices,
                          assemble_group_coos, MatrixStructure,
                          build_banded_arrays, gather_state, scatter_state,
-                         row_valid_masks)
+                         row_valid_masks, merge_conditional_equations,
+                         active_member)
 from .future import EvalContext, ev
 from . import timesteppers as timesteppers_mod
 from ..libraries import pencilops
@@ -45,10 +48,13 @@ class SolverBase:
         if matsolver is None:
             matsolver = config["linear algebra"].get("MATRIX_SOLVER", "auto")
         self.matsolver = matsolver
-        self.layout = PencilLayout(self.dist, self.variables, problem.equations)
+        self.layout = PencilLayout(self.dist, self.variables,
+                                   problem.equations)
+        self.equations = merge_conditional_equations(problem.equations,
+                                                     self.dist, self.layout)
         self.subproblems = build_subproblems(self.layout)
         self._build_pencil_system()
-        self.valid_row_mask = row_valid_masks(self.layout, problem.equations)
+        self.valid_row_mask = row_valid_masks(self.layout, self.equations)
 
     def _build_pencil_system(self):
         """
@@ -84,7 +90,7 @@ class SolverBase:
             self._matrices = self._densify_coo_store(result, names, S)
         else:
             self._matrices = build_matrices(
-                self.subproblems, self.problem.equations, self.variables,
+                self.subproblems, self.equations, self.variables,
                 names=names)
         self.ops = pencilops.DenseOps(self._dense_matsolver())
 
@@ -121,7 +127,7 @@ class SolverBase:
         # and dense paths solve the same operator up to sub-tol out-of-band
         # entries dropped at fill time.
         tol = float(config["linear algebra"].get("BAND_DETECT_CUTOFF", "1e-14"))
-        equations = self.problem.equations
+        equations = self.equations
         coo_store = []
         masks = []
         acc = PatternAccumulator(S)
@@ -271,13 +277,29 @@ class SolverBase:
 
     # ------------------------------------------------------------------ RHS
 
+    def _member_masks(self):
+        """Per-block, per-member group-activity masks (None when always
+        active); computed once — conditions are static per problem."""
+        if getattr(self, "_member_masks_cache", None) is None:
+            groups = list(self.layout.groups())
+            out = []
+            for eq in self.equations:
+                out.append([None if cond is None
+                            else np.array([float(cond(g)) for g in groups])
+                            for _, cond in eq["members"]])
+            self._member_masks_cache = out
+        return self._member_masks_cache
+
     def build_rhs_evaluator(self, key="F", time_field=None):
         problem = self.problem
         layout = self.layout
         variables = self.variables
-        equations = problem.equations
+        equations = self.equations
         dim = self.dist.dim
         dtype = self.pencil_dtype
+
+        # per-block member selection masks for conditioned equations
+        member_masks = self._member_masks()
 
         def eval_F(X, t=None):
             arrays = scatter_state(layout, variables, X)
@@ -287,14 +309,21 @@ class SolverBase:
                                                (1,) * dim)
             ctx = EvalContext(subs)
             parts = []
-            for eq in equations:
-                expr = eq.get(key)
+            for eq, masks in zip(equations, member_masks):
                 size = layout.slot_size(eq["domain"], eq["tensorsig"])
-                if expr is None:
-                    parts.append(jnp.zeros((layout.n_groups, size), dtype=dtype))
-                else:
+                total = None
+                for (member, cond), mask in zip(eq["members"], masks):
+                    expr = member.get(key)
+                    if expr is None:
+                        continue
                     data = ev(expr, ctx, "c")
-                    parts.append(layout.gather(data, eq["domain"], eq["tensorsig"]))
+                    part = layout.gather(data, eq["domain"], eq["tensorsig"])
+                    if mask is not None:
+                        part = part * jnp.asarray(mask, dtype=self.real_dtype)[:, None]
+                    total = part if total is None else total + part
+                if total is None:
+                    total = jnp.zeros((layout.n_groups, size), dtype=dtype)
+                parts.append(total)
             return jnp.concatenate(parts, axis=1).astype(dtype)
 
         return eval_F
@@ -306,7 +335,9 @@ class InitialValueSolver(SolverBase):
     matrices = ("M", "L")
 
     def __init__(self, problem, timestepper, matsolver=None,
-                 enforce_real_cadence=100, warmup_iterations=10, **kw):
+                 enforce_real_cadence=100, warmup_iterations=10,
+                 profile=None, profile_directory=None, **kw):
+        init_t0 = time_mod.time()
         super().__init__(problem, matsolver=matsolver)
         self.M_mat = self.ops.to_device(self._matrices["M"], self.pencil_dtype)
         self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
@@ -331,6 +362,18 @@ class InitialValueSolver(SolverBase):
         self.evaluator = Evaluator(self)
         self.dt = None
         self._project_state = None
+        # Profiling (reference: core/solvers.py:546-561,780-806 cProfile
+        # phases; here a jax.profiler trace of the run phase + per-phase
+        # wall times dumped at log_stats)
+        if profile is None:
+            profile = config["profiling"].getboolean("PROFILE_DEFAULT",
+                                                     fallback=False)
+        self.profile = bool(profile)
+        self.profile_directory = pathlib.Path(
+            profile_directory
+            or config["profiling"].get("PROFILE_DIRECTORY", "profiles"))
+        self._setup_time = time_mod.time() - init_t0
+        self._trace_active = False
 
     @property
     def proceed(self):
@@ -375,6 +418,12 @@ class InitialValueSolver(SolverBase):
             self._project_state = project
         self.X = self._project_state(self.X)
 
+    def _stop_trace(self):
+        if self._trace_active:
+            jax.profiler.stop_trace()
+            self._trace_active = False
+            logger.info(f"Profiler trace written to {self.profile_directory}")
+
     def step(self, dt, wall_time=None):
         """Advance the system by one timestep (reference: core/solvers.py:683)."""
         dt = float(dt)
@@ -382,6 +431,15 @@ class InitialValueSolver(SolverBase):
             raise ValueError("Invalid timestep.")
         if self.iteration == self.warmup_iterations:
             self.warmup_time = time_mod.time()
+            if self.profile and not self._trace_active:
+                import atexit
+                os.makedirs(self.profile_directory, exist_ok=True)
+                jax.profiler.start_trace(str(self.profile_directory))
+                self._trace_active = True
+                # the trace must be closed even if the run dies before
+                # log_stats (exception, NaN abort) — stop_trace is global
+                # profiler state and a leaked session poisons later runs
+                atexit.register(self._stop_trace)
         # pick up user modifications of the state fields (version-tracked)
         if self.fields_dirty():
             self.X = self.gather_fields()
@@ -448,12 +506,17 @@ class InitialValueSolver(SolverBase):
 
     def log_stats(self, format=".4g"):
         """Log run statistics including the reference's throughput metric
-        (reference: core/solvers.py:755-778 log_stats, modes-stages/cpu-sec)."""
+        (reference: core/solvers.py:755-778 log_stats, modes-stages/cpu-sec),
+        and dump profile artifacts when enabled (reference:
+        core/solvers.py:780-806 dump_profiles)."""
         log_time = time_mod.time()
         total = log_time - self.init_time
+        self._stop_trace()
         logger.info(f"Final iteration: {self.iteration}")
         logger.info(f"Final sim time: {self.sim_time}")
         logger.info(f"Setup time (init - iter 0): {self.start_time - self.init_time:{format}} sec")
+        phases = {"setup": self._setup_time,
+                  "total": total}
         if self.iteration > self.warmup_iterations and self.warmup_time:
             warmup = self.warmup_time - self.start_time
             run = log_time - self.warmup_time
@@ -465,8 +528,15 @@ class InitialValueSolver(SolverBase):
             stages = self.timestepper.stages if hasattr(self.timestepper, "stages") else 1
             rate = modes * stages * iters / run if run > 0 else 0.0
             logger.info(f"Speed: {rate:.2e} mode-stages/sec")
+            phases.update({"warmup": warmup, "run": run, "run_iterations": iters,
+                           "mode_stages_per_sec": rate})
         else:
             logger.info(f"Total time: {total:{format}} sec")
+        if self.profile:
+            import json
+            os.makedirs(self.profile_directory, exist_ok=True)
+            with open(self.profile_directory / "phase_times.json", "w") as f:
+                json.dump(phases, f, indent=2)
 
 
 class LinearBoundaryValueSolver(SolverBase):
@@ -504,9 +574,13 @@ class NonlinearBoundaryValueSolver(SolverBase):
         self._problem_ref = problem
         super().__init__(problem, matsolver=matsolver)
         self.iteration = 0
-        # residual expressions converted to equation domains
-        self.residual_exprs = [problem._wrap(eq["residual"], eq["domain"])
-                               for eq in problem.equations]
+        # residual expressions converted to equation-block domains
+        self._residual_exprs = {}
+        for block in self.equations:
+            for member, cond in block["members"]:
+                if member.get("residual") is not None:
+                    self._residual_exprs[id(member)] = problem._wrap(
+                        member["residual"], block["domain"])
 
     def matrix_variables(self, problem):
         return problem.perturbations
@@ -519,13 +593,21 @@ class NonlinearBoundaryValueSolver(SolverBase):
         layout = self.layout
         ctx = EvalContext()
         parts = []
-        for eq, expr in zip(self.problem.equations, self.residual_exprs):
+        for eq, masks in zip(self.equations, self._member_masks()):
             size = layout.slot_size(eq["domain"], eq["tensorsig"])
-            if expr is None:
-                parts.append(jnp.zeros((layout.n_groups, size)))
-            else:
+            total = None
+            for (member, cond), mask in zip(eq["members"], masks):
+                expr = self._residual_exprs.get(id(member))
+                if expr is None:
+                    continue
                 data = ev(expr, ctx, "c")
-                parts.append(layout.gather(data, eq["domain"], eq["tensorsig"]))
+                part = layout.gather(data, eq["domain"], eq["tensorsig"])
+                if mask is not None:
+                    part = part * jnp.asarray(mask, dtype=self.real_dtype)[:, None]
+                total = part if total is None else total + part
+            if total is None:
+                total = jnp.zeros((layout.n_groups, size))
+            parts.append(total)
         F = jnp.concatenate(parts, axis=1).astype(self.pencil_dtype)
         return F * jnp.asarray(self.valid_row_mask, dtype=self.real_dtype)
 

@@ -238,6 +238,81 @@ def build_subproblems(layout):
     return [Subproblem(layout, group, i) for i, group in enumerate(layout.groups())]
 
 
+def merge_conditional_equations(equations, dist, layout):
+    """
+    Convert the raw equation list into row BLOCKS: unconditioned equations
+    keep their own block; conditioned equations with identical (bases,
+    tensor signature) pack into shared blocks whose active member is
+    chosen per pencil group by evaluating the condition over separable
+    group indices named 'n' + coordinate name (reference:
+    core/subsystems.py:527-541 per-group equation conditions). Packing is
+    greedy over the per-group activity vectors, so independent
+    complementary pairs (e.g. conditioned BCs at both boundaries) occupy
+    separate blocks and each block has at most one active member per group.
+
+    Each block is an eq-like dict ({"domain", "tensorsig", "members"}) and,
+    for single-member blocks, passes through M/L/F/residual keys.
+    """
+    groups = list(layout.groups())
+    names = {f"n{coord.name}": coord.axis for coord in dist.coords}
+    blocks = []
+    by_key = {}
+    for eq in equations:
+        condition = eq.get("condition")
+        if condition is None:
+            block = dict(eq)
+            block["members"] = [(eq, None)]
+            blocks.append(block)
+            continue
+        code = compile(condition, "<equation condition>", "eval")
+
+        def make_fn(code=code):
+            def fn(group):
+                env = {name: group[axis] for name, axis in names.items()
+                       if group[axis] is not None}
+                return bool(eval(code, {}, env))
+            return fn
+
+        fn = make_fn()
+        activity = np.array([fn(g) for g in groups], dtype=bool)
+        key = (tuple(eq["domain"].bases), tuple(eq["tensorsig"]))
+        placed = False
+        for block, taken in by_key.get(key, []):
+            if not (taken & activity).any():
+                block["members"].append((eq, fn))
+                taken |= activity
+                placed = True
+                break
+        if not placed:
+            block = {"domain": eq["domain"], "tensorsig": eq["tensorsig"],
+                     "members": [(eq, fn)]}
+            by_key.setdefault(key, []).append((block, activity.copy()))
+            blocks.append(block)
+    return blocks
+
+
+def active_member(block, group):
+    """The block's active equation for `group` (None if none active)."""
+    actives = [eq for eq, cond in block["members"]
+               if cond is None or cond(group)]
+    if len(actives) > 1:
+        raise ValueError(
+            f"Multiple conditioned equations active for group {group}: "
+            f"{[eq.get('LHS_str') for eq in actives]}")
+    return actives[0] if actives else None
+
+
+def block_valid_mask(layout, eq, group):
+    """Flat row-validity of one equation block at one group: the active
+    member's mask, or all-invalid when no member's condition holds."""
+    if "members" in eq:
+        active = active_member(eq, group)
+        if active is None:
+            size = layout.slot_size(eq["domain"], eq["tensorsig"])
+            return np.zeros(size, dtype=bool)
+    return layout.valid_mask(eq["domain"], eq["tensorsig"], group).ravel()
+
+
 def _system_sizes(layout, equations, variables):
     var_sizes = [layout.slot_size(v.domain, v.tensorsig) for v in variables]
     var_offsets = np.concatenate([[0], np.cumsum(var_sizes)])
@@ -259,10 +334,12 @@ def assemble_group_coo(subproblem, equations, variables, name,
     (rows, cols, vals, row_valid, col_valid).
     """
     layout = subproblem.layout
+    group = subproblem.group
     rows_l, cols_l, vals_l = [], [], []
     row0 = 0
     for eq, esize in zip(equations, eq_sizes):
-        expr = eq.get(name)
+        active = active_member(eq, group) if "members" in eq else eq
+        expr = active.get(name) if active is not None else None
         if expr is not None and not (np.isscalar(expr) and expr == 0):
             from .operators import operand_expression_matrices
             mats = operand_expression_matrices(expr, subproblem, variables)
@@ -286,9 +363,8 @@ def assemble_group_coo(subproblem, equations, variables, name,
     col_valid = np.concatenate([
         layout.valid_mask(v.domain, v.tensorsig, subproblem.group).ravel()
         for v in variables])
-    row_valid = np.concatenate([
-        layout.valid_mask(eq["domain"], eq["tensorsig"], subproblem.group).ravel()
-        for eq in equations])
+    row_valid = np.concatenate([block_valid_mask(layout, eq, group)
+                                for eq in equations])
     if col_valid.sum() != row_valid.sum():
         raise ValueError(
             f"Invalid row/column mismatch in group {subproblem.group}: "
@@ -709,6 +785,5 @@ def row_valid_masks(layout, equations):
     masks = []
     for i, group in enumerate(layout.groups()):
         masks.append(np.concatenate([
-            layout.valid_mask(eq["domain"], eq["tensorsig"], group).ravel()
-            for eq in equations]))
+            block_valid_mask(layout, eq, group) for eq in equations]))
     return np.array(masks, dtype=np.float64)

@@ -333,6 +333,27 @@ class RK222(RungeKuttaIMEX):
 
 
 @add_scheme
+class RKSMR(RungeKuttaIMEX):
+    """(3-eps)-order 3-stage DIRK+ERK scheme of Spalart, Moser & Rogers
+    (1991, Appendix); coefficients are the published constants
+    (reference: core/timesteppers.py:692 RKSMR)."""
+    stages = 3
+    _a1, _a2, _a3 = (29/96, -3/40, 1/6)
+    _b1, _b2, _b3 = (37/160, 5/24, 1/6)
+    _g1, _g2, _g3 = (8/15, 5/12, 3/4)
+    _z2, _z3 = (-17/60, -5/12)
+    A = np.array([[0., 0., 0., 0.],
+                  [_g1, 0., 0., 0.],
+                  [_g1 + _z2, _g2, 0., 0.],
+                  [_g1 + _z2, _g2 + _z3, _g3, 0.]])
+    H = np.array([[0., 0., 0., 0.],
+                  [_a1, _b1, 0., 0.],
+                  [_a1, _b1 + _a2, _b2, 0.],
+                  [_a1, _b1 + _a2, _b2 + _a3, _b3]])
+    c = np.array([0., 8/15, 2/3, 1.])
+
+
+@add_scheme
 class RK443(RungeKuttaIMEX):
     """3rd-order 4-stage IMEX RK, ARS(4,4,3) (reference: :671)."""
     stages = 4

@@ -1,0 +1,78 @@
+"""
+Plotting helpers for grid data (reference: dedalus/extras/plot_tools.py).
+
+A compact subset of the reference surface: quad-mesh edge construction
+from basis grids, `plot_bot_2d` for fields/arrays, and a simple
+`MultiFigure` axes grid. Requires matplotlib (imported lazily).
+"""
+
+import numpy as np
+
+
+def quad_mesh(x, y):
+    """Cell-edge meshes for pcolormesh from cell-center grids
+    (reference: extras/plot_tools.py quad_mesh)."""
+    x, y = np.asarray(x).ravel(), np.asarray(y).ravel()
+
+    def edges(c):
+        if c.size == 1:
+            return np.array([c[0] - 0.5, c[0] + 0.5])
+        mid = 0.5 * (c[:-1] + c[1:])
+        return np.concatenate([[c[0] - (mid[0] - c[0])], mid,
+                               [c[-1] + (c[-1] - mid[-1])]])
+
+    xe, ye = edges(x), edges(y)
+    return np.meshgrid(xe, ye, indexing="ij")
+
+
+class MultiFigure:
+    """Grid of axes with uniform padding
+    (reference: extras/plot_tools.py MultiFigure)."""
+
+    def __init__(self, nrows, ncols, width=4.0, height=3.0, pad=0.4):
+        import matplotlib.pyplot as plt
+        self.nrows, self.ncols = nrows, ncols
+        self.figure, self.axes = plt.subplots(
+            nrows, ncols, figsize=(ncols * width, nrows * height),
+            squeeze=False)
+        self.figure.subplots_adjust(wspace=pad, hspace=pad)
+
+    def add_axes(self, i, j):
+        return self.axes[i][j]
+
+
+def plot_bot_2d(field_or_data, x=None, y=None, axes=None, title=None,
+                cmap="RdBu_r", **kw):
+    """
+    pcolormesh of a 2D field's grid data (reference:
+    extras/plot_tools.py plot_bot / plot_bot_2d). Accepts a Field (grids
+    inferred from its bases) or a plain array with x/y grids.
+    """
+    import matplotlib.pyplot as plt
+    data = field_or_data
+    if hasattr(field_or_data, "domain"):
+        field = field_or_data
+        data = np.asarray(field["g"])
+        bases = [b for b in field.domain.bases if b is not None]
+        if x is None or y is None:
+            grids = []
+            seen = set()
+            for b in bases:
+                if id(b) in seen:
+                    continue
+                seen.add(id(b))
+                if b.dim == 1:
+                    grids.append(b.global_grid(1.0))
+                else:
+                    grids.extend(b.global_grids((1.0,) * b.dim))
+            if len(grids) != 2:
+                raise ValueError("plot_bot_2d requires a 2D field.")
+            x, y = grids
+    if axes is None:
+        _, axes = plt.subplots()
+    xm, ym = quad_mesh(x, y)
+    mesh = axes.pcolormesh(xm, ym, np.asarray(data).real, cmap=cmap, **kw)
+    plt.colorbar(mesh, ax=axes)
+    if title:
+        axes.set_title(title)
+    return mesh

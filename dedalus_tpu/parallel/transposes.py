@@ -41,8 +41,8 @@ def all_to_all_transpose(data, axis_in, axis_out, mesh, axis_name,
     # existing sharding of other dims
     if data.shape[axis_out] % n:
         raise ValueError(
-            f"Axis {axis_out} (size {data.shape[axis_out]}) must divide the "
-            f"mesh axis {axis_name!r} (size {n}).")
+            f"Axis {axis_out} (size {data.shape[axis_out]}) must be "
+            f"divisible by mesh axis {axis_name!r} (size {n}).")
     in_spec = [layout.get(d) for d in range(data.ndim)]
     out_spec = list(in_spec)
     in_spec[axis_in] = axis_name

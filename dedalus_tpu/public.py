@@ -23,7 +23,8 @@ from .core.operators import (
     CoeffWrapper as Coeff, dt)
 from .core.arithmetic import Add, Multiply, DotProduct, CrossProduct, Power
 from .core.timesteppers import (schemes, CNAB1, SBDF1, CNAB2, MCNAB2, SBDF2,
-                                CNLF2, SBDF3, SBDF4, RK111, RK222, RK443)
+                                CNLF2, SBDF3, SBDF4, RK111, RK222, RK443,
+                                RKSMR)
 from .core.solvers import (InitialValueSolver, LinearBoundaryValueSolver,
                            NonlinearBoundaryValueSolver, EigenvalueSolver)
 from .core.evaluator import Evaluator

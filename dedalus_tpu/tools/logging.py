@@ -1,0 +1,56 @@
+"""
+Logging setup (reference: dedalus/tools/logging.py).
+
+Process-aware root logger configuration from the [logging] config section:
+stdout handler at `stdout_level` (non-initial processes use
+`nonroot_level`), plus optional per-process file handlers at `file_level`
+under `filename`_p{rank}.log (reference: tools/logging.py:24-47).
+"""
+
+import logging
+import os
+import pathlib
+import sys
+
+from .config import config
+
+MPI_RANK = 0  # single-controller JAX; per-process files use jax process index
+
+
+def _resolve_level(name):
+    name = (name or "none").lower()
+    if name == "none":
+        return None
+    return getattr(logging, name.upper())
+
+
+def setup_logging(force=False):
+    """Configure the dedalus_tpu root logger from config; idempotent."""
+    root = logging.getLogger("dedalus_tpu")
+    if root.handlers and not force:
+        return root
+    # Do NOT call jax.process_index() here: that initializes the backend at
+    # import time (and hangs if the accelerator tunnel is down). Multi-host
+    # launchers set this env var; single-controller runs are rank 0.
+    rank = int(os.environ.get("JAX_PROCESS_INDEX", "0") or 0)
+    section = config["logging"]
+    stdout_level = _resolve_level(
+        section.get("stdout_level", "info") if rank == 0
+        else section.get("nonroot_level", "warning"))
+    file_level = _resolve_level(section.get("file_level", "none"))
+    formatter = logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s :: %(message)s")
+    root.setLevel(logging.DEBUG)
+    if stdout_level is not None:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setLevel(stdout_level)
+        handler.setFormatter(formatter)
+        root.addHandler(handler)
+    if file_level is not None:
+        path = pathlib.Path(section.get("filename", "logs/dedalus_tpu"))
+        os.makedirs(path.parent, exist_ok=True)
+        handler = logging.FileHandler(f"{path}_p{rank}.log")
+        handler.setLevel(file_level)
+        handler.setFormatter(formatter)
+        root.addHandler(handler)
+    return root

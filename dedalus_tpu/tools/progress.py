@@ -1,0 +1,43 @@
+"""
+Progress logging for long host-side loops
+(reference: dedalus/tools/progress.py:13 log_progress).
+"""
+
+import logging
+import time
+
+default_logger = logging.getLogger(__name__)
+
+
+def log_progress(iterable, logger=None, level="info", desc="iteration",
+                 iter=None, frac=None, dt=None):
+    """
+    Wrap an iterable, logging progress every `iter` items, every `frac`
+    fraction of the total, or every `dt` seconds.
+    """
+    logger = logger or default_logger
+    log = getattr(logger, level)
+    try:
+        total = len(iterable)
+    except TypeError:
+        total = None
+    if frac is not None and total:
+        iter = max(1, int(frac * total))
+    start = last = time.time()
+    for i, item in enumerate(iterable):
+        yield item
+        now = time.time()
+        due = False
+        if iter is not None and (i + 1) % iter == 0:
+            due = True
+        if dt is not None and now - last >= dt:
+            due = True
+        if due:
+            last = now
+            if total:
+                done = (i + 1) / total
+                rate = (now - start) / done - (now - start)
+                log(f"{desc} {i + 1}/{total} ({100 * done:.0f}%), "
+                    f"~{rate:.1f} s remaining")
+            else:
+                log(f"{desc} {i + 1}")

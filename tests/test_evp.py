@@ -77,3 +77,26 @@ def test_evp_set_state():
     ref = np.sin(np.pi * grid / L)
     scale = g[np.argmax(np.abs(g))] / ref[np.argmax(np.abs(g))]
     assert np.allclose(g, scale * ref, atol=1e-8 * abs(scale))
+
+
+def test_ivp_build_evp():
+    """IVP -> EVP conversion (reference: core/problems.py:364 build_EVP):
+    dt(u) = lap(u) with Dirichlet BCs gives lam_k = -(k pi / L)^2."""
+    L = 1.0
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=np.complex128)
+    xb = d3.ChebyshevT(coords["x"], size=32, bounds=(0, L))
+    u = dist.Field(name="u", bases=xb)
+    t1 = dist.Field(name="t1")
+    t2 = dist.Field(name="t2")
+    lift = lambda A, n: d3.Lift(A, xb.derivative_basis(1), n)
+    problem = d3.IVP([u, t1, t2], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = 0")
+    problem.add_equation("u(x=0) = 0")
+    problem.add_equation(f"u(x={L}) = 0")
+    evp = problem.build_EVP()
+    solver = evp.build_solver()
+    evals = solver.solve_dense(solver.subproblems[0])
+    evals = np.sort(evals.real)[::-1]
+    exact = -((np.arange(1, 7) * np.pi / L) ** 2)
+    assert np.allclose(evals[:6], exact, rtol=1e-8)

@@ -104,3 +104,51 @@ def test_vector_lbvp():
     ug = u["g"]
     assert np.allclose(ug[0], exact0, atol=1e-12)
     assert np.allclose(ug[1], exact1, atol=1e-12)
+
+
+def test_per_group_equation_conditions():
+    """Complementary conditioned BCs (reference: core/problems.py:67
+    condition kwarg; core/subsystems.py:527-541): Dirichlet bottom at
+    nx == 0, Neumann bottom elsewhere. Laplace solution is exactly 1 - z."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=8, bounds=(0, 2*np.pi))
+    zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1))
+    u = dist.Field(name="u", bases=(xb, zb))
+    tau1 = dist.Field(name="tau1", bases=xb)
+    tau2 = dist.Field(name="tau2", bases=xb)
+    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(1), n)
+    dz = lambda A: d3.Differentiate(A, coords["z"])
+    problem = d3.LBVP([u, tau1, tau2], namespace=locals())
+    problem.add_equation("lap(u) + lift(tau1,-1) + lift(tau2,-2) = 0")
+    problem.add_equation("u(z=1) = 0")
+    problem.add_equation("u(z=0) = 1", condition="nx == 0")
+    problem.add_equation("dz(u)(z=0) = 0", condition="nx != 0")
+    solver = problem.build_solver()
+    solver.solve()
+    x, z = dist.local_grids(xb, zb)
+    assert np.abs(np.asarray(u["g"]) - (1 - z)).max() < 1e-12
+
+
+def test_independent_conditioned_pairs():
+    """Two independent complementary conditioned BC pairs (one per
+    boundary) must pack into separate row blocks."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=8, bounds=(0, 2*np.pi))
+    zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1))
+    u = dist.Field(name="u", bases=(xb, zb))
+    tau1 = dist.Field(name="tau1", bases=xb)
+    tau2 = dist.Field(name="tau2", bases=xb)
+    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(1), n)
+    dz = lambda A: d3.Differentiate(A, coords["z"])
+    problem = d3.LBVP([u, tau1, tau2], namespace=locals())
+    problem.add_equation("lap(u) + lift(tau1,-1) + lift(tau2,-2) = 0")
+    problem.add_equation("u(z=1) = 2", condition="nx == 0")
+    problem.add_equation("dz(u)(z=1) = 0", condition="nx != 0")
+    problem.add_equation("u(z=0) = 1", condition="nx == 0")
+    problem.add_equation("dz(u)(z=0) = 0", condition="nx != 0")
+    solver = problem.build_solver()
+    solver.solve()
+    x, z = dist.local_grids(xb, zb)
+    assert np.abs(np.asarray(u["g"]) - (1 + z)).max() < 1e-12

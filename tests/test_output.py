@@ -118,3 +118,30 @@ def test_filehandler_append_resumes_partial_set(tmp_path):
     assert len(files) == 1   # resumed into the partially-filled set
     with h5py.File(files[0], "r") as f:
         assert list(np.asarray(f["scales/write_number"])) == [1, 2, 3, 4]
+
+
+def test_post_merge_and_xarray(tmp_path):
+    """Set merging + xarray loading (reference: tools/post.py:166,363)."""
+    pytest.importorskip("xarray")
+    from dedalus_tpu.tools import post
+    out = tmp_path / "snaps"
+    solver, u, x = build_heat()
+    h = solver.evaluator.add_file_handler(out, iter=1, max_writes=2)
+    h.add_task(u, name="u")
+    for _ in range(5):
+        solver.step(1e-3)
+    joint = post.merge_sets(out)
+    import h5py
+    with h5py.File(joint, "r") as f:
+        assert f["tasks/u"].shape == (5, 16)
+        assert list(np.asarray(f["scales/write_number"])) == [1, 2, 3, 4, 5]
+    arrays = post.load_tasks_to_xarray(joint)
+    assert arrays["u"].shape == (5, 16)
+    assert list(arrays["u"].coords["write_number"].values) == [1, 2, 3, 4, 5]
+
+
+def test_cli_get_config(capsys):
+    from dedalus_tpu import __main__ as cli
+    cli.get_config()
+    out = capsys.readouterr().out
+    assert "MATRIX_SOLVER" in out.upper() or "matrix_solver" in out
