@@ -12,7 +12,10 @@ solver hot loop never touches Fields — it closes over pure pytrees of
 coefficient arrays (see solvers.py).
 """
 
+import functools
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .domain import Domain
@@ -47,6 +50,25 @@ def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
                                             tensorsig=tensorsig,
                                             sub_axis=axis - basis.first_axis)
     return data
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_transform(direction, domain, scales, tdim, tensorsig):
+    """
+    Jit-compiled whole-field transform, cached per static signature. All
+    host-facing layout changes go through here: eager per-op dispatch is both
+    slow and fragile on remote-compile TPU backends (each new op shape is a
+    round-trip through the backend compiler).
+    """
+    if direction == "c":
+        def fn(data):
+            return transform_to_coeff(data, domain, scales, tdim,
+                                      tensorsig=tensorsig)
+    else:
+        def fn(data):
+            return transform_to_grid(data, domain, scales, tdim,
+                                     tensorsig=tensorsig)
+    return jax.jit(fn)
 
 
 class _FieldDataView(np.ndarray):
@@ -264,6 +286,11 @@ class Field(Operand):
         self._data_epoch = 0
         self._pull = None
 
+    def atoms(self, *types):
+        if not types or isinstance(self, types):
+            return {self}
+        return set()
+
     # ---- shapes & dtypes ----
 
     @property
@@ -306,8 +333,9 @@ class Field(Operand):
     def require_coeff_space(self):
         self._sync()
         if self.layout == "g":
-            self.data = transform_to_coeff(self.data, self.domain, self.scales,
-                                           self.tdim, tensorsig=self.tensorsig)
+            fn = _compiled_transform("c", self.domain, tuple(self.scales),
+                                     self.tdim, self.tensorsig)
+            self.data = fn(self.data)
             self.layout = "c"
         return self.data
 
@@ -316,8 +344,9 @@ class Field(Operand):
         if scales is not None:
             self.change_scales(scales)
         if self.layout == "c":
-            self.data = transform_to_grid(self.data, self.domain, self.scales,
-                                          self.tdim, tensorsig=self.tensorsig)
+            fn = _compiled_transform("g", self.domain, tuple(self.scales),
+                                     self.tdim, self.tensorsig)
+            self.data = fn(self.data)
             self.layout = "g"
         return self.data
 

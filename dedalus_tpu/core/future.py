@@ -12,10 +12,15 @@ Layout protocol: 'c' = full coefficient space (in the node's output bases,
 including Jacobi derivative levels), 'g' = full grid space at dealias scales.
 """
 
+import logging
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .field import Operand, Field, transform_to_coeff, transform_to_grid
+
+logger = logging.getLogger(__name__)
 
 
 class EvalContext:
@@ -111,9 +116,36 @@ class Future(Operand):
         raise NotImplementedError
 
     def evaluate(self):
-        """Host-facing evaluation: returns a new Field with this node's data."""
-        ctx = EvalContext()
-        data = self.ev(ctx, "c")
+        """
+        Host-facing evaluation: returns a new Field with this node's data.
+
+        The whole expression tree compiles into one cached XLA program per
+        node, with the current data of every Field atom passed as an input
+        (so repeated evaluation picks up field updates without retracing).
+        Nodes whose ev_impl cannot trace (e.g. a GeneralFunction running
+        host code) fall back to eager evaluation permanently.
+        """
+        cache = getattr(self, "_evaluate_cache", None)
+        if cache is None:
+            fields = sorted(self.atoms(Field),
+                            key=lambda f: (f.name or "", id(f)))
+
+            def fn(arrays):
+                ctx = EvalContext(dict(zip(fields, arrays)))
+                return self.ev(ctx, "c")
+
+            cache = self._evaluate_cache = {
+                "fields": fields, "fn": jax.jit(fn), "jit_ok": True}
+        if cache["jit_ok"]:
+            try:
+                data = cache["fn"]([f.coeff_data() for f in cache["fields"]])
+            except (jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError):
+                logger.debug(f"{self!r}: not traceable; evaluating eagerly.")
+                cache["jit_ok"] = False
+                data = self.ev(EvalContext(), "c")
+        else:
+            data = self.ev(EvalContext(), "c")
         out = Field(self.dist, bases=self.domain.bases, tensorsig=self.tensorsig,
                     dtype=self.dtype)
         out.preset_coeff(jnp.asarray(data))

@@ -290,30 +290,59 @@ class SolverBase:
             self._member_masks_cache = out
         return self._member_masks_cache
 
-    def build_rhs_evaluator(self, key="F", time_field=None):
+    def build_rhs_evaluator(self, key="F", time_field=None, get_expr=None):
+        """
+        Build `eval_F(X, t=None, extra_arrays=None) -> (G, S)` evaluating the
+        per-equation expressions selected by `get_expr` (default: the member's
+        `key` entry). X=None skips the variable scatter (residual-style
+        evaluation over non-variable fields only).
+        """
         problem = self.problem
         layout = self.layout
         variables = self.variables
         equations = self.equations
         dim = self.dist.dim
         dtype = self.pencil_dtype
+        if get_expr is None:
+            get_expr = lambda member: member.get(key)
 
         # per-block member selection masks for conditioned equations
         member_masks = self._member_masks()
 
-        def eval_F(X, t=None):
-            arrays = scatter_state(layout, variables, X)
-            subs = {var: arrays[var.name] for var in variables}
+        # Non-variable fields feeding the RHS (parameters, forcings) become
+        # explicit inputs of the compiled evaluator, so callers that thread
+        # `extra_arrays` (see rhs_extra) pick up user updates to those fields
+        # without retracing; a None leaves them baked as trace-time constants.
+        from .field import Field as _Field
+        from .future import Future as _Future
+        extra = set()
+        for eq in equations:
+            for member, cond in eq["members"]:
+                expr = get_expr(member)
+                if isinstance(expr, (_Field, _Future)):
+                    extra |= expr.atoms(_Field)
+        extra -= set(variables)
+        if time_field is not None:
+            extra.discard(time_field)
+        extra_fields = sorted(extra, key=lambda f: (f.name or "", id(f)))
+
+        def eval_F(X, t=None, extra_arrays=None):
+            subs = {}
+            if X is not None:
+                arrays = scatter_state(layout, variables, X)
+                subs = {var: arrays[var.name] for var in variables}
             if time_field is not None:
                 subs[time_field] = jnp.reshape(jnp.asarray(t, dtype=self.real_dtype),
                                                (1,) * dim)
+            if extra_arrays is not None:
+                subs.update(zip(extra_fields, extra_arrays))
             ctx = EvalContext(subs)
             parts = []
             for eq, masks in zip(equations, member_masks):
                 size = layout.slot_size(eq["domain"], eq["tensorsig"])
                 total = None
                 for (member, cond), mask in zip(eq["members"], masks):
-                    expr = member.get(key)
+                    expr = get_expr(member)
                     if expr is None:
                         continue
                     data = ev(expr, ctx, "c")
@@ -326,7 +355,13 @@ class SolverBase:
                 parts.append(total)
             return jnp.concatenate(parts, axis=1).astype(dtype)
 
+        eval_F.extra_fields = extra_fields
         return eval_F
+
+    def rhs_extra(self):
+        """Current data of the RHS's non-variable field inputs (ordered to
+        match eval_F.extra_fields)."""
+        return [f.coeff_data() for f in self.eval_F.extra_fields]
 
 
 class InitialValueSolver(SolverBase):
@@ -549,16 +584,21 @@ class LinearBoundaryValueSolver(SolverBase):
         self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F")
         self._aux = self.ops.factor(self.L_mat)
-        self._solve = jax.jit(self.ops.solve)
+        mask = jnp.asarray(self.valid_row_mask, dtype=self.real_dtype)
+        eval_F, ops = self.eval_F, self.ops
+
+        @jax.jit
+        def _rhs_solve(aux, X0, extra):
+            return ops.solve(aux, eval_F(X0, extra_arrays=extra) * mask)
+
+        self._rhs_solve = _rhs_solve
         self.iteration = 0
 
     def solve(self):
         """Solve L.X = F with current NCC/RHS fields
         (reference: core/solvers.py:369)."""
         X0 = self.gather_fields()
-        F = self.eval_F(X0) * jnp.asarray(self.valid_row_mask,
-                                          dtype=self.real_dtype)
-        X = self._solve(self._aux, F)
+        X = self._rhs_solve(self._aux, X0, self.rhs_extra())
         self.scatter_fields(X)
         self.iteration += 1
         return self.state
@@ -590,26 +630,16 @@ class NonlinearBoundaryValueSolver(SolverBase):
         return self.problem.variables
 
     def _eval_residual(self):
-        layout = self.layout
-        ctx = EvalContext()
-        parts = []
-        for eq, masks in zip(self.equations, self._member_masks()):
-            size = layout.slot_size(eq["domain"], eq["tensorsig"])
-            total = None
-            for (member, cond), mask in zip(eq["members"], masks):
-                expr = self._residual_exprs.get(id(member))
-                if expr is None:
-                    continue
-                data = ev(expr, ctx, "c")
-                part = layout.gather(data, eq["domain"], eq["tensorsig"])
-                if mask is not None:
-                    part = part * jnp.asarray(mask, dtype=self.real_dtype)[:, None]
-                total = part if total is None else total + part
-            if total is None:
-                total = jnp.zeros((layout.n_groups, size))
-            parts.append(total)
-        F = jnp.concatenate(parts, axis=1).astype(self.pencil_dtype)
-        return F * jnp.asarray(self.valid_row_mask, dtype=self.real_dtype)
+        cache = getattr(self, "_residual_cache", None)
+        if cache is None:
+            exprs = self._residual_exprs
+            eval_R = self.build_rhs_evaluator(
+                get_expr=lambda member: exprs.get(id(member)))
+            row_mask = jnp.asarray(self.valid_row_mask, dtype=self.real_dtype)
+            fn = jax.jit(lambda extra: eval_R(None, extra_arrays=extra) * row_mask)
+            cache = self._residual_cache = (eval_R.extra_fields, fn)
+        fields, fn = cache
+        return fn([f.coeff_data() for f in fields])
 
     def newton_iteration(self, damping=1.0):
         """One Newton step: solve dG.dX = -G, update variables

@@ -90,8 +90,8 @@ class MultistepIMEX:
             return ops.factor(ops.lincomb(a0, M, b0, L))
 
         @jax.jit
-        def _advance(M, L, X, t, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
-            Fn = eval_F(X, t) * mask
+        def _advance(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
+            Fn = eval_F(X, t, extra) * mask
             MXn = ops.matvec(M, X)
             LXn = ops.matvec(L, X)
             F_hist = jnp.concatenate([Fn[None], F_hist[:-1]])
@@ -129,8 +129,8 @@ class MultistepIMEX:
                                          jnp.asarray(b[0], dtype=rd))
         X, self.F_hist, self.MX_hist, self.LX_hist = self._advance(
             solver.M_mat, solver.L_mat, solver.X,
-            jnp.asarray(solver.sim_time, dtype=rd), self.F_hist,
-            self.MX_hist, self.LX_hist, jnp.asarray(a, dtype=rd),
+            jnp.asarray(solver.sim_time, dtype=rd), solver.rhs_extra(),
+            self.F_hist, self.MX_hist, self.LX_hist, jnp.asarray(a, dtype=rd),
             jnp.asarray(b, dtype=rd), jnp.asarray(c, dtype=rd), self._lhs_aux)
         solver.X = X
         solver.sim_time = float(solver.sim_time) + float(dt)
@@ -276,14 +276,14 @@ class RungeKuttaIMEX:
             return [auxs[j] for j in stage_slot]
 
         @jax.jit
-        def _step(M, L, X0, t0, dt, lhs_auxs):
+        def _step(M, L, X0, t0, dt, extra, lhs_auxs):
             MX0 = ops.matvec(M, X0)
             LXs = []
             Fs = []
             Xi = X0
             for i in range(1, s + 1):
                 LXs.append(ops.matvec(L, Xi))
-                Fs.append(eval_F(Xi, t0 + c[i - 1] * dt) * mask)
+                Fs.append(eval_F(Xi, t0 + c[i - 1] * dt, extra) * mask)
                 RHS = MX0
                 for j in range(i):
                     RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
@@ -303,7 +303,8 @@ class RungeKuttaIMEX:
                                          jnp.asarray(float(dt), dtype=rd))
         solver.X = self._step(solver.M_mat, solver.L_mat, solver.X,
                               jnp.asarray(solver.sim_time, dtype=rd),
-                              jnp.asarray(float(dt), dtype=rd), self._lhs_aux)
+                              jnp.asarray(float(dt), dtype=rd),
+                              solver.rhs_extra(), self._lhs_aux)
         solver.sim_time = float(solver.sim_time) + float(dt)
         self.iteration += 1
 

@@ -52,6 +52,7 @@ def plot_bot_2d(field_or_data, x=None, y=None, axes=None, title=None,
     data = field_or_data
     if hasattr(field_or_data, "domain"):
         field = field_or_data
+        field.change_scales(1)
         data = np.asarray(field["g"])
         bases = [b for b in field.domain.bases if b is not None]
         if x is None or y is None:
