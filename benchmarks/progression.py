@@ -1,0 +1,202 @@
+"""
+BASELINE.md progression benchmarks (configs 1-4) on the current backend.
+
+Each config builds the example-equivalent solver, runs warmup + measured
+steps, and records steps/sec plus the reference's mode-stages/sec metric
+(reference: dedalus/core/solvers.py:770-776). Progress markers go to stderr;
+results append to benchmarks/results.jsonl and print as JSON lines.
+
+Run:  python benchmarks/progression.py [config ...]
+Configs: kdv1024 shear512 rb256x64 rb2048x1024 sw_ell255 (default: all)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[prog {time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def build_kdv(N, dtype):
+    import dedalus_tpu.public as d3
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=dtype)
+    xbasis = d3.RealFourier(xcoord, size=N, bounds=(0, 10), dealias=3 / 2)
+    u = dist.Field(name="u", bases=xbasis)
+    a, b = 1e-4, 2e-4
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - a*dx(dx(u)) - b*dx(dx(dx(u))) = - u*dx(u)")
+    solver = problem.build_solver(d3.SBDF2)
+    x = dist.local_grids(xbasis)[0]
+    n = 20
+    u["g"] = np.log(1 + np.cosh(n) ** 2 / np.cosh(n * (x - 3)) ** 2) / (2 * n)
+    return solver, 2e-3
+
+
+def build_shear(N, dtype):
+    import dedalus_tpu.public as d3
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=dtype)
+    xbasis = d3.RealFourier(coords["x"], size=N, bounds=(0, 1), dealias=3 / 2)
+    zbasis = d3.RealFourier(coords["z"], size=N, bounds=(-1, 1), dealias=3 / 2)
+    p = dist.Field(name="p", bases=(xbasis, zbasis))
+    s = dist.Field(name="s", bases=(xbasis, zbasis))
+    u = dist.VectorField(coords, name="u", bases=(xbasis, zbasis))
+    tau_p = dist.Field(name="tau_p")
+    nu = 1 / 5e4
+    D = nu
+    x, z = dist.local_grids(xbasis, zbasis)
+    problem = d3.IVP([u, s, p, tau_p], namespace=locals())
+    problem.add_equation("dt(u) + grad(p) - nu*lap(u) = - u@grad(u)")
+    problem.add_equation("dt(s) - D*lap(s) = - u@grad(s)")
+    problem.add_equation("div(u) + tau_p = 0")
+    problem.add_equation("integ(p) = 0")
+    ug = np.zeros((2,) + np.broadcast_shapes((N, 1), (1, N)))
+    ug[0] = 1 / 2 + 1 / 2 * (np.tanh((z - 0.5) / 0.1) - np.tanh((z + 0.5) / 0.1))
+    ug[1] = (0.1 * np.sin(2 * np.pi * x) * np.exp(-(z - 0.5) ** 2 / 0.01)
+             + 0.1 * np.sin(2 * np.pi * x) * np.exp(-(z + 0.5) ** 2 / 0.01))
+    u["g"] = ug
+    s["g"] = ug[0]
+    solver = problem.build_solver(d3.RK222)
+    # CFL-stable fixed step at 512^2 (u ~ 1, dx = 1/N, safety ~ 0.25)
+    return solver, 0.25 / N
+
+
+def build_rb(Nx, Nz, dtype, matsolver=None):
+    from __graft_entry__ import _build_rb_solver
+    if matsolver is not None:
+        # route through the example builder with a forced matsolver
+        from dedalus_tpu.tools.config import config
+        old = config["linear algebra"].get("MATRIX_SOLVER", "auto")
+        config["linear algebra"]["MATRIX_SOLVER"] = matsolver
+        try:
+            solver, b = _build_rb_solver(Nx, Nz, dtype)
+        finally:
+            config["linear algebra"]["MATRIX_SOLVER"] = old
+    else:
+        solver, b = _build_rb_solver(Nx, Nz, dtype)
+    return solver, 0.01 if Nx <= 512 else 5e-5
+
+
+def build_shallow_water(Nphi, Ntheta, dtype):
+    import dedalus_tpu.public as d3
+    R = 6.37122e6
+    Omega = 7.292e-5
+    nu = 1e5 * 32 ** 2
+    g = 9.80616
+    H = 1e4
+    coords = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(coords, dtype=dtype)
+    basis = d3.SphereBasis(coords, shape=(Nphi, Ntheta), dtype=dtype,
+                           radius=R, dealias=3 / 2)
+    u = dist.VectorField(coords, name="u", bases=basis)
+    h = dist.Field(name="h", bases=basis)
+    zcross = lambda A: d3.MulCosine(d3.Skew(A))
+    phi, theta = dist.local_grids(basis)
+    lat = np.pi / 2 - theta + 0 * phi
+    umax = 80 * R / (12 * 86400)
+    lat0, lat1 = np.pi / 7, np.pi / 2 - np.pi / 7
+    en = np.exp(-4 / (lat1 - lat0) ** 2)
+    jet = (lat0 <= lat) * (lat <= lat1)
+    u_jet = umax / en * np.exp(1 / ((lat[jet] - lat0) * (lat[jet] - lat1)))
+    ug = np.zeros_like(np.broadcast_to(lat, (Nphi, Ntheta)))
+    ug = np.array([ug, 0 * ug])
+    ug[0][jet] = u_jet
+    u["g"] = ug
+    h["g"] = 120 * np.cos(lat) * np.exp(-(phi / (1 / 3)) ** 2) \
+        * np.exp(-((np.pi / 4 - lat) / (1 / 15)) ** 2)
+    problem = d3.IVP([u, h], namespace=locals())
+    problem.add_equation(
+        "dt(u) + nu*lap(lap(u)) + g*grad(h) + 2*Omega*zcross(u) "
+        "= - u@grad(u)")
+    problem.add_equation("dt(h) + nu*lap(lap(h)) + H*div(u) = - div(u*h)")
+    solver = problem.build_solver(d3.RK222)
+    return solver, 300.0
+
+
+CONFIGS = {
+    "kdv1024": lambda dt_: build_kdv(1024, dt_),
+    "shear512": lambda dt_: build_shear(512, dt_),
+    "rb256x64": lambda dt_: build_rb(256, 64, dt_),
+    "rb2048x1024": lambda dt_: build_rb(2048, 1024, dt_, matsolver="banded"),
+    "sw_ell255": lambda dt_: build_shallow_water(512, 256, dt_),
+}
+
+# measured steps per config (big builds measure fewer)
+MEASURE = {"rb2048x1024": 20}
+
+
+def run_config(name, warmup=5, measure=50):
+    import jax
+    backend = jax.default_backend()
+    dtype = np.float32 if backend != "cpu" else np.float64
+    measure = MEASURE.get(name, measure)
+    mark(f"{name}: building (backend={backend}, dtype={np.dtype(dtype).name})")
+    t_build = time.time()
+    solver, dt = CONFIGS[name](dtype)
+    build_s = time.time() - t_build
+    G, S = solver.pencil_shape
+    mark(f"{name}: built in {build_s:.1f}s; pencils (G={G}, S={S}), "
+         f"ops={type(solver.ops).__name__}")
+    mark(f"{name}: warmup {warmup} steps (first compiles)")
+    t_c = time.time()
+    for i in range(warmup):
+        solver.step(dt)
+        if i == 0:
+            solver.X.block_until_ready()
+            mark(f"{name}: first step done in {time.time() - t_c:.1f}s")
+    solver.X.block_until_ready()
+    mark(f"{name}: measuring {measure} steps")
+    t0 = time.time()
+    for _ in range(measure):
+        solver.step(dt)
+    solver.X.block_until_ready()
+    elapsed = time.time() - t0
+    sps = measure / elapsed
+    finite = bool(np.all(np.isfinite(np.asarray(solver.X))))
+    stages = getattr(solver.timestepper, "stages", 1)
+    record = {
+        "config": name,
+        "backend": backend,
+        "dtype": np.dtype(dtype).name,
+        "pencil_shape": [int(G), int(S)],
+        "ops": type(solver.ops).__name__,
+        "steps_per_sec": round(sps, 3),
+        "mode_stages_per_sec": round(G * S * stages * sps, 1),
+        "build_sec": round(build_s, 2),
+        "finite": finite,
+    }
+    mark(f"{name}: {sps:.2f} steps/s, finite={finite}")
+    return record
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "results.jsonl")
+    for name in names:
+        if name not in CONFIGS:
+            mark(f"unknown config {name}; skipping")
+            continue
+        try:
+            record = run_config(name)
+        except Exception as e:
+            record = {"config": name, "error": repr(e)}
+            mark(f"{name}: FAILED {e!r}")
+        print(json.dumps(record), flush=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+if __name__ == "__main__":
+    main()

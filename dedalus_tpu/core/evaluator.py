@@ -115,19 +115,67 @@ class Handler:
                 iteration=iteration, wall_time=wall_time, sim_time=sim_time)
         return scheduled
 
+    def _compile_tasks(self):
+        """
+        One compiled program evaluating every task of this handler under a
+        shared memo, with all Field atoms as inputs: shared subexpressions
+        and transforms are computed once per pass instead of once per task
+        (reference batches tasks through grouped layout walks,
+        core/evaluator.py:94-148).
+        """
+        from .future import EvalContext, CompiledWithFallback
+        from .field import transform_to_grid
+        dist = self.solver.dist
+        tasks = list(self.tasks)
+        atoms = set()
+        for task in tasks:
+            atoms |= task["operator"].atoms(Field)
+        fields = sorted(atoms, key=lambda f: (f.name or "", id(f)))
+
+        def fn(arrays):
+            ctx = EvalContext(dict(zip(fields, arrays)))
+            out = {}
+            for task in tasks:
+                op = task["operator"]
+                if isinstance(op, Field):
+                    data_c = ctx.field_data(op, "c")
+                else:
+                    data_c = op.ev(ctx, "c")
+                if task["layout"] == "g":
+                    scales = dist.remedy_scales(task["scales"] or 1)
+                    tdim = len(op.tensorsig)
+                    data = transform_to_grid(data_c, op.domain, scales, tdim,
+                                             tensorsig=op.tensorsig)
+                else:
+                    data = data_c
+                out[task["name"]] = data
+            return out
+
+        def eager():
+            out = {}
+            for task in tasks:
+                op = task["operator"]
+                field = op if isinstance(op, Field) else op.evaluate()
+                if task["layout"] == "g":
+                    field.change_scales(task["scales"] or 1)
+                    out[task["name"]] = field["g"]
+                else:
+                    out[task["name"]] = field["c"]
+            return out
+
+        return CompiledWithFallback(fields, fn, eager,
+                                    f"handler tasks {[t['name'] for t in tasks]}")
+
     def evaluate_tasks(self):
         """Evaluate all tasks, returning {name: numpy array}."""
-        out = {}
-        for task in self.tasks:
-            op = task["operator"]
-            field = op if isinstance(op, Field) else op.evaluate()
-            if task["layout"] == "g":
-                scales = task["scales"] or 1
-                field.change_scales(scales)
-                out[task["name"]] = np.asarray(field["g"])
-            else:
-                out[task["name"]] = np.asarray(field["c"])
-        return out
+        cache = getattr(self, "_task_cache", None)
+        key = tuple((id(t["operator"]), t["layout"], t["scales"])
+                    for t in self.tasks)
+        if cache is None or cache["key"] != key:
+            cache = self._task_cache = {"key": key,
+                                        "runner": self._compile_tasks()}
+        arrays = cache["runner"]()
+        return {name: np.asarray(v) for name, v in arrays.items()}
 
     def process(self, **kw):
         raise NotImplementedError

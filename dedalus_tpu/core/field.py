@@ -52,23 +52,29 @@ def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
     return data
 
 
-@functools.lru_cache(maxsize=None)
 def _compiled_transform(direction, domain, scales, tdim, tensorsig):
     """
     Jit-compiled whole-field transform, cached per static signature. All
     host-facing layout changes go through here: eager per-op dispatch is both
     slow and fragile on remote-compile TPU backends (each new op shape is a
-    round-trip through the backend compiler).
+    round-trip through the backend compiler). The cache lives on the domain
+    object, so its compiled executables share the domain's lifetime instead
+    of pinning every domain in a global table.
     """
-    if direction == "c":
-        def fn(data):
-            return transform_to_coeff(data, domain, scales, tdim,
-                                      tensorsig=tensorsig)
-    else:
-        def fn(data):
-            return transform_to_grid(data, domain, scales, tdim,
-                                     tensorsig=tensorsig)
-    return jax.jit(fn)
+    per_domain = domain.__dict__.setdefault("_compiled_transforms", {})
+    key = (direction, scales, tdim, tensorsig)
+    fn = per_domain.get(key)
+    if fn is None:
+        if direction == "c":
+            def fn(data):
+                return transform_to_coeff(data, domain, scales, tdim,
+                                          tensorsig=tensorsig)
+        else:
+            def fn(data):
+                return transform_to_grid(data, domain, scales, tdim,
+                                         tensorsig=tensorsig)
+        fn = per_domain[key] = jax.jit(fn)
+    return fn
 
 
 class _FieldDataView(np.ndarray):

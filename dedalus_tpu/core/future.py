@@ -23,6 +23,34 @@ from .field import Operand, Field, transform_to_coeff, transform_to_grid
 logger = logging.getLogger(__name__)
 
 
+class CompiledWithFallback:
+    """
+    One jit-compiled evaluation over Field-atom inputs with a permanent
+    eager fallback: untraceable user callbacks (GeneralFunction host code,
+    backends without host callbacks) fail in arbitrary ways on the first
+    compiled call, after which evaluation stays eager. Shared by
+    Future.evaluate and the output handlers (evaluator.evaluate_tasks).
+    """
+
+    def __init__(self, fields, fn, eager, describe):
+        import jax
+        self.fields = fields
+        self.fn = jax.jit(fn)
+        self.eager = eager
+        self.describe = describe
+        self.jit_ok = True
+
+    def __call__(self):
+        if self.jit_ok:
+            try:
+                return self.fn([f.coeff_data() for f in self.fields])
+            except Exception as exc:
+                logger.debug(f"{self.describe}: compiled evaluation failed "
+                             f"({exc!r}); falling back to eager permanently.")
+                self.jit_ok = False
+        return self.eager()
+
+
 class EvalContext:
     """Carries substitutions (Field -> traced coeff array) and the memo."""
 
@@ -125,8 +153,8 @@ class Future(Operand):
         Nodes whose ev_impl cannot trace (e.g. a GeneralFunction running
         host code) fall back to eager evaluation permanently.
         """
-        cache = getattr(self, "_evaluate_cache", None)
-        if cache is None:
+        runner = getattr(self, "_evaluate_cache", None)
+        if runner is None:
             fields = sorted(self.atoms(Field),
                             key=lambda f: (f.name or "", id(f)))
 
@@ -134,18 +162,9 @@ class Future(Operand):
                 ctx = EvalContext(dict(zip(fields, arrays)))
                 return self.ev(ctx, "c")
 
-            cache = self._evaluate_cache = {
-                "fields": fields, "fn": jax.jit(fn), "jit_ok": True}
-        if cache["jit_ok"]:
-            try:
-                data = cache["fn"]([f.coeff_data() for f in cache["fields"]])
-            except (jax.errors.TracerArrayConversionError,
-                    jax.errors.ConcretizationTypeError):
-                logger.debug(f"{self!r}: not traceable; evaluating eagerly.")
-                cache["jit_ok"] = False
-                data = self.ev(EvalContext(), "c")
-        else:
-            data = self.ev(EvalContext(), "c")
+            runner = self._evaluate_cache = CompiledWithFallback(
+                fields, fn, lambda: self.ev(EvalContext(), "c"), repr(self))
+        data = runner()
         out = Field(self.dist, bases=self.domain.bases, tensorsig=self.tensorsig,
                     dtype=self.dtype)
         out.preset_coeff(jnp.asarray(data))

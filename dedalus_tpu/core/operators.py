@@ -1179,17 +1179,33 @@ class UnaryGridFunction(Future):
         return deriv_map[self.func](op) * d_op
 
 
+def _tracing_active():
+    """True when called under a jax trace (jit/vmap/grad). Conservative:
+    unknown JAX internals report True, keeping the callback path."""
+    try:
+        from jax._src.core import trace_ctx, EvalTrace
+        return not isinstance(trace_ctx.trace, EvalTrace)
+    except Exception:
+        return True
+
+
 class GeneralFunction(Future):
     """
     Arbitrary user callback producing grid data
-    (reference: core/operators.py:429). The callback runs at trace time; it
-    must be a function of the supplied operand arrays.
+    (reference: core/operators.py:429).
+
+    pure=True: the callback must be jax-traceable (jnp operations on the
+    supplied operand arrays); it is inlined into compiled programs.
+    pure=False (default, reference semantics): arbitrary host code,
+    re-executed on every evaluation via io_callback — works inside the
+    jitted RHS/analysis programs (e.g. stochastic forcing).
     """
 
     name = "GeneralFunction"
     natural_layout = "g"
 
-    def __init__(self, dist, domain, tensorsig, dtype, layout, func, args=()):
+    def __init__(self, dist, domain, tensorsig, dtype, layout, func, args=(),
+                 pure=False):
         # Bypass Future.__init__: metadata is supplied, not inferred.
         self.dist = dist
         self.domain = domain
@@ -1198,11 +1214,33 @@ class GeneralFunction(Future):
         self.func = func
         self.layout_pref = layout
         self.args = list(args)
+        self.pure = bool(pure)
+
+    def rebuild(self, new_args):
+        return GeneralFunction(self.dist, self.domain, self.tensorsig,
+                               self.dtype, self.layout_pref, self.func,
+                               new_args, pure=self.pure)
 
     def ev_impl(self, ctx):
+        import jax
         arg_data = [ev(a, ctx, "g") if isinstance(a, (Field, Future)) else a
                     for a in self.args]
-        return self.func(*arg_data)
+        if self.pure:
+            return self.func(*arg_data)
+        # Outside a trace, call the host function directly: no callback
+        # machinery needed, and backends without host send/recv support
+        # (e.g. tunneled PJRT plugins) stay usable via eager evaluation.
+        if not _tracing_active() and \
+                not any(isinstance(a, jax.core.Tracer) for a in arg_data):
+            return jnp.asarray(self.func(*[np.asarray(a) for a in arg_data]))
+        shape = self.tshape + self.domain.grid_shape(self.domain.dealias)
+        spec = jax.ShapeDtypeStruct(shape, np.dtype(self.dtype))
+        # io_callback (not pure_callback): host side effects / RNG state are
+        # legal and calls are neither elided nor deduplicated by XLA
+        from jax.experimental import io_callback
+        host = lambda *a: np.broadcast_to(
+            np.asarray(self.func(*a), dtype=spec.dtype), shape)
+        return io_callback(host, spec, *arg_data)
 
 
 class GridWrapper(Future):

@@ -24,6 +24,37 @@ from ..tools.parsing import split_equation
 from ..tools.exceptions import UnsupportedEquationError, SymbolicParsingError
 
 
+_public_parseables_cache = None
+
+
+def _public_parseables():
+    """
+    Public operator/arithmetic names usable in equation strings, matching the
+    reference's parseables built from operators.__all__ + arithmetic.__all__
+    (reference: core/problems.py:28-33). Lazily imported (sphere/arithmetic
+    would be circular at module load).
+    """
+    global _public_parseables_cache
+    if _public_parseables_cache is None:
+        from . import operators as ops
+        from .arithmetic import DotProduct, CrossProduct
+        from .sphere import MulCosine
+        _public_parseables_cache = {
+            "Lift": ops.LiftFactory, "LiftTau": ops.LiftTau,
+            "Gradient": ops.Gradient, "Divergence": ops.Divergence,
+            "Curl": ops.Curl, "Laplacian": ops.Laplacian,
+            "Differentiate": ops.Differentiate,
+            "UnaryGridFunction": ops.UnaryGridFunction,
+            "GeneralFunction": ops.GeneralFunction,
+            "RadialComponent": ops.Radial, "AngularComponent": ops.Angular,
+            "AzimuthalComponent": ops.Azimuthal,
+            "DotProduct": DotProduct, "dot": DotProduct,
+            "CrossProduct": CrossProduct, "cross": CrossProduct,
+            "MulCosine": MulCosine,
+        }
+    return _public_parseables_cache
+
+
 def _flatten_terms(expr):
     """Flatten an expression into additive terms."""
     if isinstance(expr, Add):
@@ -103,6 +134,7 @@ class ProblemBase:
     def namespace(self):
         ns = {}
         ns.update(parseables)
+        ns.update(_public_parseables())
         ns["np"] = np
         for var in self.variables:
             if var.name:

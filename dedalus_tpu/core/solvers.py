@@ -63,9 +63,15 @@ class SolverBase:
         for large single-coupled-axis systems (reference: ScipyBanded +
         Woodbury, libraries/matsolvers.py:186-194,285-316). Sets
         self._matrices (host arrays), self.ops, self.structure.
+
+        Assembly itself goes through the group-batched kron-term path
+        (core/batched_assembly.py) whenever the expression tree supports
+        it — O(1) tree walks instead of O(G) — falling back to the
+        per-group scipy walk otherwise.
         """
         names = self.matrices
         G, S = self.pencil_shape
+        self._assemble_batched(names)
         dense_bytes = G * S * S * np.dtype(self.pencil_dtype).itemsize
         spec = self.matsolver if isinstance(self.matsolver, str) else ""
         forced = spec.lower() if spec.lower() in ("banded", "dense") else None
@@ -88,11 +94,44 @@ class SolverBase:
                         f"using dense ({dense_bytes / 1e9:.2f} GB)")
             # reuse the already-assembled COO matrices for the dense fallback
             self._matrices = self._densify_coo_store(result, names, S)
+        elif self._batched is not None:
+            self._matrices = self._dense_from_batched(names)
         else:
             self._matrices = build_matrices(
                 self.subproblems, self.equations, self.variables,
                 names=names)
         self.ops = pencilops.DenseOps(self._dense_matsolver())
+
+    def _assemble_batched(self, names):
+        """Attempt group-batched assembly; sets self._batched to the shared
+        COO pattern result (rows, cols, {name: (G, nnz) vals}, row_valid,
+        col_valid) or None when the expression tree requires the per-group
+        walk."""
+        from .batched_assembly import batched_system_coos, BatchUnsupported
+        try:
+            self._batched = batched_system_coos(
+                self.layout, self.equations, self.variables, names)
+        except BatchUnsupported as exc:
+            logger.debug(f"Batched assembly unavailable ({exc}); "
+                         "using per-group assembly.")
+            self._batched = None
+
+    def _dense_from_batched(self, names):
+        """Scatter the shared-pattern COO store into dense (G, S, S) arrays
+        with the enumeration-order validity closure on the last name."""
+        pr, pc, vals, row_valid, col_valid = self._batched
+        G, S = self.pencil_shape
+        out = {}
+        for name in names:
+            dense = np.zeros((G, S, S), dtype=vals[name].dtype)
+            dense[:, pr, pc] = vals[name]
+            out[name] = dense
+        last = names[-1]
+        for g in range(G):
+            inv_rows = np.flatnonzero(~row_valid[g])
+            inv_cols = np.flatnonzero(~col_valid[g])
+            out[last][g, inv_rows, inv_cols] = 1.0
+        return out
 
     def _densify_coo_store(self, store, names, S):
         """Scatter (coo_store, masks) from a failed banded attempt into the
@@ -132,13 +171,22 @@ class SolverBase:
         masks = []
         acc = PatternAccumulator(S)
         scale = 0.0
-        for sp in self.subproblems:
-            coos, row_valid, col_valid = assemble_group_coos(
-                sp, equations, self.variables, names, closure=False)
-            coo_store.append(coos)
-            masks.append((row_valid, col_valid))
-            scale = max(scale, max((np.abs(v).max() if len(v) else 0.0
-                                    for _, _, v in coos.values()), default=0.0))
+        if self._batched is not None:
+            pr, pc, bvals, row_valid_b, col_valid_b = self._batched
+            for g in range(len(self.subproblems)):
+                coo_store.append({name: (pr, pc, bvals[name][g])
+                                  for name in names})
+                masks.append((row_valid_b[g], col_valid_b[g]))
+            scale = max((np.abs(bvals[name]).max() if bvals[name].size else 0.0)
+                        for name in names)
+        else:
+            for sp in self.subproblems:
+                coos, row_valid, col_valid = assemble_group_coos(
+                    sp, equations, self.variables, names, closure=False)
+                coo_store.append(coos)
+                masks.append((row_valid, col_valid))
+                scale = max(scale, max((np.abs(v).max() if len(v) else 0.0
+                                        for _, _, v in coos.values()), default=0.0))
         tol_abs = tol * (scale or 1.0)
         for coos, (row_valid, col_valid) in zip(coo_store, masks):
             pat = {k: (r[np.abs(v) > tol_abs], c[np.abs(v) > tol_abs],

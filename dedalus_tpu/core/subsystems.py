@@ -126,6 +126,60 @@ class PencilLayout:
                 mask = mask & bmask.reshape(full)
         return mask
 
+    def valid_masks_all(self, domain, tensorsig):
+        """
+        (G, slot_size) bool validity for ALL groups at once. For interval
+        (1D) bases the mask factorizes over axes — per-axis mask stacks are
+        built once (one call per distinct axis-group index) and folded with
+        vectorized outer products. Multi-axis (curvilinear) bases couple
+        group indices across axes, so those domains fall back to the
+        per-group `valid_mask` loop (their group counts are small).
+        """
+        cache = self.__dict__.setdefault("_valid_masks_cache", {})
+        key = (domain, tuple(tensorsig))
+        if key in cache:
+            return cache[key]
+        groups = list(self.groups())
+        G = len(groups)
+        if any(b is not None and b.dim > 1 for b in domain.bases):
+            out = np.stack([self.valid_mask(domain, tensorsig, g).ravel()
+                            for g in groups])
+            cache[key] = out
+            return out
+        tshape = tuple(cs.dim for cs in tensorsig)
+        ncomp = int(np.prod(tshape, dtype=int)) if tshape else 1
+        group_idx = {ax: np.array([g[ax] for g in groups], dtype=int)
+                     for ax in self.sep_axes}
+        out = np.ones((G, ncomp, 1), dtype=bool)
+        for axis, basis in enumerate(domain.bases):
+            if axis in self.sep_widths:
+                Ga = self.sep_n_groups[axis]
+                w = self.sep_widths[axis]
+                if basis is None:
+                    stack = np.zeros((Ga, ncomp, w), dtype=bool)
+                    stack[0, :, 0] = True
+                else:
+                    probe = [None] * self.dist.dim
+                    rows = []
+                    for ga in range(Ga):
+                        probe[axis] = ga
+                        rows.append(basis.component_valid_mask(
+                            tensorsig, tuple(probe), self.sep_widths))
+                    stack = np.stack(rows).reshape(Ga, ncomp, w)
+                axm = stack[group_idx[axis]]           # (G, ncomp, w)
+            elif basis is None:
+                axm = np.ones((1, ncomp, 1), dtype=bool)
+            else:
+                probe = (None,) * self.dist.dim
+                m = basis.component_valid_mask(tensorsig, probe,
+                                               self.sep_widths)
+                axm = np.asarray(m).reshape(1, ncomp, -1)
+            out = (out[:, :, :, None]
+                   & axm[:, :, None, :]).reshape(G, ncomp, -1)
+        out = out.reshape(G, -1)
+        cache[key] = out
+        return out
+
     # ------------------------------------------------- device gather/scatter
 
     def gather(self, array, domain, tensorsig):
@@ -782,8 +836,20 @@ def gather_rhs(layout, equations, eq_arrays, valid_masks):
 
 def row_valid_masks(layout, equations):
     """(G, S) float mask of valid equation rows (host numpy)."""
-    masks = []
-    for i, group in enumerate(layout.groups()):
-        masks.append(np.concatenate([
-            block_valid_mask(layout, eq, group) for eq in equations]))
-    return np.array(masks, dtype=np.float64)
+    groups = None
+    parts = []
+    for eq in equations:
+        base = layout.valid_masks_all(eq["domain"], eq["tensorsig"])
+        if "members" in eq and any(cond is not None
+                                   for _, cond in eq["members"]):
+            if groups is None:
+                groups = list(layout.groups())
+            active = np.zeros(len(groups), dtype=bool)
+            for member, cond in eq["members"]:
+                if cond is None:
+                    active[:] = True
+                else:
+                    active |= np.array([cond(g) for g in groups], dtype=bool)
+            base = base & active[:, None]
+        parts.append(base)
+    return np.concatenate(parts, axis=1).astype(np.float64)
