@@ -156,10 +156,13 @@ def run_config(name, warmup=5, measure=50):
             solver.X.block_until_ready()
             mark(f"{name}: first step done in {time.time() - t_c:.1f}s")
     solver.X.block_until_ready()
-    mark(f"{name}: measuring {measure} steps")
+    # block of `measure` steps in one device dispatch (compiles once)
+    mark(f"{name}: compiling {measure}-step block")
+    solver.step_many(measure, dt)
+    solver.X.block_until_ready()
+    mark(f"{name}: measuring {measure}-step block")
     t0 = time.time()
-    for _ in range(measure):
-        solver.step(dt)
+    solver.step_many(measure, dt)
     solver.X.block_until_ready()
     elapsed = time.time() - t0
     sps = measure / elapsed

@@ -56,9 +56,14 @@ def _cs_match(tcs, cs):
     return sup is not None and sup == tcs
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def recombination_matrix(tensorsig, cs):
     """Complex unitary (ncomp, ncomp): coordinate -> spin components, kron
-    over tensor indices (identity on non-curvilinear indices)."""
+    over tensor indices (identity on non-curvilinear indices). Cached so
+    downstream device-constant interning sees stable objects."""
     U = np.array([[1.0]])
     for tcs in tensorsig:
         if _cs_match(tcs, cs):
@@ -88,10 +93,10 @@ def apply_component_pair_matrix(data, C, tdim, az_axis, real):
     spatial = data.shape[tdim:]
     flat = data.reshape((ncomp,) + spatial)
     if not real:
-        C = match_precision(jnp.asarray(C), data.dtype)
+        C = match_precision(C, data.dtype)
         out = jnp.tensordot(C, flat, axes=(1, 0))
     else:
-        R = match_precision(jnp.asarray(real_pair_matrix(C)), data.dtype)
+        R = match_precision(real_pair_matrix(C), data.dtype)
         # bring azimuth axis next to components, expose pair slot
         a = 1 + az_axis
         moved = jnp.moveaxis(flat, a, 1)  # (ncomp, Naz, rest...)
@@ -118,7 +123,7 @@ def apply_group_stack(data, stack, axis_groups, axis_target, group_width):
     per-m Python loops (core/transforms.py:1260-1288) — on TPU a single MXU
     einsum over the m batch.
     """
-    stack = match_precision(jnp.asarray(stack), data.dtype)
+    stack = match_precision(stack, data.dtype)
     G = stack.shape[0]
     d = jnp.moveaxis(data, (axis_groups, axis_target), (-2, -1))
     lead = d.shape[:-2]

@@ -73,7 +73,8 @@ def _compiled_transform(direction, domain, scales, tdim, tensorsig):
             def fn(data):
                 return transform_to_grid(data, domain, scales, tdim,
                                          tensorsig=tensorsig)
-        fn = per_domain[key] = jax.jit(fn)
+        from ..tools.jitlift import lifted_jit
+        fn = per_domain[key] = lifted_jit(fn)
     return fn
 
 

@@ -33,9 +33,9 @@ class CompiledWithFallback:
     """
 
     def __init__(self, fields, fn, eager, describe):
-        import jax
+        from ..tools.jitlift import lifted_jit
         self.fields = fields
-        self.fn = jax.jit(fn)
+        self.fn = lifted_jit(fn)
         self.eager = eager
         self.describe = describe
         self.jit_ok = True

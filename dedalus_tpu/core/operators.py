@@ -137,7 +137,10 @@ def apply_term(data, tensor_factor, axis_descrs, tshape_in, tshape_out, tdim_out
             continue
         kind = descr[0]
         if kind == "full":
-            out = apply_matrix_jax(jnp.asarray(descr[1]), out, tdim_in + axis)
+            # host numpy/scipy reaches match_precision raw so large
+            # matrices are lifted to program arguments, interned by the
+            # producer-cached object's identity (tools/jitlift.py)
+            out = apply_matrix_jax(descr[1], out, tdim_in + axis)
         elif kind == "blocks":
             out = apply_axis_blocks(out, descr[1], tdim_in + axis)
         elif kind == "gblocks":

@@ -485,7 +485,8 @@ class InitialValueSolver(SolverBase):
             from .field import transform_to_grid, transform_to_coeff
             layout, variables = self.layout, self.variables
 
-            @jax.jit
+            from ..tools.jitlift import lifted_jit
+
             def project(X):
                 arrays = scatter_state(layout, variables, X)
                 out = {}
@@ -498,7 +499,7 @@ class InitialValueSolver(SolverBase):
                                                      tensorsig=v.tensorsig)
                 return gather_state(layout, variables, out)
 
-            self._project_state = project
+            self._project_state = lifted_jit(project)
         self.X = self._project_state(self.X)
 
     def _stop_trace(self):
@@ -507,22 +508,26 @@ class InitialValueSolver(SolverBase):
             self._trace_active = False
             logger.info(f"Profiler trace written to {self.profile_directory}")
 
+    def _end_warmup(self):
+        """Record warmup completion; start the profiler trace if enabled."""
+        self.warmup_time = time_mod.time()
+        if self.profile and not self._trace_active:
+            import atexit
+            os.makedirs(self.profile_directory, exist_ok=True)
+            jax.profiler.start_trace(str(self.profile_directory))
+            self._trace_active = True
+            # the trace must be closed even if the run dies before
+            # log_stats (exception, NaN abort) — stop_trace is global
+            # profiler state and a leaked session poisons later runs
+            atexit.register(self._stop_trace)
+
     def step(self, dt, wall_time=None):
         """Advance the system by one timestep (reference: core/solvers.py:683)."""
         dt = float(dt)
         if not np.isfinite(dt):
             raise ValueError("Invalid timestep.")
         if self.iteration == self.warmup_iterations:
-            self.warmup_time = time_mod.time()
-            if self.profile and not self._trace_active:
-                import atexit
-                os.makedirs(self.profile_directory, exist_ok=True)
-                jax.profiler.start_trace(str(self.profile_directory))
-                self._trace_active = True
-                # the trace must be closed even if the run dies before
-                # log_stats (exception, NaN abort) — stop_trace is global
-                # profiler state and a leaked session poisons later runs
-                atexit.register(self._stop_trace)
+            self._end_warmup()
         # pick up user modifications of the state fields (version-tracked)
         if self.fields_dirty():
             self.X = self.gather_fields()
@@ -540,6 +545,43 @@ class InitialValueSolver(SolverBase):
         self.dt = dt
         self.evaluator.evaluate_scheduled(
             iteration=self.iteration, wall_time=time_mod.time() - self.start_time,
+            sim_time=self.sim_time, timestep=dt)
+
+    def step_many(self, n, dt):
+        """
+        Advance n constant-dt steps with ONE device dispatch (lax.scan over
+        the jitted step). Small problems are host-latency bound at one
+        dispatch per step; blocking amortizes it. Scheduled handlers are
+        evaluated once at the END of the block, so per-step output cadences
+        inside a block coarsen to the block boundary; the Hermitian
+        re-projection runs at the block start when the block crosses its
+        cadence. Use step() when per-step cadences or adaptive dt matter.
+        """
+        n = int(n)
+        dt = float(dt)
+        if not np.isfinite(dt):
+            raise ValueError("Invalid timestep.")
+        if n <= 0:
+            return
+        if self.iteration <= self.warmup_iterations < self.iteration + n:
+            self._end_warmup()
+        if self.fields_dirty():
+            self.X = self.gather_fields()
+        cadence = self.enforce_real_cadence
+        if cadence:
+            r = self.iteration % cadence
+            if (n >= cadence or r < self.timestepper.steps
+                    or (cadence - r) < n):
+                self.enforce_hermitian_symmetry()
+        self.timestepper.step_many(n, dt)
+        self.defer_scatter(self.X)
+        self.snapshot_versions()
+        self.problem.sim_time = self.sim_time
+        self.iteration += n
+        self.dt = dt
+        self.evaluator.evaluate_scheduled(
+            iteration=self.iteration,
+            wall_time=time_mod.time() - self.start_time,
             sim_time=self.sim_time, timestep=dt)
 
     def evolve(self, timestep_function=None, log_cadence=100):
@@ -632,14 +674,15 @@ class LinearBoundaryValueSolver(SolverBase):
         self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F")
         self._aux = self.ops.factor(self.L_mat)
-        mask = jnp.asarray(self.valid_row_mask, dtype=self.real_dtype)
+        from ..tools.jitlift import lifted_jit, device_constant
+        mask_np, rd = self.valid_row_mask, self.real_dtype
         eval_F, ops = self.eval_F, self.ops
 
-        @jax.jit
         def _rhs_solve(aux, X0, extra):
+            mask = device_constant(mask_np, dtype=rd)
             return ops.solve(aux, eval_F(X0, extra_arrays=extra) * mask)
 
-        self._rhs_solve = _rhs_solve
+        self._rhs_solve = lifted_jit(_rhs_solve)
         self.iteration = 0
 
     def solve(self):
@@ -683,8 +726,10 @@ class NonlinearBoundaryValueSolver(SolverBase):
             exprs = self._residual_exprs
             eval_R = self.build_rhs_evaluator(
                 get_expr=lambda member: exprs.get(id(member)))
-            row_mask = jnp.asarray(self.valid_row_mask, dtype=self.real_dtype)
-            fn = jax.jit(lambda extra: eval_R(None, extra_arrays=extra) * row_mask)
+            from ..tools.jitlift import lifted_jit, device_constant
+            mask_np, rd = self.valid_row_mask, self.real_dtype
+            fn = lifted_jit(lambda extra: eval_R(None, extra_arrays=extra)
+                            * device_constant(mask_np, dtype=rd))
             cache = self._residual_cache = (eval_R.extra_fields, fn)
         fields, fn = cache
         return fn([f.coeff_data() for f in fields])

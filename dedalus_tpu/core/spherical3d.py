@@ -89,7 +89,7 @@ def apply_regularity_recombination(data, tdim, theta_data_axis, stack, forward):
     ncomp = int(np.prod(tshape, dtype=int)) if tdim else 1
     spatial = data.shape[tdim:]
     flat = data.reshape((ncomp,) + spatial)
-    stack = match_precision(jnp.asarray(stack), data.dtype)
+    stack = match_precision(stack, data.dtype)
     a = 1 + (theta_data_axis - tdim)
     moved = jnp.moveaxis(flat, a, 1)  # (ncomp, L, rest...)
     if forward:

@@ -685,7 +685,12 @@ class MatrixStructure:
         self.kl = int(max(-d.min(), 0))
         self.ku = int(max(d.max(), 0))
         nd = self.kl + self.ku + 1
-        q = max(self.kl, self.ku, 1)
+        # Block size constraints of the windowed-pivoting factorization
+        # (pencilops.BandedOps): pivot window needs kl <= q; the block
+        # tridiagonal carries ku <= 2q-1; fill width needs kl+ku <= 2q.
+        # The smallest q satisfying these minimizes factor storage, which
+        # scales linearly in q.
+        q = max(self.kl, -(-(self.ku + 1) // 2), -(-(self.kl + self.ku) // 2), 1)
         self.q = int(-(-q // 8) * 8) if q > 8 else max(q, 1)
         self.NB = -(-S // self.q)
         # nd caps: relative (structure isn't really banded) and absolute

@@ -23,6 +23,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..tools.jitlift import lifted_jit
+
 schemes = {}
 
 
@@ -80,18 +82,22 @@ class MultistepIMEX:
         self.iteration = 0
 
         eval_F = solver.eval_F
-        mask = jnp.asarray(solver.valid_row_mask, dtype=solver.real_dtype)
+        from ..tools.jitlift import device_constant
+        mask_np, mask_dt = solver.valid_row_mask, solver.real_dtype
+        # resolved inside each trace so the (G, S) mask is lifted to a
+        # program argument instead of an inline constant
+        mask = lambda: device_constant(mask_np, dtype=mask_dt)
         ops = solver.ops
 
         # M and L are explicit arguments (not closure constants) so the
         # compiled HLO stays small and the arrays live as device buffers.
-        @jax.jit
         def _factor(M, L, a0, b0):
-            return ops.factor(ops.lincomb(a0, M, b0, L))
+            return ops.factor_lincomb(a0, M, b0, L)
+        _factor = lifted_jit(_factor)
 
-        @jax.jit
-        def _advance(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c, lhs_aux):
-            Fn = eval_F(X, t, extra) * mask
+        def advance_body(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
+                         lhs_aux):
+            Fn = eval_F(X, t, extra) * mask()
             MXn = ops.matvec(M, X)
             LXn = ops.matvec(L, X)
             F_hist = jnp.concatenate([Fn[None], F_hist[:-1]])
@@ -100,11 +106,25 @@ class MultistepIMEX:
             RHS = (jnp.tensordot(c, F_hist, axes=1)
                    - jnp.tensordot(a[1:], MX_hist, axes=1)
                    - jnp.tensordot(b[1:], LX_hist, axes=1))
-            Xn = ops.solve(lhs_aux, RHS)
+            Xn = ops.solve(lhs_aux, RHS, mats=(M, L))
+            return Xn, F_hist, MX_hist, LX_hist
+
+        def _advance_n(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
+                       n, dt, lhs_aux):
+            # n constant-coefficient steps in one lax.scan dispatch
+            def body(carry, _):
+                X, t, Fh, MXh, LXh = carry
+                Xn, Fh, MXh, LXh = advance_body(M, L, X, t, extra, Fh, MXh,
+                                                LXh, a, b, c, lhs_aux)
+                return (Xn, t + dt, Fh, MXh, LXh), None
+            carry, _ = jax.lax.scan(body, (X, t, F_hist, MX_hist, LX_hist),
+                                    None, length=n)
+            Xn, _, F_hist, MX_hist, LX_hist = carry
             return Xn, F_hist, MX_hist, LX_hist
 
         self._factor = _factor
-        self._advance = _advance
+        self._advance = lifted_jit(advance_body)
+        self._advance_n = lifted_jit(_advance_n, static_argnums=(11,))
 
     def compute_coefficients(self, dt_hist, order):
         """Return (a[0..order], b[0..order], c[1..order])."""
@@ -134,6 +154,42 @@ class MultistepIMEX:
             jnp.asarray(b, dtype=rd), jnp.asarray(c, dtype=rd), self._lhs_aux)
         solver.X = X
         solver.sim_time = float(solver.sim_time) + float(dt)
+
+    def step_many(self, n, dt):
+        """
+        n constant-dt steps in one device dispatch. The startup ramp (order
+        build-up) and any dt change run as single steps until the multistep
+        coefficients are stationary; the remainder scans on device.
+        """
+        solver = self.solver
+        s = self.steps
+        n = int(n)
+        while n > 0 and not (self.iteration >= s
+                             and len(self.dt_hist) == s
+                             and all(abs(k - float(dt)) < 1e-15 * abs(dt)
+                                     for k in self.dt_hist)):
+            self.step(dt)
+            n -= 1
+        if n == 0:
+            return
+        rd = solver.real_dtype
+        a, b, c = self.compute_coefficients(self.dt_hist, s)
+        key = (round(float(a[0]), 14), round(float(b[0]), 14))
+        if key != self._lhs_key:
+            self._lhs_key = key
+            self._lhs_aux = self._factor(solver.M_mat, solver.L_mat,
+                                         jnp.asarray(a[0], dtype=rd),
+                                         jnp.asarray(b[0], dtype=rd))
+        X, self.F_hist, self.MX_hist, self.LX_hist = self._advance_n(
+            solver.M_mat, solver.L_mat, solver.X,
+            jnp.asarray(solver.sim_time, dtype=rd), solver.rhs_extra(),
+            self.F_hist, self.MX_hist, self.LX_hist,
+            jnp.asarray(a, dtype=rd), jnp.asarray(b, dtype=rd),
+            jnp.asarray(c, dtype=rd), n, jnp.asarray(float(dt), dtype=rd),
+            self._lhs_aux)
+        solver.X = X
+        solver.sim_time = float(solver.sim_time) + n * float(dt)
+        self.iteration += n
 
 
 @add_scheme
@@ -253,7 +309,9 @@ class RungeKuttaIMEX:
 
         eval_F = solver.eval_F
         rd = solver.real_dtype
-        mask = jnp.asarray(solver.valid_row_mask, dtype=rd)
+        from ..tools.jitlift import device_constant
+        mask_np = solver.valid_row_mask
+        mask = lambda: device_constant(mask_np, dtype=rd)
         A = jnp.asarray(self.A, dtype=rd)
         H = jnp.asarray(self.H, dtype=rd)
         c = jnp.asarray(self.c, dtype=rd)
@@ -270,43 +328,76 @@ class RungeKuttaIMEX:
         uniq = sorted(set(H_diag))
         stage_slot = [uniq.index(h) for h in H_diag]
 
-        @jax.jit
+        # one factorization per UNIQUE implicit diagonal; the per-stage list
+        # is assembled OUTSIDE the jit so stages sharing a factor alias the
+        # same device buffers instead of duplicating the jit's outputs
+        def _factor_uniq(M, L, dt):
+            return [ops.factor_lincomb(one, M, dt * h, L) for h in uniq]
+        _factor_uniq = lifted_jit(_factor_uniq)
+
         def _factor(M, L, dt):
-            auxs = [ops.factor(ops.lincomb(one, M, dt * h, L)) for h in uniq]
+            auxs = _factor_uniq(M, L, dt)
             return [auxs[j] for j in stage_slot]
 
-        @jax.jit
-        def _step(M, L, X0, t0, dt, extra, lhs_auxs):
+        def step_body(M, L, X0, t0, dt, extra, lhs_auxs):
             MX0 = ops.matvec(M, X0)
             LXs = []
             Fs = []
             Xi = X0
             for i in range(1, s + 1):
                 LXs.append(ops.matvec(L, Xi))
-                Fs.append(eval_F(Xi, t0 + c[i - 1] * dt, extra) * mask)
+                Fs.append(eval_F(Xi, t0 + c[i - 1] * dt, extra) * mask())
                 RHS = MX0
                 for j in range(i):
                     RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
-                Xi = ops.solve(lhs_auxs[i - 1], RHS)
+                Xi = ops.solve(lhs_auxs[i - 1], RHS, mats=(M, L))
             return Xi
 
+        def _step_n(M, L, X0, t0, dt, extra, lhs_auxs, n):
+            # n device steps in one lax.scan: one dispatch per block
+            # instead of per step (small problems are host-latency bound)
+            def body(carry, _):
+                X, t = carry
+                Xn = step_body(M, L, X, t, dt, extra, lhs_auxs)
+                return (Xn, t + dt), None
+            (Xn, _), _ = jax.lax.scan(body, (X0, t0), None, length=n)
+            return Xn
+
         self._factor = _factor
-        self._step = _step
+        self._step = lifted_jit(step_body)
+        self._step_n = lifted_jit(_step_n, static_argnums=(7,))
+
+    def _ensure_factor(self, dt):
+        solver = self.solver
+        key = round(float(dt), 14)
+        if key != self._lhs_key:
+            self._lhs_key = key
+            self._lhs_aux = self._factor(
+                solver.M_mat, solver.L_mat,
+                jnp.asarray(float(dt), dtype=solver.real_dtype))
 
     def step(self, dt, wall_time=None):
         solver = self.solver
-        key = round(float(dt), 14)
         rd = solver.real_dtype
-        if key != self._lhs_key:
-            self._lhs_key = key
-            self._lhs_aux = self._factor(solver.M_mat, solver.L_mat,
-                                         jnp.asarray(float(dt), dtype=rd))
+        self._ensure_factor(dt)
         solver.X = self._step(solver.M_mat, solver.L_mat, solver.X,
                               jnp.asarray(solver.sim_time, dtype=rd),
                               jnp.asarray(float(dt), dtype=rd),
                               solver.rhs_extra(), self._lhs_aux)
         solver.sim_time = float(solver.sim_time) + float(dt)
         self.iteration += 1
+
+    def step_many(self, n, dt):
+        """n constant-dt steps in one device dispatch (lax.scan)."""
+        solver = self.solver
+        rd = solver.real_dtype
+        self._ensure_factor(dt)
+        solver.X = self._step_n(solver.M_mat, solver.L_mat, solver.X,
+                                jnp.asarray(solver.sim_time, dtype=rd),
+                                jnp.asarray(float(dt), dtype=rd),
+                                solver.rhs_extra(), self._lhs_aux, int(n))
+        solver.sim_time = float(solver.sim_time) + n * float(dt)
+        self.iteration += n
 
 
 @add_scheme

@@ -33,6 +33,10 @@ def register_transform(basis_cls_name, name):
 
 
 def get_plan(basis, scale, library=None):
+    """Build a transform plan. Callers go through Basis.transform_plan
+    (@CachedMethod), so plans — and the host matrices they own, which the
+    device-constant registry interns by object identity — are built once
+    per (basis, scale, library)."""
     lib = library or basis.library
     key = (type(basis).__name__, lib)
     # Fall back through base classes (e.g. ChebyshevT -> Jacobi)
@@ -65,10 +69,10 @@ class MatrixTransform(TransformPlan):
         self.backward_mat = self.build_backward(basis, scale)  # (Ng, N)
 
     def forward(self, gdata, axis):
-        return apply_matrix_jax(jnp.asarray(self.forward_mat), gdata, axis)
+        return apply_matrix_jax(self.forward_mat, gdata, axis)
 
     def backward(self, cdata, axis):
-        return apply_matrix_jax(jnp.asarray(self.backward_mat), cdata, axis)
+        return apply_matrix_jax(self.backward_mat, cdata, axis)
 
 
 @register_transform("Jacobi", "matrix")

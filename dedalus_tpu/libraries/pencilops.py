@@ -59,11 +59,39 @@ class DenseOps:
     def factor(self, A):
         return self.solver_cls.factor(A)
 
-    def solve(self, aux, rhs):
+    def factor_lincomb(self, a, A, b, B):
+        return self.factor(self.lincomb(a, A, b, B))
+
+    def solve(self, aux, rhs, mats=None):
         return self.solver_cls.solve(aux, rhs)
 
     def densify_host(self, host_mat, g):
         return np.asarray(host_mat[g])
+
+
+@jax.tree_util.register_pytree_node_class
+class BandedMatrix:
+    """
+    One pencil matrix in trimmed banded + pinned-row storage: only the
+    structurally nonzero diagonals are kept (`dsel` maps stored rows to the
+    shared 0..nd-1 diagonal lattice), and an all-zero pinned-row block is
+    dropped entirely. The mass matrix M typically occupies a few diagonals
+    of the lattice the stiffness L defines, so trimming cuts both storage
+    and matvec work.
+    """
+
+    def __init__(self, bands, Vt, dsel):
+        self.bands = bands    # (G, len(dsel), n_pad)
+        self.Vt = Vt          # (G, t, n_pad) or None
+        self.dsel = tuple(int(d) for d in dsel)
+
+    def tree_flatten(self):
+        return (self.bands, self.Vt), self.dsel
+
+    @classmethod
+    def tree_unflatten(cls, dsel, children):
+        bands, Vt = children
+        return cls(bands, Vt, dsel)
 
 
 class BandedOps:
@@ -119,7 +147,18 @@ class BandedOps:
     # ------------------------------------------------------------ host side
 
     def to_device(self, host_arrs, dtype):
-        return {k: jnp.asarray(v, dtype=dtype) for k, v in host_arrs.items()}
+        """Host (G, nd, n_pad) band store -> trimmed BandedMatrix."""
+        bands = host_arrs["bands"]
+        Vt = host_arrs["Vt"]
+        dsel = [d for d in range(self.nd) if np.any(bands[:, d, :])]
+        if not dsel:
+            dsel = [self.kl]
+        trimmed = jnp.asarray(np.ascontiguousarray(bands[:, dsel, :]),
+                              dtype=dtype)
+        Vt_dev = None
+        if self.t and np.any(Vt):
+            Vt_dev = jnp.asarray(Vt, dtype=dtype)
+        return BandedMatrix(trimmed, Vt_dev, dsel)
 
     def densify_host(self, host_arrs, g):
         """Reconstruct the original-ordering dense (S, S) matrix (host)."""
@@ -140,18 +179,26 @@ class BandedOps:
 
     # ----------------------------------------------------------- device ops
 
-    def lincomb(self, a, A, b, B):
-        return jax.tree.map(lambda x, y: a * x + b * y, A, B)
+    def expand(self, A, a=1.0):
+        """Trimmed BandedMatrix -> full-lattice (bands (G, nd, n_pad),
+        Vt (G, t, n_pad)) scaled by `a` (factorization transient)."""
+        G = A.bands.shape[0]
+        dtype = A.bands.dtype
+        full = jnp.zeros((G, self.nd, self.n_pad), dtype=dtype)
+        full = full.at[:, np.asarray(A.dsel), :].set(a * A.bands)
+        if self.t:
+            Vt = (a * A.Vt if A.Vt is not None
+                  else jnp.zeros((G, self.t, self.n_pad), dtype=dtype))
+        else:
+            Vt = jnp.zeros((G, 0, self.n_pad), dtype=dtype)
+        return full, Vt
 
-    def scale(self, a, A):
-        return jax.tree.map(lambda x: a * x, A)
-
-    def _band_mv(self, bands, x):
-        """y[g, p] = sum_d bands[g, d, p] * x[g, p + d - kl]; x (G, n_pad)."""
+    def _band_mv(self, bands, dsel, x):
+        """y[g, p] = sum_{d in dsel} bands[g, i, p] * x[g, p + d - kl]."""
         xpad = jnp.pad(x, ((0, 0), (self.kl, self.ku)))
         y = jnp.zeros_like(x)
-        for d in range(self.nd):
-            y = y + bands[:, d, :] * jax.lax.slice_in_dim(
+        for i, d in enumerate(dsel):
+            y = y + bands[:, i, :] * jax.lax.slice_in_dim(
                 xpad, d, d + self.n_pad, axis=1)
         return y
 
@@ -159,26 +206,27 @@ class BandedOps:
         """Full A @ X in the ORIGINAL slot ordering; X (G, S)."""
         xp = X[:, self.col_perm]
         xp = jnp.pad(xp, ((0, 0), (0, self.n_pad - self.n)))
-        yp = self._band_mv(A["bands"], xp)
-        if self.t:
-            pin_vals = jnp.einsum("gtn,gn->gt", A["Vt"], xp)
+        yp = self._band_mv(A.bands, A.dsel, xp)
+        if self.t and A.Vt is not None:
+            pin_vals = jnp.einsum("gtn,gn->gt", A.Vt, xp)
             yp = yp.at[:, self.pin_pos].add(pin_vals)
         # yp[p] = (A @ X)[row_perm[p]]
         out = jnp.zeros_like(X)
         return out.at[:, self.row_perm].set(yp[:, :self.n])
 
-    def _blocks(self, bands):
-        """Band storage -> block tridiagonal (Dg, Lo, Up).
-        Dg (G, NB, q, q); Lo/Up (G, NB-1, q, q) are blocks (i+1, i)/(i, i+1)."""
+    def _chunk_blocks(self, chunk):
+        """One block-row's (G, D, q) band chunk -> (diag, left, right) blocks
+        ((i, i), (i, i-1), (i, i+1)); avoids materializing the full block
+        tridiagonal (3 extra (G, NB, q, q) arrays) during factorization."""
+        q = self.q
+        ri = np.broadcast_to(np.arange(q)[:, None], (q, q))
         out = {}
         for o in (-1, 0, 1):
-            d_idx, r_idx, valid = self._blk_idx[o]
-            blk = bands[:, d_idx, r_idx] * jnp.asarray(valid, dtype=bands.dtype)
+            d_idx, _, valid = self._blk_idx[o]
+            d = d_idx[0]                                     # (q, q)
+            blk = chunk[:, d, ri] * jnp.asarray(valid, dtype=chunk.dtype)
             out[o] = blk
-        Dg = out[0]
-        Up = out[1][:, :-1]   # block (i, i+1) read at block-row i
-        Lo = out[-1][:, 1:]   # block (i+1, i) read at block-row i+1
-        return Dg, Lo, Up
+        return out[0], out[-1], out[1]
 
     def _factor_interior(self, bands):
         """
@@ -192,29 +240,39 @@ class BandedOps:
         a (q x 2q) U12 block per step. Unconditionally stable where the
         no-pivot block elimination breaks on constraint rows.
 
-        Returns aux tuple (perms, L1, L2, U11, U12, lastP, lastL, lastU).
+        Factors are stored LAPACK-packed — the raw (2q x q) panel LU holds
+        L1 (unit-lower), U11 (upper) and L2 in one array — halving
+        persistent factor memory vs separate L1/L2/U11 blocks.
+
+        Returns aux tuple (perms, panelLU, U12, lastP, lastLU).
         """
         G = bands.shape[0]
         q, NB = self.q, self.NB
         dtype = bands.dtype
-        Dg, Lo, Up = self._blocks(bands)
         if NB == 1:
-            lu, _, perm = jax.lax.linalg.lu(Dg[:, 0])
-            lastL = jnp.tril(lu, -1) + jnp.eye(q, dtype=dtype)
-            lastU = jnp.triu(lu)
-            return (None, None, None, None, None, perm, lastL, lastU)
+            Dg0, _, _ = self._chunk_blocks(bands)
+            lu, _, perm = jax.lax.linalg.lu(Dg0)
+            return (None, None, None, perm, lu)
 
         eye_q = jnp.eye(q, dtype=dtype)
         zero_qq = jnp.zeros((G, q, q), dtype=dtype)
 
-        def step(carry, xs):
+        # All arrays entering/leaving the scan are flattened to (G, flat):
+        # TPU tiles the two minor dims to (8, 128), so stacked (steps, G, q,
+        # q)-shaped arrays with q ~ 32 pay 4-8x padding; (steps, G, q*q)
+        # tiles cleanly. The scan consumes the band storage directly as
+        # per-block-row chunks (one (G, D, q) slab per step) instead of a
+        # pre-materialized block tridiagonal.
+        nd = self.nd
+
+        def step(carry, chunk_flat):
             A11, A12 = carry              # (G,q,q), (G,q,2q): cols i+1, i+2
-            Lo_i, D_n, Up_n = xs          # rows i+1: cols i, i+1, i+2
+            D_n, Lo_i, Up_n = self._chunk_blocks(
+                chunk_flat.reshape(G, nd, q))
             panel = jnp.concatenate([A11, Lo_i], axis=1)          # (G,2q,q)
             lu, _, perm = jax.lax.linalg.lu(panel)
             L1 = jnp.tril(lu[:, :q, :], -1) + eye_q               # (G,q,q)
             L2 = lu[:, q:, :]                                     # (G,q,q)
-            U11 = jnp.triu(lu[:, :q, :])                          # (G,q,q)
             T = jnp.concatenate(
                 [A12, jnp.concatenate([D_n, Up_n], axis=2)], axis=1)  # (G,2q,2q)
             T = jnp.take_along_axis(T, perm[:, :, None], axis=1)
@@ -223,67 +281,80 @@ class BandedOps:
             Tn = T[:, q:, :] - L2 @ U12                           # (G,q,2q)
             carry = (Tn[:, :, :q],
                      jnp.concatenate([Tn[:, :, q:], zero_qq], axis=2))
-            return carry, (perm, L1, L2, U11, U12)
+            return carry, (perm, lu.reshape(G, 2 * q * q),
+                           U12.reshape(G, 2 * q * q))
 
-        xs = (jnp.moveaxis(Lo, 1, 0),
-              jnp.moveaxis(Dg[:, 1:], 1, 0),
-              jnp.moveaxis(jnp.concatenate([Up[:, 1:], zero_qq[:, None]],
-                                           axis=1), 1, 0))
-        A12_0 = jnp.concatenate([Up[:, 0], zero_qq], axis=2)
-        (A11_f, _), (perms, L1, L2, U11, U12) = jax.lax.scan(
-            step, (Dg[:, 0], A12_0), xs)
+        chunks = jnp.moveaxis(bands.reshape(G, nd, NB, q), 2, 0)  # (NB,G,nd,q)
+        chunks = chunks.reshape(NB, G, nd * q)
+        Dg0, _, Up0 = self._chunk_blocks(chunks[0].reshape(G, nd, q))
+        A12_0 = jnp.concatenate([Up0, zero_qq], axis=2)
+        (A11_f, _), (perms, panelLU, U12) = jax.lax.scan(
+            step, (Dg0, A12_0), chunks[1:])
         lu, _, lastP = jax.lax.linalg.lu(A11_f)
-        lastL = jnp.tril(lu, -1) + eye_q
-        lastU = jnp.triu(lu)
-        return (perms, L1, L2, U11, U12, lastP, lastL, lastU)
+        return (perms, panelLU, U12, lastP, lu)
 
     def _solve_interior(self, interior_aux, f):
         """Solve B~ x = f for f (G, n_pad, k) via the pivoted block factors."""
-        perms, L1, L2, U11, U12, lastP, lastL, lastU = interior_aux
+        perms, panelLU, U12, lastP, lastLU = interior_aux
         G, _, k = f.shape
         q, NB = self.q, self.NB
-        fb = jnp.moveaxis(f.reshape(G, NB, q, k), 1, 0)   # (NB, G, q, k)
+        eye_q = jnp.eye(q, dtype=f.dtype)
+        # flattened (steps, G, q*k) stacking: see _factor_interior layout note
+        fb = jnp.moveaxis(f.reshape(G, NB, q, k), 1, 0).reshape(NB, G, q * k)
+
+        def last_solve(w):
+            y = jsl.solve_triangular(jnp.tril(lastLU, -1) + eye_q, w,
+                                     lower=True, unit_diagonal=True)
+            return jsl.solve_triangular(jnp.triu(lastLU), y, lower=False)
+
         if NB == 1:
-            w = jnp.take_along_axis(fb[0], lastP[:, :, None], axis=1)
-            y = jsl.solve_triangular(lastL, w, lower=True, unit_diagonal=True)
-            x = jsl.solve_triangular(lastU, y, lower=False)
+            w = jnp.take_along_axis(fb[0].reshape(G, q, k),
+                                    lastP[:, :, None], axis=1)
+            x = last_solve(w)
             return jnp.moveaxis(x[None], 0, 1).reshape(G, self.n_pad, k)
 
         # forward: eliminate with pivots; carry the updated next block
         def fwd(w_cur, xs):
-            f_next, perm, L1_i, L2_i = xs
-            w = jnp.concatenate([w_cur, f_next], axis=1)          # (G,2q,k)
-            w = jnp.take_along_axis(w, perm[:, :, None], axis=1)
+            f_next, perm, lu_flat = xs
+            lu_i = lu_flat.reshape(G, 2 * q, q)
+            w = jnp.concatenate([w_cur, f_next.reshape(G, q, k)], axis=1)
+            w = jnp.take_along_axis(w, perm[:, :, None], axis=1)  # (G,2q,k)
+            L1_i = jnp.tril(lu_i[:, :q, :], -1) + eye_q
             y = jsl.solve_triangular(L1_i, w[:, :q], lower=True,
                                      unit_diagonal=True)
-            w_next = w[:, q:] - L2_i @ y
-            return w_next, y
+            w_next = w[:, q:] - lu_i[:, q:, :] @ y
+            return w_next, y.reshape(G, q * k)
 
-        w_f, ys = jax.lax.scan(fwd, fb[0], (fb[1:], perms, L1, L2))
+        w_f, ys = jax.lax.scan(fwd, fb[0].reshape(G, q, k),
+                               (fb[1:], perms, panelLU))
         w = jnp.take_along_axis(w_f, lastP[:, :, None], axis=1)
-        yl = jsl.solve_triangular(lastL, w, lower=True, unit_diagonal=True)
-        x_last = jsl.solve_triangular(lastU, yl, lower=False)     # (G,q,k)
+        x_last = last_solve(w)                                    # (G,q,k)
 
         # backward: x_i = U11_i^-1 (y_i - U12_i @ [x_{i+1}; x_{i+2}])
         zero = jnp.zeros_like(x_last)
 
         def bwd(carry, xs):
             x1, x2 = carry                                        # x_{i+1}, x_{i+2}
-            y_i, U11_i, U12_i = xs
+            y_flat, lu_flat, U12_flat = xs
+            y_i = y_flat.reshape(G, q, k)
+            lu_i = lu_flat.reshape(G, 2 * q, q)
+            U12_i = U12_flat.reshape(G, q, 2 * q)
             rhs = y_i - U12_i @ jnp.concatenate([x1, x2], axis=1)
-            x = jsl.solve_triangular(U11_i, rhs, lower=False)
-            return (x, x1), x
+            x = jsl.solve_triangular(jnp.triu(lu_i[:, :q, :]), rhs,
+                                     lower=False)
+            return (x, x1), x.reshape(G, q * k)
 
-        _, xs_rev = jax.lax.scan(bwd, (x_last, zero), (ys, U11, U12),
+        _, xs_rev = jax.lax.scan(bwd, (x_last, zero), (ys, panelLU, U12),
                                  reverse=True)
-        x = jnp.concatenate([xs_rev, x_last[None]], axis=0)
+        x = jnp.concatenate([xs_rev.reshape(NB - 1, G, q, k),
+                             x_last[None]], axis=0)
         return jnp.moveaxis(x, 0, 1).reshape(G, self.n_pad, k)
 
-    def factor(self, A):
-        """Factor the combined LHS; returns the aux pytree for solve()."""
-        G = A["bands"].shape[0]
-        dtype = A["bands"].dtype
-        bands = A["bands"]
+    def _factor_impl(self, bands, Vt, refine_aux):
+        """Shared factorization body; refine_aux supplies the residual
+        matvec without persisting a combined matrix."""
+        G = bands.shape[0]
+        dtype = bands.dtype
         # identity pins at the pinned rows + padded diagonal
         ones = jnp.ones((G, len(self.pin_pos)), dtype=dtype)
         bands = bands.at[:, self.kl, self.pin_pos].set(ones)
@@ -291,7 +362,8 @@ class BandedOps:
             tail = jnp.ones((G, self.n_pad - self.n), dtype=dtype)
             bands = bands.at[:, self.kl, self.n:].set(tail)
         interior = self._factor_interior(bands)
-        aux = {"interior": interior, "A": A}
+        aux = {"interior": interior, "Vt": Vt}
+        aux.update(refine_aux)
         if self.t:
             # Y = B~^-1 E  (E = one-hot columns at the pin positions)
             E = jnp.zeros((G, self.n_pad, self.t), dtype=dtype)
@@ -299,27 +371,63 @@ class BandedOps:
             Yb = self._solve_interior(interior, E)                # (G, n_pad, t)
             # capacitance: I + (Vt - E^T) Y
             Cap = (jnp.eye(self.t, dtype=dtype)
-                   + jnp.einsum("gtn,gnk->gtk", A["Vt"], Yb)
+                   + jnp.einsum("gtn,gnk->gtk", Vt, Yb)
                    - Yb[:, self.pin_pos, :])
-            aux["Yb"] = Yb
+            # stored (G, t, n_pad): a trailing dim of t ~ 16 pads 8x under
+            # TPU (8, 128) tiling; n_pad-minor tiles cleanly
+            aux["YbT"] = jnp.swapaxes(Yb, 1, 2)
             aux["Cap"] = jsl.lu_factor(Cap)
         return aux
+
+    def factor(self, A):
+        """Factor a matrix already resident in banded storage."""
+        bands, Vt = self.expand(A)
+        return self._factor_impl(bands, Vt, {"A": A})
+
+    def factor_lincomb(self, a, M, b, L):
+        """Factor a*M + b*L WITHOUT persisting the combined bands: the
+        combination is a transient of the factorization, and the
+        refinement residual uses matvecs of the already-resident trimmed
+        M and L (saves one full band store at large S)."""
+        G = M.bands.shape[0]
+        dtype = M.bands.dtype
+        bands = jnp.zeros((G, self.nd, self.n_pad), dtype=dtype)
+        bands = bands.at[:, np.asarray(M.dsel), :].add(a * M.bands)
+        bands = bands.at[:, np.asarray(L.dsel), :].add(b * L.bands)
+        Vt = jnp.zeros((G, self.t, self.n_pad), dtype=dtype)
+        if M.Vt is not None:
+            Vt = Vt + a * M.Vt
+        if L.Vt is not None:
+            Vt = Vt + b * L.Vt
+        # M and L themselves are NOT stored in the aux: the jitted factor
+        # would return copies of both full band stores; the refinement
+        # matvec receives them via solve(..., mats=(M, L))
+        return self._factor_impl(bands, Vt, {"ab": (a, b)})
+
+    def _aux_matvec(self, aux, x, mats):
+        if "A" in aux:
+            return self.matvec(aux["A"], x)
+        a, b = aux["ab"]
+        M, L = mats
+        return a * self.matvec(M, x) + b * self.matvec(L, x)
 
     def _solve_once(self, aux, rhs):
         fp = rhs[:, self.row_perm]
         fp = jnp.pad(fp, ((0, 0), (0, self.n_pad - self.n)))
         y = self._solve_interior(aux["interior"], fp[..., None])[..., 0]
         if self.t:
-            Vy = (jnp.einsum("gtn,gn->gt", aux["A"]["Vt"], y)
+            Vy = (jnp.einsum("gtn,gn->gt", aux["Vt"], y)
                   - y[:, self.pin_pos])
             z = jsl.lu_solve(aux["Cap"], Vy)
-            y = y - jnp.einsum("gnt,gt->gn", aux["Yb"], z)
+            y = y - jnp.einsum("gtn,gt->gn", aux["YbT"], z)
         xp = y[:, :self.n]
         return xp[:, self.pos_col]
 
-    def solve(self, aux, rhs):
+    def solve(self, aux, rhs, mats=None):
         x = self._solve_once(aux, rhs)
+        if mats is None and "A" not in aux:
+            return x  # lincomb factor without mats: no refinement possible
         for _ in range(self.refine):
-            r = rhs - self.matvec(aux["A"], x)
+            r = rhs - self._aux_matvec(aux, x, mats)
             x = x + self._solve_once(aux, r)
         return x

@@ -128,6 +128,18 @@ def _project(Lmax, m, s_out, values_fn, n_in, extra=2):
     return (Yout / env * (wq / env)) @ F.T
 
 
+def _selection_mask(Lmax, m, s_out, s_in, dl):
+    """
+    Analytic selection rule |l_out - l_in| <= dl as a boolean mask over the
+    (m, s_out) x (m, s_in) coefficient spaces. Quadrature assembly leaves
+    ~1e-15 dirt outside the rule that grows with Lmax and defeats band
+    detection; masking restores exact sparsity.
+    """
+    l_out = np.arange(lmin(m, s_out), Lmax + 1)
+    l_in = np.arange(lmin(m, s_in), Lmax + 1)
+    return np.abs(l_out[:, None] - l_in[None, :]) <= dl
+
+
 @cached_function
 def ladder_matrix(Lmax, m, s, ds):
     """
@@ -136,7 +148,8 @@ def ladder_matrix(Lmax, m, s, ds):
     (reference: dedalus_sphere/sphere.py:120 SphereOperator.__D)
     """
     n_in = spin2jacobi(Lmax, m, s)[0]
-    return _project(Lmax, m, s + ds, lambda z: ladder_values(Lmax, m, s, ds, z), n_in)
+    M = _project(Lmax, m, s + ds, lambda z: ladder_values(Lmax, m, s, ds, z), n_in)
+    return M * _selection_mask(Lmax, m, s + ds, s, 0)
 
 
 @cached_function
@@ -144,7 +157,8 @@ def cos_matrix(Lmax, m, s):
     """Multiplication by cos(theta) within the (m, s) space, truncated at
     Lmax: (n, n), tridiagonal in l (reference: sphere.py 'Cos' operator)."""
     n_in = spin2jacobi(Lmax, m, s)[0]
-    return _project(Lmax, m, s, lambda z: z * harmonics(Lmax, m, s, z), n_in)
+    M = _project(Lmax, m, s, lambda z: z * harmonics(Lmax, m, s, z), n_in)
+    return M * _selection_mask(Lmax, m, s, s, 1)
 
 
 @cached_function

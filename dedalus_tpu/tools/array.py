@@ -137,11 +137,39 @@ def match_precision(matrix, data_dtype):
     `data_dtype`, preserving complexness. Keeps float32 problems in float32
     on device (TPU: c128 unsupported, f64 emulated) instead of silently
     promoting through f64 constants.
+
+    Host (numpy) matrices above a small size are routed through the
+    device-constant registry so compiled programs receive them as runtime
+    ARGUMENTS: this JAX version inlines every non-splat constant into the
+    program text, and transform stacks reach hundreds of MB
+    (tools/jitlift.py has the full story).
     """
+    low = (jnp.dtype(data_dtype).itemsize <= 4
+           or data_dtype in (jnp.float32, jnp.complex64))
+
+    def target(dt):
+        if low:
+            return np.complex64 if np.issubdtype(dt, np.complexfloating) \
+                else np.float32
+        return dt
+
+    if sp.issparse(matrix):
+        # interned by the sparse object's identity (producers cache these)
+        tdt = target(matrix.dtype)
+        from .jitlift import device_constant
+        if np.prod(matrix.shape) * np.dtype(tdt).itemsize > 16384:
+            return device_constant(matrix, dtype=tdt)
+        return jnp.asarray(matrix.toarray(), dtype=tdt)
+    if isinstance(matrix, np.ndarray):
+        tdt = target(matrix.dtype)
+        if matrix.size * np.dtype(tdt).itemsize > 16384:
+            from .jitlift import device_constant
+            return device_constant(matrix, dtype=tdt)
+        return jnp.asarray(matrix, dtype=tdt)
     matrix = jnp.asarray(matrix)
-    if jnp.dtype(data_dtype).itemsize <= 4 or data_dtype in (jnp.float32, jnp.complex64):
-        if jnp.issubdtype(matrix.dtype, jnp.complexfloating):
-            return matrix.astype(jnp.complex64)
+    if low and jnp.issubdtype(matrix.dtype, jnp.complexfloating):
+        return matrix.astype(jnp.complex64)
+    if low:
         return matrix.astype(jnp.float32)
     return matrix
 

@@ -172,3 +172,33 @@ def test_enforce_real_cadence_projects_invalid_modes():
     for _ in range(6):
         solver.step(1e-3)
     assert np.isfinite(np.asarray(solver.X)).all()
+
+
+def test_step_many_matches_single_steps():
+    """step_many(n, dt) must reproduce n individual step(dt) calls exactly
+    (including the multistep startup ramp)."""
+    import dedalus_tpu.public as d3
+
+    def build(scheme):
+        coords = d3.CartesianCoordinates("x")
+        dist = d3.Distributor(coords, dtype=np.float64)
+        xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 2 * np.pi))
+        u = dist.Field(name="u", bases=xb)
+        problem = d3.IVP([u], namespace=locals())
+        problem.add_equation("dt(u) - lap(u) = - u*u")
+        solver = problem.build_solver(scheme)
+        x, = dist.local_grids(xb)
+        u["g"] = np.sin(x) + 0.1 * np.cos(3 * x)
+        return solver
+
+    for scheme in ("RK222", "SBDF3"):
+        s1 = build(scheme)
+        s2 = build(scheme)
+        for _ in range(7):
+            s1.step(1e-3)
+        s2.step_many(7, 1e-3)
+        X1 = np.asarray(s1.X)
+        X2 = np.asarray(s2.X)
+        assert np.allclose(X1, X2, rtol=1e-12, atol=1e-14), scheme
+        assert abs(s1.sim_time - s2.sim_time) < 1e-14
+        assert s1.iteration == s2.iteration == 7
