@@ -420,7 +420,7 @@ def batched_system_coos(layout, equations, variables, names):
     for name, r, c, v in chunks:
         idx = inverse[pos:pos + r.size]
         pos += r.size
-        np.add.at(out_vals[name].T, idx, np.ascontiguousarray(v.T))
+        np.add.at(out_vals[name], (slice(None), idx), v)
     # validity: zero invalid entries (pattern stays shared)
     keep = (row_valid[:, pattern_rows] & col_valid[:, pattern_cols])
     for name in names:

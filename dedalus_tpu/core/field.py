@@ -466,16 +466,17 @@ class Field(Operand):
         from .basis import RealFourier, ComplexFourier
         if shape is None and scales is None:
             return self
+        coeff_shape = self.domain.coeff_shape
         if shape is None:
             scales = self.dist.remedy_scales(scales)
-            shape = [1 if b is None else int(s * b.size)
-                     for b, s in zip(self.domain.bases, scales)]
+            shape = [1 if b is None else int(s * n)
+                     for b, s, n in zip(self.domain.bases, scales, coeff_shape)]
         data = np.asarray(self.require_coeff_space())
         mask = np.ones_like(data, dtype=bool)
         for axis, (basis, cutoff) in enumerate(zip(self.domain.bases, shape)):
             if basis is None:
                 continue
-            n = basis.size
+            n = coeff_shape[axis]
             if isinstance(basis, RealFourier):
                 # interleaved (cos, -sin) pairs: cutoff counts coefficients
                 keep = np.arange(n) < cutoff

@@ -153,8 +153,8 @@ class BandedOps:
         dsel = [d for d in range(self.nd) if np.any(bands[:, d, :])]
         if not dsel:
             dsel = [self.kl]
-        trimmed = jnp.asarray(np.ascontiguousarray(bands[:, dsel, :]),
-                              dtype=dtype)
+        # fancy-index slice is already a fresh contiguous array
+        trimmed = jnp.asarray(bands[:, dsel, :], dtype=dtype)
         Vt_dev = None
         if self.t and np.any(Vt):
             Vt_dev = jnp.asarray(Vt, dtype=dtype)

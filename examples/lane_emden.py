@@ -1,0 +1,75 @@
+"""
+Lane-Emden equation in the ball (reference:
+examples/nlbvp_ball_lane_emden/lane_emden.py): the structure of a
+polytropic star,
+    lap(f) = -f^n,  f(r=1) = 0,
+solved as an NLBVP with floating amplitude; the stellar radius follows as
+R = f(0)^((n-1)/2) and matches Boyd's reference values.
+
+Run: python examples/lane_emden.py
+"""
+
+import numpy as np
+import dedalus_tpu.public as d3
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters
+Nr = 64
+n = 3.0
+tolerance = 1e-10
+dealias = 2
+dtype = np.float64
+
+# Bases
+coords = d3.SphericalCoordinates('phi', 'theta', 'r')
+dist = d3.Distributor(coords, dtype=dtype)
+ball = d3.BallBasis(coords, (4, 2, Nr), radius=1, dtype=dtype,
+                    dealias=dealias)
+
+# Fields
+f = dist.Field(name='f', bases=ball)
+tau = dist.Field(name='tau', bases=ball.surface)
+
+# Substitutions
+lift = lambda A: d3.Lift(A, ball, -1)
+
+# Problem
+problem = d3.NLBVP([f, tau], namespace=locals())
+problem.add_equation("lap(f) + lift(tau) = - f**n")
+problem.add_equation("f(r=1) = 0")
+
+# Initial guess
+phi, theta, r = dist.local_grids(ball)
+R0 = 5
+f['g'] = R0 ** (2 / (n - 1)) * (1 - r ** 2) ** 2
+
+# Solver
+solver = problem.build_solver()
+pert_norm = np.inf
+while pert_norm > tolerance:
+    solver.newton_iteration()
+    pert_norm = solver.perturbation_norm()
+    f0 = np.asarray(d3.Interpolate(f, coords['r'], 0.0).evaluate()['g']).ravel()[0]
+    Ri = f0 ** ((n - 1) / 2)
+    logger.info(f'Perturbation norm: {pert_norm:.3e}; R iterate: {Ri:.10f}')
+
+# Compare to reference solutions from Boyd
+R_ref = {0.0: np.sqrt(6),
+         0.5: 2.752698054065,
+         1.0: np.pi,
+         1.5: 3.65375373621912608,
+         2.0: 4.3528745959461246769735700,
+         2.5: 5.355275459010779,
+         3.0: 6.896848619376960375454528,
+         3.25: 8.018937527,
+         3.5: 9.535805344244850444,
+         4.0: 14.971546348838095097611066,
+         4.5: 31.836463244694285264}
+
+if __name__ == "__main__":
+    logger.info('-' * 20)
+    logger.info(f'Iterations: {solver.iteration}')
+    logger.info(f'Final R iteration: {Ri}')
+    if n in R_ref:
+        logger.info(f'Error vs reference: {Ri - R_ref[n]:.3e}')

@@ -1,0 +1,92 @@
+"""
+Librational instability in a disk: incompressible Navier-Stokes linearized
+around a background librating flow (reference:
+examples/ivp_disk_libration/libration.py). Demonstrates a disk IVP with a
+time-dependent background entering through the parsing namespace.
+
+Run: python examples/libration.py
+"""
+
+import numpy as np
+import dedalus_tpu.public as d3
+from scipy.special import jv
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters (reference: libration.py:31-38)
+Nphi, Nr = 32, 128
+Ekman = 1 / 2 / 20 ** 2
+Ro = 40
+dealias = 3 / 2
+stop_sim_time = 50
+timestepper = d3.SBDF2
+timestep = 1e-3
+dtype = np.float64
+
+# Bases
+coords = d3.PolarCoordinates('phi', 'r')
+dist = d3.Distributor(coords, dtype=dtype)
+disk = d3.DiskBasis(coords, shape=(Nphi, Nr), radius=1, dealias=dealias,
+                    dtype=dtype)
+edge = disk.edge
+
+# Fields
+u = dist.VectorField(coords, name='u', bases=disk)
+p = dist.Field(name='p', bases=disk)
+tau_u = dist.VectorField(coords, name='tau_u', bases=edge)
+tau_p = dist.Field(name='tau_p')
+
+# Substitutions
+phi, r = dist.local_grids(disk)
+nu = Ekman
+lift = lambda A: d3.Lift(A, disk, -1)
+
+# Background librating flow (reference: libration.py:57-63)
+u0_real = dist.VectorField(coords, bases=disk)
+u0_imag = dist.VectorField(coords, bases=disk)
+u0_real['g'][0] = Ro * np.real(jv(1, (1 - 1j) * r / np.sqrt(2 * Ekman))
+                               / jv(1, (1 - 1j) / np.sqrt(2 * Ekman)))
+u0_imag['g'][0] = Ro * np.imag(jv(1, (1 - 1j) * r / np.sqrt(2 * Ekman))
+                               / jv(1, (1 - 1j) / np.sqrt(2 * Ekman)))
+t = dist.Field()
+u0 = np.cos(t) * u0_real - np.sin(t) * u0_imag
+
+# Problem
+problem = d3.IVP([p, u, tau_u, tau_p], time=t, namespace=locals())
+problem.add_equation("div(u) + tau_p = 0")
+problem.add_equation(
+    "dt(u) - nu*lap(u) + grad(p) + lift(tau_u) = - u@grad(u0) - u0@grad(u)")
+problem.add_equation("u(r=1) = 0")
+problem.add_equation("integ(p) = 0")
+
+# Solver
+solver = problem.build_solver(timestepper)
+solver.stop_sim_time = stop_sim_time
+
+# Initial conditions
+u.fill_random('g', seed=42, distribution='normal')
+u.low_pass_filter(scales=0.25)
+
+# Analysis
+snapshots = solver.evaluator.add_file_handler('snapshots_libration',
+                                              sim_dt=0.1, max_writes=10)
+snapshots.add_task(-d3.div(d3.skew(u)), name='vorticity')
+flow = d3.GlobalFlowProperty(solver, cadence=10)
+flow.add_property(u @ u, name='u2')
+
+# Main loop
+if __name__ == "__main__":
+    try:
+        logger.info('Starting main loop')
+        while solver.proceed:
+            solver.step(timestep)
+            if (solver.iteration - 1) % 10 == 0:
+                max_u = np.sqrt(flow.max('u2'))
+                logger.info(f"Iteration={solver.iteration}, "
+                            f"Time={solver.sim_time:.3f}, dt={timestep:.3e}, "
+                            f"max(u)={max_u:.3e}")
+    except Exception:
+        logger.error('Exception raised, triggering end of main loop.')
+        raise
+    finally:
+        solver.log_stats()

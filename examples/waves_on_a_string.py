@@ -1,0 +1,58 @@
+"""
+Eigenmodes of waves on a clamped string (reference:
+examples/evp_1d_waves_on_a_string/waves_on_a_string.py): Legendre EVP
+    s*u + dx(dx(u)) = 0,  u(0) = u(Lx) = 0
+with first-order tau reduction. Eigenvalues are s_n = (n pi / Lx)^2.
+
+Run: python examples/waves_on_a_string.py
+"""
+
+import numpy as np
+import dedalus_tpu.public as d3
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters
+Lx = 1
+Nx = 128
+dtype = np.complex128
+
+# Bases
+xcoord = d3.Coordinate('x')
+dist = d3.Distributor(xcoord, dtype=dtype)
+xbasis = d3.Legendre(xcoord, size=Nx, bounds=(0, Lx))
+
+# Fields
+u = dist.Field(name='u', bases=xbasis)
+tau_1 = dist.Field(name='tau_1')
+tau_2 = dist.Field(name='tau_2')
+s = dist.Field(name='s')
+
+# Substitutions
+dx = lambda A: d3.Differentiate(A, xcoord)
+lift_basis = xbasis.derivative_basis(1)
+lift = lambda A: d3.Lift(A, lift_basis, -1)
+ux = dx(u) + lift(tau_1)   # First-order reduction
+uxx = dx(ux) + lift(tau_2)
+
+# Problem
+problem = d3.EVP([u, tau_1, tau_2], eigenvalue=s, namespace=locals())
+problem.add_equation("s*u + uxx = 0")
+problem.add_equation("u(x=0) = 0")
+problem.add_equation("u(x=Lx) = 0")
+
+# Solve
+solver = problem.build_solver()
+solver.solve_dense(solver.subproblems[0])
+# physical modes have the smallest magnitudes; spurious tau modes are huge
+order = np.argsort(np.abs(solver.eigenvalues))
+evals = solver.eigenvalues[order].real
+n = 1 + np.arange(len(evals))
+true = (n * np.pi / Lx) ** 2
+
+if __name__ == "__main__":
+    logger.info("First eigenvalues (computed vs (n pi/L)^2):")
+    for i in range(8):
+        rel = abs(evals[i] - true[i]) / abs(true[i])
+        logger.info(f"  n={i+1}: {evals[i]:.6f} vs {true[i]:.6f} "
+                    f"(rel err {rel:.2e})")
