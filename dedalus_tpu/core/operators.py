@@ -1132,6 +1132,45 @@ def _jnp_ufunc(np_ufunc):
     return jfn
 
 
+@parseable("advective_cfl", "AdvectiveCFL")
+class AdvectiveCFL(Future):
+    """
+    Advective CFL frequency of a velocity field: sum over components of
+    |u_i| / (local grid spacing), with per-geometry spacings — uniform
+    Fourier, sin-theta Chebyshev, r/mmax azimuth on disk/annulus,
+    r/sqrt(Lmax(Lmax+1)) angular on sphere/ball/shell (reference:
+    core/operators.py:4306 AdvectiveCFL + core/basis.py:6086-6215
+    cfl_spacing subclasses). Produces a scalar grid field; CFL flow tools
+    reduce it to a timestep.
+    """
+
+    name = "AdvectiveCFL"
+    natural_layout = "g"
+
+    def __init__(self, operand, coords=None):
+        if not operand.tensorsig:
+            raise ValueError("AdvectiveCFL requires a vector (velocity) field.")
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return AdvectiveCFL(new_args[0])
+
+    @property
+    def operand(self):
+        return self.args[0]
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        self.domain = operand.domain
+        self.tensorsig = ()
+        self.dtype = operand.dtype
+
+    def ev_impl(self, ctx):
+        from ..extras.flow_tools import advective_cfl_frequency
+        ug = ev(self.operand, ctx, "g")
+        return advective_cfl_frequency(self.operand, ug, xp=jnp)
+
+
 class UnaryGridFunction(Future):
     """Pointwise grid-space function (reference: core/operators.py:504)."""
 

@@ -9,6 +9,97 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _axis_profile(values, axis, ndim):
+    """Reshape a 1D per-axis profile for broadcasting over the grid."""
+    shape = [1] * ndim
+    shape[axis] = np.size(values)
+    return np.reshape(values, shape)
+
+
+def interval_cfl_spacing(basis):
+    """
+    Local grid spacing of an interval basis at dealias scales, rescaled
+    by dealias so the frequency reflects the nominal resolution
+    (reference: core/basis.py:6091 CartesianAdvectiveCFL.cfl_spacing).
+    """
+    from ..core.basis import Jacobi, FourierBase
+    dealias = basis.dealias if np.isscalar(basis.dealias) else basis.dealias[0]
+    grid = basis.global_grid(dealias)
+    N = grid.size
+    if isinstance(basis, FourierBase):
+        # uniform: dealias * (2 pi / N_dealias) * stretch
+        return np.full(N, dealias * 2 * np.pi / N * basis.COV.stretch)
+    if isinstance(basis, Jacobi) and basis.a0 == -0.5 and basis.b0 == -0.5:
+        # Chebyshev: analytic sin(theta) spacing
+        theta = np.pi * (np.arange(N) + 0.5) / N
+        return dealias * basis.COV.stretch * np.sin(theta) * np.pi / N
+    return dealias * (np.gradient(grid) if N > 1 else np.array([np.inf]))
+
+
+def advective_cfl_frequency(u, ug, xp=np):
+    """
+    Advective CFL frequency of velocity field `u` with grid data `ug` on
+    the dealias grid, per geometry (reference: core/basis.py:6086-6215
+    *AdvectiveCFL.cfl_spacing; component conventions: polar (phi, r),
+    spherical (phi, theta, r)). `xp` selects numpy (host) or jax.numpy
+    (traced, for the AdvectiveCFL operator); spacing profiles are static
+    numpy constants either way.
+    """
+    from ..core import coords as cmod
+    cs = u.tensorsig[0]
+    dist = u.dist
+    ndim = dist.dim
+    total = 0.0
+    if isinstance(cs, cmod.PolarCoordinates):
+        basis = u.domain.bases[dist.get_axis(cs.coords[1])]
+        r_axis = basis.first_axis + 1
+        r = np.ravel(basis.global_grids(basis.dealias)[1])
+        mmax = max(basis.shape[0] // 2 - 1, 0)
+        if mmax == 0:
+            az = np.array([np.inf])
+        elif hasattr(basis, "radii"):  # annulus: spacing r / mmax
+            az = r / mmax
+        else:  # disk: spacing R / mmax
+            az = np.array([basis.radius / mmax])
+        dr = basis.dealias[1] * (np.gradient(r) if r.size > 1
+                                 else np.array([np.inf]))
+        total = (xp.abs(ug[0]) / _axis_profile(az, r_axis, ndim)
+                 + xp.abs(ug[1]) / _axis_profile(dr, r_axis, ndim))
+    elif isinstance(cs, cmod.S2Coordinates):
+        basis = u.domain.bases[dist.get_axis(cs.coords[0])]
+        u_mag = xp.sqrt(ug[0] ** 2 + ug[1] ** 2)
+        Lmax = basis.Lmax
+        k = np.sqrt(Lmax * (Lmax + 1)) if Lmax > 0 else 0.0
+        total = u_mag * (k / basis.radius)
+    elif isinstance(cs, cmod.SphericalCoordinates):
+        basis = u.domain.bases[dist.get_axis(cs.coords[2])]
+        r_axis = basis.first_axis + 2
+        r = np.ravel(basis.global_grids(basis.dealias)[2])
+        Lmax = basis.shape[1] - 1
+        k = np.sqrt(Lmax * (Lmax + 1)) if Lmax > 0 else 0.0
+        u_mag = xp.sqrt(ug[0] ** 2 + ug[1] ** 2)
+        if hasattr(basis, "radii"):  # shell: angular spacing r / k
+            ang = (k / _axis_profile(r, r_axis, ndim)) if k else 0.0
+            total = u_mag * ang
+        else:  # ball: angular spacing R / k
+            total = u_mag * (k / basis.radius)
+        dr = basis.dealias[2] * (np.gradient(r) if r.size > 1
+                                 else np.array([np.inf]))
+        total = total + xp.abs(ug[2]) / _axis_profile(dr, r_axis, ndim)
+    else:
+        # Cartesian / direct products of interval bases
+        for i, coord in enumerate(cs.coords):
+            axis = dist.get_axis(coord)
+            basis = u.domain.bases[axis]
+            if basis is None:
+                continue
+            dx = interval_cfl_spacing(basis)
+            total = total + xp.abs(ug[i]) / _axis_profile(dx, axis, ndim)
+    if np.isscalar(total):
+        total = xp.zeros(ug.shape[1:])
+    return total
+
+
 class GlobalArrayReducer:
     """Global reductions over grid data (reference: extras/flow_tools.py:15).
     Single-controller JAX arrays are already global; reductions are direct."""
@@ -82,104 +173,21 @@ class CFL:
         self.current_dt = initial_dt
 
     def add_velocity(self, velocity):
-        """Register a velocity vector field for CFL frequencies."""
+        """Register a velocity vector field for CFL frequencies
+        (evaluated through the AdvectiveCFL operator's compiled path when
+        the velocity is an expression; plain fields use the host path)."""
         self.velocities.append(velocity)
 
     def add_frequency(self, freq):
         """Register an additional frequency expression."""
         self.frequencies.append(freq)
 
-    @staticmethod
-    def _axis_profile(values, axis, ndim):
-        """Reshape a 1D per-axis profile for broadcasting over the grid."""
-        shape = [1] * ndim
-        shape[axis] = np.size(values)
-        return np.reshape(values, shape)
-
-    def _interval_spacing(self, basis):
-        """
-        Local grid spacing of an interval basis at dealias scales, rescaled
-        by dealias so the frequency reflects the nominal resolution
-        (reference: core/basis.py:6091 CartesianAdvectiveCFL.cfl_spacing).
-        """
-        from ..core.basis import Jacobi, FourierBase
-        dealias = basis.dealias if np.isscalar(basis.dealias) else basis.dealias[0]
-        grid = basis.global_grid(dealias)
-        N = grid.size
-        if isinstance(basis, FourierBase):
-            # uniform: dealias * (2 pi / N_dealias) * stretch
-            return np.full(N, dealias * 2 * np.pi / N * basis.COV.stretch)
-        if isinstance(basis, Jacobi) and basis.a0 == -0.5 and basis.b0 == -0.5:
-            # Chebyshev: analytic sin(theta) spacing
-            theta = np.pi * (np.arange(N) + 0.5) / N
-            return dealias * basis.COV.stretch * np.sin(theta) * np.pi / N
-        return dealias * (np.gradient(grid) if N > 1 else np.array([np.inf]))
-
-    def _cfl_frequency(self, u, ug):
-        """
-        Advective CFL frequency on the dealias grid, per geometry
-        (reference: core/basis.py:6086-6215 *AdvectiveCFL.cfl_spacing;
-        component conventions: polar (phi, r), spherical (phi, theta, r)).
-        """
-        from ..core import coords as cmod
-        cs = u.tensorsig[0]
-        dist = u.dist
-        ndim = dist.dim
-        total = np.zeros(ug.shape[1:])
-        if isinstance(cs, cmod.PolarCoordinates):
-            basis = u.domain.bases[dist.get_axis(cs.coords[1])]
-            r_axis = basis.first_axis + 1
-            _, r = basis.global_grids(basis.dealias)
-            r = np.ravel(r)
-            mmax = max(basis.shape[0] // 2 - 1, 0)
-            if mmax == 0:
-                az = np.array([np.inf])
-            elif hasattr(basis, "radii"):  # annulus: spacing r / mmax
-                az = r / mmax
-            else:  # disk: spacing R / mmax
-                az = np.array([basis.radius / mmax])
-            dr = basis.dealias[1] * (np.gradient(r) if r.size > 1
-                                     else np.array([np.inf]))
-            total += np.abs(ug[0]) / self._axis_profile(az, r_axis, ndim)
-            total += np.abs(ug[1]) / self._axis_profile(dr, r_axis, ndim)
-        elif isinstance(cs, cmod.S2Coordinates):
-            basis = u.domain.bases[dist.get_axis(cs.coords[0])]
-            u_mag = np.sqrt(ug[0] ** 2 + ug[1] ** 2)
-            Lmax = basis.Lmax
-            k = np.sqrt(Lmax * (Lmax + 1)) if Lmax > 0 else 0.0
-            total += u_mag * k / basis.radius
-        elif isinstance(cs, cmod.SphericalCoordinates):
-            basis = u.domain.bases[dist.get_axis(cs.coords[2])]
-            r_axis = basis.first_axis + 2
-            r = np.ravel(basis.global_grids(basis.dealias)[2])
-            Lmax = basis.shape[1] - 1
-            k = np.sqrt(Lmax * (Lmax + 1)) if Lmax > 0 else 0.0
-            u_mag = np.sqrt(ug[0] ** 2 + ug[1] ** 2)
-            if hasattr(basis, "radii"):  # shell: angular spacing r / k
-                total += u_mag * (k / self._axis_profile(r, r_axis, ndim)
-                                  if k else 0.0)
-            else:  # ball: angular spacing R / k
-                total += u_mag * k / basis.radius
-            dr = basis.dealias[2] * (np.gradient(r) if r.size > 1
-                                     else np.array([np.inf]))
-            total += np.abs(ug[2]) / self._axis_profile(dr, r_axis, ndim)
-        else:
-            # Cartesian / direct products of interval bases
-            for i, coord in enumerate(cs.coords):
-                axis = dist.get_axis(coord)
-                basis = u.domain.bases[axis]
-                if basis is None:
-                    continue
-                dx = self._interval_spacing(basis)
-                total += np.abs(ug[i]) / self._axis_profile(dx, axis, ndim)
-        return total
-
     def compute_max_frequency(self):
         freq_max = 0.0
         for u in self.velocities:
             u.change_scales(u.domain.dealias)
             ug = np.asarray(u["g"])
-            total = self._cfl_frequency(u, ug)
+            total = advective_cfl_frequency(u, ug, xp=np)
             if total.size:
                 freq_max = max(freq_max, np.max(total))
         for fexpr in self.frequencies:

@@ -15,6 +15,7 @@ from .core.spherical3d import ShellBasis, BallBasis
 from .core.field import Field, LockedField
 from .core.problems import IVP, LBVP, NLBVP, EVP
 from .core.operators import (
+    AdvectiveCFL,
     Differentiate, Convert, Interpolate, Integrate, Average,
     LiftFactory as Lift, LiftTau,
     Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents,
