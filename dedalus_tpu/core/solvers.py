@@ -602,8 +602,17 @@ class InitialValueSolver(SolverBase):
         finally:
             self.log_stats()
 
-    def print_subproblem_ranks(self, **kw):
-        for sp in self.subproblems:
+    def print_subproblem_ranks(self, max_groups=16, **kw):
+        """Rank/conditioning diagnostic of the first `max_groups` pencil
+        matrices (reference: solver debug helper). Densifies per group on
+        the host — O(S^3) each, so the group count is bounded by default
+        (pass max_groups=None for all groups)."""
+        subproblems = self.subproblems
+        if max_groups is not None and len(subproblems) > max_groups:
+            print(f"(showing {max_groups} of {len(subproblems)} groups; "
+                  "pass max_groups=None for all)")
+            subproblems = subproblems[:max_groups]
+        for sp in subproblems:
             L = self.ops.densify_host(self._matrices["L"], sp.index)
             M = self.ops.densify_host(self._matrices["M"], sp.index)
             A = M + L
