@@ -88,6 +88,48 @@ def build_rb(Nx, Nz, dtype, matsolver=None):
     return solver, 0.01 if Nx <= 512 else 5e-5
 
 
+def build_rb3d(Nx, Ny, Nz, dtype):
+    """3D Rayleigh-Benard (Fourier^2 x Chebyshev) — BASELINE config 5's
+    single-chip variant; the multi-chip version shards the pencil batch
+    (see __graft_entry__.dryrun_multichip)."""
+    import dedalus_tpu.public as d3
+    coords = d3.CartesianCoordinates("x", "y", "z")
+    dist = d3.Distributor(coords, dtype=dtype)
+    xb = d3.RealFourier(coords["x"], size=Nx, bounds=(0, 4.0), dealias=3 / 2)
+    yb = d3.RealFourier(coords["y"], size=Ny, bounds=(0, 4.0), dealias=3 / 2)
+    zb = d3.ChebyshevT(coords["z"], size=Nz, bounds=(0, 1.0), dealias=3 / 2)
+    p = dist.Field(name="p", bases=(xb, yb, zb))
+    b = dist.Field(name="b", bases=(xb, yb, zb))
+    u = dist.VectorField(coords, name="u", bases=(xb, yb, zb))
+    tau_p = dist.Field(name="tau_p")
+    tau_b1 = dist.Field(name="tau_b1", bases=(xb, yb))
+    tau_b2 = dist.Field(name="tau_b2", bases=(xb, yb))
+    tau_u1 = dist.VectorField(coords, name="tau_u1", bases=(xb, yb))
+    tau_u2 = dist.VectorField(coords, name="tau_u2", bases=(xb, yb))
+    kappa = nu = 2.0e-6 ** 0.5
+    x, y, z = dist.local_grids(xb, yb, zb)
+    ex, ey, ez = coords.unit_vector_fields(dist)
+    lift_basis = zb.derivative_basis(1)
+    lift = lambda A: d3.Lift(A, lift_basis, -1)
+    grad_u = d3.grad(u) + ez * lift(tau_u1)
+    grad_b = d3.grad(b) + ez * lift(tau_b1)
+    problem = d3.IVP([p, b, u, tau_p, tau_b1, tau_b2, tau_u1, tau_u2],
+                     namespace=locals())
+    problem.add_equation("trace(grad_u) + tau_p = 0")
+    problem.add_equation("dt(b) - kappa*div(grad_b) + lift(tau_b2) = - u@grad(b)")
+    problem.add_equation(
+        "dt(u) - nu*div(grad_u) + grad(p) - b*ez + lift(tau_u2) = - u@grad(u)")
+    problem.add_equation("b(z=0) = 1")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("b(z=1) = 0")
+    problem.add_equation("u(z=1) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(d3.RK222)
+    b.fill_random("g", seed=42, distribution="normal", scale=1e-3)
+    b["g"] += (1 - z)
+    return solver, 1e-3
+
+
 def build_shallow_water(Nphi, Ntheta, dtype):
     import dedalus_tpu.public as d3
     R = 6.37122e6
@@ -128,12 +170,14 @@ CONFIGS = {
     "kdv1024": lambda dt_: build_kdv(1024, dt_),
     "shear512": lambda dt_: build_shear(512, dt_),
     "rb256x64": lambda dt_: build_rb(256, 64, dt_),
+    "rb512x128": lambda dt_: build_rb(512, 128, dt_),
     "rb2048x1024": lambda dt_: build_rb(2048, 1024, dt_, matsolver="banded"),
+    "rb3d_128": lambda dt_: build_rb3d(128, 128, 64, dt_),
     "sw_ell255": lambda dt_: build_shallow_water(512, 256, dt_),
 }
 
 # measured steps per config (big builds measure fewer)
-MEASURE = {"rb2048x1024": 20}
+MEASURE = {"rb2048x1024": 20, "rb3d_128": 20}
 
 
 def run_config(name, warmup=5, measure=50):

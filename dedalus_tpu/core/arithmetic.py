@@ -313,17 +313,13 @@ class ProductBase(Future):
                 return b
         return None
 
-    def _spherical_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
+    def _sph_ncc_setup(self, ncc, operand, ncc_index):
         """
-        Pencil matrix for multiplication by a radially-directed,
-        angularly-constant NCC (f(r), f(r)*er, f(r)*er*er, ...) over a
-        shell/ball basis: per-(m, ell) group, the Q-intertwined component
-        coupling kron'd with per-(ell, regularity) radial multiplication
-        matrices (reference: core/arithmetic.py:559 Gamma machinery +
-        core/basis.py:4101 ball NCC matrices, restricted to the radial-NCC
-        case used by the shell/ball examples).
+        Validate a radially-directed, angularly-constant spherical NCC and
+        return its assembly context (operand basis, NCC basis, radial
+        profile coefficients, ranks, per-sweep cache).
         """
-        from .spherical3d import q_stack, spherical_rank, reg_totals
+        from .spherical3d import spherical_rank
         basis = self._spherical_regularity_basis(operand)
         ncc_basis = self._spherical_regularity_basis(ncc)
         if basis is None or ncc_basis is None:
@@ -364,31 +360,35 @@ class ProductBase(Future):
                                                             l_env=rank_n)
             cache = self._sph_ncc_cache = {"coeffs": profile_coeffs,
                                            "version": version}
-        profile_coeffs = cache["coeffs"]
+        return {"basis": basis, "ncc_basis": ncc_basis, "cache": cache,
+                "rank_n": rank_n, "rank_in": rank_in,
+                "radial_flat": radial_flat, "ncc_index": ncc_index}
 
-        layout = subproblem.layout
-        az_axis = basis.first_axis
-        colat_axis = az_axis + 1
-        ell = subproblem.group[colat_axis]
+    def _sph_ncc_pairs(self, setup, ell):
+        """
+        [(i, j, C_ij, M_ij)] for one ell: the Q-intertwined component
+        coupling C = Q_out^T P Q_in (P placing the radial NCC slot in spin
+        space) and per-(ell, regularity) radial multiplication matrices.
+        """
+        from .spherical3d import q_stack, reg_totals
+        basis = setup["basis"]
+        cache = setup["cache"]
+        rank_n, rank_in = setup["rank_n"], setup["rank_in"]
+        ncomp_n = 3 ** rank_n
         ncomp_in = 3 ** rank_in
         rank_out = rank_n + rank_in
         totals_in = reg_totals(rank_in)
         totals_out = reg_totals(rank_out)
-        # Component coupling at this ell: C = Q_out^T P Q_in with P placing
-        # the radial NCC slot in spin space.
         e_col = np.zeros((ncomp_n, 1))
-        e_col[radial_flat, 0] = 1.0
-        if ncc_index == 0:
+        e_col[setup["radial_flat"], 0] = 1.0
+        if setup["ncc_index"] == 0:
             P = np.kron(e_col, np.identity(ncomp_in))
         else:
             P = np.kron(np.identity(ncomp_in), e_col)
         Q_in = q_stack(basis.Ntheta, rank_in)[ell]
         Q_out = q_stack(basis.Ntheta, rank_out)[ell]
         C = Q_out.T @ P @ Q_in
-        gs = layout.sep_widths[az_axis]
-        I_gs = sp.identity(gs, format="csr")
-        Nr = basis.Nr
-        total = sp.csr_matrix((3 ** rank_out * gs * Nr, ncomp_in * gs * Nr))
+        out = []
         for i in range(3 ** rank_out):
             for j in range(ncomp_in):
                 if abs(C[i, j]) < 1e-12:
@@ -397,12 +397,38 @@ class ProductBase(Future):
                 M = cache.get(key)
                 if M is None:
                     M = sparsify(basis.ncc_radial_matrix(
-                        profile_coeffs, ncc_basis.k, totals_in[j],
+                        cache["coeffs"], setup["ncc_basis"].k, totals_in[j],
                         totals_out[i], ell, k_out=0, l_env=rank_n), 1e-12)
                     cache[key] = M
-                sel = sp.csr_matrix(
-                    (np.ones(1), ([i], [j])), shape=(3 ** rank_out, ncomp_in))
-                total = total + C[i, j] * sparse_kron(sel, I_gs, M)
+                out.append((i, j, C[i, j], M))
+        return out
+
+    def _spherical_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
+        """
+        Pencil matrix for multiplication by a radially-directed,
+        angularly-constant NCC (f(r), f(r)*er, f(r)*er*er, ...) over a
+        shell/ball basis: per-(m, ell) group, the Q-intertwined component
+        coupling kron'd with per-(ell, regularity) radial multiplication
+        matrices (reference: core/arithmetic.py:559 Gamma machinery +
+        core/basis.py:4101 ball NCC matrices, restricted to the radial-NCC
+        case used by the shell/ball examples).
+        """
+        setup = self._sph_ncc_setup(ncc, operand, ncc_index)
+        basis = setup["basis"]
+        layout = subproblem.layout
+        az_axis = basis.first_axis
+        colat_axis = az_axis + 1
+        ell = subproblem.group[colat_axis]
+        ncomp_in = 3 ** setup["rank_in"]
+        rank_out = setup["rank_n"] + setup["rank_in"]
+        gs = layout.sep_widths[az_axis]
+        I_gs = sp.identity(gs, format="csr")
+        Nr = basis.Nr
+        total = sp.csr_matrix((3 ** rank_out * gs * Nr, ncomp_in * gs * Nr))
+        for i, j, Cij, M in self._sph_ncc_pairs(setup, ell):
+            sel = sp.csr_matrix(
+                (np.ones(1), ([i], [j])), shape=(3 ** rank_out, ncomp_in))
+            total = total + Cij * sparse_kron(sel, I_gs, M)
         return total
 
     def _assemble_ncc_matrix(self, subproblem, ncc, operand, tensor_factor_fn):

@@ -192,13 +192,60 @@ def batched_expression_matrices(expr, layout, vars):
     raise BatchUnsupported(f"No batched matrices for {type(expr).__name__}.")
 
 
+def _batched_spherical_ncc(expr, layout, vars, ncc_index, ncc, operand):
+    """
+    Spherical (shell/ball) radial NCC products, batched over groups: the
+    ell-dependent Q-intertwined coupling C_ij(ell) folds into per-ell
+    radial stacks C_ij(ell) * M_ij(ell), leaving one BTerm per active
+    regularity pair with a one-hot tensor factor and a colatitude-indexed
+    "gblocks" radial factor.
+    """
+    setup = expr._sph_ncc_setup(ncc, operand, ncc_index)
+    basis = setup["basis"]
+    az_axis = basis.first_axis
+    colat_axis = az_axis + 1
+    r_axis = az_axis + 2
+    Nell = layout.sep_n_groups[colat_axis]
+    ncomp_in = 3 ** setup["rank_in"]
+    ncomp_out = 3 ** (setup["rank_n"] + setup["rank_in"])
+    # per-(i, j) stacks over ell
+    stacks = {}
+    for ell in range(Nell):
+        for i, j, Cij, M in expr._sph_ncc_pairs(setup, ell):
+            Md = _dense(M)
+            stack = stacks.get((i, j))
+            if stack is None:
+                stack = stacks[(i, j)] = np.zeros((Nell,) + Md.shape)
+            if Md.shape != stack.shape[1:]:
+                raise BatchUnsupported(
+                    f"Inconsistent radial NCC shapes across ell for pair "
+                    f"({i}, {j}): {Md.shape} vs {stack.shape[1:]}.")
+            stack[ell] = Cij * Md
+    my_terms = []
+    dim = operand.domain.dim
+    for (i, j), stack in stacks.items():
+        tensor = np.zeros((ncomp_out, ncomp_in))
+        tensor[i, j] = 1.0
+        factors = [("I", 1)] * dim
+        factors[az_axis] = ("I", layout.sep_widths[az_axis])
+        factors[colat_axis] = ("I", layout.sep_widths.get(colat_axis, 1))
+        factors[r_axis] = ("B", colat_axis, stack)
+        my_terms.append(BTerm(1.0, tensor, factors))
+    op_terms = batched_expression_matrices(operand, layout, vars)
+    out = {}
+    for var, terms in op_terms.items():
+        out[var] = [mt.matmul(ot) for mt in my_terms for ot in terms]
+    return out
+
+
 def _batched_ncc_matrices(expr, layout, vars):
-    """NCC products (MultiplyFields/DotProduct) with group-independent
-    axis matrices; the spherical regularity path is per-group and falls
-    back (arithmetic._spherical_ncc_matrix)."""
+    """NCC products (MultiplyFields/DotProduct); group-independent axis
+    matrices batch directly, spherical regularity NCCs via per-ell
+    stacks."""
     ncc_index, ncc, operand = expr._split_ncc(vars)
     if expr._spherical_regularity_basis(ncc) is not None:
-        raise BatchUnsupported("Spherical regularity NCC product.")
+        return _batched_spherical_ncc(expr, layout, vars, ncc_index, ncc,
+                                      operand)
     tensor_factor_fn = _ncc_tensor_factor_fn(expr, ncc, operand, ncc_index)
     comp_indices = list(np.ndindex(*ncc.tshape)) if ncc.tshape else [()]
     my_terms = []

@@ -121,3 +121,35 @@ def test_valid_masks_all_matches_per_group():
             per_group = layout.valid_mask(operand.domain, operand.tensorsig,
                                           group).ravel()
             assert np.array_equal(batched[g_i], per_group)
+
+
+def test_ball_radial_ncc():
+    """Spherical radial NCC (T*r_vec) batches via per-ell stacks and
+    matches the per-group path."""
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    ball = d3.BallBasis(coords, shape=(8, 4, 8), radius=1.0, dealias=3 / 2)
+    u = dist.VectorField(coords, name="u", bases=ball)
+    p = dist.Field(name="p", bases=ball)
+    T = dist.Field(name="T", bases=ball)
+    tau_p = dist.Field(name="tau_p")
+    tau_u = dist.VectorField(coords, name="tau_u", bases=ball.surface)
+    tau_T = dist.Field(name="tau_T", bases=ball.surface)
+    r_vec = dist.VectorField(coords, name="r_vec", bases=ball)
+    phi, theta, r = dist.local_grids(ball)
+    r_vec["g"][2] = np.broadcast_to(np.asarray(r),
+                                    np.asarray(r_vec["g"])[2].shape)
+    nu = kappa = 1e-2
+    lift = lambda A: d3.Lift(A, ball, -1)
+    problem = d3.IVP([p, u, T, tau_p, tau_u, tau_T], namespace=locals())
+    problem.add_equation("div(u) + tau_p = 0")
+    problem.add_equation(
+        "dt(u) - nu*lap(u) + grad(p) - T*r_vec + lift(tau_u) = - u@grad(u)")
+    problem.add_equation(
+        "dt(T) - kappa*lap(T) + lift(tau_T) = - u@grad(T) + 1")
+    problem.add_equation("u(r=1) = 0")
+    problem.add_equation("T(r=1) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(d3.RK222)
+    assert solver._batched is not None, "spherical NCC did not batch"
+    assert_batched_matches(solver, ("M", "L"))
