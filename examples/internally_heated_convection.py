@@ -72,11 +72,12 @@ T["g"] += 1 - np.asarray(r) ** 2  # conductive profile for T_source = 6
 # Main loop
 flow = d3.GlobalFlowProperty(solver, cadence=10)
 flow.add_property(u @ u, name="u2")
-try:
-    while solver.proceed:
-        solver.step(timestep)
-        if solver.iteration % 10 == 0:
-            logger.info(f"Iteration={solver.iteration}, Time={solver.sim_time:.3f}, "
-                        f"max(u2)={flow.max('u2'):.3e}")
-finally:
-    solver.log_stats()
+if __name__ == "__main__":
+    try:
+        while solver.proceed:
+            solver.step(timestep)
+            if solver.iteration % 10 == 0:
+                logger.info(f"Iteration={solver.iteration}, Time={solver.sim_time:.3f}, "
+                            f"max(u2)={flow.max('u2'):.3e}")
+    finally:
+        solver.log_stats()

@@ -12,7 +12,9 @@ import logging
 logger = logging.getLogger(__name__)
 
 # Parameters (reference: shallow_water.py:28-40)
-Nphi, Ntheta = 256, 128
+import sys
+quick = "--quick" in sys.argv
+Nphi, Ntheta = (64, 32) if quick else (256, 128)
 dealias = 3 / 2
 R = 6.37122e6          # meters
 Omega = 7.292e-5       # 1 / s
@@ -20,7 +22,7 @@ nu = 1e5 * 32**2       # m^2/s (hyperdiffusion at ell = 32)
 g = 9.80616            # m / s^2
 H = 1e4                # m
 timestep = 600         # s
-stop_sim_time = 360 * 3600
+stop_sim_time = 10 * 600 if quick else 360 * 3600
 dtype = np.float64
 
 # Bases
@@ -83,15 +85,16 @@ snapshots.add_task(h, name='height')
 snapshots.add_task(-d3.div(d3.Skew(u)), name='vorticity')
 
 # Main loop
-try:
-    logger.info('Starting main loop')
-    while solver.proceed:
-        solver.step(timestep)
-        if (solver.iteration - 1) % 10 == 0:
-            logger.info(f'Iteration={solver.iteration}, '
-                        f'Time={solver.sim_time:.3e}, dt={timestep:.3e}')
-except Exception:
-    logger.error('Exception raised, triggering end of main loop.')
-    raise
-finally:
-    solver.log_stats()
+if __name__ == "__main__":
+    try:
+        logger.info('Starting main loop')
+        while solver.proceed:
+            solver.step(timestep)
+            if (solver.iteration - 1) % 10 == 0:
+                logger.info(f'Iteration={solver.iteration}, '
+                            f'Time={solver.sim_time:.3e}, dt={timestep:.3e}')
+    except Exception:
+        logger.error('Exception raised, triggering end of main loop.')
+        raise
+    finally:
+        solver.log_stats()

@@ -84,12 +84,13 @@ flow = d3.GlobalFlowProperty(solver, cadence=10)
 flow.add_property(u @ u, name="u2")
 
 # Main loop
-try:
-    while solver.proceed:
-        solver.step(timestep)
-        if solver.iteration % 10 == 0:
-            max_u2 = flow.max("u2")
-            logger.info(f"Iteration={solver.iteration}, Time={solver.sim_time:.3f}, "
-                        f"max(u2)={max_u2:.3e}")
-finally:
-    solver.log_stats()
+if __name__ == "__main__":
+    try:
+        while solver.proceed:
+            solver.step(timestep)
+            if solver.iteration % 10 == 0:
+                max_u2 = flow.max("u2")
+                logger.info(f"Iteration={solver.iteration}, Time={solver.sim_time:.3f}, "
+                            f"max(u2)={max_u2:.3e}")
+    finally:
+        solver.log_stats()
