@@ -274,3 +274,29 @@ def test_3d_rb_sharded_matches_single_device():
     for _ in range(3):
         solver_sh.step(1e-3)
     assert np.allclose(np.asarray(solver_sh.X), X_ref, atol=1e-13)
+
+
+@needs_8
+def test_sharded_banded_solver_matches():
+    """The banded + pinned-Woodbury pencil path (BandedMatrix pytrees)
+    shards over the mesh and matches the unsharded run."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from __graft_entry__ import _build_rb_solver
+    from dedalus_tpu.tools.config import config
+    old = config["linear algebra"].get("MATRIX_SOLVER", "auto")
+    config["linear algebra"]["MATRIX_SOLVER"] = "banded"
+    try:
+        ref, _ = _build_rb_solver(16, 16, np.float64)
+        assert type(ref.ops).__name__ == "BandedOps"
+        for _ in range(3):
+            ref.step(1e-3)
+        X_ref = np.asarray(ref.X)
+        sh, _ = _build_rb_solver(16, 16, np.float64)
+        distribute_solver(sh, make_mesh(8))
+        for _ in range(3):
+            sh.step(1e-3)
+        assert np.abs(np.asarray(sh.X) - X_ref).max() < 1e-10
+    finally:
+        config["linear algebra"]["MATRIX_SOLVER"] = old
