@@ -1,0 +1,81 @@
+"""
+Expression-tree plotting (reference: dedalus/tools/plot_op.py): render the
+Future/Field operator tree of an expression with matplotlib, or dump it as
+indented text.
+"""
+
+import numpy as np
+
+__all__ = ["format_op_tree", "plot_operator_tree"]
+
+
+def _label(node):
+    from ..core.field import Field
+    if isinstance(node, Field):
+        return node.name or "Field"
+    if np.isscalar(node):
+        return repr(node)
+    return type(node).__name__
+
+
+def _children(node):
+    args = getattr(node, "args", None)
+    if args is None:
+        return []
+    from ..core.field import Field, Operand
+    return [a for a in args if isinstance(a, Operand) or np.isscalar(a)]
+
+
+def format_op_tree(op, indent=0):
+    """Indented text rendering of the expression tree."""
+    lines = ["  " * indent + _label(op)]
+    for child in _children(op):
+        if np.isscalar(child):
+            lines.append("  " * (indent + 1) + repr(child))
+        else:
+            lines.extend(format_op_tree(child, indent + 1))
+    return lines if indent else "\n".join(lines)
+
+
+def _layout(node, depth, x0, positions, edges):
+    """Assign (x, y) positions bottom-up; returns subtree width."""
+    children = [c for c in _children(node) if not np.isscalar(c)]
+    if not children:
+        positions[id(node)] = (x0, -depth, _label(node))
+        return 1
+    width = 0
+    xs = []
+    for c in children:
+        w = _layout(c, depth + 1, x0 + width, positions, edges)
+        xs.append(positions[id(c)][0])
+        edges.append((id(node), id(c)))
+        width += w
+    positions[id(node)] = (sum(xs) / len(xs), -depth, _label(node))
+    return max(width, 1)
+
+
+def plot_operator_tree(op, filename=None, figsize=(8, 5)):
+    """Draw the expression tree; saves to `filename` or returns the figure
+    (reference: tools/plot_op.py Node-walk rendering)."""
+    import matplotlib
+    if filename:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    positions = {}
+    edges = []
+    _layout(op, 0, 0, positions, edges)
+    fig, ax = plt.subplots(figsize=figsize)
+    for parent, child in edges:
+        x1, y1, _ = positions[parent]
+        x2, y2, _ = positions[child]
+        ax.plot([x1, x2], [y1, y2], "-", color="0.6", zorder=1)
+    for x, y, label in positions.values():
+        ax.annotate(label, (x, y), ha="center", va="center", zorder=2,
+                    bbox=dict(boxstyle="round,pad=0.3", fc="w", ec="0.3"))
+    ax.axis("off")
+    fig.tight_layout()
+    if filename:
+        fig.savefig(filename, dpi=120)
+        plt.close(fig)
+        return filename
+    return fig

@@ -145,3 +145,20 @@ def test_cli_get_config(capsys):
     cli.get_config()
     out = capsys.readouterr().out
     assert "MATRIX_SOLVER" in out.upper() or "matrix_solver" in out
+
+
+def test_op_tree_rendering(tmp_path):
+    """tools/plot_op formats and draws expression trees
+    (reference: tools/plot_op.py)."""
+    import dedalus_tpu.public as d3
+    from dedalus_tpu.tools.plot_op import format_op_tree, plot_operator_tree
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=8, bounds=(0, 1))
+    u = dist.Field(name="u", bases=xb)
+    expr = d3.lap(u) + u * d3.Differentiate(u, coords["x"])
+    text = format_op_tree(expr)
+    assert "u" in text and "Lap" in str(text) or "Add" in text
+    out = plot_operator_tree(expr, filename=str(tmp_path / "tree.png"))
+    import os
+    assert os.path.exists(out)
