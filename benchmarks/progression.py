@@ -231,6 +231,27 @@ def main():
     names = sys.argv[1:] or list(CONFIGS)
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "results.jsonl")
+    if len(names) > 1:
+        # One subprocess per config: a config's device allocations (or a
+        # wedged backend) must not poison the next — leftover HBM from an
+        # OOM'd build previously surfaced as spurious RESOURCE_EXHAUSTED
+        # on tiny later configs.
+        import subprocess
+        failures = 0
+        for name in names:
+            mark(f"--- spawning {name} ---")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), name])
+            if proc.returncode != 0:
+                # a hard-killed child (OOM, segfault) writes no record of
+                # its own — leave one so the sweep output stays complete
+                failures += 1
+                record = {"config": name,
+                          "error": f"subprocess exit {proc.returncode}"}
+                print(json.dumps(record), flush=True)
+                with open(out_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+        sys.exit(1 if failures else 0)
     for name in names:
         if name not in CONFIGS:
             mark(f"unknown config {name}; skipping")

@@ -248,12 +248,41 @@ class DifferentiateCartesian(LinearOperator):
         return [(None, descrs)]
 
 
+def _resolve_coord(operand, coord):
+    """Resolve a coordinate given by NAME to the distributor's Coordinate
+    object (strings otherwise fail get_basis identity checks silently)."""
+    if not isinstance(coord, str):
+        return coord
+    for c in operand.dist.coords:
+        if c.name == coord:
+            return c
+    raise ValueError(f"Unknown coordinate name: {coord!r}")
+
+
+def _resolve_coords(operand, coords):
+    """Normalize a coords spec (None, name, Coordinate, coordinate system,
+    or sequence of these) to a list of Coordinate objects, or None for
+    'all axes'. Resolution happens BEFORE any selection logic so names and
+    objects take identical paths."""
+    if coords is None:
+        return None
+    if isinstance(coords, str):
+        coords = (coords,)
+    expanded = getattr(coords, "coords", None)
+    if expanded is not None:
+        coords = expanded
+    elif not isinstance(coords, (tuple, list)):
+        coords = (coords,)
+    return [_resolve_coord(operand, c) for c in coords]
+
+
 @parseable("d", "Differentiate")
 def Differentiate(operand, coord):
     if np.isscalar(operand):
         return 0
     if isinstance(coord, CartesianCoordinates):
         raise ValueError("Differentiate needs a single coordinate.")
+    coord = _resolve_coord(operand, coord)
     if operand.domain.get_basis(coord) is None:
         return 0
     return DifferentiateCartesian(operand, coord)
@@ -425,6 +454,7 @@ class InterpolateCartesian(LinearOperator):
 def Interpolate(operand, coord, position):
     if np.isscalar(operand):
         return operand
+    coord = _resolve_coord(operand, coord)
     basis = operand.domain.get_basis(coord)
     if basis is None:
         return operand
@@ -516,14 +546,13 @@ def _curv_selected(curv, coords):
 def Integrate(operand, coords=None):
     if np.isscalar(operand):
         return operand
+    coords = _resolve_coords(operand, coords)
     out = operand
     curv = _curvilinear_basis(operand)
     if curv is not None and _curv_selected(curv, coords):
         out = _curv_integrate(out, curv)
     if coords is None:
         coords = [b.coord for b in out.domain.bases if b is not None]
-    elif isinstance(coords, (Coordinate, CartesianCoordinates)):
-        coords = getattr(coords, "coords", (coords,))
     for coord in coords:
         if out.domain.get_basis(coord) is not None:
             out = IntegrateCartesian(out, coord)
@@ -534,6 +563,7 @@ def Integrate(operand, coords=None):
 def Average(operand, coords=None):
     if np.isscalar(operand):
         return operand
+    coords = _resolve_coords(operand, coords)
     volume = 1.0
     out = operand
     curv = _curvilinear_basis(operand)
@@ -542,8 +572,6 @@ def Average(operand, coords=None):
         out = _curv_integrate(out, curv)
     if coords is None:
         coords = [b.coord for b in out.domain.bases if b is not None]
-    elif isinstance(coords, (Coordinate, CartesianCoordinates)):
-        coords = getattr(coords, "coords", (coords,))
     for coord in coords:
         basis = out.domain.get_basis(coord)
         if basis is not None:

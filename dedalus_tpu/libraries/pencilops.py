@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
 from .matsolvers import get_solver
+from ..tools.config import config
 
 
 class DenseOps:
@@ -117,6 +118,14 @@ class BandedOps:
         st = structure
         self.st = st
         self.refine = int(refine)
+        # pencil-batch chunking (lax.map over G-chunks): bounds the
+        # factorization's HLO temp footprint AND forces the scan-stacked
+        # factor outputs into flat (Gc, 2q*q) layouts that tile (8, 128)
+        # cleanly — full-G factors otherwise materialize as 4-D
+        # (NB, G, 2q, q) buffers whose q-sized minor dims pad 2-4x on TPU.
+        # Chosen at factor time (needs G and the dtype); solve re-derives
+        # the count from the aux's shapes — this attr is diagnostic only.
+        self._g_chunks = 1
         self.q = st.q
         self.NB = st.NB
         self.n = st.S                  # true system size
@@ -350,9 +359,25 @@ class BandedOps:
                              x_last[None]], axis=0)
         return jnp.moveaxis(x, 0, 1).reshape(G, self.n_pad, k)
 
-    def _factor_impl(self, bands, Vt, refine_aux):
-        """Shared factorization body; refine_aux supplies the residual
-        matvec without persisting a combined matrix."""
+    def _pick_chunks(self, G, itemsize):
+        """Number of G-chunks for factorization: smallest divisor of G
+        keeping a chunk's persistent factor slab (panelLU + U12) under
+        BANDED_CHUNK_MB (the observed XLA temp footprint is a small
+        multiple of that slab)."""
+        target = float(config["linear algebra"].get(
+            "BANDED_CHUNK_MB", "256")) * 1e6
+        per_g = self.NB * (2 * self.q * self.q) * 2 * itemsize
+        want = int(np.ceil(G * per_g / max(target, 1e6)))
+        if want <= 1:
+            return 1
+        for d in range(1, G + 1):
+            if G % d == 0 and d >= want:
+                return d
+        return G
+
+    def _factor_core(self, bands, Vt):
+        """Factor one full-lattice band slab (any leading batch size).
+        Returns (interior, Vt, YbT, CapLU) — a pytree safe to lax.map."""
         G = bands.shape[0]
         dtype = bands.dtype
         # identity pins at the pinned rows + padded diagonal
@@ -362,8 +387,7 @@ class BandedOps:
             tail = jnp.ones((G, self.n_pad - self.n), dtype=dtype)
             bands = bands.at[:, self.kl, self.n:].set(tail)
         interior = self._factor_interior(bands)
-        aux = {"interior": interior, "Vt": Vt}
-        aux.update(refine_aux)
+        YbT = CapLU = None
         if self.t:
             # Y = B~^-1 E  (E = one-hot columns at the pin positions)
             E = jnp.zeros((G, self.n_pad, self.t), dtype=dtype)
@@ -375,9 +399,33 @@ class BandedOps:
                    - Yb[:, self.pin_pos, :])
             # stored (G, t, n_pad): a trailing dim of t ~ 16 pads 8x under
             # TPU (8, 128) tiling; n_pad-minor tiles cleanly
-            aux["YbT"] = jnp.swapaxes(Yb, 1, 2)
-            aux["Cap"] = jsl.lu_factor(Cap)
+            YbT = jnp.swapaxes(Yb, 1, 2)
+            CapLU = jsl.lu_factor(Cap)
+        return (interior, Vt, YbT, CapLU)
+
+    def _aux_from_core(self, core, refine_aux):
+        interior, Vt, YbT, CapLU = core
+        aux = {"interior": interior, "Vt": Vt}
+        if YbT is not None:
+            aux["YbT"] = YbT
+            aux["Cap"] = CapLU
+        aux.update(refine_aux)
         return aux
+
+    def _factor_impl(self, bands, Vt, refine_aux):
+        """Shared factorization body; refine_aux supplies the residual
+        matvec without persisting a combined matrix."""
+        G = bands.shape[0]
+        C = self._g_chunks = self._pick_chunks(G, bands.dtype.itemsize)
+        if C == 1:
+            core = self._factor_core(bands, Vt)
+        else:
+            Gc = G // C
+            bands_c = bands.reshape(C, Gc, self.nd, self.n_pad)
+            Vt_c = Vt.reshape(C, Gc, Vt.shape[1], self.n_pad)
+            core = jax.lax.map(lambda xs: self._factor_core(*xs),
+                               (bands_c, Vt_c))
+        return self._aux_from_core(core, refine_aux)
 
     def factor(self, A):
         """Factor a matrix already resident in banded storage."""
@@ -386,23 +434,55 @@ class BandedOps:
 
     def factor_lincomb(self, a, M, b, L):
         """Factor a*M + b*L WITHOUT persisting the combined bands: the
-        combination is a transient of the factorization, and the
-        refinement residual uses matvecs of the already-resident trimmed
-        M and L (saves one full band store at large S)."""
+        combination is a transient of the factorization (built per G-chunk
+        when chunking is active), and the refinement residual uses matvecs
+        of the already-resident trimmed M and L (saves one full band store
+        at large S)."""
         G = M.bands.shape[0]
         dtype = M.bands.dtype
-        bands = jnp.zeros((G, self.nd, self.n_pad), dtype=dtype)
-        bands = bands.at[:, np.asarray(M.dsel), :].add(a * M.bands)
-        bands = bands.at[:, np.asarray(L.dsel), :].add(b * L.bands)
-        Vt = jnp.zeros((G, self.t, self.n_pad), dtype=dtype)
-        if M.Vt is not None:
-            Vt = Vt + a * M.Vt
-        if L.Vt is not None:
-            Vt = Vt + b * L.Vt
+        C = self._g_chunks = self._pick_chunks(G, dtype.itemsize)
+        dM = np.asarray(M.dsel)
+        dL = np.asarray(L.dsel)
+
+        def combine(mb, lb, mv, lv, g):
+            bands = jnp.zeros((g, self.nd, self.n_pad), dtype=dtype)
+            bands = bands.at[:, dM, :].add(a * mb)
+            bands = bands.at[:, dL, :].add(b * lb)
+            Vt = jnp.zeros((g, self.t, self.n_pad), dtype=dtype)
+            if mv is not None:
+                Vt = Vt + a * mv
+            if lv is not None:
+                Vt = Vt + b * lv
+            return bands, Vt
+
         # M and L themselves are NOT stored in the aux: the jitted factor
         # would return copies of both full band stores; the refinement
         # matvec receives them via solve(..., mats=(M, L))
-        return self._factor_impl(bands, Vt, {"ab": (a, b)})
+        if C == 1:
+            bands, Vt = combine(M.bands, L.bands, M.Vt, L.Vt, G)
+            core = self._factor_core(bands, Vt)
+        else:
+            Gc = G // C
+            has_mv = M.Vt is not None
+            has_lv = L.Vt is not None
+            xs = [M.bands.reshape(C, Gc, -1, self.n_pad),
+                  L.bands.reshape(C, Gc, -1, self.n_pad)]
+            if has_mv:
+                xs.append(M.Vt.reshape(C, Gc, self.t, self.n_pad))
+            if has_lv:
+                xs.append(L.Vt.reshape(C, Gc, self.t, self.n_pad))
+
+            def one(xs):
+                mb, lb = xs[0], xs[1]
+                i = 2
+                mv = xs[i] if has_mv else None
+                i += has_mv
+                lv = xs[i] if has_lv else None
+                bands, Vt = combine(mb, lb, mv, lv, Gc)
+                return self._factor_core(bands, Vt)
+
+            core = jax.lax.map(one, tuple(xs))
+        return self._aux_from_core(core, {"ab": (a, b)})
 
     def _aux_matvec(self, aux, x, mats):
         if "A" in aux:
@@ -411,15 +491,32 @@ class BandedOps:
         M, L = mats
         return a * self.matvec(M, x) + b * self.matvec(L, x)
 
+    def _solve_core(self, auxc, fp):
+        y = self._solve_interior(auxc["interior"], fp[..., None])[..., 0]
+        if self.t:
+            Vy = (jnp.einsum("gtn,gn->gt", auxc["Vt"], y)
+                  - y[:, self.pin_pos])
+            z = jsl.lu_solve(auxc["Cap"], Vy)
+            y = y - jnp.einsum("gtn,gt->gn", auxc["YbT"], z)
+        return y
+
     def _solve_once(self, aux, rhs):
         fp = rhs[:, self.row_perm]
         fp = jnp.pad(fp, ((0, 0), (0, self.n_pad - self.n)))
-        y = self._solve_interior(aux["interior"], fp[..., None])[..., 0]
-        if self.t:
-            Vy = (jnp.einsum("gtn,gn->gt", aux["Vt"], y)
-                  - y[:, self.pin_pos])
-            z = jsl.lu_solve(aux["Cap"], Vy)
-            y = y - jnp.einsum("gtn,gt->gn", aux["YbT"], z)
+        # chunking is read off the aux's own stacked shapes (lastLU is
+        # (G, q, q) unchunked, (C, Gc, q, q) chunked) — instance state
+        # would go stale across auxes factored under different configs
+        lastLU = aux["interior"][-1]
+        C = lastLU.shape[0] if lastLU.ndim == 4 else 1
+        if C == 1:
+            y = self._solve_core(aux, fp)
+        else:
+            Gc = fp.shape[0] // C
+            auxc = {k: aux[k] for k in ("interior", "Vt", "YbT", "Cap")
+                    if k in aux}
+            y = jax.lax.map(lambda xs: self._solve_core(xs[0], xs[1]),
+                            (auxc, fp.reshape(C, Gc, self.n_pad)))
+            y = y.reshape(-1, self.n_pad)
         xp = y[:, :self.n]
         return xp[:, self.pos_col]
 

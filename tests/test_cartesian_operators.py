@@ -149,3 +149,36 @@ def test_complex_fourier_differentiate():
     u["g"] = np.exp(3j * x)
     du = d3.Differentiate(u, xc).evaluate()["g"]
     assert np.allclose(du, 3j * np.exp(3j * x).ravel())
+
+
+def test_string_coordinate_specs(setup_2d):
+    """Coordinate NAMES must resolve to the same operators as coordinate
+    objects (a string used to silently no-op Interpolate/Integrate)."""
+    coords, dist, xb, zb, x, z = setup_2d
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = 0 * x + z ** 2
+    vi = np.asarray(d3.Interpolate(f, "z", 0.25).evaluate()["g"]).ravel()
+    assert np.allclose(vi, 0.0625)
+    vq = np.asarray(d3.Integrate(f, "z").evaluate()["g"]).ravel()
+    assert np.allclose(vq, 1 / 3)
+    va = np.asarray(d3.Average(f, ("x", "z")).evaluate()["g"]).ravel()
+    assert np.allclose(va, 1 / 3)
+    vd = np.asarray(d3.Differentiate(f, "z").evaluate()["g"])
+    assert np.allclose(vd, 2 * z + 0 * x)
+    with pytest.raises(ValueError, match="Unknown coordinate"):
+        d3.Interpolate(f, "w", 0.0)
+
+
+def test_string_coordinate_specs_curvilinear():
+    """String coords must take the curvilinear reduction path in
+    Integrate/Average (resolution happens before _curv_selected)."""
+    coords = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    disk = d3.DiskBasis(coords, shape=(16, 16), radius=2.0)
+    f = dist.Field(name="f", bases=disk)
+    phi, r = dist.local_grids(disk)
+    f["g"] = np.broadcast_to(r ** 2, np.broadcast_shapes(phi.shape, r.shape))
+    v = float(np.asarray(d3.Integrate(f, ("phi", "r")).evaluate()["g"]).ravel()[0])
+    assert abs(v - 8 * np.pi) < 1e-10
+    va = float(np.asarray(d3.Average(f, ("phi", "r")).evaluate()["g"]).ravel()[0])
+    assert abs(va - 2.0) < 1e-10
