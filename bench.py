@@ -92,12 +92,39 @@ def run_benchmark():
     }
 
 
+def _run_child(env, timeout, tag):
+    """Run the measurement in a fresh interpreter with a hard timeout (an
+    in-process wedge — PJRT init or a hung remote compile — cannot be
+    interrupted any other way). Returns (record_or_None, error_or_None)."""
+    env = dict(env)
+    env["_BENCH_CHILD"] = "1"
+    mark(f"running benchmark in {tag} subprocess (timeout {timeout}s)")
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"")
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        sys.stderr.write(tail[-2000:])
+        return None, f"{tag} child timed out after {timeout}s"
+    sys.stderr.write(r.stderr or "")
+    line = next((ln for ln in r.stdout.splitlines() if ln.startswith("{")), None)
+    if r.returncode == 0 and line:
+        try:
+            return json.loads(line), None
+        except ValueError:
+            return None, f"{tag} child emitted unparsable record"
+    return None, f"{tag} child rc={r.returncode}"
+
+
 def main():
     if os.environ.get("_BENCH_CHILD"):
-        # Re-exec'd fallback child: the parent already validated this env.
+        # Re-exec'd measurement child: the parent already validated this env.
         print(json.dumps(run_benchmark()), flush=True)
         return
 
+    errors = []
     mark(f"probing backend JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r}")
     ok, info = probe_backend(dict(os.environ))
     if not ok:
@@ -105,55 +132,36 @@ def main():
         ok, info = probe_backend(dict(os.environ))
     if ok:
         mark(f"backend probe ok: {info}")
-        try:
-            print(json.dumps(run_benchmark()), flush=True)
+        record, err = _run_child(os.environ, 2400, "default-backend")
+        if record is not None:
+            print(json.dumps(record), flush=True)
             return
-        except Exception as e:  # fall through to CPU fallback
-            mark(f"benchmark on default backend FAILED: {e!r}")
-            primary_error = f"default-backend run failed: {e!r}"
+        mark(f"default-backend run FAILED: {err}")
+        errors.append(err)
     else:
         mark(f"backend probe failed twice ({info}); falling back to CPU")
-        primary_error = f"default-backend init failed: {info}"
+        errors.append(f"default-backend init failed: {info}")
 
     # CPU fallback in a fresh subprocess (this process may have a half-wedged
     # plugin registered; a clean interpreter with JAX_PLATFORMS=cpu is safer).
     env = _strip_plugin_env(os.environ)
-    env["_BENCH_CHILD"] = "1"
     mark("probing CPU fallback")
     ok, info = probe_backend(env, timeout=120)
-    if not ok:
-        mark(f"CPU fallback probe also failed: {info}")
-        print(json.dumps({
-            "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec",
-            "value": 0.0, "unit": "steps/sec", "vs_baseline": 0.0,
-            "error": f"{primary_error}; cpu fallback failed: {info}",
-        }), flush=True)
-        sys.exit(1)
-    mark("running benchmark in CPU-fallback subprocess")
-    try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
-                           capture_output=True, text=True, timeout=1800)
-    except subprocess.TimeoutExpired as e:
-        mark("CPU fallback child timed out after 1800s")
-        print(json.dumps({
-            "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec",
-            "value": 0.0, "unit": "steps/sec", "vs_baseline": 0.0,
-            "error": f"{primary_error}; cpu child timed out after 1800s",
-        }), flush=True)
-        sys.exit(1)
-    sys.stderr.write(r.stderr)
-    line = next((ln for ln in r.stdout.splitlines() if ln.startswith("{")), None)
-    if r.returncode == 0 and line:
-        record = json.loads(line)
-        record["error"] = primary_error
-        print(json.dumps(record), flush=True)
+    if ok:
+        record, err = _run_child(env, 1800, "cpu-fallback")
+        if record is not None:
+            record["error"] = "; ".join(errors)
+            print(json.dumps(record), flush=True)
+            return
+        errors.append(err)
     else:
-        print(json.dumps({
-            "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec",
-            "value": 0.0, "unit": "steps/sec", "vs_baseline": 0.0,
-            "error": f"{primary_error}; cpu child rc={r.returncode}",
-        }), flush=True)
-        sys.exit(1)
+        errors.append(f"cpu fallback probe failed: {info}")
+    print(json.dumps({
+        "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec",
+        "value": 0.0, "unit": "steps/sec", "vs_baseline": 0.0,
+        "error": "; ".join(errors),
+    }), flush=True)
+    sys.exit(1)
 
 
 if __name__ == "__main__":

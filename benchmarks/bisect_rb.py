@@ -1,0 +1,94 @@
+"""
+Compile-time bisection for the RB 2048x1024 step on TPU: times the
+compilation of each device program the IMEX step is made of (transforms,
+eval_F, matvecs, chunked factor, solve) so a wedged TPU compile can be
+attributed to one piece. Usage:
+
+  python benchmarks/bisect_rb.py [fft|evalF|matvec|factor|all] [Nx Nz]
+"""
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+T0 = time.time()
+
+
+def mark(m):
+    print(f"[{time.time()-T0:7.1f}s] {m}", file=sys.stderr, flush=True)
+
+
+def main():
+    mark(f"backend={jax.default_backend()}")
+    phase = sys.argv[1] if len(sys.argv) > 1 else "all"
+    Nx = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    Nz = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+
+    if phase == "fft":
+        gx, gz = 3 * Nx // 2, 3 * Nz // 2
+        for shape, axis in [((gx, gz), 0), ((gx, gz), 1), ((4, gx, gz), 2)]:
+            x = jnp.zeros(shape, jnp.float32)
+            t = time.time()
+            jax.jit(lambda a, ax=axis: jnp.fft.rfft(a, axis=ax)).lower(x).compile()
+            mark(f"rfft {shape} axis={axis}: compile {time.time()-t:.1f}s")
+        return
+
+    from __graft_entry__ import _build_rb_solver
+    mark(f"building solver {Nx}x{Nz} (banded)")
+    from dedalus_tpu.tools.config import config
+    config["linear algebra"]["MATRIX_SOLVER"] = "banded"
+    solver, b = _build_rb_solver(Nx, Nz, np.float32)
+    mark(f"built; pencil={solver.pencil_shape} ops={type(solver.ops).__name__} "
+         f"q={solver.structure.q} NB={solver.structure.NB} "
+         f"t={solver.structure.t_pins}")
+    rd = solver.real_dtype
+    M, L = solver.M_mat, solver.L_mat
+    X0 = solver.gather_fields()
+    t0 = jnp.asarray(0.0, dtype=rd)
+    dt = jnp.asarray(5e-5, dtype=rd)
+    extra = solver.rhs_extra()
+    from dedalus_tpu.tools.jitlift import lifted_jit
+    ops = solver.ops
+
+    if phase in ("evalF", "all"):
+        mark("compiling eval_F alone")
+        f = lifted_jit(lambda X, t, e: solver.eval_F(X, t, e))
+        t = time.time()
+        y = f(X0, t0, extra)
+        y.block_until_ready()
+        mark(f"eval_F compile+run {time.time()-t:.1f}s")
+
+    if phase in ("matvec", "all"):
+        mark("compiling matvecs")
+        f = lifted_jit(lambda M, L, X: (ops.matvec(M, X), ops.matvec(L, X)))
+        t = time.time()
+        y = f(M, L, X0)
+        y[0].block_until_ready()
+        mark(f"matvec compile+run {time.time()-t:.1f}s")
+
+    if phase in ("factor", "all"):
+        mark("compiling chunked factor")
+        ffac = lifted_jit(lambda M, L, dt: ops.factor_lincomb(
+            jnp.asarray(1.0, rd), M, dt, L))
+        t = time.time()
+        aux = ffac(M, L, dt)
+        jax.tree.leaves(aux)[0].block_until_ready()
+        mark(f"factor compile+run {time.time()-t:.1f}s; chunks={ops._g_chunks}")
+
+        mark("compiling solve")
+        fs = lifted_jit(lambda aux, rhs, M, L: ops.solve(aux, rhs, mats=(M, L)))
+        t = time.time()
+        x = fs(aux, X0, M, L)
+        x.block_until_ready()
+        mark(f"solve compile+run {time.time()-t:.1f}s")
+
+    mark("done")
+
+
+if __name__ == "__main__":
+    main()

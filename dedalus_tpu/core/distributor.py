@@ -67,6 +67,14 @@ class Distributor:
             return coord.axis
         return coord.first_axis
 
+    def get_coord(self, name):
+        """The Coordinate object with the given name (the single name
+        lookup behind f(z=...) and string coord specs)."""
+        for coord in self.coords:
+            if coord.name == name:
+                return coord
+        raise ValueError(f"Unknown coordinate name: {name!r}")
+
     def expand_bases(self, bases):
         """Expand a basis/tuple-of-bases spec to a full per-axis tuple."""
         full = [None] * self.dim

@@ -219,10 +219,7 @@ class Operand:
         return out
 
     def _lookup_coord(self, name):
-        for coord in self.dist.coords:
-            if coord.name == name:
-                return coord
-        raise ValueError(f"Unknown coordinate: {name}")
+        return self.dist.get_coord(name)
 
     def __array_ufunc__(self, ufunc, method, *inputs, **kw):
         """Dispatch numpy ufuncs on operands to symbolic nodes

@@ -253,10 +253,7 @@ def _resolve_coord(operand, coord):
     object (strings otherwise fail get_basis identity checks silently)."""
     if not isinstance(coord, str):
         return coord
-    for c in operand.dist.coords:
-        if c.name == coord:
-            return c
-    raise ValueError(f"Unknown coordinate name: {coord!r}")
+    return operand.dist.get_coord(coord)
 
 
 def _resolve_coords(operand, coords):

@@ -24,8 +24,25 @@ import jax
 import jax.numpy as jnp
 
 from ..tools.jitlift import lifted_jit
+from ..tools.config import config
 
 schemes = {}
+
+
+def _use_split_step(solver):
+    """
+    Whether to compile the step as SEVERAL small device programs (per-stage
+    eval/solve dispatches) instead of one fused program. Monolithic step
+    programs at very large pencil counts have wedged the TPU AOT compiler;
+    above the mode threshold the ~ms of extra per-step dispatch latency is
+    negligible against the per-step device time.
+    """
+    mode = config["execution"].get("STEP_PROGRAM", "auto").lower()
+    if mode in ("fused", "split"):
+        return mode == "split"
+    G, S = solver.pencil_shape
+    threshold = int(config["execution"].get("STEP_SPLIT_MODES", str(1 << 22)))
+    return G * S > threshold
 
 
 def add_scheme(cls):
@@ -95,11 +112,14 @@ class MultistepIMEX:
             return ops.factor_lincomb(a0, M, b0, L)
         _factor = lifted_jit(_factor)
 
-        def advance_body(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
-                         lhs_aux):
-            Fn = eval_F(X, t, extra) * mask()
-            MXn = ops.matvec(M, X)
-            LXn = ops.matvec(L, X)
+        # the fused step body composes the same two pieces the split mode
+        # dispatches separately, so the numerics cannot drift between modes
+        def eval_parts(M, L, X, t, extra):
+            return (eval_F(X, t, extra) * mask(), ops.matvec(M, X),
+                    ops.matvec(L, X))
+
+        def update_solve(Fn, MXn, LXn, F_hist, MX_hist, LX_hist, a, b, c,
+                         lhs_aux, M, L):
             F_hist = jnp.concatenate([Fn[None], F_hist[:-1]])
             MX_hist = jnp.concatenate([MXn[None], MX_hist[:-1]])
             LX_hist = jnp.concatenate([LXn[None], LX_hist[:-1]])
@@ -108,6 +128,12 @@ class MultistepIMEX:
                    - jnp.tensordot(b[1:], LX_hist, axes=1))
             Xn = ops.solve(lhs_aux, RHS, mats=(M, L))
             return Xn, F_hist, MX_hist, LX_hist
+
+        def advance_body(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
+                         lhs_aux):
+            Fn, MXn, LXn = eval_parts(M, L, X, t, extra)
+            return update_solve(Fn, MXn, LXn, F_hist, MX_hist, LX_hist,
+                                a, b, c, lhs_aux, M, L)
 
         def _advance_n(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
                        n, dt, lhs_aux):
@@ -125,6 +151,13 @@ class MultistepIMEX:
         self._factor = _factor
         self._advance = lifted_jit(advance_body)
         self._advance_n = lifted_jit(_advance_n, static_argnums=(11,))
+
+        # split-step pieces: the SAME bodies the fused program composes,
+        # compiled as separate (smaller) device programs for very large
+        # systems (see _use_split_step)
+        self._split = _use_split_step(solver)
+        self._eval_parts = lifted_jit(eval_parts)
+        self._update_solve = lifted_jit(update_solve)
 
     def compute_coefficients(self, dt_hist, order):
         """Return (a[0..order], b[0..order], c[1..order])."""
@@ -147,11 +180,21 @@ class MultistepIMEX:
             self._lhs_aux = self._factor(solver.M_mat, solver.L_mat,
                                          jnp.asarray(a[0], dtype=rd),
                                          jnp.asarray(b[0], dtype=rd))
-        X, self.F_hist, self.MX_hist, self.LX_hist = self._advance(
-            solver.M_mat, solver.L_mat, solver.X,
-            jnp.asarray(solver.sim_time, dtype=rd), solver.rhs_extra(),
-            self.F_hist, self.MX_hist, self.LX_hist, jnp.asarray(a, dtype=rd),
-            jnp.asarray(b, dtype=rd), jnp.asarray(c, dtype=rd), self._lhs_aux)
+        if self._split:
+            Fn, MXn, LXn = self._eval_parts(
+                solver.M_mat, solver.L_mat, solver.X,
+                jnp.asarray(solver.sim_time, dtype=rd), solver.rhs_extra())
+            X, self.F_hist, self.MX_hist, self.LX_hist = self._update_solve(
+                Fn, MXn, LXn, self.F_hist, self.MX_hist, self.LX_hist,
+                jnp.asarray(a, dtype=rd), jnp.asarray(b, dtype=rd),
+                jnp.asarray(c, dtype=rd), self._lhs_aux,
+                solver.M_mat, solver.L_mat)
+        else:
+            X, self.F_hist, self.MX_hist, self.LX_hist = self._advance(
+                solver.M_mat, solver.L_mat, solver.X,
+                jnp.asarray(solver.sim_time, dtype=rd), solver.rhs_extra(),
+                self.F_hist, self.MX_hist, self.LX_hist, jnp.asarray(a, dtype=rd),
+                jnp.asarray(b, dtype=rd), jnp.asarray(c, dtype=rd), self._lhs_aux)
         solver.X = X
         solver.sim_time = float(solver.sim_time) + float(dt)
 
@@ -164,6 +207,12 @@ class MultistepIMEX:
         solver = self.solver
         s = self.steps
         n = int(n)
+        if self._split:
+            # split mode targets huge systems where per-step device time
+            # dominates dispatch latency; no need for the scanned block
+            for _ in range(n):
+                self.step(dt)
+            return
         while n > 0 and not (self.iteration >= s
                              and len(self.dt_hist) == s
                              and all(abs(k - float(dt)) < 1e-15 * abs(dt)
@@ -339,18 +388,27 @@ class RungeKuttaIMEX:
             auxs = _factor_uniq(M, L, dt)
             return [auxs[j] for j in stage_slot]
 
+        # the fused step body composes the same per-stage pieces the split
+        # mode dispatches separately, so the numerics cannot drift
+        def stage_eval(M, L, Xi, ti, extra):
+            return (ops.matvec(L, Xi), eval_F(Xi, ti, extra) * mask())
+
+        def stage_solve(i, MX0, Fs, LXs, dt, lhs_aux, M, L):
+            RHS = MX0
+            for j in range(i):
+                RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
+            return ops.solve(lhs_aux, RHS, mats=(M, L))
+
         def step_body(M, L, X0, t0, dt, extra, lhs_auxs):
             MX0 = ops.matvec(M, X0)
             LXs = []
             Fs = []
             Xi = X0
             for i in range(1, s + 1):
-                LXs.append(ops.matvec(L, Xi))
-                Fs.append(eval_F(Xi, t0 + c[i - 1] * dt, extra) * mask())
-                RHS = MX0
-                for j in range(i):
-                    RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
-                Xi = ops.solve(lhs_auxs[i - 1], RHS, mats=(M, L))
+                LXi, Fi = stage_eval(M, L, Xi, t0 + c[i - 1] * dt, extra)
+                LXs.append(LXi)
+                Fs.append(Fi)
+                Xi = stage_solve(i, MX0, Fs, LXs, dt, lhs_auxs[i - 1], M, L)
             return Xi
 
         def _step_n(M, L, X0, t0, dt, extra, lhs_auxs, n):
@@ -367,6 +425,35 @@ class RungeKuttaIMEX:
         self._step = lifted_jit(step_body)
         self._step_n = lifted_jit(_step_n, static_argnums=(7,))
 
+        # split-step pieces: the SAME per-stage bodies the fused program
+        # composes, compiled as separate device programs (see _use_split_step)
+        self._split = _use_split_step(solver)
+        self._mx0 = lifted_jit(lambda M, X0: ops.matvec(M, X0))
+        self._stage_eval = lifted_jit(stage_eval)
+        self._stage_solve = lifted_jit(stage_solve, static_argnums=(0,))
+
+    def _step_split(self, dt):
+        solver = self.solver
+        rd = solver.real_dtype
+        M, L = solver.M_mat, solver.L_mat
+        extra = solver.rhs_extra()
+        dtj = jnp.asarray(float(dt), dtype=rd)
+        t0 = float(solver.sim_time)
+        MX0 = self._mx0(M, solver.X)
+        Fs, LXs = [], []
+        Xi = solver.X
+        for i in range(1, self.stages + 1):
+            # stage time in rd arithmetic (t0 + c*dt term-by-term), exactly
+            # matching the fused body's on-device rd computation
+            ti = jnp.asarray(rd.type(t0)
+                             + rd.type(self.c[i - 1]) * rd.type(dt), dtype=rd)
+            LXi, Fi = self._stage_eval(M, L, Xi, ti, extra)
+            LXs.append(LXi)
+            Fs.append(Fi)
+            Xi = self._stage_solve(i, MX0, Fs, LXs, dtj,
+                                   self._lhs_aux[i - 1], M, L)
+        return Xi
+
     def _ensure_factor(self, dt):
         solver = self.solver
         key = round(float(dt), 14)
@@ -380,18 +467,26 @@ class RungeKuttaIMEX:
         solver = self.solver
         rd = solver.real_dtype
         self._ensure_factor(dt)
-        solver.X = self._step(solver.M_mat, solver.L_mat, solver.X,
-                              jnp.asarray(solver.sim_time, dtype=rd),
-                              jnp.asarray(float(dt), dtype=rd),
-                              solver.rhs_extra(), self._lhs_aux)
+        if self._split:
+            solver.X = self._step_split(dt)
+        else:
+            solver.X = self._step(solver.M_mat, solver.L_mat, solver.X,
+                                  jnp.asarray(solver.sim_time, dtype=rd),
+                                  jnp.asarray(float(dt), dtype=rd),
+                                  solver.rhs_extra(), self._lhs_aux)
         solver.sim_time = float(solver.sim_time) + float(dt)
         self.iteration += 1
 
     def step_many(self, n, dt):
-        """n constant-dt steps in one device dispatch (lax.scan)."""
+        """n constant-dt steps in one device dispatch (lax.scan); split
+        mode steps singly (dispatch latency is negligible at that size)."""
         solver = self.solver
         rd = solver.real_dtype
         self._ensure_factor(dt)
+        if self._split:
+            for _ in range(int(n)):
+                self.step(dt)
+            return
         solver.X = self._step_n(solver.M_mat, solver.L_mat, solver.X,
                                 jnp.asarray(solver.sim_time, dtype=rd),
                                 jnp.asarray(float(dt), dtype=rd),

@@ -202,3 +202,30 @@ def test_step_many_matches_single_steps():
         assert np.allclose(X1, X2, rtol=1e-12, atol=1e-14), scheme
         assert abs(s1.sim_time - s2.sim_time) < 1e-14
         assert s1.iteration == s2.iteration == 7
+
+
+@pytest.mark.parametrize("ts_name", ["RK222", "SBDF2"])
+def test_split_step_matches_fused(ts_name):
+    """Split-step mode (per-stage eval/solve device programs, the
+    TPU-compiler-friendly path for very large systems) must be bit-exact
+    against the fused single-program step."""
+    import sys as _sys, os as _os
+    _sys.path.insert(0, _os.path.dirname(__file__))
+    from test_banded import build_rb
+    from dedalus_tpu.tools.config import config
+    ts = getattr(d3, ts_name)
+    old = config["execution"].get("STEP_PROGRAM", "auto")
+    try:
+        config["execution"]["STEP_PROGRAM"] = "fused"
+        sf = build_rb(16, 32, timestepper=ts)
+        config["execution"]["STEP_PROGRAM"] = "split"
+        ss = build_rb(16, 32, timestepper=ts)
+        assert ss.timestepper._split and not sf.timestepper._split
+        for _ in range(5):
+            sf.step(0.01)
+            ss.step(0.01)
+        sf.step_many(4, 0.01)
+        ss.step_many(4, 0.01)
+    finally:
+        config["execution"]["STEP_PROGRAM"] = old
+    assert np.abs(np.asarray(sf.X) - np.asarray(ss.X)).max() < 1e-12
