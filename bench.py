@@ -100,15 +100,12 @@ def _run_child(env, timeout, tag):
     env["_BENCH_CHILD"] = "1"
     mark(f"running benchmark in {tag} subprocess (timeout {timeout}s)")
     try:
+        # stderr streams through live (progress marks stay observable
+        # during long compiles); only stdout (the JSON record) is captured
         r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired as e:
-        tail = (e.stderr or b"")
-        if isinstance(tail, bytes):
-            tail = tail.decode(errors="replace")
-        sys.stderr.write(tail[-2000:])
+                           stdout=subprocess.PIPE, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
         return None, f"{tag} child timed out after {timeout}s"
-    sys.stderr.write(r.stderr or "")
     line = next((ln for ln in r.stdout.splitlines() if ln.startswith("{")), None)
     if r.returncode == 0 and line:
         try:

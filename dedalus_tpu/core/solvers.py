@@ -200,8 +200,9 @@ class SolverBase:
         if not structure.ok:
             self._banded_reason = structure.reason
             return (coo_store, masks)
-        # validity closure aligned with the matching
-        last = names[-1]
+        # validity closure aligned with the matching (passed separately to
+        # build_banded_arrays so the shared COO pattern stays shared and
+        # the scatter can vectorize over the whole group batch)
         closures = []
         for coos, (row_valid, col_valid) in zip(coo_store, masks):
             closure = compute_group_closure(structure, row_valid, col_valid)
@@ -209,24 +210,13 @@ class SolverBase:
                 self._banded_reason = "validity closure misaligned with matching"
                 return (coo_store, masks)
             closures.append(closure)
-        for coos, closure in zip(coo_store, closures):
-            rows, cols, vals = coos[last]
-            coos[last] = (np.concatenate([rows, closure[0]]),
-                          np.concatenate([cols, closure[1]]),
-                          np.concatenate([vals, np.ones(len(closure[0]))]))
         host_dtype = (np.complex128 if is_complex_dtype(self.pencil_dtype)
                       else np.float64)
         try:
             self._matrices = build_banded_arrays(coo_store, structure, names,
-                                                 host_dtype, drop_tol=tol_abs)
+                                                 host_dtype, drop_tol=tol_abs,
+                                                 closures=closures)
         except ValueError as exc:
-            # strip the closure entries we appended before falling back
-            for coos, closure in zip(coo_store, closures):
-                rows, cols, vals = coos[last]
-                n = len(closure[0])
-                coos[last] = (rows[:-n] if n else rows,
-                              cols[:-n] if n else cols,
-                              vals[:-n] if n else vals)
             self._banded_reason = str(exc)
             return (coo_store, masks)
         self.structure = structure

@@ -771,7 +771,8 @@ def compute_group_closure(structure, row_valid, col_valid):
     return inv_rows, cols
 
 
-def build_banded_arrays(coo_store, structure, names, dtype, drop_tol=0.0):
+def build_banded_arrays(coo_store, structure, names, dtype, drop_tol=0.0,
+                        closures=None):
     """
     Scatter per-group COO matrices into banded + pinned-row storage:
     matched rows' entries go to the (G, D, n_pad) diagonal bands at their
@@ -779,6 +780,12 @@ def build_banded_arrays(coo_store, structure, names, dtype, drop_tol=0.0):
     Woodbury correction (the identity pins themselves are injected at
     factor time, not stored, so the per-name arrays represent the TRUE
     matrices and matvec needs no special casing).
+
+    `closures` optionally supplies per-group (rows, cols) identity-closure
+    entries (value 1.0) for the LAST name, kept out of the COO store so
+    the batched-assembly path's SHARED pattern survives — when all groups
+    share one (rows, cols) pattern the scatter vectorizes over the whole
+    group batch instead of looping (the loop dominated large builds).
     Returns {name: {"bands": ..., "Vt": ...}}.
     """
     st = structure
@@ -788,28 +795,79 @@ def build_banded_arrays(coo_store, structure, names, dtype, drop_tol=0.0):
     pos_col = np.argsort(st.col_perm)
     pin_index = -np.ones(st.S, dtype=int)
     pin_index[st.pinned_rows] = np.arange(st.t_pins)
+
+    def masks_for(rows, cols, oob_max):
+        """(mb, mv, d, pr, pc, pi) for one (rows, cols) pattern; raises on
+        a genuine out-of-band entry, drops sub-tolerance ones. `oob_max`
+        maps an out-of-band index mask to the max |value| there (called
+        only when out-of-band entries exist, so the common all-in-band
+        build never materializes an abs temp)."""
+        pi = pin_index[rows]
+        pr, pc = st.row_pos[rows], pos_col[cols]
+        mb = pi < 0               # entries of banded (non-pinned) rows
+        mv = ~mb                  # entries of pinned rows
+        d = pc - pr + st.kl
+        oob = mb & ((d < 0) | (d >= nd))
+        if oob.any():
+            # sub-tolerance out-of-band entries (excluded from the
+            # detected pattern) are dropped; anything larger is a
+            # genuine structure violation
+            if oob_max(oob) > drop_tol:
+                raise ValueError("Entry outside detected band")
+            mb = mb & ~oob
+        return mb, mv, d, pr, pc, pi
+
     out = {}
     for name in names:
-        bands = np.zeros((G, nd, n_pad), dtype=dtype)
-        Vt = np.zeros((G, st.t_pins, n_pad), dtype=dtype)
-        for g in range(G):
-            rows, cols, vals = coo_store[g][name]
-            pi = pin_index[rows]
-            pr, pc = st.row_pos[rows], pos_col[cols]
-            mb = pi < 0               # entries of banded (non-pinned) rows
-            mv = ~mb                  # entries of pinned rows
-            d = pc - pr + st.kl
-            oob = mb & ((d < 0) | (d >= nd))
-            if oob.any():
-                # sub-tolerance out-of-band entries (excluded from the
-                # detected pattern) are dropped; anything larger is a
-                # genuine structure violation
-                if (np.abs(vals[oob]) > drop_tol).any():
-                    raise ValueError("Entry outside detected band")
-                mb = mb & ~oob
-            bands[g][d[mb], pr[mb]] = vals[mb]
-            Vt[g][pi[mv], pc[mv]] = vals[mv]
-        out[name] = {"bands": bands, "Vt": Vt}
+        is_last = (closures is not None and name == names[-1])
+        if is_last:
+            # vectorized closure entries: concatenated (g, row, col),
+            # value 1.0 (closure columns are the matched diagonal, always
+            # in band; closure rows may be pinned)
+            cl_g = np.concatenate([np.full(len(c[0]), g, dtype=int)
+                                   for g, c in enumerate(closures)])
+            cl_rows = np.concatenate([c[0] for c in closures])
+            cl_cols = np.concatenate([c[1] for c in closures])
+            cl = masks_for(cl_rows, cl_cols, lambda oob: np.inf)
+        r0, c0, _ = coo_store[0][name]
+        shared = all(coo_store[g][name][0] is r0
+                     and coo_store[g][name][1] is c0 for g in range(G))
+        if shared:
+            vals_all = np.stack([coo_store[g][name][2] for g in range(G)])
+            mb, mv, d, pr, pc, pi = masks_for(
+                r0, c0, lambda oob: np.abs(vals_all[:, oob]).max(initial=0.0))
+            # assemble straight into TRIMMED storage: only the occupied
+            # diagonals are allocated (dsel maps stored rows to the full
+            # 0..nd-1 lattice), skipping the (G, nd, n_pad) host lattice
+            # and the trim copy to_device would otherwise pay
+            dsel = np.unique(np.concatenate(
+                [d[mb], [st.kl]] + ([cl[2][cl[0]]] if is_last else [])))
+            remap = np.zeros(nd, dtype=int)
+            remap[dsel] = np.arange(len(dsel))
+            bands = np.zeros((G, len(dsel), n_pad), dtype=dtype)
+            Vt = np.zeros((G, st.t_pins, n_pad), dtype=dtype)
+            bands[:, remap[d[mb]], pr[mb]] = vals_all[:, mb]
+            Vt[:, pi[mv], pc[mv]] = vals_all[:, mv]
+            if is_last and len(cl_g):
+                mb_c, mv_c, d_c, pr_c, pc_c, pi_c = cl
+                bands[cl_g[mb_c], remap[d_c[mb_c]], pr_c[mb_c]] = 1.0
+                Vt[cl_g[mv_c], pi_c[mv_c], pc_c[mv_c]] = 1.0
+            out[name] = {"bands": bands, "Vt": Vt,
+                         "dsel": tuple(int(x) for x in dsel)}
+        else:
+            bands = np.zeros((G, nd, n_pad), dtype=dtype)
+            Vt = np.zeros((G, st.t_pins, n_pad), dtype=dtype)
+            for g in range(G):
+                rows, cols, vals = coo_store[g][name]
+                mb, mv, d, pr, pc, pi = masks_for(
+                    rows, cols, lambda oob: np.abs(vals[oob]).max(initial=0.0))
+                bands[g][d[mb], pr[mb]] = vals[mb]
+                Vt[g][pi[mv], pc[mv]] = vals[mv]
+            if is_last and len(cl_g):
+                mb_c, mv_c, d_c, pr_c, pc_c, pi_c = cl
+                bands[cl_g[mb_c], d_c[mb_c], pr_c[mb_c]] = 1.0
+                Vt[cl_g[mv_c], pi_c[mv_c], pc_c[mv_c]] = 1.0
+            out[name] = {"bands": bands, "Vt": Vt}
     return out
 
 

@@ -156,14 +156,20 @@ class BandedOps:
     # ------------------------------------------------------------ host side
 
     def to_device(self, host_arrs, dtype):
-        """Host (G, nd, n_pad) band store -> trimmed BandedMatrix."""
+        """Host band store -> trimmed BandedMatrix. Accepts pre-trimmed
+        storage (a "dsel" key, the assembly fast path) or a full
+        (G, nd, n_pad) lattice, trimmed here."""
         bands = host_arrs["bands"]
         Vt = host_arrs["Vt"]
-        dsel = [d for d in range(self.nd) if np.any(bands[:, d, :])]
-        if not dsel:
-            dsel = [self.kl]
-        # fancy-index slice is already a fresh contiguous array
-        trimmed = jnp.asarray(bands[:, dsel, :], dtype=dtype)
+        if "dsel" in host_arrs:
+            dsel = list(host_arrs["dsel"])
+            trimmed = jnp.asarray(bands, dtype=dtype)
+        else:
+            dsel = [d for d in range(self.nd) if np.any(bands[:, d, :])]
+            if not dsel:
+                dsel = [self.kl]
+            # fancy-index slice is already a fresh contiguous array
+            trimmed = jnp.asarray(bands[:, dsel, :], dtype=dtype)
         Vt_dev = None
         if self.t and np.any(Vt):
             Vt_dev = jnp.asarray(Vt, dtype=dtype)
@@ -174,10 +180,11 @@ class BandedOps:
         S = self.n
         Ap = np.zeros((self.n_pad, self.n_pad), dtype=host_arrs["bands"].dtype)
         bands = host_arrs["bands"][g]
-        for d in range(self.nd):
+        dsel = host_arrs.get("dsel", range(self.nd))
+        for i, d in enumerate(dsel):
             off = d - self.kl
             rr = np.arange(max(0, -off), min(self.n_pad, self.n_pad - off))
-            Ap[rr, rr + off] = bands[d, rr]
+            Ap[rr, rr + off] = bands[i, rr]
         if self.t:
             Ap[self.pin_pos, :] += host_arrs["Vt"][g]
         Ap = Ap[:S, :S]
