@@ -18,6 +18,7 @@ from .domain import Domain
 from .basis import Jacobi
 from ..tools.array import kron as sparse_kron, sparsify
 from ..tools.exceptions import NonlinearOperatorError
+from ..tools.general import is_complex_dtype
 
 from .operators import (operand_expression_matrices, ConvertNode, Convert,
                         tensor_identity)
@@ -217,7 +218,7 @@ class ProductBase(Future):
 
     natural_layout = "g"
 
-    def _split_ncc(self, vars):
+    def _split_ncc(self, vars, layout=None):
         """Return (ncc_side_index, ncc_field, operand_expr)."""
 
         def contains_vars(x):
@@ -238,10 +239,19 @@ class ProductBase(Future):
         ncc = self.args[ncc_index]
         if not isinstance(ncc, Field):
             ncc = ncc.evaluate()
-        # NCCs must be constant along separable axes for group-diagonality
-        # (reference requires coupled-only NCC bases on the LHS).
-        for basis in ncc.domain.bases:
-            if basis is not None and basis.separable:
+        # NCCs must be constant along axes the LAYOUT keeps separable for
+        # group-diagonality; axes the layout coupled (forced by this very
+        # NCC, see subsystems._ncc_forced_coupled_axes) build full
+        # multiplication matrices instead. Without layout context, fall
+        # back to the conservative basis-level check.
+        for axis, basis in enumerate(ncc.domain.bases):
+            if basis is None or basis.dim != 1:
+                # multi-dim curvilinear NCC bases validate angular
+                # constancy in their own assembly paths
+                continue
+            separable = (axis in layout.sep_widths) if layout is not None \
+                else basis.separable
+            if separable:
                 raise NonlinearOperatorError(
                     "LHS coefficient fields must be constant along separable axes.")
         return ncc_index, ncc, self.args[op_index]
@@ -298,6 +308,19 @@ class ProductBase(Future):
                 descrs.extend([None] * (nb.dim - 1))  # angular identities
                 descrs.append(("full", sparsify(M, 1e-12)))
                 axis += nb.dim
+            elif hasattr(nb, "multiplication_matrix") and nb.separable:
+                # Fourier-type NCC on a layout-coupled periodic axis:
+                # whole-axis convolution matrix (reference: non-separable
+                # Fourier-NCC subproblems, e.g. the Mathieu example)
+                ax_coeffs = np.moveaxis(ccomp, axis, -1)
+                assert ax_coeffs.size == ax_coeffs.shape[-1], \
+                    "NCCs coupling multiple axes are not supported yet."
+                if ob is None:
+                    descrs.append(("full", sparsify(ax_coeffs.reshape(-1, 1), 1e-12)))
+                else:
+                    M = ob.multiplication_matrix(ax_coeffs.ravel(), nb)
+                    descrs.append(("full", sparsify(M, 1e-12)))
+                axis += 1
             else:
                 raise NonlinearOperatorError(
                     f"LHS NCCs may not vary along basis {nb!r}.")
@@ -312,6 +335,176 @@ class ProductBase(Future):
             if b is not None and getattr(b, "regularity", False):
                 return b
         return None
+
+    def _polar_spin_basis(self, operand):
+        from .curvilinear import SpinBasisMixin
+        for b in operand.domain.bases:
+            if (b is not None and b.dim == 2 and isinstance(b, SpinBasisMixin)
+                    and not getattr(b, "regularity", False)):
+                return b
+        return None
+
+    def _disk_ncc_matrix(self, subproblem, ncc, operand, place_fn):
+        """
+        Pencil matrix of an angularly-constant NCC on the DISK (scalar or
+        tensor valued; e.g. the pipe-flow example's w0*dz(u) advection and
+        u@grad(w0) terms). Zernike radial spaces are (m, spin)-dependent,
+        so each coordinate component c of the NCC contributes per-m radial
+        stacks bracketed by the spin coupling C = U_out P_c U_in^H:
+
+            term(c, i, j) = C_ij * F_out(s_i)[m] diag(f_c) B_in(s_j)[m]
+
+        assembled through ("gblocks", az, stack) descriptors. Profiles are
+        sampled on the 2x radial quadrature grid through the field's own
+        transforms (spin-envelope-faithful), making the product projection
+        exact for resolved data. `place_fn(c)` gives the coordinate-space
+        component placement (outer product or contraction).
+        """
+        from .curvilinear import (recombination_matrix, real_pair_matrix,
+                                  component_spins, PAIR_J)
+        from .operators import _axis_identity, assemble_group_matrix
+        nb = self._polar_spin_basis(ncc)
+        ob = self._polar_spin_basis(operand)
+        if ob is None:
+            raise NonlinearOperatorError(
+                "Disk NCCs require the operand on the disk basis too.")
+        cs = nb.cs
+        az_axis = nb.first_axis
+        r_axis = az_axis + 1
+        dim = self.dist.dim
+        # profiles on the 2x quadrature grid, via the field's transforms
+        old_scales = ncc.scales
+        ncc.change_scales(2)
+        grid = np.asarray(ncc["g"])
+        ncc.change_scales(old_scales)
+        tdim_n = len(ncc.tensorsig)
+        ncomp_n = int(np.prod(ncc.tshape, dtype=int)) if ncc.tshape else 1
+        flat = grid.reshape((ncomp_n,) + grid.shape[tdim_n:])
+        tol = 1e-10 * max(np.abs(flat).max(), 1e-300)
+        moved = np.moveaxis(flat, 1 + az_axis, 1)
+        if np.abs(moved - moved[:, :1]).max() > tol:
+            raise NonlinearOperatorError(
+                "LHS NCCs on disk bases must be angularly constant.")
+        profiles = moved[:, 0].reshape(ncomp_n, -1)   # (ncomp_n, Ngr2)
+        U_in = recombination_matrix(tuple(operand.tensorsig), cs)
+        U_out = recombination_matrix(tuple(self.tensorsig), cs)
+        s_in = component_spins(tuple(operand.tensorsig), cs)
+        s_out = component_spins(tuple(self.tensorsig), cs)
+        real = not is_complex_dtype(self.dtype)
+        out_basis = self.domain.bases[az_axis]
+        terms = []
+        nonzero = [c for c in range(ncomp_n)
+                   if np.abs(profiles[c]).max() > tol]
+        for c in (nonzero or [0]):
+            prof = profiles[c]
+            C = U_out @ place_fn(c) @ U_in.conj().T
+            for i in range(C.shape[0]):
+                for j in range(C.shape[1]):
+                    if abs(C[i, j]) < 1e-14 and nonzero:
+                        continue
+                    F = out_basis.radial_forward_stack(int(s_out[i]), 2.0)
+                    B = ob.radial_backward_stack(int(s_in[j]), 2.0)
+                    stack = np.einsum("gnr,r,grk->gnk", F, prof, B)
+                    E = np.zeros((C.shape[0], C.shape[1]))
+                    E[i, j] = 1.0
+                    descrs = [None] * dim
+                    if real:
+                        az2 = (np.eye(2) * C[i, j].real
+                               + PAIR_J * C[i, j].imag)
+                        descrs[az_axis] = ("full", sparsify(az2, 1e-14))
+                    else:
+                        descrs[az_axis] = ("full", sp.csr_matrix(
+                            np.array([[C[i, j]]])))
+                    descrs[r_axis] = ("gblocks", az_axis, stack)
+                    terms.append((E, descrs))
+        return assemble_group_matrix(terms, operand.domain, operand.tshape,
+                                     self.tshape, subproblem)
+
+    def _polar_tensor_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
+        """
+        Pencil matrix of a tensor-valued, angularly-constant polar NCC
+        (e.g. the annulus example's radial-vector gravity b*g and
+        rvec*lift(tau) terms; reference handles these via the Clenshaw
+        tensor-NCC pipeline, core/arithmetic.py:359-558).
+
+        The polar spin recombination U is m-independent, so each NCC
+        COORDINATE component c with radial profile f_c(r) contributes
+            (U_out P_c U_in^H)  (x)  angular-identity  (x)  RadialMult(f_c)
+        with P_c placing component c in the coordinate component space.
+        Real dtypes apply the complex component coupling jointly on the
+        interleaved (cos, -sin) azimuth pair (real_pair_matrix).
+        """
+        from .curvilinear import recombination_matrix, real_pair_matrix
+        from .operators import _axis_identity
+        nb = self._polar_spin_basis(ncc)
+        ob = self._polar_spin_basis(operand)
+        if ob is None or not hasattr(ob, "radial_multiplication_matrix"):
+            raise NonlinearOperatorError(
+                "Tensor-valued polar NCCs require annulus bases on both "
+                "factors (disk regularity spaces are not supported yet).")
+        cs = nb.cs
+        az_axis = nb.first_axis
+        r_axis = az_axis + 1
+        # angular constancy check on coordinate-component grid data, read
+        # at scale 1 to match the radial forward matrix below
+        old_scales = ncc.scales
+        ncc.change_scales(1)
+        grid = np.asarray(ncc["g"])
+        ncc.change_scales(old_scales)
+        ncomp_n = int(np.prod(ncc.tshape, dtype=int)) if ncc.tshape else 1
+        flat = grid.reshape((ncomp_n,) + grid.shape[len(ncc.tshape):])
+        tol = 1e-10 * max(np.abs(flat).max(), 1e-300)
+        moved = np.moveaxis(flat, 1 + az_axis, 1)
+        if np.abs(moved - moved[:, :1]).max() > tol:
+            raise NonlinearOperatorError(
+                "LHS tensor NCCs on polar bases must be angularly constant.")
+        profiles = moved[:, 0].reshape(ncomp_n, -1)  # (ncomp_n, Nr)
+        # radial coefficients of each component profile at the NCC's level
+        fwd = np.asarray(nb._radial_forward_matrix(1.0))
+        # intertwiner sandwich pieces
+        U_in = recombination_matrix(tuple(operand.tensorsig), cs)
+        out_tsig = (tuple(ncc.tensorsig) + tuple(operand.tensorsig)
+                    if ncc_index == 0
+                    else tuple(operand.tensorsig) + tuple(ncc.tensorsig))
+        U_out = recombination_matrix(out_tsig, cs)
+        ncomp_op = U_in.shape[0]
+        real = not is_complex_dtype(self.dtype)
+        dim = self.dist.dim
+        sep_widths = subproblem.layout.sep_widths
+        nonzero = [c for c in range(ncomp_n)
+                   if np.abs(profiles[c]).max() > tol]
+        total = None
+        for c in (nonzero or [0]):     # all-zero NCC: one zero term (shape)
+            f_coeffs = fwd @ profiles[c]
+            R = sparsify(ob.radial_multiplication_matrix(f_coeffs, nb.k,
+                                                         k_out=0), 1e-12)
+            P_c = np.zeros((ncomp_n, 1))
+            P_c[c, 0] = 1.0
+            place = (np.kron(P_c, np.eye(ncomp_op)) if ncc_index == 0
+                     else np.kron(np.eye(ncomp_op), P_c))
+            C = U_out @ place @ U_in.conj().T
+            if real:
+                # joint (component, azimuth-pair) real representation; the
+                # azimuth slot IS the (cos, -sin) pair (group_shape == 2),
+                # so the pair action is absorbed into the leading factor
+                T = sp.csr_matrix(real_pair_matrix(C))
+            else:
+                T = sp.csr_matrix(C)
+            factors = [T]
+            for axis in range(dim):
+                basisx = operand.domain.bases[axis]
+                if axis == az_axis:
+                    if not real:
+                        factors.append(sp.identity(1, format="csr"))
+                elif axis == r_axis:
+                    factors.append(R)
+                else:
+                    sub = 0 if basisx is None else axis - basisx.first_axis
+                    factors.append(_axis_identity(basisx,
+                                                  sep_widths.get(axis), sub))
+            mat = sparse_kron(*factors)
+            total = mat if total is None else total + mat
+        return total
 
     def _sph_ncc_setup(self, ncc, operand, ncc_index):
         """
@@ -482,10 +675,32 @@ class MultiplyFields(ProductBase):
         return da_x * db  # broadcasting over tensor + constant grid axes
 
     def expression_matrices(self, subproblem, vars, **kw):
-        ncc_index, ncc, operand = self._split_ncc(vars)
+        ncc_index, ncc, operand = self._split_ncc(vars, subproblem.layout)
         if self._spherical_regularity_basis(ncc) is not None:
             M = self._spherical_ncc_matrix(subproblem, ncc, operand,
                                            ncc_index)
+            op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
+            return {var: M @ mat for var, mat in op_mats.items()}
+        pol = self._polar_spin_basis(ncc)
+        if pol is not None and (ncc.tensorsig
+                                or not hasattr(pol, "radial_multiplication_matrix")):
+            if hasattr(pol, "radial_multiplication_matrix"):
+                # annulus: spin-independent radial space, single matrix
+                M = self._polar_tensor_ncc_matrix(subproblem, ncc, operand,
+                                                  ncc_index)
+            else:
+                # disk: per-(m, spin) Zernike stacks
+                n_n = int(np.prod(ncc.tshape, dtype=int)) if ncc.tshape else 1
+                n_op = int(np.prod(operand.tshape, dtype=int)) \
+                    if operand.tshape else 1
+
+                def place(c):
+                    P = np.zeros((n_n, 1))
+                    P[c, 0] = 1.0
+                    return (np.kron(P, np.eye(n_op)) if ncc_index == 0
+                            else np.kron(np.eye(n_op), P))
+
+                M = self._disk_ncc_matrix(subproblem, ncc, operand, place)
             op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
             return {var: M @ mat for var, mat in op_mats.items()}
         ncomp_op = int(np.prod([cs.dim for cs in operand.tensorsig], dtype=int)) \
@@ -548,7 +763,7 @@ class DotProduct(ProductBase):
         return jnp.einsum(f"{l_sub},{r_sub}->{o_sub}", da, db)
 
     def expression_matrices(self, subproblem, vars, **kw):
-        ncc_index, ncc, operand = self._split_ncc(vars)
+        ncc_index, ncc, operand = self._split_ncc(vars, subproblem.layout)
         d = ncc.tensorsig[-1].dim if ncc_index == 0 else ncc.tensorsig[0].dim
 
         if ncc_index == 0:
@@ -580,6 +795,15 @@ class DotProduct(ProductBase):
                 return sparse_kron(sp.identity(n_lead_op, format="csr"),
                                    sp.csr_matrix(row), sp.csr_matrix(col))
 
+        pol = self._polar_spin_basis(ncc)
+        if pol is not None and not hasattr(pol, "radial_multiplication_matrix"):
+            # disk contraction (e.g. pipe flow's u@grad(w0)): the same
+            # coordinate placement feeds the per-(m, spin) stack path
+            place = lambda cflat: np.asarray(tensor_factor(
+                tuple(np.unravel_index(cflat, ncc.tshape))).toarray())
+            M = self._disk_ncc_matrix(subproblem, ncc, operand, place)
+            op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
+            return {var: M @ mat for var, mat in op_mats.items()}
         M = self._assemble_ncc_matrix(subproblem, ncc, operand, tensor_factor)
         op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
         return {var: M @ mat for var, mat in op_mats.items()}

@@ -303,6 +303,30 @@ class FourierBase(Basis):
                 return "matrix"
         return library
 
+    def multiplication_matrix(self, ncc_coeffs, ncc_basis=None):
+        """
+        Coefficient-space matrix multiplying by the function with
+        coefficients `ncc_coeffs` (on `ncc_basis`, default self): the
+        coupling matrix of an LHS NCC that varies along this periodic axis
+        (reference supports Fourier NCCs via non-separable subproblems,
+        e.g. the Mathieu example). Built exactly as forward . diag(ncc on
+        grid) . backward on a 2x-oversampled common grid (alias-free for
+        products of two resolved functions).
+        """
+        from .transforms import transform_registry
+        ncc_basis = ncc_basis or self
+        plan_cls = transform_registry[(type(self).__name__, "matrix")]
+        Ng = 2 * max(self.size, ncc_basis.size)
+        F = plan_cls.build_forward(self, Ng / self.size)
+        B = plan_cls.build_backward(self, Ng / self.size)
+        if ncc_basis is self:
+            B_ncc = B
+        else:
+            ncc_cls = transform_registry[(type(ncc_basis).__name__, "matrix")]
+            B_ncc = ncc_cls.build_backward(ncc_basis, Ng / ncc_basis.size)
+        g = B_ncc @ np.asarray(ncc_coeffs)
+        return F @ (g[:, None] * B)
+
 
 class RealFourier(FourierBase):
     """

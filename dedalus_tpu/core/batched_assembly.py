@@ -242,10 +242,16 @@ def _batched_ncc_matrices(expr, layout, vars):
     """NCC products (MultiplyFields/DotProduct); group-independent axis
     matrices batch directly, spherical regularity NCCs via per-ell
     stacks."""
-    ncc_index, ncc, operand = expr._split_ncc(vars)
+    ncc_index, ncc, operand = expr._split_ncc(vars, layout)
     if expr._spherical_regularity_basis(ncc) is not None:
         return _batched_spherical_ncc(expr, layout, vars, ncc_index, ncc,
                                       operand)
+    pol = expr._polar_spin_basis(ncc)
+    if pol is not None and (ncc.tensorsig
+                            or not hasattr(pol, "radial_multiplication_matrix")):
+        # polar tensor NCCs (intertwiner sandwich) and disk NCCs (per-m
+        # Zernike stacks) assemble through the per-group path
+        raise BatchUnsupported("polar tensor/disk NCC")
     tensor_factor_fn = _ncc_tensor_factor_fn(expr, ncc, operand, ncc_index)
     comp_indices = list(np.ndindex(*ncc.tshape)) if ncc.tshape else [()]
     my_terms = []
@@ -381,6 +387,11 @@ def batched_system_coos(layout, equations, variables, names):
     Raises BatchUnsupported when any LHS expression lacks batched terms.
     """
     from .subsystems import _system_sizes
+    if getattr(layout, "forced_coupled", None):
+        # NCC-coupled separable axes build whole-axis multiplication
+        # matrices; their group structure is not batchable (and is tiny —
+        # typically G=1), so use the per-group walk
+        raise BatchUnsupported("layout has NCC-coupled separable axes")
     var_offsets, eq_sizes, S = _system_sizes(layout, equations, variables)
     groups = list(layout.groups())
     G = len(groups)

@@ -58,14 +58,14 @@ def _axis_identity(basis, sep_width=None, sub_axis=0):
     Identity factor for an untouched axis. On problem-separable axes the
     uniform pencil slot width (`sep_width` = group_shape) is used even when
     the operand is constant along the axis (its dummy slots are masked by
-    validity later).
+    validity later); any other axis carries its full coefficient size
+    (including separable-capable bases the LAYOUT coupled, e.g. a Fourier
+    axis an LHS NCC varies along).
     """
     if sep_width is not None:
         return sp.identity(sep_width, format="csr")
     if basis is None:
         return sp.identity(1, format="csr")
-    if basis.sub_separable(sub_axis):
-        return sp.identity(basis.sub_group_shape(sub_axis), format="csr")
     return sp.identity(basis.coeff_size(sub_axis), format="csr")
 
 
@@ -93,7 +93,15 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
                 if kind == "full":
                     factors.append(sparsify(descr[1]))
                 elif kind == "blocks":
-                    factors.append(sparsify(descr[1][group[axis]]))
+                    if group[axis] is None:
+                        # layout-coupled separable basis (e.g. a Fourier
+                        # axis an LHS NCC varies along): the whole-axis
+                        # matrix is the block diagonal of the per-group
+                        # blocks in group order
+                        factors.append(sp.block_diag(
+                            [sparsify(b) for b in descr[1]], format="csr"))
+                    else:
+                        factors.append(sparsify(descr[1][group[axis]]))
                 elif kind == "gblocks":
                     # per-group blocks on a coupled axis, group read from a
                     # different (separable) axis

@@ -90,6 +90,51 @@ def _strip_dt(expr):
     return expr
 
 
+def _distribute_marker(expr, marker):
+    """
+    Distribute products over Add factors containing `marker`, so that each
+    top-level additive term carries at most one linear marker occurrence
+    (lets equations like "(a - 2*q*cos_2x)*y = 0" split into eigenvalue
+    and non-eigenvalue terms; reference expands LHS expressions before
+    matrix extraction, core/problems.py:431).
+    """
+    if not isinstance(expr, (Field, Future)) or expr is marker:
+        return expr
+    if not _contains_marker(expr, marker):
+        return expr
+    if isinstance(expr, Add):
+        return Add(*[_distribute_marker(a, marker) for a in expr.args])
+    if isinstance(expr, ScalarMultiply):
+        inner = _distribute_marker(expr.operand, marker)
+        if isinstance(inner, Add):
+            return Add(*[ScalarMultiply(expr.scalar, t) for t in inner.args])
+        return ScalarMultiply(expr.scalar, inner)
+    if isinstance(expr, MultiplyFields):
+        a, b = expr.args
+        a = _distribute_marker(a, marker)
+        b = _distribute_marker(b, marker)
+        if isinstance(a, Add) and _contains_marker(a, marker):
+            return Add(*[_distribute_marker(MultiplyFields(t, b), marker)
+                         for t in a.args])
+        if isinstance(b, Add) and _contains_marker(b, marker):
+            return Add(*[_distribute_marker(MultiplyFields(a, t), marker)
+                         for t in b.args])
+        # hoist scalar prefactors off the marker side so the linear-factor
+        # strip sees MultiplyFields(marker, X) directly (e.g. the
+        # dt = -1j*omega*A idiom builds ((-1j)*omega)*A)
+        if isinstance(a, ScalarMultiply) and _contains_marker(a, marker):
+            return ScalarMultiply(a.scalar, _distribute_marker(
+                MultiplyFields(a.operand, b), marker))
+        if isinstance(b, ScalarMultiply) and _contains_marker(b, marker):
+            return ScalarMultiply(b.scalar, _distribute_marker(
+                MultiplyFields(a, b.operand), marker))
+        return MultiplyFields(a, b)
+    if isinstance(expr, Future):
+        new_args = [_distribute_marker(arg, marker) for arg in expr.args]
+        return expr.rebuild(new_args)
+    return expr
+
+
 def _strip_linear_factor(expr, marker):
     """Remove one linear occurrence of `marker` (a constant Field) from expr."""
     if expr is marker:
@@ -315,6 +360,7 @@ class EVP(ProblemBase):
     def _build_matrix_expressions(self, lhs, rhs):
         if not (_is_scalar(rhs) and rhs == 0):
             raise UnsupportedEquationError("EVP equations must have zero RHS.")
+        lhs = _distribute_marker(lhs, self.eigenvalue)
         terms = _flatten_terms(lhs)
         m_terms, l_terms = [], []
         for term in terms:

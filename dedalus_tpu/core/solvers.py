@@ -253,6 +253,12 @@ class SolverBase:
         return (self.layout.n_groups, S)
 
     @property
+    def subproblems_by_group(self):
+        """Subproblems keyed by their group tuple (reference:
+        core/solvers.py SolverBase.subproblems_by_group)."""
+        return {sp.group: sp for sp in self.subproblems}
+
+    @property
     def pencil_dtype(self):
         """Device working dtype: 32-bit when every variable is 32-bit."""
         cplx = any(is_complex_dtype(v.dtype) for v in self.variables)
@@ -775,9 +781,14 @@ class EigenvalueSolver(SolverBase):
         self.eigenvectors = None
         self.eigenvalue_subproblem = None
 
-    def solve_dense(self, subproblem, left=False, normalize_left=True, **kw):
+    def solve_dense(self, subproblem, left=False, normalize_left=True,
+                    rebuild_matrices=False, **kw):
         """Dense generalized eigensolve for one pencil
-        (reference: core/solvers.py:180 solve_dense)."""
+        (reference: core/solvers.py:180 solve_dense). `rebuild_matrices`
+        reassembles M/L around the current NCC field data (parameter
+        continuation, e.g. the Mathieu example's q sweep)."""
+        if rebuild_matrices:
+            self._build_pencil_system()
         sp_i = subproblem.index
         L = self.ops.densify_host(self._matrices["L"], sp_i)
         M = self.ops.densify_host(self._matrices["M"], sp_i)
@@ -800,11 +811,14 @@ class EigenvalueSolver(SolverBase):
         self.eigenvalue_subproblem = subproblem
         return self.eigenvalues
 
-    def solve_sparse(self, subproblem, N, target, left=False, **kw):
+    def solve_sparse(self, subproblem, N, target, left=False,
+                     rebuild_matrices=False, **kw):
         """Sparse shift-invert eigensolve around `target`
         (reference: core/solvers.py:225 solve_sparse)."""
         from ..tools.array import scipy_sparse_eigs
         import scipy.sparse as sps
+        if rebuild_matrices:
+            self._build_pencil_system()
         sp_i = subproblem.index
         L = sps.csr_matrix(self.ops.densify_host(self._matrices["L"], sp_i))
         M = sps.csr_matrix(self.ops.densify_host(self._matrices["M"], sp_i))

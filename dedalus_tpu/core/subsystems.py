@@ -27,6 +27,55 @@ from .domain import Domain
 from ..tools.general import is_complex_dtype
 
 
+def _ncc_forced_coupled_axes(variables, equations):
+    """
+    Axes that LHS non-constant coefficients vary along: products on the
+    matrix expressions whose non-variable factor has a basis on an
+    otherwise-separable axis couple that axis's groups (the reference
+    handles this by making such subproblems non-separable, e.g. Fourier
+    NCCs in the Mathieu example).
+    """
+    from .arithmetic import ProductBase
+    from .future import Future
+    vset = set(variables)
+
+    def contains_vars(x):
+        if isinstance(x, Field):
+            return x in vset
+        if isinstance(x, Future):
+            return x.has(*vset)
+        return False
+
+    forced = set()
+
+    def walk(expr):
+        if not isinstance(expr, Future):
+            return
+        if isinstance(expr, ProductBase):
+            sides = [a for a in expr.args if isinstance(a, (Field, Future))]
+            ncc_sides = [a for a in sides if not contains_vars(a)]
+            if len(ncc_sides) == 1:
+                for axis, basis in enumerate(ncc_sides[0].domain.bases):
+                    if basis is None or basis.dim != 1:
+                        # multi-dim (curvilinear) NCC bases are handled by
+                        # the angularly-constant radial-matrix path; only
+                        # 1-D separable (Fourier) axes force coupling
+                        continue
+                    sub = axis - basis.first_axis
+                    if basis.sub_separable(sub):
+                        forced.add(axis)
+        for a in expr.args:
+            if isinstance(a, Future):
+                walk(a)
+
+    for eq in equations:
+        for key in ("M", "L"):
+            expr = eq.get(key)
+            if isinstance(expr, Future):
+                walk(expr)
+    return forced
+
+
 class PencilLayout:
     """Global pencil structure shared by all subproblems of a problem."""
 
@@ -35,13 +84,14 @@ class PencilLayout:
         dim = dist.dim
         sep_basis = [None] * dim      # (basis, sub_axis)
         coupled_basis = [None] * dim  # (basis, sub_axis)
+        self.forced_coupled = _ncc_forced_coupled_axes(variables, equations)
         domains = [v.domain for v in variables] + [eq["domain"] for eq in equations]
         for domain in domains:
             for axis, basis in enumerate(domain.bases):
                 if basis is None:
                     continue
                 sub = axis - basis.first_axis
-                if basis.sub_separable(sub):
+                if basis.sub_separable(sub) and axis not in self.forced_coupled:
                     if sep_basis[axis] is None:
                         sep_basis[axis] = (basis, sub)
                     else:

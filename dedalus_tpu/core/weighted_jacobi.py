@@ -165,7 +165,9 @@ class WeightedJacobiRadial:
         Clenshaw replaced by direct quadrature).
         """
         a0, b0 = self.alpha
-        f_radial_coeffs = np.asarray(f_radial_coeffs, dtype=np.float64)
+        f_radial_coeffs = np.asarray(f_radial_coeffs)
+        if not np.iscomplexobj(f_radial_coeffs):
+            f_radial_coeffs = f_radial_coeffs.astype(np.float64)
         Nf = f_radial_coeffs.shape[-1]
         Nq = self.Nr + Nf + self.k + int(abs(k_out)) + 32
         z = jacobi_tools.build_grid(Nq, a0 + k_out, b0 + k_out)

@@ -25,8 +25,10 @@ def sparsify(dense, cutoff=1e-14):
     """
     Convert a dense matrix to CSR, dropping entries below `cutoff` relative
     to the max magnitude. Used to recover exact band structure from
-    quadrature-built matrices.
+    quadrature-built matrices. Sparse input passes through as CSR.
     """
+    if sp.issparse(dense):
+        return dense.tocsr()
     dense = np.asarray(dense)
     scale = np.max(np.abs(dense)) if dense.size else 0.0
     if scale == 0.0:

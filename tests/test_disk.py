@@ -176,3 +176,46 @@ def test_disk_vector_poisson_lbvp():
     expect = np.array([-np.sin(phi) * ex + np.cos(phi) * ey,
                        np.cos(phi) * ex + np.sin(phi) * ey])
     assert np.abs(u["g"] - expect).max() < 1e-12
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_disk_ncc_lhs(dtype):
+    """Disk LHS NCCs (scalar, radial-vector, and contraction forms): the
+    per-(m, spin) Zernike stack path (arithmetic._disk_ncc_matrix) must
+    reproduce grid products exactly for band-limited data (the pipe-flow
+    EVP relies on w0*dz(u) and u@grad(w0) terms of these forms)."""
+    coords = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(coords, dtype=dtype)
+    disk = d3.DiskBasis(coords, shape=(16, 12), radius=1.0, dtype=dtype)
+    phi, r = dist.local_grids(disk)
+    w0 = dist.Field(name="w0", bases=disk)
+    w0["g"] = np.broadcast_to(np.asarray(1 - r ** 2),
+                              np.broadcast_shapes(phi.shape, r.shape))
+    gv = dist.VectorField(coords, name="gv", bases=disk)
+    gv["g"][1] = np.broadcast_to(np.asarray(r),
+                                 np.broadcast_shapes(phi.shape, r.shape))
+    bsrc = dist.Field(name="bsrc", bases=disk)
+    bsrc["g"] = (r * np.cos(phi)) ** 2 + r * np.sin(phi)
+    vsrc = dist.VectorField(coords, name="vsrc", bases=disk)
+    vsrc["g"][0] = r * np.cos(phi)
+    vsrc["g"][1] = r ** 2
+    b2 = dist.Field(name="b2", bases=disk)
+    u = dist.VectorField(coords, name="u", bases=disk)
+    v2 = dist.VectorField(coords, name="v2", bases=disk)
+    s2 = dist.Field(name="s2", bases=disk)
+    w2 = dist.Field(name="w2", bases=disk)
+    problem = d3.LBVP([b2, u, v2, s2, w2], namespace=locals())
+    problem.add_equation("b2 = bsrc")
+    problem.add_equation("v2 = vsrc")
+    problem.add_equation("u + gv*b2 = 0")
+    problem.add_equation("s2 - w0*b2 = 0")
+    problem.add_equation("w2 + gv@v2 = 0")
+    solver = problem.build_solver()
+    solver.solve()
+    e1 = np.abs(np.asarray(u["g"])
+                + np.asarray(gv["g"]) * np.asarray(bsrc["g"])[None]).max()
+    e2 = np.abs(np.asarray(s2["g"])
+                - np.asarray(w0["g"]) * np.asarray(bsrc["g"])).max()
+    e3 = np.abs(np.asarray(w2["g"])
+                + (np.asarray(gv["g"]) * np.asarray(vsrc["g"])).sum(0)).max()
+    assert max(e1, e2, e3) < 1e-11, (e1, e2, e3)

@@ -100,3 +100,37 @@ def test_ivp_build_evp():
     evals = np.sort(evals.real)[::-1]
     exact = -((np.arange(1, 7) * np.pi / L) ** 2)
     assert np.allclose(evals[:6], exact, rtol=1e-8)
+
+
+def test_mathieu_fourier_ncc():
+    """Periodic EVP with a Fourier-varying LHS NCC (reference:
+    examples/evp_1d_mathieu): the cos(2x) coefficient couples Fourier
+    modes, forcing the layout to treat the axis as coupled (G=1) and the
+    NCC to assemble a whole-axis convolution matrix. Characteristic
+    values at q=5 from Abramowitz & Stegun 20.
+    """
+    N = 32
+    xcoord = d3.Coordinate('x')
+    dist = d3.Distributor(xcoord, dtype=np.complex128)
+    xbasis = d3.ComplexFourier(xcoord, size=N, bounds=(0, 2 * np.pi))
+    x = dist.local_grids(xbasis)[0]
+    y = dist.Field(name='y', bases=xbasis)
+    a = dist.Field(name='a')
+    q = dist.Field(name='q')
+    cos_2x = dist.Field(name='cos_2x', bases=xbasis)
+    cos_2x['g'] = np.cos(2 * x)
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.EVP([y], eigenvalue=a, namespace=locals())
+    problem.add_equation("dx(dx(y)) + (a - 2*q*cos_2x)*y = 0")
+    solver = problem.build_solver()
+    assert solver.pencil_shape[0] == 1  # NCC coupling -> single pencil
+    # q=0: plain Fourier eigenvalues n^2 (doubly degenerate for n>0)
+    solver.solve_dense(solver.subproblems[0])
+    got0 = np.sort(solver.eigenvalues.real)[:5]
+    assert np.allclose(got0, [0, 1, 1, 4, 4], atol=1e-10)
+    # q=5: interleaved even/odd characteristic values a0 < b1 < a1 < b2
+    q['g'] = 5.0
+    solver.solve_dense(solver.subproblems[0], rebuild_matrices=True)
+    got5 = np.sort(solver.eigenvalues.real)[:4]
+    expect5 = [-5.80004602, -5.79008060, 1.85818754, 2.09946045]
+    assert np.allclose(got5, expect5, atol=1e-6), got5
