@@ -1,0 +1,28 @@
+#!/bin/bash
+# Probe the TPU tunnel; when it answers, run the benchmark progression
+# (subprocess-isolated per config) and record results. One-shot: exits
+# after a successful sweep (or after MAX_WAIT).
+cd "$(dirname "$0")/.."
+MAX_WAIT=${MAX_WAIT:-14400}
+START=$(date +%s)
+echo "[tpu_watch] start $(date)" >> benchmarks/tpu_watch.log
+while true; do
+    NOW=$(date +%s)
+    if [ $((NOW - START)) -gt "$MAX_WAIT" ]; then
+        echo "[tpu_watch] gave up after ${MAX_WAIT}s" >> benchmarks/tpu_watch.log
+        exit 1
+    fi
+    if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+(x @ x).block_until_ready()
+print('ok')
+" > /dev/null 2>&1; then
+        echo "[tpu_watch] TPU responsive at $(date); running progression" >> benchmarks/tpu_watch.log
+        timeout 7200 python benchmarks/progression.py kdv1024 rb256x64 shear512 sw_ell255 rb2048x1024 \
+            >> benchmarks/tpu_watch.log 2>&1
+        echo "[tpu_watch] progression done rc=$? at $(date)" >> benchmarks/tpu_watch.log
+        exit 0
+    fi
+    sleep 300
+done
