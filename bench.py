@@ -36,7 +36,9 @@ MEASURE = 50
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # Shared wedge-defense helpers (probe subprocess, plugin-strip env) live in
 # __graft_entry__ so bench.py and the dryrun use identical logic.
-from __graft_entry__ import _probe_devices, _strip_plugin_env  # noqa: E402
+from __graft_entry__ import (_kill_group, _probe_devices,
+                             _probe_backend_retrying,
+                             _strip_plugin_env)  # noqa: E402
 
 
 def mark(msg):
@@ -99,20 +101,24 @@ def _run_child(env, timeout, tag):
     env = dict(env)
     env["_BENCH_CHILD"] = "1"
     mark(f"running benchmark in {tag} subprocess (timeout {timeout}s)")
+    # own session + process-GROUP kill on timeout: a leaked chip-holding
+    # grandchild is the round-2 wedge; stderr streams through live (progress
+    # marks stay observable); only stdout (the JSON record) is captured
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env, stdout=subprocess.PIPE, text=True,
+                            start_new_session=True)
     try:
-        # stderr streams through live (progress marks stay observable
-        # during long compiles); only stdout (the JSON record) is captured
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
-                           stdout=subprocess.PIPE, text=True, timeout=timeout)
+        out, _ = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        _kill_group(proc)
         return None, f"{tag} child timed out after {timeout}s"
-    line = next((ln for ln in r.stdout.splitlines() if ln.startswith("{")), None)
-    if r.returncode == 0 and line:
+    line = next((ln for ln in out.splitlines() if ln.startswith("{")), None)
+    if proc.returncode == 0 and line:
         try:
             return json.loads(line), None
         except ValueError:
             return None, f"{tag} child emitted unparsable record"
-    return None, f"{tag} child rc={r.returncode}"
+    return None, f"{tag} child rc={proc.returncode}"
 
 
 def main():
@@ -123,10 +129,15 @@ def main():
 
     errors = []
     mark(f"probing backend JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r}")
-    ok, info = probe_backend(dict(os.environ))
+    # several cheap probes spread over ~5 minutes: a transiently busy chip
+    # should not forfeit the round (round-2 failure mode: two 240s probes
+    # in one wedged window -> CPU fallback recorded as the official number)
+    backend, info = _probe_backend_retrying(dict(os.environ))
+    ok = backend is not None
     if not ok:
-        mark(f"backend probe FAILED ({info}); retrying once")
-        ok, info = probe_backend(dict(os.environ))
+        info = f"device probe failed after retries: {info}"
+    else:
+        info = backend
     if ok:
         mark(f"backend probe ok: {info}")
         record, err = _run_child(os.environ, 2400, "default-backend")
@@ -136,7 +147,7 @@ def main():
         mark(f"default-backend run FAILED: {err}")
         errors.append(err)
     else:
-        mark(f"backend probe failed twice ({info}); falling back to CPU")
+        mark(f"backend probe exhausted retries ({info}); falling back to CPU")
         errors.append(f"default-backend init failed: {info}")
 
     # CPU fallback in a fresh subprocess (this process may have a half-wedged

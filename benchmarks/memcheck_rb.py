@@ -1,0 +1,141 @@
+"""
+HBM budget audit for the north-star config (RB 2048x1024, banded path).
+
+Builds the solver on CPU (f32), then accounts every persistent device
+buffer (state, histories, M/L band stores, factorization aux) with both
+its raw size and its TPU-tiled size ((8, 128) tiling of the two minor
+dims — the padding that produced round 2's OOM shapes), and runs
+jax.jit(...).lower().compile().memory_analysis() on the factor and step
+programs to bound the transient footprint.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/memcheck_rb.py [Nx Nz]
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[mem {time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def tpu_padded_bytes(shape, itemsize):
+    """Bytes under TPU (8, 128) tiling of the two minor dims."""
+    if len(shape) == 0:
+        return itemsize
+    if len(shape) == 1:
+        return int(np.ceil(shape[0] / 128)) * 128 * itemsize
+    lead = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    sub = int(np.ceil(shape[-2] / 8)) * 8
+    lane = int(np.ceil(shape[-1] / 128)) * 128
+    return lead * sub * lane * itemsize
+
+
+def fmt(nbytes):
+    return f"{nbytes / 1e9:.3f}G" if nbytes > 1e8 else f"{nbytes / 1e6:.1f}M"
+
+
+def audit_tree(name, tree, rows):
+    total = padded = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if leaf is None or not hasattr(leaf, "shape"):
+            continue
+        raw = leaf.size * leaf.dtype.itemsize
+        pad = tpu_padded_bytes(leaf.shape, leaf.dtype.itemsize)
+        total += raw
+        padded += pad
+        rows.append((f"{name}{jax.tree_util.keystr(path)}", leaf.shape,
+                     str(leaf.dtype), raw, pad))
+    return total, padded
+
+
+def main():
+    Nx = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    Nz = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    from dedalus_tpu.tools.config import config
+    config["linear algebra"]["MATRIX_SOLVER"] = "banded"
+    from __graft_entry__ import _build_rb_solver
+
+    mark(f"building RB {Nx}x{Nz} f32 banded on {jax.default_backend()}")
+    solver, b = _build_rb_solver(Nx, Nz, np.float32)
+    G, S = solver.pencil_shape
+    ops = solver.ops
+    mark(f"built: pencils (G={G}, S={S}), ops={type(ops).__name__}")
+    if hasattr(ops, "q"):
+        mark(f"structure: q={ops.q} NB={ops.NB} n_pad={ops.n_pad} "
+             f"nd={ops.nd} kl={ops.kl} ku={ops.ku} t={ops.t}")
+        mark(f"M dsel={len(solver.M_mat.dsel)} L dsel={len(solver.L_mat.dsel)}")
+
+    rows = []
+    audit_tree("X", solver.X, rows)
+    audit_tree("M", solver.M_mat, rows)
+    audit_tree("L", solver.L_mat, rows)
+
+    # factor once (RK222 path: one dt)
+    dt = 5e-5
+    ts = solver.timestepper
+    mark(f"split={ts._split}; factoring at dt={dt}")
+    t1 = time.time()
+    ts._ensure_factor(dt)
+    jax.block_until_ready(ts._lhs_aux)
+    mark(f"factor done in {time.time() - t1:.1f}s (chunks={ops._g_chunks})")
+    seen = set()
+    for i, aux in enumerate(ts._lhs_aux):
+        leaves = jax.tree_util.tree_leaves(aux)
+        key = tuple(id(x) for x in leaves)
+        if key in seen:
+            rows.append((f"aux[{i}] (aliased)", (), "-", 0, 0))
+            continue
+        seen.add(key)
+        audit_tree(f"aux[{i}]", aux, rows)
+
+    print(f"{'buffer':58s} {'shape':>24s} {'dtype':>8s} {'raw':>9s} {'tpu':>9s}")
+    tot_raw = tot_pad = 0
+    for name, shape, dt_, raw, pad in sorted(rows, key=lambda r: -r[3]):
+        tot_raw += raw
+        tot_pad += pad
+        if raw > 1e6:
+            print(f"{name:58s} {str(shape):>24s} {dt_:>8s} "
+                  f"{fmt(raw):>9s} {fmt(pad):>9s}")
+    print(f"{'TOTAL persistent':58s} {'':>24s} {'':>8s} "
+          f"{fmt(tot_raw):>9s} {fmt(tot_pad):>9s}")
+
+    # compiled-program memory analysis (CPU numbers: unpadded temps)
+    rd = solver.real_dtype
+    mark("lowering split-step programs for memory analysis")
+    M, L, X = solver.M_mat, solver.L_mat, solver.X
+    extra = solver.rhs_extra()
+
+    def analyze(name, fn, *args, **kw):
+        try:
+            c = fn.lower(*args, **kw).compile()
+            ma = c.memory_analysis()
+            print(f"program {name:20s} temp={fmt(ma.temp_size_in_bytes)} "
+                  f"out={fmt(ma.output_size_in_bytes)} "
+                  f"args={fmt(ma.argument_size_in_bytes)}")
+        except Exception as e:
+            print(f"program {name:20s} analysis failed: {type(e).__name__}: {e}")
+
+    dtj = jnp.asarray(dt, dtype=rd)
+    analyze("factor", ts._factor_uniq, M, L, dtj)
+    ti = jnp.asarray(0.0, dtype=rd)
+    analyze("stage_eval", ts._stage_eval, M, L, X, ti, extra)
+    LXi, Fi = ts._stage_eval(M, L, X, ti, extra)
+    MX0 = ts._mx0(M, X)
+    analyze("stage_solve", ts._stage_solve, 1, MX0, [Fi], [LXi], dtj,
+            ts._lhs_aux[0], M, L)
+    mark("done")
+
+
+if __name__ == "__main__":
+    main()

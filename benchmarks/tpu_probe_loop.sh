@@ -1,0 +1,15 @@
+#!/bin/bash
+# Periodically probe the TPU (cheap, in a killed-on-timeout subprocess) and
+# log when it becomes claimable. Never leaves children: timeout -k kills the
+# whole probe process group.
+LOG=/root/repo/benchmarks/tpu_probe.log
+for i in $(seq 1 200); do
+    ts=$(date +%H:%M:%S)
+    out=$(timeout -k 5 90 setsid python -c "import jax; d=jax.devices(); print('PROBE_OK', jax.default_backend(), len(d), d[0].device_kind)" 2>&1 | tail -2)
+    if echo "$out" | grep -q PROBE_OK; then
+        echo "$ts OK: $out" >> "$LOG"
+    else
+        echo "$ts FAIL: $(echo $out | tail -c 200)" >> "$LOG"
+    fi
+    sleep 60
+done

@@ -486,7 +486,18 @@ class ProductBase(Future):
             if real:
                 # joint (component, azimuth-pair) real representation; the
                 # azimuth slot IS the (cos, -sin) pair (group_shape == 2),
-                # so the pair action is absorbed into the leading factor
+                # so the pair action is absorbed into the leading factor.
+                # That leading placement is only the azimuth-pair position
+                # when no wider axis precedes the annulus in the pencil
+                # ordering (width-1 leading identities are scalars and
+                # commute through the kron).
+                wide = [ax for ax in range(az_axis)
+                        if sep_widths.get(ax, 1) != 1]
+                if wide:
+                    raise NonlinearOperatorError(
+                        "Tensor-valued polar NCCs with real dtype require "
+                        "the annulus azimuth to lead the pencil ordering "
+                        f"(axes {wide} precede it with width > 1).")
                 T = sp.csr_matrix(real_pair_matrix(C))
             else:
                 T = sp.csr_matrix(C)

@@ -303,6 +303,22 @@ class FourierBase(Basis):
                 return "matrix"
         return library
 
+    @CachedMethod
+    def _mult_forward_matrix(self, Ng):
+        """Cached dense forward MMT on the Ng-point grid: only diag(g)
+        varies between multiplication_matrix calls (e.g. the Mathieu
+        parameter sweep rebuilds per q), so the O(Ng N^2) construction is
+        paid once per (basis, Ng)."""
+        from .transforms import transform_registry
+        plan_cls = transform_registry[(type(self).__name__, "matrix")]
+        return plan_cls.build_forward(self, Ng / self.size)
+
+    @CachedMethod
+    def _mult_backward_matrix(self, Ng):
+        from .transforms import transform_registry
+        plan_cls = transform_registry[(type(self).__name__, "matrix")]
+        return plan_cls.build_backward(self, Ng / self.size)
+
     def multiplication_matrix(self, ncc_coeffs, ncc_basis=None):
         """
         Coefficient-space matrix multiplying by the function with
@@ -313,17 +329,11 @@ class FourierBase(Basis):
         grid) . backward on a 2x-oversampled common grid (alias-free for
         products of two resolved functions).
         """
-        from .transforms import transform_registry
         ncc_basis = ncc_basis or self
-        plan_cls = transform_registry[(type(self).__name__, "matrix")]
         Ng = 2 * max(self.size, ncc_basis.size)
-        F = plan_cls.build_forward(self, Ng / self.size)
-        B = plan_cls.build_backward(self, Ng / self.size)
-        if ncc_basis is self:
-            B_ncc = B
-        else:
-            ncc_cls = transform_registry[(type(ncc_basis).__name__, "matrix")]
-            B_ncc = ncc_cls.build_backward(ncc_basis, Ng / ncc_basis.size)
+        F = self._mult_forward_matrix(Ng)
+        B = self._mult_backward_matrix(Ng)
+        B_ncc = B if ncc_basis is self else ncc_basis._mult_backward_matrix(Ng)
         g = B_ncc @ np.asarray(ncc_coeffs)
         return F @ (g[:, None] * B)
 

@@ -387,6 +387,7 @@ class RungeKuttaIMEX:
         def _factor(M, L, dt):
             auxs = _factor_uniq(M, L, dt)
             return [auxs[j] for j in stage_slot]
+        self._factor_uniq = _factor_uniq
 
         # the fused step body composes the same per-stage pieces the split
         # mode dispatches separately, so the numerics cannot drift

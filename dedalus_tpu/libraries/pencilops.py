@@ -367,20 +367,31 @@ class BandedOps:
         return jnp.moveaxis(x, 0, 1).reshape(G, self.n_pad, k)
 
     def _pick_chunks(self, G, itemsize):
-        """Number of G-chunks for factorization: smallest divisor of G
+        """(C, Gc): chunk count and width for the G-chunked factorization,
         keeping a chunk's persistent factor slab (panelLU + U12) under
         BANDED_CHUNK_MB (the observed XLA temp footprint is a small
-        multiple of that slab)."""
+        multiple of that slab). When C*Gc > G (e.g. prime G) the batch is
+        edge-padded with copies of the last group — factoring a duplicate
+        is well-conditioned and its results are trimmed — so chunking
+        never degenerates to size-1 sequential chunks."""
         target = float(config["linear algebra"].get(
             "BANDED_CHUNK_MB", "256")) * 1e6
         per_g = self.NB * (2 * self.q * self.q) * 2 * itemsize
-        want = int(np.ceil(G * per_g / max(target, 1e6)))
-        if want <= 1:
-            return 1
-        for d in range(1, G + 1):
-            if G % d == 0 and d >= want:
-                return d
-        return G
+        Gc = int(max(1, min(G, target // max(per_g, 1))))
+        C = -(-G // Gc)
+        if C <= 1:
+            return 1, G
+        Gc = -(-G // C)  # rebalance: padding stays below one chunk width
+        return C, Gc
+
+    @staticmethod
+    def _pad_groups(arr, G_pad):
+        """Edge-pad the leading (group) axis to G_pad."""
+        pad = G_pad - arr.shape[0]
+        if pad <= 0:
+            return arr
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        return jnp.pad(arr, widths, mode="edge")
 
     def _factor_core(self, bands, Vt):
         """Factor one full-lattice band slab (any leading batch size).
@@ -423,13 +434,15 @@ class BandedOps:
         """Shared factorization body; refine_aux supplies the residual
         matvec without persisting a combined matrix."""
         G = bands.shape[0]
-        C = self._g_chunks = self._pick_chunks(G, bands.dtype.itemsize)
+        C, Gc = self._pick_chunks(G, bands.dtype.itemsize)
+        self._g_chunks = C
         if C == 1:
             core = self._factor_core(bands, Vt)
         else:
-            Gc = G // C
-            bands_c = bands.reshape(C, Gc, self.nd, self.n_pad)
-            Vt_c = Vt.reshape(C, Gc, Vt.shape[1], self.n_pad)
+            bands_c = self._pad_groups(bands, C * Gc).reshape(
+                C, Gc, self.nd, self.n_pad)
+            Vt_c = self._pad_groups(Vt, C * Gc).reshape(
+                C, Gc, Vt.shape[1], self.n_pad)
             core = jax.lax.map(lambda xs: self._factor_core(*xs),
                                (bands_c, Vt_c))
         return self._aux_from_core(core, refine_aux)
@@ -447,7 +460,8 @@ class BandedOps:
         at large S)."""
         G = M.bands.shape[0]
         dtype = M.bands.dtype
-        C = self._g_chunks = self._pick_chunks(G, dtype.itemsize)
+        C, Gc = self._pick_chunks(G, dtype.itemsize)
+        self._g_chunks = C
         dM = np.asarray(M.dsel)
         dL = np.asarray(L.dsel)
 
@@ -469,15 +483,17 @@ class BandedOps:
             bands, Vt = combine(M.bands, L.bands, M.Vt, L.Vt, G)
             core = self._factor_core(bands, Vt)
         else:
-            Gc = G // C
+            G_pad = C * Gc
             has_mv = M.Vt is not None
             has_lv = L.Vt is not None
-            xs = [M.bands.reshape(C, Gc, -1, self.n_pad),
-                  L.bands.reshape(C, Gc, -1, self.n_pad)]
+            xs = [self._pad_groups(M.bands, G_pad).reshape(C, Gc, -1, self.n_pad),
+                  self._pad_groups(L.bands, G_pad).reshape(C, Gc, -1, self.n_pad)]
             if has_mv:
-                xs.append(M.Vt.reshape(C, Gc, self.t, self.n_pad))
+                xs.append(self._pad_groups(M.Vt, G_pad).reshape(
+                    C, Gc, self.t, self.n_pad))
             if has_lv:
-                xs.append(L.Vt.reshape(C, Gc, self.t, self.n_pad))
+                xs.append(self._pad_groups(L.Vt, G_pad).reshape(
+                    C, Gc, self.t, self.n_pad))
 
             def one(xs):
                 mb, lb = xs[0], xs[1]
@@ -508,6 +524,7 @@ class BandedOps:
         return y
 
     def _solve_once(self, aux, rhs):
+        G = rhs.shape[0]
         fp = rhs[:, self.row_perm]
         fp = jnp.pad(fp, ((0, 0), (0, self.n_pad - self.n)))
         # chunking is read off the aux's own stacked shapes (lastLU is
@@ -518,12 +535,13 @@ class BandedOps:
         if C == 1:
             y = self._solve_core(aux, fp)
         else:
-            Gc = fp.shape[0] // C
+            Gc = lastLU.shape[1]
+            fp = self._pad_groups(fp, C * Gc)   # match factor-time padding
             auxc = {k: aux[k] for k in ("interior", "Vt", "YbT", "Cap")
                     if k in aux}
             y = jax.lax.map(lambda xs: self._solve_core(xs[0], xs[1]),
                             (auxc, fp.reshape(C, Gc, self.n_pad)))
-            y = y.reshape(-1, self.n_pad)
+            y = y.reshape(-1, self.n_pad)[:G]
         xp = y[:, :self.n]
         return xp[:, self.pos_col]
 

@@ -178,6 +178,34 @@ def test_rb_banded_chunked_matches_dense(timestepper):
     assert np.abs(Xd - Xb).max() < 1e-11
 
 
+def test_rb_banded_chunk_padding_matches_dense():
+    """Group counts with no convenient divisor edge-pad the chunked batch
+    (C*Gc > G) instead of degenerating to size-1 sequential chunks."""
+    from dedalus_tpu.tools.config import config
+    sd = build_rb(14, 64)
+    sb0 = build_rb(14, 64, matsolver="banded")
+    ops = sb0.ops
+    G = sb0.pencil_shape[0]
+    assert G % 2 == 1, "want an odd group count to force padding"
+    # target exactly two groups per chunk -> C = ceil(G/2), G_pad = C*2 > G
+    per_g = ops.NB * 2 * ops.q * ops.q * 2 * np.dtype(sb0.pencil_dtype).itemsize
+    old = config["linear algebra"].get("BANDED_CHUNK_MB")
+    # 2.05x margin: the /1e6 str round-trip must not land below 2*per_g
+    config["linear algebra"]["BANDED_CHUNK_MB"] = str(2.05 * per_g / 1e6)
+    try:
+        sb = build_rb(14, 64, matsolver="banded")
+        for _ in range(5):
+            sd.step(0.01)
+            sb.step(0.01)
+        C = sb.ops._g_chunks
+        assert C > 1 and G % C != 0, f"padding path not engaged (G={G}, C={C})"
+    finally:
+        config["linear algebra"]["BANDED_CHUNK_MB"] = old
+    Xd, Xb = np.asarray(sd.X), np.asarray(sb.X)
+    assert np.isfinite(Xd).all()
+    assert np.abs(Xd - Xb).max() < 1e-11
+
+
 def test_lbvp_banded_chunked_matches_dense():
     """factor()/solve() (LBVP path) under forced chunking."""
     from dedalus_tpu.tools.config import config
