@@ -1,0 +1,161 @@
+"""
+f32-vs-f64 accuracy study (BASELINE.md demands "identical spectral
+convergence"; the TPU path runs f32, the CPU reference f64 — this script
+prices that dtype change independently of hardware, on one backend).
+
+Cases:
+  1. Heat-equation decay vs EXACT solution at f64 and f32 (spectral +
+     temporal convergence: the error floor shows the dtype's accuracy
+     ceiling, the dt-sweep shows when truncation dominates roundoff).
+  2. KdV-Burgers soliton: f32 state vs f64 state over 1000 steps
+     (nonlinear cascade sensitivity), plus mass conservation drift.
+  3. RB 256x64: f32 vs f64 buoyancy field over 500 steps from identical
+     initial conditions; max relative state divergence and the total
+     kinetic-energy trace difference.
+
+Emits one JSON line per case (appended to benchmarks/results.jsonl by
+--record) and a markdown table on stdout for BENCHMARKS.md.
+
+Run: python benchmarks/accuracy_f32.py [--record]
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("ACC_PLATFORM", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+if os.environ.get("ACC_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+T0 = time.time()
+RESULTS = []
+
+
+def mark(msg):
+    print(f"[acc {time.time() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def heat_decay_error(dtype, N=64, dt_=1e-3, steps=200, k=3):
+    """Max error vs exact exp(-k^2 t) decay (RK443)."""
+    import dedalus_tpu.public as d3
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=dtype)
+    xb = d3.RealFourier(xc, size=N, bounds=(0, 2 * np.pi), dealias=3 / 2)
+    u = dist.Field(name="u", bases=xb)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) = 0")
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(k * x).astype(dtype)
+    solver = problem.build_solver(d3.RK443)
+    for _ in range(steps):
+        solver.step(dt_)
+    exact = np.sin(k * x) * np.exp(-k * k * solver.sim_time)
+    return float(np.abs(np.asarray(u["g"]) - exact).max())
+
+
+def kdv_divergence(N=256, steps=1000, dt_=2e-3):
+    """f32 vs f64 KdV-Burgers state divergence + mass drift."""
+    import dedalus_tpu.public as d3
+
+    def run(dtype):
+        xc = d3.Coordinate("x")
+        dist = d3.Distributor(xc, dtype=dtype)
+        xb = d3.RealFourier(xc, size=N, bounds=(0, 10), dealias=3 / 2)
+        u = dist.Field(name="u", bases=xb)
+        a, bb = 1e-4, 2e-4
+        dx = lambda A: d3.Differentiate(A, xc)
+        problem = d3.IVP([u], namespace=locals())
+        problem.add_equation(
+            "dt(u) - a*dx(dx(u)) - bb*dx(dx(dx(u))) = - u*dx(u)")
+        solver = problem.build_solver(d3.SBDF2)
+        x = dist.local_grids(xb)[0]
+        n = 20
+        u["g"] = (np.log(1 + np.cosh(n) ** 2 / np.cosh(n * (x - 3)) ** 2)
+                  / (2 * n)).astype(dtype)
+        m0 = float(np.sum(np.asarray(u["g"], dtype=np.float64)))
+        for _ in range(steps):
+            solver.step(dt_)
+        g = np.asarray(u["g"], dtype=np.float64)
+        m1 = float(np.sum(g))
+        return g, abs(m1 - m0) / abs(m0)
+
+    g64, drift64 = run(np.float64)
+    g32, drift32 = run(np.float32)
+    scale = np.abs(g64).max()
+    return float(np.abs(g64 - g32).max() / scale), drift64, drift32
+
+
+def rb_divergence(Nx=256, Nz=64, steps=500, dt=0.01):
+    """f32 vs f64 RB buoyancy divergence + KE-trace difference."""
+    from __graft_entry__ import _build_rb_solver
+    import dedalus_tpu.public as d3
+
+    def run(dtype):
+        solver, b = _build_rb_solver(Nx, Nz, dtype)
+        u = solver.problem.namespace["u"] if hasattr(solver.problem, "namespace") else None
+        ke = []
+        for i in range(steps):
+            solver.step(dt)
+        bg = np.asarray(b["g"], dtype=np.float64)
+        X = np.asarray(solver.X, dtype=np.float64)
+        return bg, X
+
+    b64, X64 = run(np.float64)
+    b32, X32 = run(np.float32)
+    bscale = np.abs(b64).max()
+    Xscale = np.abs(X64).max()
+    return (float(np.abs(b64 - b32).max() / bscale),
+            float(np.abs(X64 - X32).max() / Xscale))
+
+
+def main():
+    record = "--record" in sys.argv
+    rows = []
+
+    mark("heat decay f64/f32")
+    e64 = heat_decay_error(np.float64)
+    e32 = heat_decay_error(np.float32)
+    rows.append(("heat decay vs exact (RK443, 200 steps)", e64, e32))
+    RESULTS.append({"case": "accuracy_heat_exact", "err_f64": e64,
+                    "err_f32": e32})
+
+    mark("kdv divergence (1000 steps)")
+    div, drift64, drift32 = kdv_divergence()
+    rows.append(("KdV f32-vs-f64 state (rel, 1000 steps)", "-", div))
+    rows.append(("KdV mass drift (rel)", drift64, drift32))
+    RESULTS.append({"case": "accuracy_kdv", "state_rel_div_f32": div,
+                    "mass_drift_f64": drift64, "mass_drift_f32": drift32})
+
+    mark("RB 256x64 divergence (500 steps)")
+    bdiv, xdiv = rb_divergence()
+    rows.append(("RB 256x64 f32-vs-f64 buoyancy (rel, 500 steps)", "-", bdiv))
+    rows.append(("RB 256x64 f32-vs-f64 state (rel)", "-", xdiv))
+    RESULTS.append({"case": "accuracy_rb256", "b_rel_div_f32": bdiv,
+                    "state_rel_div_f32": xdiv})
+
+    print("\n| Case | f64 | f32 |")
+    print("|---|---|---|")
+    for name, a, b in rows:
+        fa = a if isinstance(a, str) else f"{a:.2e}"
+        fb = b if isinstance(b, str) else f"{b:.2e}"
+        print(f"| {name} | {fa} | {fb} |")
+    for r in RESULTS:
+        r["backend"] = jax.default_backend()
+        print(json.dumps(r))
+    if record:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "results.jsonl")
+        with open(path, "a") as f:
+            for r in RESULTS:
+                f.write(json.dumps(r) + "\n")
+    mark("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""
+Program-size audit for sw_ell255 (BASELINE config 4): round 2's TPU attempt
+died with HTTP 413 (remote-compile request body over the transport limit)
+before RESOURCE_EXHAUSTED wedged the chip. This measures the lowered MLIR
+text size of every device program the split step dispatches, so the
+constant-lifting (tools/jitlift) can be verified to keep each program under
+the transport limit (~10 MB observed OK, sw previously exceeded it).
+
+Run: python benchmarks/progsize_sw.py [Nphi Ntheta]
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[size {time.time() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    Nphi = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    Ntheta = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    from benchmarks.progression import build_shallow_water
+    mark(f"building shallow water {Nphi}x{Ntheta} f32")
+    solver, dt = build_shallow_water(Nphi, Ntheta, np.float32)
+    G, S = solver.pencil_shape
+    mark(f"built; pencils (G={G}, S={S}), ops={type(solver.ops).__name__}, "
+         f"split={solver.timestepper._split}")
+    ts = solver.timestepper
+    rd = solver.real_dtype
+    dtj = jnp.asarray(dt, dtype=rd)
+    M, L, X = solver.M_mat, solver.L_mat, solver.X
+    extra = solver.rhs_extra()
+
+    def size_of(name, lowered):
+        txt = lowered.as_text()
+        mb = len(txt.encode()) / 1e6
+        print(f"program {name:12s} lowered MLIR {mb:8.2f} MB")
+        return mb
+
+    total = 0
+    total += size_of("factor", ts._factor_uniq.lower(M, L, dtj))
+    ti = jnp.asarray(0.0, dtype=rd)
+    total += size_of("stage_eval", ts._stage_eval.lower(M, L, X, ti, extra))
+    mark("running one stage_eval to build solve inputs")
+    LXi, Fi = ts._stage_eval(M, L, X, ti, extra)
+    MX0 = ts._mx0(M, X)
+    ts._ensure_factor(dt)
+    total += size_of("stage_solve", ts._stage_solve.lower(
+        1, MX0, [Fi], [LXi], dtj, ts._lhs_aux[0], M, L))
+    print(f"TOTAL split-step programs: {total:.2f} MB "
+          f"(remote-compile transport limit ~10 MB each)")
+    # the FUSED programs (what the bench dispatches when split=False)
+    t0 = jnp.asarray(0.0, dtype=rd)
+    size_of("step(fused)", ts._step.lower(M, L, X, t0, dtj, extra,
+                                          ts._lhs_aux))
+    size_of("step_n(50)", ts._step_n.lower(M, L, X, t0, dtj, extra,
+                                           ts._lhs_aux, 50))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,9 +27,32 @@ class CoordinateSystem:
     def first_axis(self):
         return self.coords[0].axis
 
+    @property
+    def _cache_token(self):
+        """Interning key for CachedClass arguments (tools/cache.serialize):
+        name-equality PLUS the distributor-assigned axes, so equal-named
+        systems at different axis positions (a standalone disk vs a
+        cylinder's disk factor) never alias cached bases."""
+        return (type(self).__name__, self.names,
+                tuple(getattr(c, "axis", None) for c in self.coords))
+
     def set_distributor(self, dist):
+        self.dist = dist
         for coord in self.coords:
             coord.dist = dist
+
+    def unit_vector_fields(self, dist):
+        """Constant component-space unit vector fields e_1 .. e_dim (for
+        curvilinear components: constant in component representation,
+        position-dependent in the embedding)."""
+        fields = []
+        for i, name in enumerate(self.names):
+            ei = dist.VectorField(self, name=f"e{name}")
+            data = np.zeros(self.dim)
+            data[i] = 1.0
+            ei["g"] = data.reshape((self.dim,) + (1,) * dist.dim)
+            fields.append(ei)
+        return tuple(fields)
 
 
 class Coordinate(CoordinateSystem):
@@ -54,6 +77,14 @@ class Coordinate(CoordinateSystem):
     def __hash__(self):
         return hash(("Coordinate", self.name))
 
+    @property
+    def _cache_token(self):
+        # mirror __eq__ (name + owning system) plus the assigned axis
+        cs_token = None
+        if self.cs is not None:
+            cs_token = (type(self.cs).__name__, tuple(self.cs.names))
+        return ("Coordinate", self.name, getattr(self, "axis", None), cs_token)
+
     def set_distributor(self, dist):
         self.dist = dist
 
@@ -76,22 +107,6 @@ class CartesianCoordinates(CoordinateSystem):
     def __repr__(self):
         return f"CartesianCoordinates{self.names}"
 
-    def set_distributor(self, dist):
-        self.dist = dist
-        for coord in self.coords:
-            coord.dist = dist
-
-    def unit_vector_fields(self, dist):
-        """Constant unit vector fields e_1 .. e_dim (reference API)."""
-        fields = []
-        for i, name in enumerate(self.names):
-            ei = dist.VectorField(self, name=f"e{name}")
-            data = np.zeros(self.dim)
-            data[i] = 1.0
-            ei["g"] = data.reshape((self.dim,) + (1,) * dist.dim)
-            fields.append(ei)
-        return tuple(fields)
-
 
 class AzimuthalCoordinate(Coordinate):
     """Periodic azimuthal coordinate of a curvilinear system
@@ -101,11 +116,6 @@ class AzimuthalCoordinate(Coordinate):
 class CurvilinearCoordinateSystem(CoordinateSystem):
     """Base for curvilinear systems: defines spin/regularity intertwiners
     (reference: core/coords.py CurvilinearCoordinateSystem)."""
-
-    def set_distributor(self, dist):
-        self.dist = dist
-        for coord in self.coords:
-            coord.dist = dist
 
     def spin_weights(self, indices):
         """Total spin weight of a flat tensor-component index tuple."""
@@ -117,6 +127,115 @@ def _nkron(U, order):
     for _ in range(order):
         out = np.kron(out, U)
     return out
+
+
+class DirectProduct(CoordinateSystem):
+    """
+    Direct product of coordinate systems — the cylinder geometry's
+    coordinate container, e.g. DirectProduct(Coordinate('z'),
+    PolarCoordinates('phi', 'r')) (reference: core/coords.py:99
+    DirectProduct).
+
+    Tensor components over the product concatenate the sub-systems'
+    components in order; the coordinate->spin intertwiner is the block
+    diagonal of the sub-systems' intertwiners (identity on non-curvilinear
+    blocks), so e.g. a cylinder vector stores (z, spin-, spin+) components
+    in coefficient space.
+    """
+
+    def __init__(self, *coordsystems, right_handed=None):
+        self.coordsystems = tuple(coordsystems)
+        coords = []
+        for cs in coordsystems:
+            coords.extend(cs.coords)
+        names = tuple(c.name for c in coords)
+        if len(set(names)) != len(names):
+            raise ValueError("Cannot repeat coordinate names in DirectProduct.")
+        self.coords = tuple(coords)
+        self.names = names
+        self.dim = sum(cs.dim for cs in coordsystems)
+        if right_handed is None:
+            # 3D products with a curvilinear factor default left-handed
+            # (z, phi, r ordering), matching the reference convention
+            right_handed = not (self.dim == 3 and self.curvilinear)
+        self.right_handed = right_handed
+        self.dist = None
+
+    def __repr__(self):
+        return f"DirectProduct{self.names}"
+
+    def __eq__(self, other):
+        # structural: same factor systems in the same order (name-only
+        # equality would alias distinct products with matching flattened
+        # names and poison the lru-cached intertwiners)
+        return (isinstance(other, DirectProduct)
+                and self.coordsystems == other.coordsystems)
+
+    def __hash__(self):
+        return hash(("DirectProduct",)
+                    + tuple((type(cs).__name__,) + tuple(cs.names)
+                            for cs in self.coordsystems))
+
+    @property
+    def _cache_token(self):
+        # structural (per-factor tokens) + assigned axes
+        return ("DirectProduct",
+                tuple(cs._cache_token for cs in self.coordsystems))
+
+    @property
+    def curvilinear(self):
+        return any(isinstance(cs, CurvilinearCoordinateSystem)
+                   for cs in self.coordsystems)
+
+    @property
+    def spin_ordering(self):
+        """Concatenated spin labels of the product's spin components
+        (zeros on non-curvilinear blocks)."""
+        out = []
+        for cs in self.coordsystems:
+            sub = getattr(cs, "spin_ordering", None)
+            out.extend(sub if sub is not None else (0,) * cs.dim)
+        return tuple(out)
+
+    def set_distributor(self, dist):
+        self.dist = dist
+        for cs in self.coordsystems:
+            cs.set_distributor(dist)
+
+    def sub_slice(self, sub_cs):
+        """Component slice of one factor inside the product's component
+        space (by coordinate-system equality)."""
+        start = 0
+        for cs in self.coordsystems:
+            if cs == sub_cs:
+                return slice(start, start + cs.dim)
+            start += cs.dim
+        raise ValueError(f"{sub_cs} is not a factor of {self}.")
+
+    def curvilinear_sub(self):
+        """The (single) curvilinear factor, or None."""
+        subs = [cs for cs in self.coordsystems
+                if isinstance(cs, CurvilinearCoordinateSystem)]
+        if len(subs) > 1:
+            raise NotImplementedError(
+                "Products of multiple curvilinear systems.")
+        return subs[0] if subs else None
+
+    def U_forward(self, order=1):
+        """Block-diagonal coordinate->spin unitary over the product
+        components (kron over tensor order)."""
+        import scipy.linalg
+        blocks = []
+        for cs in self.coordsystems:
+            if hasattr(cs, "U_forward"):
+                blocks.append(cs.U_forward(1))
+            else:
+                blocks.append(np.eye(cs.dim))
+        U = scipy.linalg.block_diag(*blocks)
+        return _nkron(U, order)
+
+    def U_backward(self, order=1):
+        return self.U_forward(order).T.conj()
 
 
 class PolarCoordinates(CurvilinearCoordinateSystem):

@@ -27,6 +27,22 @@ from ..tools.array import match_precision
 PAIR_J = np.array([[0.0, -1.0], [1.0, 0.0]])
 
 
+def _entry_spins(tcs, cs):
+    """Spin labels of one tensor index's components w.r.t. basis cs:
+    the index's own ordering when it rotates with cs, per-factor labels
+    for DirectProduct indices (zeros on non-matching factors), zeros
+    otherwise."""
+    if _cs_match(tcs, cs):
+        return np.array(tcs.spin_ordering)
+    subs = getattr(tcs, "coordsystems", None)
+    if subs is not None:
+        return np.concatenate([
+            np.array(sub.spin_ordering) if _cs_match(sub, cs)
+            else np.zeros(sub.dim, dtype=int)
+            for sub in subs])
+    return np.zeros(tcs.dim, dtype=int)
+
+
 def component_spins(tensorsig, cs):
     """
     Total spin weight per flattened tensor component, counting only indices
@@ -35,10 +51,7 @@ def component_spins(tensorsig, cs):
     """
     spins = [np.zeros(1, dtype=int)]
     for tcs in tensorsig:
-        if _cs_match(tcs, cs):
-            s = np.array(tcs.spin_ordering)
-        else:
-            s = np.zeros(tcs.dim, dtype=int)
+        s = _entry_spins(tcs, cs)
         spins = [np.add.outer(sp, s).ravel() for sp in spins]
     return spins[0]
 
@@ -62,14 +75,22 @@ import functools
 @functools.lru_cache(maxsize=None)
 def recombination_matrix(tensorsig, cs):
     """Complex unitary (ncomp, ncomp): coordinate -> spin components, kron
-    over tensor indices (identity on non-curvilinear indices). Cached so
-    downstream device-constant interning sees stable objects."""
+    over tensor indices (identity on non-curvilinear indices; block
+    diagonal on DirectProduct indices, rotating only the factor matching
+    `cs`). Cached so downstream device-constant interning sees stable
+    objects."""
+    import scipy.linalg
     U = np.array([[1.0]])
     for tcs in tensorsig:
         if _cs_match(tcs, cs):
-            U = np.kron(U, tcs.U_forward(1))
+            Ui = tcs.U_forward(1)
+        elif getattr(tcs, "coordsystems", None) is not None:
+            Ui = scipy.linalg.block_diag(*[
+                sub.U_forward(1) if _cs_match(sub, cs) else np.eye(sub.dim)
+                for sub in tcs.coordsystems])
         else:
-            U = np.kron(U, np.eye(tcs.dim))
+            Ui = np.eye(tcs.dim)
+        U = np.kron(U, Ui)
     return U
 
 

@@ -1,0 +1,377 @@
+"""
+Cylinder calculus: vector operators over DirectProduct coordinate systems
+(Coordinate/Cartesian factors x PolarCoordinates), covering periodic
+cylinders (Fourier x disk) and cylindrical annuli (Fourier x annulus)
+(reference: core/coords.py:99 DirectProduct; core/operators.py:2384
+DirectProduct operator subclasses; tests/test_cylinder_calculus.py).
+
+Component convention: the product's tensor components concatenate the
+factors' components in order, with the polar factor stored as spin (-, +)
+components in coefficient space (curvilinear.recombination_matrix applies
+the block-diagonal intertwiner inside the disk transforms). The straight
+factors' components carry spin 0.
+
+Operator structure: every term is either
+  * a straight-axis derivative (separable Fourier differentiation blocks)
+    paired with a radial k -> k+1 conversion stack so all terms land on the
+    disk's derivative basis, or
+  * a polar ladder/Laplacian stack, exactly as the 2D polar operators.
+Curl uses the standard embedding (right-handed x, y, z) orientation, the
+convention the reference's cylinder tests check.
+"""
+
+import numpy as np
+
+from .coords import CurvilinearCoordinateSystem, DirectProduct
+from .curvilinear import SpinBasisMixin, component_spins
+from .operators import LinearOperator, _diff_descr
+from .polar import SPIN_INDEX, _expand_complex_terms
+
+__all__ = ["CylinderGradient", "CylinderDivergence", "CylinderLaplacian",
+           "CylinderCurl"]
+
+
+def _cyl_parts(operand, dp):
+    """
+    Decompose a DirectProduct operand: returns (polar_cs, spin_basis,
+    straight, pol_off) with `straight` = [(comp_offset, coord, axis,
+    basis_or_None)] for the non-curvilinear factors' coordinates and
+    `pol_off` the polar factor's component offset.
+    """
+    polar = dp.curvilinear_sub()
+    if polar is None:
+        raise ValueError("DirectProduct calculus requires a curvilinear factor.")
+    disk = None
+    for b in operand.domain.bases:
+        if isinstance(b, SpinBasisMixin) and b.cs == polar:
+            disk = b
+    if disk is None:
+        # Polar-constant operand (e.g. a z-only background profile):
+        # gradients/Laplacians reduce to straight derivatives as long as no
+        # tensor index couples to the polar factor (a constant-COMPONENT
+        # polar vector is not a constant vector field — its covariant
+        # derivatives need the basis).
+        if any(np.any(_entry_spins_any(tcs, polar))
+               or _touches(tcs, polar)
+               for tcs in operand.tensorsig):
+            raise ValueError(
+                "DirectProduct operand with polar tensor components has no "
+                "basis on the polar factor (covariant derivatives of "
+                "constant-component polar vectors are not representable).")
+    straight = []
+    off = 0
+    for cs in dp.coordsystems:
+        if not isinstance(cs, CurvilinearCoordinateSystem):
+            for j, coord in enumerate(cs.coords):
+                axis = operand.dist.get_axis(coord)
+                basis = operand.domain.bases[axis]
+                if basis is not None and not basis.separable:
+                    raise NotImplementedError(
+                        "DirectProduct calculus requires separable (Fourier) "
+                        "bases on the straight factors (a coupled straight "
+                        "axis would need two-coupled-axis pencils).")
+                straight.append((off + j, coord, axis, basis))
+        off += cs.dim
+    pol_off = dp.sub_slice(polar).start
+    return polar, disk, straight, pol_off
+
+
+def _touches(tcs, polar):
+    """Whether a tensor index couples to the polar factor (directly, or as
+    a factor of a DirectProduct index)."""
+    from .curvilinear import _cs_match
+    if _cs_match(tcs, polar):
+        return True
+    subs = getattr(tcs, "coordsystems", None)
+    return subs is not None and any(_cs_match(sub, polar) for sub in subs)
+
+
+def _entry_spins_any(tcs, polar):
+    from .curvilinear import _entry_spins
+    return _entry_spins(tcs, polar)
+
+
+def _conv_descr(disk, az, s, dk):
+    """Radial k -> k+dk conversion descriptor: per-m spin stacks on the
+    disk (Zernike), one spin-independent matrix on the annulus; None when
+    the operand has no polar basis (polar-constant fields)."""
+    if dk == 0 or disk is None:
+        return None
+    if hasattr(disk, "conversion_stack"):
+        return ("gblocks", az, disk.conversion_stack(int(s), dk))
+    return ("full", disk._conversion_matrix_total(dk))
+
+
+class CylinderOperator(LinearOperator):
+    """Base for DirectProduct (cylinder) calculus operators."""
+
+    def _parts(self, operand=None):
+        return _cyl_parts(operand or self.operand, self._dp())
+
+    def _dp(self):
+        raise NotImplementedError
+
+
+class CylinderGradient(CylinderOperator):
+    """Covariant gradient on the product: straight components are plain
+    derivatives (with radial k -> k+1 conversion); polar components map
+    through the D_{+-} spin ladders (reference: core/operators.py:2384
+    Gradient on DirectProduct)."""
+
+    name = "Grad"
+
+    def __init__(self, operand, cs):
+        self.cs = cs
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return CylinderGradient(new_args[0], self.cs)
+
+    def _dp(self):
+        return self.cs
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        _, disk, _, _ = _cyl_parts(operand, self.cs)
+        self.domain = (operand.domain if disk is None else
+                       operand.domain.substitute_basis(
+                           disk, disk.derivative_basis(1)))
+        self.tensorsig = (self.cs,) + tuple(operand.tensorsig)
+        self.dtype = operand.dtype
+
+    def terms(self):
+        operand = self.operand
+        polar, disk, straight, pol_off = self._parts()
+        az = disk.first_axis if disk is not None else None
+        rad = None if az is None else az + 1
+        spins = component_spins(operand.tensorsig, polar)
+        ncomp = len(spins)
+        dim = operand.domain.dim
+        D = self.cs.dim
+        terms = []
+        for off_c, coord, axis, basis in straight:
+            if basis is None:
+                continue  # derivative of a constant axis
+            for s in np.unique(spins):
+                sel = np.zeros((D * ncomp, ncomp))
+                for c in np.flatnonzero(spins == s):
+                    sel[off_c * ncomp + c, c] = 1.0
+                descrs = [None] * dim
+                descrs[axis] = _diff_descr(basis)
+                if disk is not None:
+                    descrs[rad] = _conv_descr(disk, az, s, 1)
+                terms.append((sel, descrs))
+        if disk is None:
+            return terms   # polar-constant operand: ladder rows are zero
+        for sigma, ds in ((0, -1), (1, +1)):
+            for s in np.unique(spins):
+                sel = np.zeros((D * ncomp, ncomp))
+                for c in np.flatnonzero(spins == s):
+                    sel[(pol_off + sigma) * ncomp + c, c] = 1.0
+                descrs = [None] * dim
+                descrs[rad] = ("gblocks", az, disk.ladder_stack(int(s), ds))
+                terms.append((sel, descrs))
+        return terms
+
+
+class CylinderDivergence(CylinderOperator):
+    """div u = sum_c d_c u_c + D_+ u_- + D_- u_+ over the leading product
+    index (reference: core/operators.py:3385 Divergence)."""
+
+    name = "Div"
+
+    def __init__(self, operand, index=0):
+        if index != 0:
+            raise NotImplementedError("Divergence only supports index=0.")
+        self.cs = operand.tensorsig[0]
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return CylinderDivergence(new_args[0])
+
+    def _dp(self):
+        return self.cs
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        _, disk, _, _ = _cyl_parts(operand, self.cs)
+        self.domain = operand.domain.substitute_basis(
+            disk, disk.derivative_basis(1))
+        self.tensorsig = tuple(operand.tensorsig[1:])
+        self.dtype = operand.dtype
+
+    def terms(self):
+        operand = self.operand
+        polar, disk, straight, pol_off = self._parts()
+        az = disk.first_axis
+        rad = az + 1
+        rest_sig = operand.tensorsig[1:]
+        rest_spins = component_spins(rest_sig, polar)
+        nrest = len(rest_spins)
+        dim = operand.domain.dim
+        D = self.cs.dim
+        terms = []
+        for off_c, coord, axis, basis in straight:
+            if basis is None:
+                continue
+            for s in np.unique(rest_spins):
+                sel = np.zeros((nrest, D * nrest))
+                for c in np.flatnonzero(rest_spins == s):
+                    sel[c, off_c * nrest + c] = 1.0
+                descrs = [None] * dim
+                descrs[axis] = _diff_descr(basis)
+                descrs[rad] = _conv_descr(disk, az, s, 1)
+                terms.append((sel, descrs))
+        for sigma, sspin in ((0, -1), (1, +1)):
+            for sr in np.unique(rest_spins):
+                sel = np.zeros((nrest, D * nrest))
+                for c in np.flatnonzero(rest_spins == sr):
+                    sel[c, (pol_off + sigma) * nrest + c] = 1.0
+                s_total = int(sspin + sr)
+                descrs = [None] * dim
+                descrs[rad] = ("gblocks", az,
+                               disk.ladder_stack(s_total, -sspin))
+                terms.append((sel, descrs))
+        return terms
+
+
+class CylinderLaplacian(CylinderOperator):
+    """lap X = sum_c d_c^2 X + polar spin-weighted Laplacian, diagonal over
+    spin components (reference: core/operators.py:3952 Laplacian)."""
+
+    name = "Lap"
+
+    def __init__(self, operand, cs=None):
+        self.cs = cs
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return CylinderLaplacian(new_args[0], self.cs)
+
+    def _dp(self):
+        return self.cs
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        _, disk, _, _ = _cyl_parts(operand, self.cs)
+        self.domain = (operand.domain if disk is None else
+                       operand.domain.substitute_basis(
+                           disk, disk.derivative_basis(2)))
+        self.tensorsig = tuple(operand.tensorsig)
+        self.dtype = operand.dtype
+
+    def terms(self):
+        operand = self.operand
+        polar, disk, straight, pol_off = self._parts()
+        az = disk.first_axis if disk is not None else None
+        rad = None if az is None else az + 1
+        spins = component_spins(operand.tensorsig, polar)
+        ncomp = len(spins)
+        dim = operand.domain.dim
+        terms = []
+        for off_c, coord, axis, basis in straight:
+            if basis is None:
+                continue
+            kind, blocks = _diff_descr(basis)
+            assert kind == "blocks"
+            blocks2 = np.einsum("gij,gjk->gik", blocks, blocks)
+            for s in np.unique(spins):
+                sel = (np.diag((spins == s).astype(float))
+                       if ncomp > 1 else None)
+                descrs = [None] * dim
+                descrs[axis] = ("blocks", blocks2)
+                if disk is not None:
+                    descrs[rad] = _conv_descr(disk, az, s, 2)
+                terms.append((sel, descrs))
+        if disk is None:
+            return terms
+        for s in np.unique(spins):
+            sel = np.diag((spins == s).astype(float)) if ncomp > 1 else None
+            descrs = [None] * dim
+            descrs[rad] = ("gblocks", az, disk.laplacian_stack(int(s)))
+            terms.append((sel, descrs))
+        return terms
+
+
+class CylinderCurl(CylinderOperator):
+    """
+    Curl of a product vector (one straight coordinate z + polar), in the
+    standard embedding orientation (the convention checked by the
+    reference's tests/test_cylinder_calculus.py::test_curl_vector):
+
+        (curl u)_z = i (D_+ u_-  -  D_- u_+)
+        (curl u)_+ = i (d_z u_+  -  D_+ u_z)
+        (curl u)_- = -i (d_z u_-  -  D_- u_z)
+
+    derived from the cylindrical-coordinate curl with u_+- = (u_r +-
+    i u_phi)/sqrt(2); multiplication by i is represented on real dtypes by
+    the azimuthal pair rotation (polar._expand_complex_terms).
+    """
+
+    name = "Curl"
+
+    def __init__(self, operand):
+        if len(operand.tensorsig) != 1:
+            raise ValueError("Curl requires a vector operand.")
+        self.cs = operand.tensorsig[0]
+        if self.cs.dim != 3:
+            raise ValueError("Curl requires a 3D coordinate system.")
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return CylinderCurl(new_args[0])
+
+    def _dp(self):
+        return self.cs
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        _, disk, _, _ = _cyl_parts(operand, self.cs)
+        self.domain = operand.domain.substitute_basis(
+            disk, disk.derivative_basis(1))
+        self.tensorsig = tuple(operand.tensorsig)
+        self.dtype = operand.dtype
+
+    def terms(self):
+        operand = self.operand
+        polar, disk, straight, pol_off = self._parts()
+        if len(straight) != 1:
+            raise NotImplementedError(
+                "Cylinder curl requires exactly one straight coordinate.")
+        z_off, _, z_axis, z_basis = straight[0]
+        az = disk.first_axis
+        rad = az + 1
+        dim = operand.domain.dim
+        m_row = pol_off + SPIN_INDEX[-1]
+        p_row = pol_off + SPIN_INDEX[+1]
+        raw = []
+
+        def term(row, col, coeff, descrs):
+            E = np.zeros((3, 3), dtype=complex)
+            E[row, col] = coeff
+            raw.append((E, descrs))
+
+        def rdescr(stack):
+            d = [None] * dim
+            d[rad] = ("gblocks", az, stack)
+            return d
+
+        # (curl u)_z = i D_+ u_-  -  i D_- u_+
+        term(z_off, m_row, +1j, rdescr(disk.ladder_stack(-1, +1)))
+        term(z_off, p_row, -1j, rdescr(disk.ladder_stack(+1, -1)))
+        # (curl u)_+ = i d_z u_+  -  i D_+ u_z
+        if z_basis is not None:
+            d = [None] * dim
+            d[rad] = _conv_descr(disk, az, +1, 1)
+            d[z_axis] = _diff_descr(z_basis)
+            term(p_row, p_row, +1j, d)
+        term(p_row, z_off, -1j, rdescr(disk.ladder_stack(0, +1)))
+        # (curl u)_- = -i d_z u_-  +  i D_- u_z
+        if z_basis is not None:
+            d = [None] * dim
+            d[rad] = _conv_descr(disk, az, -1, 1)
+            d[z_axis] = _diff_descr(z_basis)
+            term(m_row, m_row, -1j, d)
+        term(m_row, z_off, +1j, rdescr(disk.ladder_stack(0, -1)))
+        return _expand_complex_terms(raw, az, disk.sub_n_groups(0),
+                                     disk.complex)

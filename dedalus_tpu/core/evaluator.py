@@ -124,7 +124,7 @@ class Handler:
         core/evaluator.py:94-148).
         """
         from .future import EvalContext, CompiledWithFallback
-        from .field import transform_to_grid
+        from .field import transform_to_grid, mesh_transforms
         dist = self.solver.dist
         tasks = list(self.tasks)
         atoms = set()
@@ -133,6 +133,10 @@ class Handler:
         fields = sorted(atoms, key=lambda f: (f.name or "", id(f)))
 
         def fn(arrays):
+            with mesh_transforms(dist.mesh):
+                return fn_body(arrays)
+
+        def fn_body(arrays):
             ctx = EvalContext(dict(zip(fields, arrays)))
             out = {}
             for task in tasks:
@@ -270,6 +274,49 @@ class FileHandler(Handler):
                     tasks.create_dataset(name, shape=(0,) + data.shape,
                                          maxshape=(None,) + data.shape,
                                          dtype=data.dtype)
+                    self._attach_grid_scales(f, tasks[name], name)
                 ds = tasks[name]
                 ds.resize((ds.shape[0] + 1,) + data.shape)
                 ds[-1] = data
+
+    def _attach_grid_scales(self, f, ds, name):
+        """Store the task's grid arrays once and attach them as HDF5
+        dimension scales (reference: core/evaluator.py:656-728 setup_file
+        attaches per-axis scales), so post-processing (plot_snapshots,
+        xarray) can recover coordinates from the file alone."""
+        task = next((t for t in self.tasks if t["name"] == name), None)
+        if task is None or task["layout"] != "g":
+            return
+        op = task["operator"]
+        scales = self.solver.dist.remedy_scales(task["scales"] or 1)
+        tdim = len(op.tensorsig)
+        grp = f["scales"]
+        dim = 0
+        ds.dims[dim].label = "write"
+        dim += 1
+        for _ in range(tdim):
+            ds.dims[dim].label = "component"
+            dim += 1
+        grids = []
+        for axis, basis in enumerate(op.domain.bases):
+            if basis is None:
+                grids.append((f"const_{axis}", np.zeros(1)))
+            elif basis.dim == 1:
+                coord = basis.coord
+                grids.append((coord.name, basis.global_grid(scales[axis])))
+            else:
+                sub = axis - basis.first_axis
+                if sub == 0:
+                    gs = basis.global_grids(
+                        tuple(scales[basis.first_axis + i]
+                              for i in range(basis.dim)))
+                    for i, g in enumerate(gs):
+                        grids.append((basis.cs.names[i], np.ravel(g)))
+        for gname, grid in grids:
+            key = f"{gname}_{hash(tuple(np.ravel(grid)[:3].tolist())) & 0xffff:x}"
+            if key not in grp:
+                grp.create_dataset(key, data=np.ravel(grid))
+                grp[key].make_scale(gname)
+            ds.dims[dim].attach_scale(grp[key])
+            ds.dims[dim].label = gname
+            dim += 1

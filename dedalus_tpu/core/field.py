@@ -24,6 +24,77 @@ from ..tools.general import is_complex_dtype
 
 # ------------------------------------------------------------------
 # Transform pipeline: pure jnp, safe inside jit.
+#
+# Mesh-aware mode: inside `mesh_transforms(mesh)` the walk pins the
+# intermediate shardings of the reference's layout chain
+# (core/distributor.py:128-166: coeff keeps the first R axes distributed,
+# transforming axis r first moves its blocks to axis r+1) via
+# with_sharding_constraint, so GSPMD lowers the moves to all-to-all pencil
+# transposes instead of gathering the full state (the reference's
+# Alltoallv transposes, core/transposes.pyx:246). Host/setup paths run
+# outside the context and are untouched.
+
+import threading as _threading
+
+from . import meshctx
+
+_MESH_CTX = _threading.local()
+
+
+class mesh_transforms:
+    """Context manager activating sharded transform walks (trace-time)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self.prev = getattr(_MESH_CTX, "mesh", None)
+        _MESH_CTX.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _MESH_CTX.mesh = self.prev
+
+
+def _active_mesh(domain):
+    """(mesh, axis_names) for the current transform walk, or (None, ())."""
+    mesh = getattr(_MESH_CTX, "mesh", None)
+    if mesh is None:
+        return None, ()
+    R = min(len(mesh.axis_names), domain.dim - 1)
+    if R < 1:
+        return None, ()
+    return mesh, mesh.axis_names[:R]
+
+
+def _constrain(data, mesh, layout):
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = [layout.get(d) for d in range(data.ndim)]
+    return jax.lax.with_sharding_constraint(
+        data, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def _walk_divisible(data, domain, scales, tdim, mesh, names):
+    """Whether every stage of the sharded layout walk divides evenly: mesh
+    axis r shards the coeff size of axis r and the grid size of axis r+1.
+    Uneven stages (reduced tau fields, odd sizes) fall back to the plain
+    global-view walk — correct, but GSPMD may gather; choose divisible
+    resolutions for the distributed axes."""
+    def size(axis, grid):
+        basis = domain.bases[axis]
+        if basis is None:
+            return data.shape[tdim + axis]
+        sub = axis - basis.first_axis
+        if grid:
+            return basis.sub_grid_size(sub, scales[axis])
+        return basis.coeff_size(sub)
+
+    for r, name in enumerate(names):
+        n = mesh.shape[name]
+        if size(r, grid=False) % n or size(r + 1, grid=True) % n:
+            return False
+    return True
+
 
 def transform_to_coeff(data, domain, scales, tdim, library=None, tensorsig=()):
     """
@@ -32,24 +103,76 @@ def transform_to_coeff(data, domain, scales, tdim, library=None, tensorsig=()):
     colatitude/radial transforms run (reference layout-walk direction:
     core/distributor.py:128-166).
     """
-    for axis in range(domain.dim):
+    def fwd(data, axis):
         basis = domain.bases[axis]
-        if basis is not None:
-            data = basis.forward_transform(data, tdim + axis, scales[axis], library,
-                                           tensorsig=tensorsig,
-                                           sub_axis=axis - basis.first_axis)
-    return data
+        if basis is None:
+            return data
+        return basis.forward_transform(data, tdim + axis, scales[axis],
+                                       library, tensorsig=tensorsig,
+                                       sub_axis=axis - basis.first_axis)
+
+    mesh, names = _active_mesh(domain)
+    if mesh is not None and not _walk_divisible(data, domain, scales, tdim,
+                                                mesh, names):
+        mesh = None
+    if mesh is None:
+        for axis in range(domain.dim):
+            data = fwd(data, axis)
+        return data
+    R = len(names)
+    # grid layout: mesh axis r shards array dim r+1
+    layout = {tdim + r + 1: names[r] for r in range(R)}
+    prev = meshctx.set_walk(mesh, layout)
+    try:
+        data = _constrain(data, mesh, layout)
+        for r in range(R):
+            data = fwd(data, r)                 # axis r is local in grid layout
+            del layout[tdim + r + 1]
+            layout[tdim + r] = names[r]
+            meshctx.set_walk(mesh, layout)
+            data = _constrain(data, mesh, layout)  # all-to-all: dim r+1 -> dim r
+        for axis in range(R, domain.dim):
+            data = fwd(data, axis)
+        return _constrain(data, mesh, layout)
+    finally:
+        meshctx.restore_walk(prev)
 
 
 def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
     """Full coefficient -> full grid transform: last axis first."""
-    for axis in range(domain.dim - 1, -1, -1):
+    def bwd(data, axis):
         basis = domain.bases[axis]
-        if basis is not None:
-            data = basis.backward_transform(data, tdim + axis, scales[axis], library,
-                                            tensorsig=tensorsig,
-                                            sub_axis=axis - basis.first_axis)
-    return data
+        if basis is None:
+            return data
+        return basis.backward_transform(data, tdim + axis, scales[axis],
+                                        library, tensorsig=tensorsig,
+                                        sub_axis=axis - basis.first_axis)
+
+    mesh, names = _active_mesh(domain)
+    if mesh is not None and not _walk_divisible(data, domain, scales, tdim,
+                                                mesh, names):
+        mesh = None
+    if mesh is None:
+        for axis in range(domain.dim - 1, -1, -1):
+            data = bwd(data, axis)
+        return data
+    R = len(names)
+    # coeff layout: mesh axis r shards array dim r
+    layout = {tdim + r: names[r] for r in range(R)}
+    prev = meshctx.set_walk(mesh, layout)
+    try:
+        data = _constrain(data, mesh, layout)
+        for axis in range(domain.dim - 1, R - 1, -1):
+            data = bwd(data, axis)
+        for r in range(R - 1, -1, -1):
+            del layout[tdim + r]
+            layout[tdim + r + 1] = names[r]
+            meshctx.set_walk(mesh, layout)
+            data = _constrain(data, mesh, layout)  # all-to-all: dim r -> dim r+1
+            data = bwd(data, r)                 # axis r now local
+        return data
+    finally:
+        meshctx.restore_walk(prev)
 
 
 def _compiled_transform(direction, domain, scales, tdim, tensorsig):

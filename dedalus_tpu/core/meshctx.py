@@ -1,0 +1,82 @@
+"""
+Mesh context for sharded transform walks.
+
+XLA's SPMD partitioner cannot partition `fft` ops: a batched FFT whose
+batch dims are sharded is lowered as all-gather + replicated full-size FFT
+(observed on the compiled sharded step), which destroys both memory and
+scaling at large sizes. The transform walk therefore publishes the current
+{array dim: mesh axis} layout here, and the Fourier/DCT plans route their
+FFT calls through `local_fft`, which runs the op inside shard_map so each
+device transforms only its own batch block — the compiled program then
+contains only the walk's intended all-to-all pencil transposes
+(reference counterpart: FFTW transforms are always rank-local,
+dedalus/core/transposes.pyx moves data so that stays true).
+"""
+
+import threading
+from functools import partial
+
+import jax
+from jax.sharding import PartitionSpec
+
+_CTX = threading.local()
+
+
+def set_walk(mesh, layout):
+    """Activate (mesh, {absolute data dim: mesh axis name}) for subsequent
+    transform calls; returns the previous state for restoration."""
+    prev = getattr(_CTX, "state", None)
+    _CTX.state = (mesh, dict(layout)) if mesh is not None else None
+    return prev
+
+
+def restore_walk(prev):
+    _CTX.state = prev
+
+
+def active():
+    return getattr(_CTX, "state", None)
+
+
+def local_fft(fn, data, orig_axis):
+    """
+    Apply `fn` (an FFT-like op along the LAST axis of `data`, where `data`
+    is the walk-level array with `orig_axis` moved to the end) per-device:
+    inside shard_map each device runs the FFT on its local batch block.
+    Falls back to the global-view call (which GSPMD will gather) when no
+    walk is active, nothing is sharded, or a sharded dim does not divide
+    the mesh axis.
+    """
+    state = active()
+    if state is None or orig_axis is None:
+        return fn(data)
+    mesh, layout = state
+    # moveaxis(orig_axis -> -1): dims before orig_axis keep their index,
+    # dims after shift down one, the transformed axis lands last
+    moved = {}
+    for dim, name in layout.items():
+        if name is None:
+            continue
+        if dim == orig_axis:
+            # the walk must have localized the transform axis already
+            return fn(data)
+        moved[dim if dim < orig_axis else dim - 1] = name
+    if not moved:
+        return fn(data)
+    for dim, name in moved.items():
+        if data.shape[dim] % mesh.shape[name]:
+            return fn(data)  # uneven block: let GSPMD handle it
+    spec = PartitionSpec(*[moved.get(d) for d in range(data.ndim)])
+
+    def local(block):
+        # collapse batch dims to 2D around the FFT: XLA:CPU's fft thunk
+        # requires a dim0-major operand layout, which fusion inside the
+        # shard_map body does not always produce for high-rank operands;
+        # the reshape forces a standard-layout copy when needed
+        shp = block.shape
+        flat = block.reshape((-1, shp[-1]))
+        out = fn(flat)
+        return out.reshape(shp[:-1] + out.shape[-1:])
+
+    return partial(jax.shard_map, mesh=mesh, in_specs=spec,
+                   out_specs=spec)(local)(data)

@@ -951,6 +951,11 @@ def _spherical_cs(cs):
     return isinstance(cs, SphericalCoordinates)
 
 
+def _product_cs(cs):
+    from .coords import DirectProduct
+    return isinstance(cs, DirectProduct) and cs.curvilinear
+
+
 @parseable("grad", "Gradient")
 def Gradient(operand, cs=None):
     if np.isscalar(operand):
@@ -962,6 +967,9 @@ def Gradient(operand, cs=None):
     if _spin_cs(cs):
         from .polar import PolarGradient
         return PolarGradient(operand, cs)
+    if _product_cs(cs):
+        from .cylinder import CylinderGradient
+        return CylinderGradient(operand, cs)
     return CartesianGradient(operand, cs)
 
 
@@ -975,6 +983,9 @@ def Divergence(operand, index=0):
     if _spin_cs(operand.tensorsig[index]):
         from .polar import PolarDivergence
         return PolarDivergence(operand, index)
+    if _product_cs(operand.tensorsig[index]):
+        from .cylinder import CylinderDivergence
+        return CylinderDivergence(operand, index)
     return CartesianDivergence(operand, index)
 
 
@@ -989,6 +1000,9 @@ def Laplacian(operand, cs=None):
     if _spin_cs(cs2):
         from .polar import PolarLaplacian
         return PolarLaplacian(operand, cs2)
+    if _product_cs(cs2):
+        from .cylinder import CylinderLaplacian
+        return CylinderLaplacian(operand, cs2)
     return CartesianLaplacian(operand, cs)
 
 
@@ -999,6 +1013,9 @@ def Curl(operand):
     if operand.tensorsig and _spherical_cs(operand.tensorsig[0]):
         from .spherical3d import SphericalCurl
         return SphericalCurl(operand)
+    if operand.tensorsig and _product_cs(operand.tensorsig[0]):
+        from .cylinder import CylinderCurl
+        return CylinderCurl(operand)
     return CartesianCurl(operand)
 
 

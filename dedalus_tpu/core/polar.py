@@ -246,10 +246,12 @@ class DiskBasis(SpinBasisMixin, Basis):
         """Assemble (G, rows, cols) stack from per-m builder
         `build(m, nmodes) -> (r, c)`; slot dimensions (align_*=True) are
         right-aligned at nmin(m), grid/point dimensions are not."""
+        from ..tools.progress import log_progress
         ms = self.group_m()
         G = len(ms)
         out = np.zeros((G, rows, cols))
-        for g, m in enumerate(ms):
+        for g, m in log_progress(list(enumerate(ms)), dt=10,
+                                 desc=f"{type(self).__name__} stack group"):
             if self.complex and g == self.Nphi // 2:
                 continue  # Nyquist
             nmin = self._nmin(m)

@@ -371,6 +371,11 @@ class SolverBase:
         extra_fields = sorted(extra, key=lambda f: (f.name or "", id(f)))
 
         def eval_F(X, t=None, extra_arrays=None):
+            from .field import mesh_transforms
+            with mesh_transforms(self.dist.mesh):
+                return eval_F_body(X, t, extra_arrays)
+
+        def eval_F_body(X, t=None, extra_arrays=None):
             subs = {}
             if X is not None:
                 arrays = scatter_state(layout, variables, X)
@@ -478,22 +483,25 @@ class InitialValueSolver(SolverBase):
         (curvilinear triangular truncation, Nyquist slots).
         """
         if self._project_state is None:
-            from .field import transform_to_grid, transform_to_coeff
+            from .field import (transform_to_grid, transform_to_coeff,
+                                mesh_transforms)
             layout, variables = self.layout, self.variables
 
             from ..tools.jitlift import lifted_jit
 
             def project(X):
-                arrays = scatter_state(layout, variables, X)
-                out = {}
-                for v in variables:
-                    scales = tuple(v.domain.dealias)
-                    tdim = len(v.tensorsig)
-                    g = transform_to_grid(arrays[v.name], v.domain, scales,
-                                          tdim, tensorsig=v.tensorsig)
-                    out[v.name] = transform_to_coeff(g, v.domain, scales, tdim,
-                                                     tensorsig=v.tensorsig)
-                return gather_state(layout, variables, out)
+                with mesh_transforms(self.dist.mesh):
+                    arrays = scatter_state(layout, variables, X)
+                    out = {}
+                    for v in variables:
+                        scales = tuple(v.domain.dealias)
+                        tdim = len(v.tensorsig)
+                        g = transform_to_grid(arrays[v.name], v.domain, scales,
+                                              tdim, tensorsig=v.tensorsig)
+                        out[v.name] = transform_to_coeff(g, v.domain, scales,
+                                                         tdim,
+                                                         tensorsig=v.tensorsig)
+                    return gather_state(layout, variables, out)
 
             self._project_state = lifted_jit(project)
         self.X = self._project_state(self.X)

@@ -183,10 +183,12 @@ class SphereBasis(SpinBasisMixin, Basis):
         """Assemble (G, rows, cols) stack from per-m builder
         `build(m) -> (r, c)`; `row_off(m)` / `col_off(m)` give the slot
         alignment offsets (None = 0, for grid/point dimensions)."""
+        from ..tools.progress import log_progress
         ms = self.group_m()
         G = len(ms)
         out = np.zeros((G, rows, cols))
-        for g, m in enumerate(ms):
+        for g, m in log_progress(list(enumerate(ms)), dt=10,
+                                 desc=f"{type(self).__name__} stack group"):
             if self.complex and g == self.Nphi // 2:
                 continue  # Nyquist
             if abs(m) > self.Lmax:

@@ -108,9 +108,19 @@ class MultistepIMEX:
 
         # M and L are explicit arguments (not closure constants) so the
         # compiled HLO stays small and the arrays live as device buffers.
-        def _factor(M, L, a0, b0):
+        def _factor_body(M, L, a0, b0):
             return ops.factor_lincomb(a0, M, b0, L)
-        _factor = lifted_jit(_factor)
+        _factor_jit = lifted_jit(_factor_body)
+        G = solver.pencil_shape[0]
+        itemsize = np.dtype(solver.pencil_dtype).itemsize
+
+        def _factor(M, L, a0, b0):
+            # very large factor outputs go chunk-by-chunk in separate
+            # dispatches (caps the transient HBM peak; pencilops)
+            if (hasattr(ops, "use_incremental_factor")
+                    and ops.use_incremental_factor(G, itemsize)):
+                return ops.factor_lincomb_incremental(a0, M, L, b_scale=b0)
+            return _factor_jit(M, L, a0, b0)
 
         # the fused step body composes the same two pieces the split mode
         # dispatches separately, so the numerics cannot drift between modes
@@ -383,9 +393,19 @@ class RungeKuttaIMEX:
         def _factor_uniq(M, L, dt):
             return [ops.factor_lincomb(one, M, dt * h, L) for h in uniq]
         _factor_uniq = lifted_jit(_factor_uniq)
+        G = solver.pencil_shape[0]
+        itemsize = np.dtype(solver.pencil_dtype).itemsize
 
         def _factor(M, L, dt):
-            auxs = _factor_uniq(M, L, dt)
+            # very large factor outputs go chunk-by-chunk in separate
+            # dispatches (caps the transient HBM peak; pencilops)
+            if (hasattr(ops, "use_incremental_factor")
+                    and ops.use_incremental_factor(G, itemsize)):
+                auxs = [ops.factor_lincomb_incremental(one, M, L,
+                                                       b_scale=dt * h)
+                        for h in uniq]
+            else:
+                auxs = _factor_uniq(M, L, dt)
             return [auxs[j] for j in stage_slot]
         self._factor_uniq = _factor_uniq
 

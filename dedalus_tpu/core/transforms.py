@@ -18,6 +18,8 @@ by the jitted step.
 import numpy as np
 import jax.numpy as jnp
 
+from . import meshctx
+
 from ..tools.array import apply_matrix_jax
 
 # Registry: {(basis_class_name, library): plan_class}
@@ -103,7 +105,7 @@ class JacobiMMT(MatrixTransform):
         return jacobi.build_polynomials(basis.size, basis.a, basis.b, x).T
 
 
-def _dct2(x):
+def _dct2(x, orig_axis=None):
     """
     Unnormalized DCT-II along the last axis with explicit dtype control:
     y_n = 2 sum_j x_j cos(pi n (2j+1) / (2N)), via Makhoul's single
@@ -114,24 +116,25 @@ def _dct2(x):
     if jnp.iscomplexobj(x):
         # Makhoul's Re() identity only holds for real input: transform the
         # real and imaginary parts separately
-        return _dct2(x.real) + 1j * _dct2(x.imag)
+        return _dct2(x.real, orig_axis) + 1j * _dct2(x.imag, orig_axis)
     N = x.shape[-1]
     cdt = jnp.complex64 if x.dtype == jnp.float32 else jnp.complex128
     v = jnp.concatenate([x[..., 0::2], x[..., 1::2][..., ::-1]], axis=-1)
-    V = jnp.fft.fft(v.astype(cdt), axis=-1)
+    V = meshctx.local_fft(lambda a: jnp.fft.fft(a, axis=-1), v.astype(cdt),
+                          orig_axis)
     n = np.arange(N)
     phase = jnp.asarray(np.exp(-1j * np.pi * n / (2 * N)), dtype=cdt)
     return 2.0 * (phase * V).real.astype(x.dtype)
 
 
-def _idct2(y):
+def _idct2(y, orig_axis=None):
     """
     Inverse of _dct2 (up to the factor 2N): x_j such that
     _dct2(x) = y; equivalently a DCT-III evaluation
     x_j = y_0/(2N) + (1/N) sum_{n>=1} y_n cos(pi n (2j+1)/(2N)).
     """
     if jnp.iscomplexobj(y):
-        return _idct2(y.real) + 1j * _idct2(y.imag)
+        return _idct2(y.real, orig_axis) + 1j * _idct2(y.imag, orig_axis)
     N = y.shape[-1]
     cdt = jnp.complex64 if y.dtype == jnp.float32 else jnp.complex128
     n = np.arange(N)
@@ -139,7 +142,8 @@ def _idct2(y):
     yrev = jnp.concatenate([jnp.zeros_like(y[..., :1]), y[..., 1:][..., ::-1]],
                            axis=-1)
     W = phase * (y.astype(cdt) - 1j * yrev.astype(cdt))
-    v = jnp.fft.ifft(W, axis=-1).real.astype(y.dtype)
+    v = meshctx.local_fft(lambda a: jnp.fft.ifft(a, axis=-1), W,
+                          orig_axis).real.astype(y.dtype)
     half = (N + 1) // 2
     x = jnp.zeros_like(v)
     x = x.at[..., 0::2].set(v[..., :half])
@@ -222,7 +226,7 @@ class FastChebyshevTransform(TransformPlan):
         N, Ng = self.N, self.Ng
         data = jnp.moveaxis(gdata, axis, -1)[..., ::-1]
         dt = data.dtype
-        y = _dct2(data)                                # y_n = 2 sum g cos(n th)
+        y = _dct2(data, axis)                          # y_n = 2 sum g cos(n th)
         chat = y / Ng
         chat = chat.at[..., 0].divide(2.0)
         # constants cast to the data dtype: f32 data must not promote to
@@ -248,7 +252,7 @@ class FastChebyshevTransform(TransformPlan):
         chat = jnp.pad(chat, [(0, 0)] * (chat.ndim - 1) + [(0, Ng - N)])
         # _idct2(y)_j = y_0/(2Ng) + (1/Ng) sum_n y_n cos(n th_j)
         chat = chat.at[..., 0].multiply(2.0)
-        g = _idct2(chat * Ng)
+        g = _idct2(chat * Ng, axis)
         return jnp.moveaxis(g[..., ::-1], -1, axis)
 
 
@@ -300,7 +304,8 @@ class RealFourierFFT(TransformPlan):
     def forward(self, gdata, axis):
         N, Ng = self.N, self.Ng
         data = jnp.moveaxis(gdata, axis, -1)
-        F = jnp.fft.rfft(data, axis=-1) / Ng
+        F = meshctx.local_fft(lambda a: jnp.fft.rfft(a, axis=-1), data,
+                              axis) / Ng
         K = N // 2
         F = F[..., :K]
         cos = 2.0 * F.real
@@ -322,7 +327,8 @@ class RealFourierFFT(TransformPlan):
         # pad spectrum to the grid's rfft length
         pad = Ng // 2 + 1 - K
         F = jnp.concatenate([F, jnp.zeros(F.shape[:-1] + (pad,), dtype=F.dtype)], axis=-1)
-        out = jnp.fft.irfft(F * Ng, n=Ng, axis=-1)
+        out = meshctx.local_fft(
+            lambda a: jnp.fft.irfft(a, n=Ng, axis=-1), F * Ng, axis)
         return jnp.moveaxis(out, -1, axis)
 
 
@@ -366,7 +372,8 @@ class ComplexFourierFFT(TransformPlan):
     def forward(self, gdata, axis):
         N, Ng = self.N, self.Ng
         data = jnp.moveaxis(gdata, axis, -1)
-        F = jnp.fft.fft(data, axis=-1) / Ng
+        F = meshctx.local_fft(lambda a: jnp.fft.fft(a, axis=-1), data,
+                              axis) / Ng
         K = N // 2
         # keep modes [0..K-1] and [-K..-1], zero the Nyquist slot
         out = jnp.concatenate([F[..., :K],
@@ -382,5 +389,6 @@ class ComplexFourierFFT(TransformPlan):
         neg = data[..., K + 1:]
         mid = jnp.zeros(data.shape[:-1] + (Ng - N + 1,), data.dtype)
         F = jnp.concatenate([pos, mid, neg], axis=-1)
-        out = jnp.fft.ifft(F * Ng, axis=-1)
+        out = meshctx.local_fft(
+            lambda a: jnp.fft.ifft(a, axis=-1), F * Ng, axis)
         return jnp.moveaxis(out, -1, axis)

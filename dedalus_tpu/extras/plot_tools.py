@@ -41,6 +41,46 @@ class MultiFigure:
         return self.axes[i][j]
 
 
+def plot_bot_3d(dset, normal_axis, index, axes=None, title=None,
+                cmap="RdBu_r", even_scale=False, visible_axes=True, **kw):
+    """
+    pcolormesh of one slice of an h5py task dataset along `normal_axis`
+    (typically 0 = the write/time axis), using the file's attached
+    dimension scales for coordinates (reference:
+    extras/plot_tools.py plot_bot_3d; our file handler attaches scales at
+    dataset creation, core/evaluator.py)."""
+    import matplotlib.pyplot as plt
+    data = np.asarray(np.take(dset, index, axis=normal_axis))
+    # coordinate grids from the remaining dims' attached scales
+    grids = []
+    for d in range(len(dset.shape)):
+        if d == normal_axis:
+            continue
+        dim = dset.dims[d]
+        if len(dim) and dim[0].shape[0] == dset.shape[d] and dset.shape[d] > 1:
+            grids.append(np.asarray(dim[0]))
+        elif dset.shape[d] > 1:
+            grids.append(np.arange(dset.shape[d]))
+    data = np.squeeze(data)
+    if data.ndim != 2 or len(grids) < 2:
+        raise ValueError("plot_bot_3d slice is not 2D.")
+    x, y = grids[-2], grids[-1]
+    if axes is None:
+        _, axes = plt.subplots()
+    xm, ym = quad_mesh(x, y)
+    if even_scale:
+        lim = np.abs(data).max() or 1.0
+        kw.setdefault("vmin", -lim)
+        kw.setdefault("vmax", lim)
+    mesh = axes.pcolormesh(xm, ym, np.asarray(data).real, cmap=cmap, **kw)
+    if title:
+        axes.set_title(title)
+    if not visible_axes:
+        axes.set_xticks([])
+        axes.set_yticks([])
+    return mesh
+
+
 def plot_bot_2d(field_or_data, x=None, y=None, axes=None, title=None,
                 cmap="RdBu_r", **kw):
     """

@@ -507,6 +507,97 @@ class BandedOps:
             core = jax.lax.map(one, tuple(xs))
         return self._aux_from_core(core, {"ab": (a, b)})
 
+    # ------------------------------------------------ incremental factor
+
+    def use_incremental_factor(self, G, itemsize):
+        """Whether to factor chunk-by-chunk in SEPARATE device dispatches
+        with donated accumulation (caps the transient HBM peak at roughly
+        store + M/L + one chunk, vs the fused program's store + M/L + all
+        scan temps). Engaged automatically when the factor output alone
+        exceeds BANDED_INCREMENTAL_GB (the RB 2048x1024 regime: ~5.5 GB of
+        factors on a 16 GB chip)."""
+        mode = config["linear algebra"].get(
+            "BANDED_FACTOR_MODE", "auto").lower()
+        if mode in ("fused", "incremental"):
+            return mode == "incremental"
+        C, Gc = self._pick_chunks(G, itemsize)
+        if C <= 1:
+            return False
+        thresh = float(config["linear algebra"].get(
+            "BANDED_INCREMENTAL_GB", "2.0")) * 1e9
+        out_bytes = G * self.NB * (2 * self.q * self.q) * 2 * itemsize
+        return out_bytes > thresh
+
+    def factor_lincomb_incremental(self, a, M, L, b_scale=None):
+        """factor_lincomb(a, M, b, L) as C separate device dispatches: each
+        chunk is combined + factored by a small jitted program whose result
+        is written into donated (C, Gc, ...) stores, so the full-batch scan
+        temps never coexist with the finished factors. Returns the same
+        chunked aux `solve` already consumes. Host-level: call OUTSIDE jit."""
+        import functools
+        b = b_scale
+        G = M.bands.shape[0]
+        dtype = M.bands.dtype
+        C, Gc = self._pick_chunks(G, dtype.itemsize)
+        C = max(C, 2)  # incremental mode implies chunked aux layout
+        Gc = -(-G // C)
+        self._g_chunks = C
+        dM = np.asarray(M.dsel)
+        dL = np.asarray(L.dsel)
+        has_mv = M.Vt is not None
+        has_lv = L.Vt is not None
+        rd = np.dtype(dtype)
+        a = jnp.asarray(a, dtype=rd)
+        b = jnp.asarray(b, dtype=rd)
+
+        def chunk_core(mb, lb, mv, lv, a, b):
+            bands = jnp.zeros((Gc, self.nd, self.n_pad), dtype=dtype)
+            bands = bands.at[:, dM, :].add(a * mb)
+            bands = bands.at[:, dL, :].add(b * lb)
+            Vt = jnp.zeros((Gc, self.t, self.n_pad), dtype=dtype)
+            if mv is not None:
+                Vt = Vt + a * mv
+            if lv is not None:
+                Vt = Vt + b * lv
+            return self._factor_core(bands, Vt)
+
+        shapes = jax.eval_shape(
+            chunk_core,
+            jax.ShapeDtypeStruct((Gc, len(dM), self.n_pad), dtype),
+            jax.ShapeDtypeStruct((Gc, len(dL), self.n_pad), dtype),
+            jax.ShapeDtypeStruct((Gc, self.t, self.n_pad), dtype)
+            if has_mv else None,
+            jax.ShapeDtypeStruct((Gc, self.t, self.n_pad), dtype)
+            if has_lv else None,
+            jax.ShapeDtypeStruct((), rd), jax.ShapeDtypeStruct((), rd))
+        store = jax.tree.map(
+            lambda s: jnp.zeros((C,) + s.shape, dtype=s.dtype), shapes)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def write(store, i, mb, lb, mv, lv, a, b):
+            core = chunk_core(mb, lb, mv, lv, a, b)
+            return jax.tree.map(
+                lambda s, c: jax.lax.dynamic_update_index_in_dim(s, c, i, 0),
+                store, core)
+
+        def chunk_of(arr, i):
+            if arr is None:
+                return None
+            lo = i * Gc
+            hi = min(lo + Gc, G)
+            sl = arr[lo:hi]
+            if hi - lo < Gc:
+                sl = self._pad_groups(sl, Gc)  # edge-pad the final chunk
+            return sl
+
+        for i in range(C):
+            store = write(store, i,
+                          chunk_of(M.bands, i), chunk_of(L.bands, i),
+                          chunk_of(M.Vt, i) if has_mv else None,
+                          chunk_of(L.Vt, i) if has_lv else None, a, b)
+        jax.block_until_ready(store)
+        return self._aux_from_core(store, {"ab": (a, b)})
+
     def _aux_matvec(self, aux, x, mats):
         if "A" in aux:
             return self.matvec(aux["A"], x)

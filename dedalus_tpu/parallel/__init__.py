@@ -6,3 +6,4 @@ chain — the MPI pencil machinery replaced by XLA collectives over ICI/DCN).
 
 from .transposes import all_to_all_transpose, DistributedPencilPipeline
 from .sharding import distribute_solver, pencil_sharding
+from . import multihost

@@ -32,6 +32,9 @@ def distribute_solver(solver, mesh=None, axis_name=None):
     mesh = mesh or solver.dist.mesh
     if mesh is None:
         return solver
+    # record on the distributor: the compiled transform walks read it to
+    # pin intermediate shardings (field.mesh_transforms)
+    solver.dist.mesh = mesh
     axis_name = axis_name or mesh.axis_names[0]
     G = solver.pencil_shape[0]
     n = mesh.shape[axis_name]

@@ -107,4 +107,11 @@ def serialize(arg):
         return tuple(map(serialize, arg))
     if isinstance(arg, dict):
         return tuple((k, serialize(v)) for k, v in sorted(arg.items()))
+    # objects with state beyond their __eq__/__hash__ that must key caches
+    # (e.g. coordinate systems: equal-by-name, but the distributor-assigned
+    # AXES distinguish a disk at axes (0,1) from one inside a cylinder at
+    # (1,2) — interning must not alias them)
+    token = getattr(arg, "_cache_token", None)
+    if token is not None:
+        return token
     return arg

@@ -1,0 +1,61 @@
+"""
+Plot 2D snapshot files produced by the examples' file handlers
+(reference workflow: examples/ivp_2d_rayleigh_benard/plot_snapshots.py).
+
+Usage:
+    python examples/plot_snapshots.py snapshots/*.h5 [--output=frames]
+                                      [--tasks=buoyancy,vorticity]
+"""
+
+import pathlib
+import sys
+
+import h5py
+import numpy as np
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+from dedalus_tpu.extras import plot_tools  # noqa: E402
+
+
+def plot_file(filename, output, tasks=None, dpi=150):
+    output = pathlib.Path(output)
+    output.mkdir(parents=True, exist_ok=True)
+    saved = []
+    with h5py.File(filename, "r") as f:
+        names = tasks or list(f["tasks"])
+        n_writes = f["tasks"][names[0]].shape[0]
+        sim_time = np.asarray(f["scales"]["sim_time"])
+        write_number = np.asarray(f["scales"]["write_number"])
+        for index in range(n_writes):
+            fig, axes = plt.subplots(len(names), 1,
+                                     figsize=(6, 2.2 * len(names)),
+                                     squeeze=False)
+            for n, name in enumerate(names):
+                plot_tools.plot_bot_3d(f["tasks"][name], 0, index,
+                                       axes=axes[n][0], title=name,
+                                       even_scale=True, visible_axes=False)
+            fig.suptitle(f"t = {sim_time[index]:.3f}")
+            savename = output / f"write_{int(write_number[index]):06d}.png"
+            fig.savefig(savename, dpi=dpi)
+            plt.close(fig)
+            saved.append(savename)
+    return saved
+
+
+def main(argv):
+    files = [a for a in argv if not a.startswith("--")]
+    output = next((a.split("=", 1)[1] for a in argv
+                   if a.startswith("--output=")), "frames")
+    tasks = next((a.split("=", 1)[1].split(",") for a in argv
+                  if a.startswith("--tasks=")), None)
+    for fn in files:
+        saved = plot_file(fn, output, tasks)
+        print(f"{fn}: {len(saved)} frames -> {output}/")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

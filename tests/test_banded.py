@@ -206,6 +206,32 @@ def test_rb_banded_chunk_padding_matches_dense():
     assert np.abs(Xd - Xb).max() < 1e-11
 
 
+@pytest.mark.parametrize("timestepper", [d3.RK222, d3.SBDF2])
+def test_rb_banded_incremental_factor_matches_dense(timestepper):
+    """Incremental (per-chunk dispatch, donated-store) factorization — the
+    HBM-peak-capping mode for RB 2048x1024 — must reproduce the dense
+    answer exactly like the fused factor."""
+    from dedalus_tpu.tools.config import config
+    sd = build_rb(16, 64, timestepper=timestepper)
+    la = config["linear algebra"]
+    old = (la.get("BANDED_CHUNK_MB"), la.get("BANDED_FACTOR_MODE", "auto"))
+    la["BANDED_CHUNK_MB"] = "0.01"
+    la["BANDED_FACTOR_MODE"] = "incremental"
+    try:
+        sb = build_rb(16, 64, matsolver="banded", timestepper=timestepper)
+        assert sb.ops.kind == "banded"
+        for _ in range(5):
+            sd.step(0.01)
+            sb.step(0.01)
+        assert sb.ops._g_chunks > 1
+    finally:
+        la["BANDED_CHUNK_MB"] = old[0]
+        la["BANDED_FACTOR_MODE"] = old[1]
+    Xd, Xb = np.asarray(sd.X), np.asarray(sb.X)
+    assert np.isfinite(Xd).all()
+    assert np.abs(Xd - Xb).max() < 1e-11
+
+
 def test_lbvp_banded_chunked_matches_dense():
     """factor()/solve() (LBVP path) under forced chunking."""
     from dedalus_tpu.tools.config import config

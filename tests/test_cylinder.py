@@ -1,0 +1,300 @@
+"""
+Cylinder (DirectProduct: Fourier z x disk/annulus) calculus tests against
+closed-form grid expressions (reference test pattern:
+/root/reference/dedalus/tests/test_cylinder_calculus.py).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+length = 1.88
+radius_disk = 1.5
+radii_annulus = (0.5, 3.0)
+
+
+def build_cylinder(Nz, Nphi, Nr, dealias, dtype, shape="disk"):
+    cz = d3.Coordinate("z")
+    cp = d3.PolarCoordinates("phi", "r")
+    c = d3.DirectProduct(cz, cp)
+    dist = d3.Distributor(c, dtype=dtype)
+    if np.dtype(dtype).kind == "c":
+        bz = d3.ComplexFourier(cz, size=Nz, bounds=(0, length), dealias=dealias)
+    else:
+        bz = d3.RealFourier(cz, size=Nz, bounds=(0, length), dealias=dealias)
+    if shape == "disk":
+        bp = d3.DiskBasis(cp, (Nphi, Nr), dtype=dtype, radius=radius_disk,
+                          dealias=dealias)
+    else:
+        bp = d3.AnnulusBasis(cp, (Nphi, Nr), dtype=dtype,
+                             radii=radii_annulus, dealias=dealias)
+    z, phi, r = dist.local_grids(bz, bp)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    return c, dist, (bz, bp), z, phi, r, x, y
+
+
+kz = 4 * np.pi / length
+params = pytest.mark.parametrize("shape,dealias,dtype", [
+    ("disk", 1, np.float64),
+    ("disk", 3 / 2, np.float64),
+    ("disk", 1, np.complex128),
+    ("annulus", 1, np.float64),
+    ("annulus", 3 / 2, np.complex128),
+])
+
+
+def polar_comps(fx, fy, phi):
+    """Cartesian (fx, fy) -> cylinder (phi, r) components."""
+    return (-fx * np.sin(phi) + fy * np.cos(phi),
+            fx * np.cos(phi) + fy * np.sin(phi))
+
+
+def assert_comps(data, expected, atol=1e-8):
+    for i, e in enumerate(expected):
+        got = np.asarray(data[i])
+        err = np.abs(got - np.broadcast_to(e, got.shape)).max()
+        assert err < atol, f"component {i}: max err {err}"
+
+
+@params
+def test_gradient_scalar(shape, dealias, dtype):
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 8, dealias, dtype,
+                                                 shape)
+    f = dist.Field(bases=b, dtype=dtype)
+    f["g"] = 3 * x ** 2 + 2 * y + np.sin(kz * z) * x
+    u = d3.grad(f).evaluate()
+    u.change_scales(1)
+    fx = 6 * x + np.sin(kz * z)
+    fy = 2 + 0 * x + 0 * z
+    fz = kz * np.cos(kz * z) * x
+    gphi, gr = polar_comps(fx, fy, phi)
+    assert_comps(u["g"], (fz + 0 * phi, gphi + 0 * z, gr + 0 * z))
+
+
+@params
+def test_gradient_vector(shape, dealias, dtype):
+    """grad(grad(f)): rank-2 tensor over the product."""
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 10, dealias, dtype,
+                                                 shape)
+    f = dist.Field(bases=b, dtype=dtype)
+    f["g"] = 3 * x ** 4 + 2 * y ** 3 + np.sin(kz * z) * x * y
+    T = d3.grad(d3.grad(f)).evaluate()
+    T.change_scales(1)
+    s = np.sin(kz * z)
+    cz_ = np.cos(kz * z)
+    # cartesian second derivatives
+    fxx = 36 * x ** 2
+    fyy = 12 * y + 0 * x
+    fxy = s + 0 * x
+    fzz = -kz ** 2 * s * x * y
+    fzx = kz * cz_ * y
+    fzy = kz * cz_ * x
+    # rotate to cylinder components (z, phi, r) for both indices
+    def rot(vx, vy):
+        return polar_comps(vx, vy, phi)
+    # first index z row: (fzz, (fzx, fzy)->polar)
+    zphi, zr = rot(fzx, fzy)
+    # hessian in (phi, r) x (phi, r): H_polar = R H R^T with R the
+    # cartesian->polar rotation; rotate columns, then rows
+    phix, rx = rot(fxx, fxy)
+    phiy, ry = rot(fxy, fyy)
+    pp, pr = rot(phix, phiy)
+    rp, rr = rot(rx, ry)
+    expected = np.empty((3, 3) + np.broadcast_shapes(x.shape, z.shape),
+                        dtype=np.result_type(dtype, float))
+    expected[0, 0] = fzz + 0 * x
+    expected[0, 1] = zphi + 0 * z
+    expected[0, 2] = zr + 0 * z
+    expected[1, 0] = zphi + 0 * z
+    expected[1, 1] = pp + 0 * z
+    expected[1, 2] = pr + 0 * z
+    expected[2, 0] = zr + 0 * z
+    expected[2, 1] = rp + 0 * z
+    expected[2, 2] = rr + 0 * z
+    got = np.asarray(T["g"])
+    err = np.abs(got - expected).max()
+    assert err < 1e-7, f"max err {err}"
+
+
+@params
+def test_divergence_vector(shape, dealias, dtype):
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 8, dealias, dtype,
+                                                 shape)
+    f = dist.Field(bases=b, dtype=dtype)
+    f["g"] = 3 * x ** 2 + 2 * y ** 2 + np.sin(kz * z) * x
+    h = d3.div(d3.grad(f)).evaluate()
+    h.change_scales(1)
+    expected = 10 - kz ** 2 * np.sin(kz * z) * x + 0 * y
+    got = np.asarray(h["g"])
+    err = np.abs(got - np.broadcast_to(expected, got.shape)).max()
+    assert err < 1e-8, f"max err {err}"
+
+
+@params
+def test_divergence_tensor(shape, dealias, dtype):
+    """div(grad(grad(f))) = grad(lap(f)) componentwise."""
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 10, dealias, dtype,
+                                                 shape)
+    f = dist.Field(bases=b, dtype=dtype)
+    f["g"] = x ** 4 + y ** 4 + np.sin(kz * z) * x * y
+    v = d3.div(d3.grad(d3.grad(f))).evaluate()
+    v.change_scales(1)
+    # lap f = 12x^2 + 12y^2 - kz^2 sin x y
+    s = np.sin(kz * z)
+    gx = 24 * x - kz ** 2 * s * y
+    gy = 24 * y - kz ** 2 * s * x
+    gz = -kz ** 3 * np.cos(kz * z) * x * y
+    gphi, gr = polar_comps(gx, gy, phi)
+    assert_comps(v["g"], (gz + 0 * phi, gphi + 0 * z, gr + 0 * z), 1e-7)
+
+
+@params
+def test_curl_vector(shape, dealias, dtype):
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 8, dealias, dtype,
+                                                 shape)
+    v = dist.VectorField(c, bases=b, dtype=dtype)
+    # v = (4x^3 + 3y^2) e_y + x y sin(kz z) e_z
+    vy = 4 * x ** 3 + 3 * y ** 2 + 0 * z
+    vz = x * y * np.sin(kz * z)
+    vphi, vr = polar_comps(0 * vy, vy, phi)
+    vg = np.empty((3,) + np.broadcast_shapes(x.shape, z.shape),
+                  dtype=np.result_type(dtype, float))
+    vg[0] = vz
+    vg[1] = vphi + 0 * z
+    vg[2] = vr + 0 * z
+    v["g"] = vg
+    u = d3.curl(v).evaluate()
+    u.change_scales(1)
+    s = np.sin(kz * z)
+    # curl = (d_y v_z - d_z v_y, d_z v_x - d_x v_z, d_x v_y - d_y v_x)
+    ux = x * s - 0 * y
+    uy = -y * s + 0 * x
+    uz = 12 * x ** 2 + 0 * y + 0 * z
+    uphi, ur = polar_comps(ux, uy, phi)
+    assert_comps(u["g"], (uz + 0 * phi + 0 * z, uphi, ur), 1e-8)
+
+
+@params
+def test_laplacian_scalar(shape, dealias, dtype):
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 8, dealias, dtype,
+                                                 shape)
+    f = dist.Field(bases=b, dtype=dtype)
+    f["g"] = x ** 4 + 2 * y ** 4 + np.sin(kz * z) * x
+    h = d3.lap(f).evaluate()
+    h.change_scales(1)
+    expected = 12 * x ** 2 + 24 * y ** 2 - kz ** 2 * np.sin(kz * z) * x
+    got = np.asarray(h["g"])
+    err = np.abs(got - np.broadcast_to(expected, got.shape)).max()
+    assert err < 1e-7, f"max err {err}"
+
+
+@pytest.mark.parametrize("shape", ["disk", "annulus"])
+def test_ncc_scalar_lhs_vs_rhs(shape):
+    """LHS NCC matrices on the cylinder match explicit grid multiplication
+    (reference: tests/test_cylinder_ncc.py)."""
+    # annulus needs radial resolution for the 1/r profile (geometric
+    # convergence: ~1e-5 at Nr=12, ~3e-10 at Nr=24)
+    Nr = 24 if shape == "annulus" else 12
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 8, Nr, 1, np.float64,
+                                                 shape)
+    ncc = dist.Field(name="ncc", bases=b[1])
+    ncc["g"] = r ** 2 + (1 / r if shape == "annulus" else 0)
+    u = dist.Field(name="u", bases=b)
+    v = dist.Field(name="v", bases=b)
+    problem = d3.LBVP([u], namespace=locals())
+    problem.add_equation("ncc*u = ncc*v")
+    v["g"] = (x * y + 3 * y + r) * (1 + 0.5 * np.sin(kz * z))
+    problem.build_solver().solve()
+    u.change_scales(1)
+    v.change_scales(1)
+    assert np.abs(np.asarray(u["g"]) - np.asarray(v["g"])).max() < 1e-9
+
+
+def test_ncc_vector_operand_lhs_vs_rhs():
+    """Scalar radial NCC times a product-vector operand."""
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 8, 12, 1, np.float64)
+    ncc = dist.Field(name="ncc", bases=b[1])
+    ncc["g"] = 1 + r ** 2
+    u = dist.VectorField(c, name="u", bases=b)
+    v = dist.VectorField(c, name="v", bases=b)
+    problem = d3.LBVP([u], namespace=locals())
+    problem.add_equation("ncc*u = ncc*v")
+    vg = np.zeros((3,) + np.broadcast_shapes(x.shape, z.shape))
+    vg[0] = x * y * np.sin(kz * z)
+    vg[1], vg[2] = polar_comps(3 * x ** 2 + y, x + 2 * y, phi)
+    vg[1] = vg[1] + 0 * z
+    vg[2] = vg[2] + 0 * z
+    v["g"] = vg
+    problem.build_solver().solve()
+    u.change_scales(1)
+    v.change_scales(1)
+    assert np.abs(np.asarray(u["g"]) - np.asarray(v["g"])).max() < 1e-9
+
+
+def test_poisson_lbvp():
+    """lap(u) = f in the periodic cylinder with u(r=R)=0; manufactured
+    u = (R^2 - r^2) x sin(kz z) type solution via RHS evaluation."""
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 8, 16, 1, np.float64)
+    bz, bp = b
+    R = radius_disk
+    u = dist.Field(name="u", bases=b)
+    tau = dist.Field(name="tau", bases=(bz, bp.edge))
+    f = dist.Field(name="f", bases=b)
+    # u_exact = (R^2 - r^2) * x * sin(kz z) (vanishes at r=R; x = r cos phi)
+    # lap u_exact: compute in cartesian: u = (R^2 - x^2 - y^2) x sin
+    # d2x: -6x sin; d2y: -2x sin; d2z: -kz^2 (R^2-r^2) x sin
+    s = np.sin(kz * z)
+    f["g"] = (-6 * x - 2 * x - kz ** 2 * (R ** 2 - r ** 2) * x) * s
+    lift = lambda A: d3.Lift(A, bp.derivative_basis(2), -1)
+    problem = d3.LBVP([u, tau], namespace=locals())
+    problem.add_equation("lap(u) + lift(tau) = f")
+    problem.add_equation("u(r=1.5) = 0")
+    problem.build_solver().solve()
+    u.change_scales(1)
+    expected = (R ** 2 - r ** 2) * x * s
+    err = np.abs(np.asarray(u["g"]) - expected).max()
+    assert err < 1e-10, f"max err {err}"
+
+
+def test_heat_ivp_decay():
+    """Periodic-cylinder heat equation: the (kz, m=0) Bessel mode decays at
+    rate kz^2 + j01^2/R^2 (j01 = first zero of J0)."""
+    from scipy.special import jn_zeros, j0
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 8, 32, 1, np.float64)
+    bz, bp = b
+    R = radius_disk
+    u = dist.Field(name="u", bases=b)
+    tau = dist.Field(name="tau", bases=(bz, bp.edge))
+    lift = lambda A: d3.Lift(A, bp, -1)
+    problem = d3.IVP([u, tau], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(tau) = 0")
+    problem.add_equation("u(r=1.5) = 0")
+    solver = problem.build_solver(d3.RK443)
+    j01 = jn_zeros(0, 1)[0]
+    u["g"] = j0(j01 * r / R) * np.cos(kz * z) + 0 * phi
+    u0 = np.asarray(u["g"]).copy()
+    dt, n = 2e-4, 50
+    for _ in range(n):
+        solver.step(dt)
+    rate = kz ** 2 + (j01 / R) ** 2
+    expected = u0 * np.exp(-rate * n * dt)
+    err = np.abs(np.asarray(u["g"]) - expected).max()
+    assert err < 1e-6 * np.abs(u0).max(), f"max err {err}"
+
+
+@params
+def test_laplacian_vector(shape, dealias, dtype):
+    """lap(grad f) = grad(lap f)."""
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 16, 10, dealias, dtype,
+                                                 shape)
+    f = dist.Field(bases=b, dtype=dtype)
+    f["g"] = x ** 4 + y ** 4 + np.sin(kz * z) * x * y
+    u = d3.lap(d3.grad(f)).evaluate()
+    u.change_scales(1)
+    s = np.sin(kz * z)
+    gx = 24 * x - kz ** 2 * s * y
+    gy = 24 * y - kz ** 2 * s * x
+    gz = -kz ** 3 * np.cos(kz * z) * x * y
+    gphi, gr = polar_comps(gx, gy, phi)
+    assert_comps(u["g"], (gz + 0 * phi, gphi + 0 * z, gr + 0 * z), 1e-7)

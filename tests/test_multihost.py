@@ -1,0 +1,100 @@
+"""
+Two-process distributed execution test (reference: dedalus runs on any MPI
+world, tests_parallel/ under mpiexec; here two REAL jax.distributed
+processes on localhost, each owning 4 virtual CPU devices of a global
+8-device mesh).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO"])
+from dedalus_tpu.parallel import multihost as mh
+
+pid = int(sys.argv[1])
+mh.initialize(coordinator_address=os.environ["COORD"], num_processes=2,
+              process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.parallel import distribute_solver
+
+mesh = mh.device_mesh()
+coords = d3.CartesianCoordinates("x", "z")
+dist = d3.Distributor(coords, dtype=np.float64)
+xb = d3.RealFourier(coords["x"], size=32, bounds=(0, 4.0), dealias=3/2)
+zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1.0), dealias=3/2)
+u = dist.Field(name="u", bases=(xb, zb))
+t1 = dist.Field(name="t1", bases=xb)
+t2 = dist.Field(name="t2", bases=xb)
+lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+problem = d3.IVP([u, t1, t2], namespace=locals())
+problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+problem.add_equation("u(z=0) = 0")
+problem.add_equation("u(z=1) = 0")
+solver = problem.build_solver(d3.SBDF2)
+x, z = dist.local_grids(xb, zb)
+u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+distribute_solver(solver, mesh)
+for _ in range(3):
+    solver.step(1e-3)
+import jax.numpy as jnp
+finite = bool(jax.jit(lambda X: jnp.all(jnp.isfinite(X)))(solver.X))
+assert finite
+norm = float(jax.jit(lambda X: jnp.linalg.norm(X))(solver.X))
+Xfull = mh.process_allgather(solver.X)
+mh.barrier("done")
+print(f"WORKER_OK {pid} norm={norm:.12e} shape={Xfull.shape}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_MULTIHOST") == "1",
+                    reason="multihost disabled")
+def test_two_process_sharded_step(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["COORD"] = f"localhost:{_free_port()}"
+    env["REPO"] = repo
+    env.pop("JAX_PLATFORMS", None)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = [subprocess.Popen([sys.executable, str(script), str(i)], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              start_new_session=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n{err[-2000:]}"
+        assert "WORKER_OK" in out
+    # both processes agree on the global norm
+    norms = [out.split("norm=")[1].split()[0] for _, out, _ in outs]
+    assert norms[0] == norms[1]
