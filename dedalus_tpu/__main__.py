@@ -27,6 +27,26 @@ def bench():
     runpy.run_path(str(bench_path), run_name="__main__")
 
 
+def cov():
+    """Test suite under coverage (reference: dedalus/tests/__init__.py:30
+    cov). Requires the `coverage` package. Runs in a fresh interpreter so
+    coverage measures modules imported by the package itself (starting
+    coverage after this import would under-report __init__/tools)."""
+    try:
+        import coverage  # noqa: F401
+    except ImportError:
+        print("cov requires the 'coverage' package (pip install coverage)",
+              file=sys.stderr)
+        sys.exit(1)
+    import subprocess
+    root = pathlib.Path(__file__).parent.parent
+    rc = subprocess.run(
+        [sys.executable, "-m", "coverage", "run", "--source=dedalus_tpu",
+         "-m", "pytest", str(root / "tests"), "-q"], cwd=root).returncode
+    subprocess.run([sys.executable, "-m", "coverage", "report"], cwd=root)
+    sys.exit(rc)
+
+
 def get_config():
     from .tools.config import config
     config.write(sys.stdout)
@@ -38,8 +58,8 @@ def get_examples():
 
 
 def main():
-    commands = {"test": test, "bench": bench, "get_config": get_config,
-                "get_examples": get_examples}
+    commands = {"test": test, "bench": bench, "cov": cov,
+                "get_config": get_config, "get_examples": get_examples}
     if len(sys.argv) < 2 or sys.argv[1] not in commands:
         print(f"usage: python -m dedalus_tpu [{'|'.join(commands)}]",
               file=sys.stderr)

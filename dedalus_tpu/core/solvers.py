@@ -90,8 +90,20 @@ class SolverBase:
             if forced == "banded":
                 raise ValueError("Banded solve forced but not applicable: "
                                  f"{self._banded_reason}")
-            logger.info(f"Banded path not applicable ({self._banded_reason}); "
-                        f"using dense ({dense_bytes / 1e9:.2f} GB)")
+            msg = (f"Banded path not applicable ({self._banded_reason}); "
+                   f"using dense ({dense_bytes / 1e9:.2f} GB)")
+            if dense_bytes > 4 * cutoff_bytes:
+                # e.g. a Chebyshev x Chebyshev problem (two coupled axes):
+                # O(G S^2) memory and O(G S^3) factor work with no banded
+                # escape hatch yet — make the scale cost loud (reference
+                # handles arbitrary coupled sets with sparse LU,
+                # core/subsystems.py:493-598)
+                logger.warning(
+                    msg + " — this exceeds the banded cutoff 4x; consider "
+                    "lowering the coupled-axis resolution or making more "
+                    "axes separable (Fourier).")
+            else:
+                logger.info(msg)
             # reuse the already-assembled COO matrices for the dense fallback
             self._matrices = self._densify_coo_store(result, names, S)
         elif self._batched is not None:

@@ -72,9 +72,25 @@ def load_tasks_to_xarray(path, tasks=None):
                   if "scales/write_number" in f else None)
         names = tasks or list(f["tasks"])
         for name in names:
-            data = np.asarray(f["tasks"][name])
-            dims = ["t"] + [f"dim_{i}" for i in range(data.ndim - 1)]
+            dset = f["tasks"][name]
+            data = np.asarray(dset)
+            # dimension names/coordinates from the attached HDF5 scales
+            # (written at dataset creation, core/evaluator.py)
+            dims = []
             coords = {}
+            seen = set()
+            for d in range(data.ndim):
+                label = dset.dims[d].label or (
+                    "t" if d == 0 else f"dim_{d - 1}")
+                if label in seen:
+                    label = f"{label}_{d}"
+                seen.add(label)
+                dims.append(label)
+                if len(dset.dims[d]) and \
+                        dset.dims[d][0].shape[0] == data.shape[d]:
+                    coords[label] = (label, np.asarray(dset.dims[d][0]))
+            if dims and dims[0] in ("write", "t"):
+                dims[0] = "t"
             if t is not None:
                 coords["t"] = ("t", t)
             if writes is not None:

@@ -120,6 +120,27 @@ def test_filehandler_append_resumes_partial_set(tmp_path):
         assert list(np.asarray(f["scales/write_number"])) == [1, 2, 3, 4]
 
 
+def test_filehandler_grid_dimension_scales(tmp_path):
+    """Task datasets carry attached grid dimension scales (reference:
+    core/evaluator.py:656-728 setup_file scales), so post-processing can
+    recover coordinates from the file alone."""
+    import h5py
+    solver, u, x = build_heat()
+    out = tmp_path / "snaps"
+    handler = solver.evaluator.add_file_handler(out, iter=1, max_writes=10)
+    handler.add_task(u, name="u", layout="g")
+    for _ in range(3):
+        solver.step(1e-3)
+    files = sorted(out.glob("snaps_s*.h5"))
+    with h5py.File(files[0], "r") as f:
+        ds = f["tasks/u"]
+        assert ds.dims[0].label == "write"
+        assert ds.dims[1].label == "x"
+        grid = np.asarray(ds.dims[1][0])
+        assert grid.shape[0] == ds.shape[1]
+        assert np.allclose(grid, np.ravel(x))
+
+
 def test_post_merge_and_xarray(tmp_path):
     """Set merging + xarray loading (reference: tools/post.py:166,363)."""
     pytest.importorskip("xarray")
