@@ -257,6 +257,18 @@ class SphereBasis(SpinBasisMixin, Basis):
             col_off=lambda m: self._lmin(m, s))
 
     @CachedMethod
+    def sin_stack(self, s_out, s_in):
+        """(G, Ntheta, Ntheta): multiplication by sin(theta) carrying
+        spin-s_in components into the spin-s_out space (|ds| = 1; banded
+        with |l_out - l_in| <= 1) — the spin-mixing half of meridional
+        (ez-type) couplings."""
+        return self._build_stack(
+            lambda m: swsh.sin_matrix(self.Lmax, m, s_out, s_in),
+            self.Ntheta, self.Ntheta,
+            row_off=lambda m: self._lmin(m, s_out),
+            col_off=lambda m: self._lmin(m, s_in))
+
+    @CachedMethod
     def interpolation_stack(self, s, position):
         """(G, 1, Ntheta): evaluate spin-s components at colatitude
         `position`."""

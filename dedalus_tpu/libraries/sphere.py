@@ -162,6 +162,26 @@ def cos_matrix(Lmax, m, s):
 
 
 @cached_function
+def sin_matrix(Lmax, m, s_out, s_in):
+    """
+    Multiplication by sin(theta) mapping spin-s_in coefficients into the
+    spin-s_out space (|s_out - s_in| = 1): the spin-mixing half of
+    meridional (ez-type) couplings, banded with |l_out - l_in| <= 1.
+    Quadrature-exact: sin(theta) = (1-z)^(1/2) (1+z)^(1/2) shifts the
+    Jacobi envelope exponents by exactly the spin change, so the projected
+    integrand stays polynomial (reference: the Gaunt/Clenshaw couplings of
+    core/arithmetic.py:359-558 specialized to one sin(theta) factor).
+    """
+    if abs(s_out - s_in) != 1:
+        raise ValueError("sin_matrix requires |s_out - s_in| = 1.")
+    n_in = spin2jacobi(Lmax, m, s_in)[0]
+    M = _project(Lmax, m, s_out,
+                 lambda z: np.sqrt(1 - z * z) * harmonics(Lmax, m, s_in, z),
+                 n_in)
+    return M * _selection_mask(Lmax, m, s_out, s_in, 1)
+
+
+@cached_function
 def forward_matrix(Lmax, m, s, Ng=None):
     """
     Forward colatitude transform: values on the Ng-point Gauss-Legendre grid

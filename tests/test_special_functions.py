@@ -66,6 +66,51 @@ def test_swsh_cos_matrix():
     assert np.allclose((C @ c)[:-1], cf[:-1], atol=1e-11)
 
 
+@pytest.mark.parametrize("m", [0, 1, 3])
+@pytest.mark.parametrize("s_in,s_out", [(0, 1), (0, -1), (1, 0), (-1, 0),
+                                        (1, 2), (-1, -2)])
+def test_swsh_sin_matrix(m, s_in, s_out):
+    """sin(theta) spin-mixing multiplication reproduces grid-space
+    multiplication (the meridional ez-coupling half), with the |dl| <= 1
+    band structure."""
+    Lmax = 12
+    z, w = sphere.quadrature(Lmax + 3)
+    Yin = sphere.harmonics(Lmax, m, s_in, z)
+    Yout = sphere.harmonics(Lmax, m, s_out, z)
+    if not len(Yin) or not len(Yout):
+        pytest.skip("empty spin space at this (m, s)")
+    rng = np.random.default_rng(1)
+    c = rng.standard_normal(len(Yin))
+    f = c @ Yin
+    M = sphere.sin_matrix(Lmax, m, s_out, s_in)
+    proj = (Yout * w) @ (np.sqrt(1 - z * z) * f)
+    # the top degree couples past the truncation; compare below it
+    assert np.allclose((M @ c)[:-1], proj[:-1], atol=1e-11)
+    # band structure: |l_out - l_in| <= 1
+    l_out = np.arange(sphere.lmin(m, s_out), Lmax + 1)
+    l_in = np.arange(sphere.lmin(m, s_in), Lmax + 1)
+    outside = np.abs(l_out[:, None] - l_in[None, :]) > 1
+    assert np.abs(M[outside]).max() < 1e-13
+
+
+def test_sphere_sin_stack_alignment():
+    """SphereBasis.sin_stack aligns per-m blocks at each spin's l_min."""
+    import dedalus_tpu.public as d3
+    cs = d3.S2Coordinates("phi", "theta")
+    basis = d3.SphereBasis(cs, shape=(8, 8), dtype=np.float64)
+    stack = basis.sin_stack(1, 0)
+    ms = basis.group_m()
+    for g, m in enumerate(ms):
+        M = sphere.sin_matrix(basis.Lmax, int(m), 1, 0)
+        r0 = basis._lmin(int(m), 1)
+        c0 = basis._lmin(int(m), 0)
+        block = stack[g, r0:r0 + M.shape[0], c0:c0 + M.shape[1]]
+        assert np.allclose(block, M)
+        # nothing outside the aligned block
+        total = np.abs(stack[g]).sum()
+        assert np.isclose(total, np.abs(M).sum())
+
+
 def test_swsh_transform_roundtrip():
     Lmax, m, s = 20, 3, 1
     F = sphere.forward_matrix(Lmax, m, s)
