@@ -36,9 +36,19 @@ MEASURE = 50
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # Shared wedge-defense helpers (probe subprocess, plugin-strip env) live in
 # __graft_entry__ so bench.py and the dryrun use identical logic.
-from __graft_entry__ import (_kill_group, _probe_devices,
+from __graft_entry__ import (_append_result, _kill_group, _probe_devices,
                              _probe_backend_retrying,
                              _strip_plugin_env)  # noqa: E402
+
+
+def _log_result(record):
+    """Machine-record the PARENT-ACCEPTED outcome (success, fallback with
+    its error context, or total failure): a figure that exists only in
+    stdout/prose is a claim, not a result — and a child's own append could
+    leave orphan lines for runs the parent rejects."""
+    entry = {"config": f"rb{NX}x{NZ}_bench"}
+    entry.update(record)
+    _append_result(entry)
 
 
 def mark(msg):
@@ -142,6 +152,7 @@ def main():
         mark(f"backend probe ok: {info}")
         record, err = _run_child(os.environ, 2400, "default-backend")
         if record is not None:
+            _log_result(record)
             print(json.dumps(record), flush=True)
             return
         mark(f"default-backend run FAILED: {err}")
@@ -159,16 +170,19 @@ def main():
         record, err = _run_child(env, 1800, "cpu-fallback")
         if record is not None:
             record["error"] = "; ".join(errors)
+            _log_result(record)
             print(json.dumps(record), flush=True)
             return
         errors.append(err)
     else:
         errors.append(f"cpu fallback probe failed: {info}")
-    print(json.dumps({
+    failure = {
         "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec",
         "value": 0.0, "unit": "steps/sec", "vs_baseline": 0.0,
         "error": "; ".join(errors),
-    }), flush=True)
+    }
+    _log_result(failure)
+    print(json.dumps(failure), flush=True)
     sys.exit(1)
 
 

@@ -149,11 +149,9 @@ def main():
         r["backend"] = jax.default_backend()
         print(json.dumps(r))
     if record:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "results.jsonl")
-        with open(path, "a") as f:
-            for r in RESULTS:
-                f.write(json.dumps(r) + "\n")
+        from __graft_entry__ import _append_result
+        for r in RESULTS:
+            _append_result(r)
     mark("done")
 
 

@@ -228,9 +228,8 @@ def run_config(name, warmup=5, measure=50):
 
 
 def main():
+    from __graft_entry__ import _append_result
     names = sys.argv[1:] or list(CONFIGS)
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "results.jsonl")
     if len(names) > 1:
         # One subprocess per config: a config's device allocations (or a
         # wedged backend) must not poison the next — leftover HBM from an
@@ -249,8 +248,7 @@ def main():
                 record = {"config": name,
                           "error": f"subprocess exit {proc.returncode}"}
                 print(json.dumps(record), flush=True)
-                with open(out_path, "a") as f:
-                    f.write(json.dumps(record) + "\n")
+                _append_result(record)
         sys.exit(1 if failures else 0)
     for name in names:
         if name not in CONFIGS:
@@ -262,8 +260,7 @@ def main():
             record = {"config": name, "error": repr(e)}
             mark(f"{name}: FAILED {e!r}")
         print(json.dumps(record), flush=True)
-        with open(out_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        _append_result(record)
 
 
 if __name__ == "__main__":
