@@ -49,9 +49,11 @@ def advective_cfl_frequency(u, ug, xp=np):
     cs = u.tensorsig[0]
     dist = u.dist
     ndim = dist.dim
-    total = 0.0
-    if isinstance(cs, cmod.PolarCoordinates):
-        basis = u.domain.bases[dist.get_axis(cs.coords[1])]
+
+    def polar_frequency(polar_cs, u_az, u_r):
+        basis = u.domain.bases[dist.get_axis(polar_cs.coords[1])]
+        if basis is None:
+            return 0.0  # velocity constant over the polar factor
         r_axis = basis.first_axis + 1
         r = np.ravel(basis.global_grids(basis.dealias)[1])
         mmax = max(basis.shape[0] // 2 - 1, 0)
@@ -63,8 +65,36 @@ def advective_cfl_frequency(u, ug, xp=np):
             az = np.array([basis.radius / mmax])
         dr = basis.dealias[1] * (np.gradient(r) if r.size > 1
                                  else np.array([np.inf]))
-        total = (xp.abs(ug[0]) / _axis_profile(az, r_axis, ndim)
-                 + xp.abs(ug[1]) / _axis_profile(dr, r_axis, ndim))
+        return (xp.abs(u_az) / _axis_profile(az, r_axis, ndim)
+                + xp.abs(u_r) / _axis_profile(dr, r_axis, ndim))
+
+    def interval_frequency(coord, u_c):
+        axis = dist.get_axis(coord)
+        basis = u.domain.bases[axis]
+        if basis is None:
+            return 0.0
+        dx = interval_cfl_spacing(basis)
+        return xp.abs(u_c) / _axis_profile(dx, axis, ndim)
+
+    total = 0.0
+    if isinstance(cs, cmod.PolarCoordinates):
+        total = polar_frequency(cs, ug[0], ug[1])
+    elif isinstance(cs, cmod.DirectProduct):
+        # cylinder: straight factors get interval spacings, the polar
+        # factor its (azimuth, radius) spacings on its component slice
+        off = 0
+        for sub in cs.coordsystems:
+            if isinstance(sub, cmod.PolarCoordinates):
+                total = total + polar_frequency(sub, ug[off], ug[off + 1])
+            elif isinstance(sub, cmod.CurvilinearCoordinateSystem):
+                # an S2/spherical factor must not fall into the polar
+                # formula (it would read colatitude as radius, silently)
+                raise NotImplementedError(
+                    "CFL spacing for this DirectProduct factor.")
+            else:
+                for j, coord in enumerate(sub.coords):
+                    total = total + interval_frequency(coord, ug[off + j])
+            off += sub.dim
     elif isinstance(cs, cmod.S2Coordinates):
         basis = u.domain.bases[dist.get_axis(cs.coords[0])]
         u_mag = xp.sqrt(ug[0] ** 2 + ug[1] ** 2)
@@ -87,14 +117,9 @@ def advective_cfl_frequency(u, ug, xp=np):
                                  else np.array([np.inf]))
         total = total + xp.abs(ug[2]) / _axis_profile(dr, r_axis, ndim)
     else:
-        # Cartesian / direct products of interval bases
+        # Cartesian: per-axis interval spacings
         for i, coord in enumerate(cs.coords):
-            axis = dist.get_axis(coord)
-            basis = u.domain.bases[axis]
-            if basis is None:
-                continue
-            dx = interval_cfl_spacing(basis)
-            total = total + xp.abs(ug[i]) / _axis_profile(dx, axis, ndim)
+            total = total + interval_frequency(coord, ug[i])
     if np.isscalar(total):
         total = xp.zeros(ug.shape[1:])
     return total

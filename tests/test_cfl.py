@@ -38,6 +38,34 @@ def test_cfl_uniform_advection():
     assert abs(dt - expected) / expected < 0.05
 
 
+def test_cfl_cylinder_geometry():
+    """Cylinder (DirectProduct) velocities combine the straight axis's
+    interval spacing with the disk's (azimuth, radius) spacings."""
+    from dedalus_tpu.extras.flow_tools import advective_cfl_frequency
+    length, R = 2.0, 1.5
+    Nz, Nphi, Nr = 8, 8, 16
+    cz = d3.Coordinate("z")
+    cp = d3.PolarCoordinates("phi", "r")
+    c = d3.DirectProduct(cz, cp)
+    dist = d3.Distributor(c, dtype=np.float64)
+    bz = d3.RealFourier(cz, size=Nz, bounds=(0, length))
+    bp = d3.DiskBasis(cp, (Nphi, Nr), dtype=np.float64, radius=R)
+    u = dist.VectorField(c, name="u", bases=(bz, bp))
+    vz, vphi, vr = 2.0, 0.7, 0.3
+    ug = np.zeros((3, Nz, Nphi, Nr))
+    ug[0], ug[1], ug[2] = vz, vphi, vr
+    u["g"] = ug
+    freq = np.asarray(advective_cfl_frequency(u, ug))
+    # manual spacings: dz uniform; azimuth R/mmax (disk); dr from gradient
+    dz = length / Nz
+    mmax = Nphi // 2 - 1
+    r = np.ravel(bp.global_grids((1, 1))[1])
+    dr = np.gradient(r)
+    expected = vz / dz + vphi / (R / mmax) + vr / dr[None, None, :]
+    expected = np.broadcast_to(expected, freq.shape)
+    assert np.allclose(freq, expected, rtol=1e-12)
+
+
 def test_cfl_bounds_and_threshold():
     solver, u, coords = build_advection(2.0, 0.0)
     # max_dt bound binds for tiny velocity
