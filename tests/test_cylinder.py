@@ -283,6 +283,68 @@ def test_heat_ivp_decay():
     assert err < 1e-6 * np.abs(u0).max(), f"max err {err}"
 
 
+def test_volume_integral_and_interpolation():
+    """Volume integral over the product (Fourier x disk measure r dr dphi)
+    and interpolation along the straight axis."""
+    R = radius_disk
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 8, 16, 1, np.float64)
+    f = dist.Field(name="f", bases=b)
+    f["g"] = (1 + np.cos(2 * np.pi * z / length)) * (R ** 2 - r ** 2)
+    exact = length * np.pi * R ** 4 / 2
+    got = float(np.asarray(d3.Integrate(f, c).evaluate()["g"]).ravel()[0])
+    assert np.isclose(got, exact)
+    nested = float(np.asarray(
+        d3.Integrate(d3.Integrate(f, c.coordsystems[0]),
+                     c.coordsystems[1]).evaluate()["g"]).ravel()[0])
+    assert np.isclose(nested, exact)
+    g = d3.Interpolate(f, c["z"], 0.5).evaluate()
+    expect = (1 + np.cos(2 * np.pi * 0.5 / length)) * (R ** 2 - r ** 2)
+    assert np.abs(np.asarray(g["g"])[0] - expect).max() < 1e-12
+
+
+def test_pipe_flow_ivp_structure():
+    """Incompressible flow in a periodic pipe: vector IVP with pressure
+    gauge, divergence constraint, and no-slip walls — the full cylinder
+    fluid stack (reference geometry: tests/test_cylinder_*.py; no
+    reference pipe IVP exists, the disk EVP covers the physics)."""
+    R = radius_disk
+    c, dist, b, z, phi, r, x, y = build_cylinder(8, 8, 12, 3 / 2, np.float64)
+    bz, bp = b
+    cp = c.coordsystems[1]
+    u = dist.VectorField(c, name="u", bases=(bz, bp))
+    p = dist.Field(name="p", bases=(bz, bp))
+    tau_u = dist.VectorField(c, name="tau_u", bases=(bz, bp.edge))
+    tau_p = dist.Field(name="tau_p")
+    Fz = dist.VectorField(c, name="Fz")
+    Fz["g"] = np.array([1.0, 0, 0]).reshape((3, 1, 1, 1))
+    nu = 1.0
+    lift = lambda A: d3.Lift(A, bp, -1)
+    problem = d3.IVP([u, p, tau_u, tau_p], namespace=locals())
+    problem.add_equation(
+        "dt(u) - nu*lap(u) + grad(p) + lift(tau_u) = - u@grad(u) + Fz")
+    problem.add_equation("div(u) + tau_p = 0")
+    problem.add_equation(f"u(r={R}) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(d3.RK222)
+    for _ in range(20):
+        solver.step(2e-3)
+    X = np.asarray(solver.X)
+    assert np.isfinite(X).all()
+    # walls: no slip
+    wall = np.asarray(d3.Interpolate(u, cp["r"], R).evaluate()["g"])
+    assert np.abs(wall).max() < 1e-10
+    # incompressibility (constraint residual includes tau_p)
+    resid = np.asarray((d3.div(u) + tau_p).evaluate()["g"])
+    assert np.abs(resid).max() < 1e-10
+    # gauge
+    pint = float(np.asarray(d3.Integrate(p, c).evaluate()["g"]).ravel()[0])
+    assert abs(pint) < 1e-10
+    # flow accelerates along +z under the axial force
+    uz_mean = float(np.asarray(
+        d3.Integrate(u @ Fz, c).evaluate()["g"]).ravel()[0])
+    assert uz_mean > 0
+
+
 @params
 def test_laplacian_vector(shape, dealias, dtype):
     """lap(grad f) = grad(lap f)."""
