@@ -312,10 +312,12 @@ class FileHandler(Handler):
                               for i in range(basis.dim)))
                     for i, g in enumerate(gs):
                         grids.append((basis.cs.names[i], np.ravel(g)))
+        import hashlib
         for gname, grid in grids:
-            key = f"{gname}_{hash(tuple(np.ravel(grid)[:3].tolist())) & 0xffff:x}"
+            flat = np.ravel(grid)
+            key = f"{gname}_{hashlib.sha1(flat.tobytes()).hexdigest()[:12]}"
             if key not in grp:
-                grp.create_dataset(key, data=np.ravel(grid))
+                grp.create_dataset(key, data=flat)
                 grp[key].make_scale(gname)
             ds.dims[dim].attach_scale(grp[key])
             ds.dims[dim].label = gname

@@ -372,8 +372,11 @@ class BandedOps:
         BANDED_CHUNK_MB (the observed XLA temp footprint is a small
         multiple of that slab). When C*Gc > G (e.g. prime G) the batch is
         edge-padded with copies of the last group — factoring a duplicate
-        is well-conditioned and its results are trimmed — so chunking
-        never degenerates to size-1 sequential chunks."""
+        is well-conditioned and its results are trimmed — so divisibility
+        never degenerates chunking to size-1 sequential chunks. (When one
+        group's factor slab alone exceeds the target, Gc still clamps to 1
+        and factorization proceeds group-at-a-time: the target is a soft
+        bound, exceeded only by indivisible per-group slabs.)"""
         target = float(config["linear algebra"].get(
             "BANDED_CHUNK_MB", "256")) * 1e6
         per_g = self.NB * (2 * self.q * self.q) * 2 * itemsize
@@ -528,13 +531,16 @@ class BandedOps:
         out_bytes = G * self.NB * (2 * self.q * self.q) * 2 * itemsize
         return out_bytes > thresh
 
-    def factor_lincomb_incremental(self, a, M, L, b_scale=None):
+    def factor_lincomb_incremental(self, a, M, L, b_scale):
         """factor_lincomb(a, M, b, L) as C separate device dispatches: each
         chunk is combined + factored by a small jitted program whose result
         is written into donated (C, Gc, ...) stores, so the full-batch scan
         temps never coexist with the finished factors. Returns the same
         chunked aux `solve` already consumes. Host-level: call OUTSIDE jit."""
         import functools
+        if b_scale is None:
+            raise ValueError("factor_lincomb_incremental requires b_scale "
+                             "(the coefficient multiplying L).")
         b = b_scale
         G = M.bands.shape[0]
         dtype = M.bands.dtype

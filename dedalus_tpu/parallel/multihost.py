@@ -51,6 +51,11 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     global _initialized
     if _initialized:
         return
+    client = getattr(jax.distributed, "global_state", None)
+    if client is not None and getattr(client, "client", None) is not None:
+        # user code already called jax.distributed.initialize() directly
+        _initialized = True
+        return
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
