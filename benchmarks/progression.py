@@ -132,11 +132,20 @@ def build_rb3d(Nx, Ny, Nz, dtype):
 
 def build_shallow_water(Nphi, Ntheta, dtype):
     import dedalus_tpu.public as d3
-    R = 6.37122e6
-    Omega = 7.292e-5
-    nu = 1e5 * 32 ** 2
-    g = 9.80616
-    H = 1e4
+    # Simulation units (reference: examples/ivp_sphere_shallow_water/
+    # shallow_water.py:24-40): nondimensionalized so R = 1, hour = 1.
+    # Raw SI units put the hyperdiffusion matrix entries (~ ell^4 / R^4
+    # ~ 1e-36) at the f32 denormal boundary, where the factorization
+    # flushes them to zero — the root cause of the round-3 sw_ell255
+    # finite=false run (see BENCHMARKS.md).
+    meter = 1 / 6.37122e6
+    hour = 1
+    second = hour / 3600
+    R = 6.37122e6 * meter
+    Omega = 7.292e-5 / second
+    nu = 1e5 * meter ** 2 / second / 32 ** 2  # hyperdiffusion matched at ell=32
+    g = 9.80616 * meter / second ** 2
+    H = 1e4 * meter
     coords = d3.S2Coordinates("phi", "theta")
     dist = d3.Distributor(coords, dtype=dtype)
     basis = d3.SphereBasis(coords, shape=(Nphi, Ntheta), dtype=dtype,
@@ -146,7 +155,7 @@ def build_shallow_water(Nphi, Ntheta, dtype):
     zcross = lambda A: d3.MulCosine(d3.Skew(A))
     phi, theta = dist.local_grids(basis)
     lat = np.pi / 2 - theta + 0 * phi
-    umax = 80 * R / (12 * 86400)
+    umax = 80 * meter / second  # reference: shallow_water.py:44
     lat0, lat1 = np.pi / 7, np.pi / 2 - np.pi / 7
     en = np.exp(-4 / (lat1 - lat0) ** 2)
     jet = (lat0 <= lat) * (lat <= lat1)
@@ -155,7 +164,7 @@ def build_shallow_water(Nphi, Ntheta, dtype):
     ug = np.array([ug, 0 * ug])
     ug[0][jet] = u_jet
     u["g"] = ug
-    h["g"] = 120 * np.cos(lat) * np.exp(-(phi / (1 / 3)) ** 2) \
+    h["g"] = 120 * meter * np.cos(lat) * np.exp(-(phi / (1 / 3)) ** 2) \
         * np.exp(-((np.pi / 4 - lat) / (1 / 15)) ** 2)
     problem = d3.IVP([u, h], namespace=locals())
     problem.add_equation(
@@ -163,7 +172,7 @@ def build_shallow_water(Nphi, Ntheta, dtype):
         "= - u@grad(u)")
     problem.add_equation("dt(h) + nu*lap(lap(h)) + H*div(u) = - div(u*h)")
     solver = problem.build_solver(d3.RK222)
-    return solver, 300.0
+    return solver, 300.0 * second
 
 
 CONFIGS = {
@@ -200,6 +209,11 @@ def run_config(name, warmup=5, measure=50):
             solver.X.block_until_ready()
             mark(f"{name}: first step done in {time.time() - t_c:.1f}s")
     solver.X.block_until_ready()
+    finite_warmup = bool(np.all(np.isfinite(np.asarray(solver.X))))
+    if not finite_warmup:
+        mark(f"{name}: STATE NOT FINITE after {warmup} warmup steps — "
+             "failing loudly (check dt stability / f32 dynamic range; "
+             "see BENCHMARKS.md sw_ell255 root cause)")
     # block of `measure` steps in one device dispatch (compiles once)
     mark(f"{name}: compiling {measure}-step block")
     solver.step_many(measure, dt)
@@ -222,6 +236,7 @@ def run_config(name, warmup=5, measure=50):
         "mode_stages_per_sec": round(G * S * stages * sps, 1),
         "build_sec": round(build_s, 2),
         "finite": finite,
+        "finite_after_warmup": finite_warmup,
     }
     mark(f"{name}: {sps:.2f} steps/s, finite={finite}")
     return record

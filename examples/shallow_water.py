@@ -11,18 +11,25 @@ import dedalus_tpu.public as d3
 import logging
 logger = logging.getLogger(__name__)
 
+# Simulation units (reference: shallow_water.py:24-27): nondimensionalize
+# so the radius is 1 and an hour is 1 — raw SI values span enough orders
+# that the hyperdiffusion entries underflow f32 on accelerators.
+meter = 1 / 6.37122e6
+hour = 1
+second = hour / 3600
+
 # Parameters (reference: shallow_water.py:28-40)
 import sys
 quick = "--quick" in sys.argv
 Nphi, Ntheta = (64, 32) if quick else (256, 128)
 dealias = 3 / 2
-R = 6.37122e6          # meters
-Omega = 7.292e-5       # 1 / s
-nu = 1e5 * 32**2       # m^2/s (hyperdiffusion at ell = 32)
-g = 9.80616            # m / s^2
-H = 1e4                # m
-timestep = 600         # s
-stop_sim_time = 10 * 600 if quick else 360 * 3600
+R = 6.37122e6 * meter
+Omega = 7.292e-5 / second
+nu = 1e5 * meter**2 / second / 32**2  # hyperdiffusion matched at ell = 32
+g = 9.80616 * meter / second**2
+H = 1e4 * meter
+timestep = 600 * second
+stop_sim_time = 10 * 600 * second if quick else 360 * hour
 dtype = np.float64
 
 # Bases
@@ -41,7 +48,7 @@ phi, theta = dist.local_grids(basis)
 lat = np.pi / 2 - theta + 0 * phi
 
 # Initial conditions: zonal jet (Galewsky et al. 2004)
-umax = 80 * R / (12 * 86400)
+umax = 80 * meter / second
 lat0 = np.pi / 7
 lat1 = np.pi / 2 - lat0
 en = np.exp(-4 / (lat1 - lat0) ** 2)
@@ -62,7 +69,7 @@ solver.solve()
 
 # Initial conditions: perturbation
 lat2 = np.pi / 4
-hpert = 120
+hpert = 120 * meter
 alpha = 1 / 3
 beta = 1 / 15
 h['g'] += hpert * np.cos(lat) * np.exp(-(phi / alpha) ** 2) \
@@ -80,7 +87,7 @@ solver.stop_sim_time = stop_sim_time
 
 # Analysis
 snapshots = solver.evaluator.add_file_handler(
-    'snapshots_shallow_water', sim_dt=3600, max_writes=10)
+    'snapshots_shallow_water', sim_dt=1 * hour, max_writes=10)
 snapshots.add_task(h, name='height')
 snapshots.add_task(-d3.div(d3.Skew(u)), name='vorticity')
 

@@ -209,3 +209,22 @@ def test_sphere_shallow_water_ivp():
     assert np.isfinite(np.asarray(u["g"])).all()
     mass1 = float(np.asarray(d3.integ(h).evaluate()["g"]).ravel()[0])
     assert abs(mass1 - mass0) < 1e-10
+
+
+def test_shallow_water_f32_finite():
+    """The nondimensionalized Galewsky config must stay finite in f32
+    (regression: round-3 sw_ell255 NaN came from raw-SI units putting
+    hyperdiffusion entries below the f32 normal range; BENCHMARKS.md)."""
+    import sys
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "benchmarks"))
+    import progression
+    solver, dt = progression.build_shallow_water(64, 32, np.float32)
+    for _ in range(5):
+        solver.step(dt)
+    X = np.asarray(solver.X)
+    assert np.isfinite(X).all()
+    # hyperdiffusion entries must be representable in f32 (not denormal)
+    L = solver._matrices["L"]
+    vals = np.abs(np.asarray(L)[np.asarray(L) != 0])
+    assert vals.min() > 1e-30
