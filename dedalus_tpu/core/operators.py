@@ -83,9 +83,27 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
             factors = [tensor_identity(tshape_in)]
         else:
             factors = [sparsify(tensor_factor)]
+        # gblocks whose selector axis the LAYOUT coupled (e.g. radial
+        # stacks selected by ell when a theta-dependent NCC couples ell):
+        # the (selector x this) joint factor is the block diagonal of the
+        # stack in selector-group order, consuming the selector axis's
+        # identity slot (valid only for an adjacent, otherwise-untouched
+        # selector axis — the kron ordering then matches block_diag's).
+        joint_consumed = set()
+        for axis, descr in enumerate(axis_descrs):
+            if (descr is not None and descr[0] == "gblocks"
+                    and group[descr[1]] is None):
+                group_axis = descr[1]
+                if group_axis != axis - 1 or axis_descrs[group_axis] is not None:
+                    raise NotImplementedError(
+                        "Layout-coupled gblocks selector must be the "
+                        "adjacent untouched axis.")
+                joint_consumed.add(group_axis)
         for axis, descr in enumerate(axis_descrs):
             basis = operand_domain.bases[axis]
             sub = 0 if basis is None else axis - basis.first_axis
+            if axis in joint_consumed:
+                continue  # replaced by the adjacent joint block factor
             if descr is None:
                 factors.append(_axis_identity(basis, sep_widths.get(axis), sub))
             else:
@@ -106,7 +124,11 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
                     # per-group blocks on a coupled axis, group read from a
                     # different (separable) axis
                     _, group_axis, stack = descr
-                    factors.append(sparsify(stack[group[group_axis]]))
+                    if group[group_axis] is None:
+                        factors.append(sp.block_diag(
+                            [sparsify(b) for b in stack], format="csr"))
+                    else:
+                        factors.append(sparsify(stack[group[group_axis]]))
                 else:
                     raise ValueError(kind)
         mat = sparse_kron(*factors)

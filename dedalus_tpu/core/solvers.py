@@ -40,19 +40,25 @@ class SolverBase:
     (reference: core/solvers.py:31 SolverBase)."""
 
     matrices = ("L",)
+    lazy_ok = False   # EVP: per-group on-demand assembly at large sizes
 
-    def __init__(self, problem, matsolver=None):
+    def __init__(self, problem, matsolver=None, ncc_cutoff=None, **kw):
         self.problem = problem
         self.dist = problem.dist
         self.variables = self.matrix_variables(problem)
         if matsolver is None:
             matsolver = config["linear algebra"].get("MATRIX_SOLVER", "auto")
         self.matsolver = matsolver
+        # parity kwarg (reference: solvers accept ncc_cutoff for Clenshaw
+        # truncation); here NCC matrices are quadrature-built and sparsified
+        # at fixed tolerance, so the value only gates sparsify cutoffs
+        self.ncc_cutoff = ncc_cutoff
         self.layout = PencilLayout(self.dist, self.variables,
                                    problem.equations)
         self.equations = merge_conditional_equations(problem.equations,
                                                      self.dist, self.layout)
         self.subproblems = build_subproblems(self.layout)
+        self._lazy = False
         self._build_pencil_system()
         self.valid_row_mask = row_valid_masks(self.layout, self.equations)
 
@@ -71,8 +77,26 @@ class SolverBase:
         """
         names = self.matrices
         G, S = self.pencil_shape
-        self._assemble_batched(names)
         dense_bytes = G * S * S * np.dtype(self.pencil_dtype).itemsize
+        lazy_bytes = int(config["linear algebra"].get(
+            "EVP_LAZY_BYTES", str(1 << 28)))
+        if self.lazy_ok and dense_bytes > lazy_bytes:
+            # EVP at scale (e.g. ell-coupled rotating convection): skip the
+            # full (G, S, S) batched store entirely; solve_dense/solve_sparse
+            # assemble the requested group on demand, sparse end-to-end
+            # (reference: per-subproblem sparse assembly + SuperLU,
+            # core/solvers.py:225 solve_sparse)
+            logger.info(
+                f"EVP pencil system: lazy per-group assembly "
+                f"(G={G}, S={S}; dense store would be "
+                f"{dense_bytes / 1e9:.2f} GB)")
+            self._lazy = True
+            self._batched = None
+            self._matrices = None
+            self.structure = None
+            self.ops = None
+            return
+        self._assemble_batched(names)
         spec = self.matsolver if isinstance(self.matsolver, str) else ""
         forced = spec.lower() if spec.lower() in ("banded", "dense") else None
         cutoff_bytes = int(config["linear algebra"].get(
@@ -434,7 +458,7 @@ class InitialValueSolver(SolverBase):
                  enforce_real_cadence=100, warmup_iterations=10,
                  profile=None, profile_directory=None, **kw):
         init_t0 = time_mod.time()
-        super().__init__(problem, matsolver=matsolver)
+        super().__init__(problem, matsolver=matsolver, **kw)
         self.M_mat = self.ops.to_device(self._matrices["M"], self.pencil_dtype)
         self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F", time_field=problem.time)
@@ -695,7 +719,7 @@ class LinearBoundaryValueSolver(SolverBase):
     matrices = ("L",)
 
     def __init__(self, problem, matsolver=None, **kw):
-        super().__init__(problem, matsolver=matsolver)
+        super().__init__(problem, matsolver=matsolver, **kw)
         self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F")
         self._aux = self.ops.factor(self.L_mat)
@@ -728,7 +752,7 @@ class NonlinearBoundaryValueSolver(SolverBase):
     def __init__(self, problem, matsolver=None, **kw):
         # Matrices are in terms of the perturbation variables.
         self._problem_ref = problem
-        super().__init__(problem, matsolver=matsolver)
+        super().__init__(problem, matsolver=matsolver, **kw)
         self.iteration = 0
         # residual expressions converted to equation-block domains
         self._residual_exprs = {}
@@ -794,12 +818,54 @@ class EigenvalueSolver(SolverBase):
     """EVP solver: lam*M.X + L.X = 0 (reference: core/solvers.py:134)."""
 
     matrices = ("M", "L")
+    lazy_ok = True
 
     def __init__(self, problem, matsolver=None, **kw):
-        super().__init__(problem, matsolver=matsolver)
+        super().__init__(problem, matsolver=matsolver, **kw)
         self.eigenvalues = None
         self.eigenvectors = None
         self.eigenvalue_subproblem = None
+
+    def _group_csr(self, subproblem):
+        """
+        {name: scipy CSR} of one subproblem's pencil matrices, sparse
+        end-to-end: lazy mode assembles the single group on demand; the
+        batched shared-pattern store scatters directly to CSR; only the
+        banded/dense device stores densify (reference: sparse per-
+        subproblem matrices, core/subsystems.py:493-598).
+        """
+        import scipy.sparse as sps
+        names = self.matrices
+        G, S = self.pencil_shape
+        if self._lazy:
+            cache = getattr(self, "_lazy_cache", None)
+            if cache is not None and cache[0] == subproblem.index:
+                return cache[1]
+            coos, _, _ = assemble_group_coos(
+                subproblem, self.equations, self.variables, names)
+            out = {name: sps.csr_matrix(
+                (vals, (rows, cols)), shape=(S, S))
+                for name, (rows, cols, vals) in coos.items()}
+            self._lazy_cache = (subproblem.index, out)
+            return out
+        if self._batched is not None:
+            pr, pc, vals, row_valid, col_valid = self._batched
+            g = subproblem.index
+            out = {}
+            for name in names:
+                mat = sps.csr_matrix((vals[name][g], (pr, pc)), shape=(S, S))
+                out[name] = mat
+            inv_rows = np.flatnonzero(~row_valid[g])
+            inv_cols = np.flatnonzero(~col_valid[g])
+            if len(inv_rows):
+                closure = sps.csr_matrix(
+                    (np.ones(len(inv_rows)), (inv_rows, inv_cols)),
+                    shape=(S, S))
+                out[names[-1]] = out[names[-1]] + closure
+            return out
+        return {name: sps.csr_matrix(
+            self.ops.densify_host(self._matrices[name], subproblem.index))
+            for name in names}
 
     def solve_dense(self, subproblem, left=False, normalize_left=True,
                     rebuild_matrices=False, **kw):
@@ -808,10 +874,13 @@ class EigenvalueSolver(SolverBase):
         reassembles M/L around the current NCC field data (parameter
         continuation, e.g. the Mathieu example's q sweep)."""
         if rebuild_matrices:
-            self._build_pencil_system()
-        sp_i = subproblem.index
-        L = self.ops.densify_host(self._matrices["L"], sp_i)
-        M = self.ops.densify_host(self._matrices["M"], sp_i)
+            if self._lazy:
+                self._lazy_cache = None
+            else:
+                self._build_pencil_system()
+        mats = self._group_csr(subproblem)
+        L = mats["L"].toarray()
+        M = mats["M"].toarray()
         out = scipy.linalg.eig(L, b=-M, left=left, **kw)
         if left:
             evals, evecs_left, evecs = out
@@ -836,12 +905,13 @@ class EigenvalueSolver(SolverBase):
         """Sparse shift-invert eigensolve around `target`
         (reference: core/solvers.py:225 solve_sparse)."""
         from ..tools.array import scipy_sparse_eigs
-        import scipy.sparse as sps
         if rebuild_matrices:
-            self._build_pencil_system()
-        sp_i = subproblem.index
-        L = sps.csr_matrix(self.ops.densify_host(self._matrices["L"], sp_i))
-        M = sps.csr_matrix(self.ops.densify_host(self._matrices["M"], sp_i))
+            if self._lazy:
+                self._lazy_cache = None
+            else:
+                self._build_pencil_system()
+        mats = self._group_csr(subproblem)
+        L, M = mats["L"], mats["M"]
         out = scipy_sparse_eigs(A=L, B=-M, N=N, target=target, left=left, **kw)
         if left:
             self.eigenvalues, self.eigenvectors, self.left_eigenvalues, \

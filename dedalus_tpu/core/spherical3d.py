@@ -175,6 +175,23 @@ class ShellBasis(WeightedJacobiRadial, Basis):
             dtype=self.dtype, radius=radius, dealias=self.dealias[:2],
             ell_separable=True)
 
+    @property
+    def meridional_basis(self):
+        """Basis for NCC fields varying along (theta, r) only (reference:
+        core/basis.py ShellBasis.meridional_basis). Here NCC angular
+        structure is detected from field DATA rather than the declared
+        basis, so this aliases the full basis; phi-constancy is validated
+        at assembly (grid memory for the extra phi dim is negligible at
+        NCC-construction scales)."""
+        return self
+
+    @property
+    def radial_basis(self):
+        """Basis for radius-only NCC fields (reference: core/basis.py
+        ShellBasis.radial_basis); aliases the full basis — see
+        `meridional_basis`."""
+        return self
+
     # ------------------------------------------------------------ structure
 
     @property
@@ -238,21 +255,27 @@ class ShellBasis(WeightedJacobiRadial, Basis):
         az_axis = self.first_axis
         colat_axis = az_axis + 1
         gs = self.sub_group_shape(0)
-        if az_axis not in sep_widths or colat_axis not in sep_widths:
+        if az_axis not in sep_widths:
             raise NotImplementedError(
-                "Shell angular axes must be pencil (group) axes.")
+                "Shell azimuth must be a pencil (group) axis.")
         ms = self.group_m()
         m = ms[group[az_axis]]
-        ell = group[colat_axis]
-        comp_ok = valid_regularities(ell, rank) & (ell >= abs(m))
-        mask = np.broadcast_to(comp_ok[:, None, None, None],
-                               (ncomp, gs, 1, self.Nr)).copy()
+        if colat_axis in sep_widths:
+            ells = np.array([group[colat_axis]])
+        else:
+            # layout-coupled colatitude (theta-dependent NCC): all ell
+            # slots live in one per-m pencil
+            ells = np.arange(self.Ntheta)
+        comp_ok = np.stack([valid_regularities(int(ell), rank)
+                            & (ell >= abs(m)) for ell in ells], axis=1)
+        mask = np.broadcast_to(comp_ok[:, None, :, None],
+                               (ncomp, gs, ells.size, self.Nr)).copy()
         if self.complex and group[az_axis] == self.Nphi // 2:
             mask[:] = False  # Nyquist
-        if (not self.complex) and rank <= 1 and ell == 0:
+        if (not self.complex) and rank <= 1:
             # Drop msin slots at ell == 0 for real scalars and vectors
             # (reference: core/basis.py:4301)
-            mask[:, 1, :, :] = False
+            mask[:, 1, ells == 0, :] = False
         return mask
 
     # ----------------------------------------------------------- transforms
@@ -456,6 +479,17 @@ class BallBasis(Basis):
             self.coordsystem.S2coordsys, (self.Nphi, self.Ntheta),
             dtype=self.dtype, radius=radius, dealias=self.dealias[:2],
             ell_separable=True)
+
+    @property
+    def meridional_basis(self):
+        """See ShellBasis.meridional_basis: aliases the full basis (NCC
+        angular structure is detected from data)."""
+        return self
+
+    @property
+    def radial_basis(self):
+        """See ShellBasis.radial_basis: aliases the full basis."""
+        return self
 
     # ------------------------------------------------------------ structure
 

@@ -48,6 +48,32 @@ def _ncc_forced_coupled_axes(variables, equations):
 
     forced = set()
 
+    def couples_colatitude(ncc_expr, basis):
+        """Does a spherical-basis NCC vary with colatitude (or carry
+        non-radial components)? Evaluated from its data, mirroring the
+        validation rules of the angularly-constant fast path
+        (arithmetic.ProductBase._sph_ncc_setup); anything that path would
+        reject couples ell instead (reference: theta-dependent NCCs make
+        subproblems ell-coupled, core/arithmetic.py:359-406)."""
+        from .arithmetic import ProductBase
+        from ..tools.exceptions import NonlinearOperatorError
+        try:
+            ncc = ncc_expr if isinstance(ncc_expr, Field) \
+                else ncc_expr.evaluate()
+            spin_prof, tol = ProductBase.sph_ncc_angular_profile(
+                ncc, basis, basis.cs)
+        except NonlinearOperatorError:
+            raise
+        except Exception:
+            return True  # cannot classify: couple conservatively
+        ncomp = spin_prof.shape[0]
+        radial_flat = ncomp - 1  # all-radial (spin 0) flat slot
+        for c in range(ncomp):
+            if c != radial_flat and np.abs(spin_prof[c]).max() > tol:
+                return True
+        rad = spin_prof[radial_flat]
+        return bool(np.abs(rad - rad[:1, :]).max() > tol)
+
     def walk(expr):
         if not isinstance(expr, Future):
             return
@@ -56,10 +82,17 @@ def _ncc_forced_coupled_axes(variables, equations):
             ncc_sides = [a for a in sides if not contains_vars(a)]
             if len(ncc_sides) == 1:
                 for axis, basis in enumerate(ncc_sides[0].domain.bases):
-                    if basis is None or basis.dim != 1:
-                        # multi-dim (curvilinear) NCC bases are handled by
-                        # the angularly-constant radial-matrix path; only
-                        # 1-D separable (Fourier) axes force coupling
+                    if basis is None:
+                        continue
+                    if basis.dim != 1:
+                        # multi-dim (curvilinear) NCC: angularly-constant
+                        # radial profiles keep per-(m, ell) pencils;
+                        # theta-dependent data couples the colatitude axis
+                        colat = basis.first_axis + 1
+                        if (basis.dim == 3 and axis == colat
+                                and basis.sub_separable(1)
+                                and couples_colatitude(ncc_sides[0], basis)):
+                            forced.add(colat)
                         continue
                     sub = axis - basis.first_axis
                     if basis.sub_separable(sub):

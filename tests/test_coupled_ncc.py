@@ -1,0 +1,150 @@
+"""
+Colatitude-dependent (ell-coupled) NCCs on the shell
+(reference: dedalus/core/arithmetic.py:359-406 theta-dependent Clenshaw
+NCCs; dedalus/examples/evp_shell_rotating_convection).
+
+The core check: the assembled pencil matrix of an LHS product with a
+theta/radius-dependent NCC must act on coefficients exactly like the
+grid-space pointwise product. Both are linear maps applied to the same
+operand coefficients, so agreement on every azimuthal group is a
+bit-level validation of the SWSH triple-product couplings, the
+regularity intertwiner sandwich, and the slot bookkeeping.
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.core.subsystems import PencilLayout, build_subproblems
+
+
+def _shell(dtype, Nphi=8, Ntheta=8, Nr=6, radii=(0.6, 1.5)):
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=dtype)
+    shell = d3.ShellBasis(coords, shape=(Nphi, Ntheta, Nr), radii=radii,
+                          dtype=dtype)
+    return coords, dist, shell
+
+
+def _ez(dist, coords, shell):
+    phi, theta, r = dist.local_grids(shell)
+    ez = dist.VectorField(coords, name="ez", bases=shell.meridional_basis)
+    ez["g"][1] = -np.sin(theta)
+    ez["g"][2] = np.cos(theta)
+    return ez
+
+
+def _check_expr(dist, expr, operand, groups=None):
+    """Compare the assembled pencil matrix action against grid evaluation
+    on every (or selected) azimuthal group."""
+    eq = {"domain": expr.domain, "tensorsig": tuple(expr.tensorsig), "L": expr}
+    layout = PencilLayout(dist, [operand], [eq])
+    # the theta-dependent NCC must have forced the colatitude coupled
+    colat = expr.domain.bases[-1].first_axis + 1
+    assert colat not in layout.sep_widths
+    sps = build_subproblems(layout)
+    Xin = np.asarray(layout.gather(operand.coeff_data(), operand.domain,
+                                   operand.tensorsig))
+    out = expr.evaluate()
+    Xout = np.asarray(layout.gather(out.coeff_data(), out.domain,
+                                    out.tensorsig))
+    scale = max(np.abs(Xout).max(), 1e-12)
+    checked = 0
+    for sp in sps:
+        if groups is not None and sp.index not in groups:
+            continue
+        mats = expr.expression_matrices(sp, [operand])
+        y = mats[operand] @ Xin[sp.index]
+        valid = layout.valid_mask(expr.domain, tuple(expr.tensorsig),
+                                  sp.group).ravel()
+        err = np.abs(y - Xout[sp.index])[valid].max(initial=0.0) / scale
+        assert err < 2e-10, (sp.group, err)
+        # grid evaluation must not put significant data in invalid slots
+        inv = np.abs(Xout[sp.index])[~valid].max(initial=0.0) / scale
+        assert inv < 1e-8, (sp.group, inv)
+        checked += 1
+    assert checked
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_scalar_ncc_theta_radial(dtype):
+    """f(theta, r) * u for scalar u: pure ell-coupling, no spin mixing."""
+    coords, dist, shell = _shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    f = dist.Field(name="f", bases=shell.meridional_basis)
+    f["g"] = 2.0 + np.cos(theta) * (1 + 0.3 * r) + 0.5 * np.cos(theta) ** 2
+    u = dist.Field(name="u", bases=shell)
+    u["g"] = np.sin(theta) ** 2 * np.cos(2 * phi) * (r - 1) + np.cos(theta)
+    _check_expr(dist, (f * u), u)
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_vector_ncc_times_scalar(dtype):
+    """ez * u: spin-mixing vector NCC times scalar operand."""
+    coords, dist, shell = _shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    ez = _ez(dist, coords, shell)
+    u = dist.Field(name="u", bases=shell)
+    u["g"] = np.cos(theta) * r + np.sin(theta) * np.sin(phi) * (r - 1)
+    _check_expr(dist, (ez * u), u)
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_dot_ncc_vector(dtype):
+    """dot(ez, v) for vector v: contraction through the spin metric."""
+    coords, dist, shell = _shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    ez = _ez(dist, coords, shell)
+    v = dist.VectorField(coords, name="v", bases=shell)
+    v["g"][0] = np.sin(theta) * np.cos(phi) * r
+    v["g"][1] = np.sin(theta) * np.cos(theta) * (r - 1)
+    v["g"][2] = np.cos(theta) ** 2 + 0.2 * r
+    _check_expr(dist, d3.dot(ez, v), v)
+
+
+def test_cross_ncc_vector_complex():
+    """cross(ez, v): the Coriolis coupling (complex dtype)."""
+    dtype = np.complex128
+    coords, dist, shell = _shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    ez = _ez(dist, coords, shell)
+    v = dist.VectorField(coords, name="v", bases=shell)
+    v["g"][0] = np.sin(theta) * np.sin(phi) * r
+    v["g"][1] = np.sin(theta) * np.cos(theta)
+    v["g"][2] = np.cos(theta) + 0.1 * r
+    _check_expr(dist, d3.cross(ez, v), v)
+
+
+def test_radial_ncc_stays_separable():
+    """An angularly-constant radial NCC must NOT couple ell (fast path)."""
+    dtype = np.complex128
+    coords, dist, shell = _shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    rvec = dist.VectorField(coords, name="rvec", bases=shell.radial_basis)
+    rvec["g"][2] = np.broadcast_to(r, rvec["g"][2].shape)
+    u = dist.Field(name="u", bases=shell)
+    expr = rvec * u
+    eq = {"domain": expr.domain, "tensorsig": tuple(expr.tensorsig), "L": expr}
+    layout = PencilLayout(dist, [u], [eq])
+    colat = shell.first_axis + 1
+    assert colat in layout.sep_widths
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_lbvp_coupled_ncc_roundtrip(dtype):
+    """Full-chain: solve (2 + cos(theta)(1+r)/2) * u = F for known u."""
+    coords, dist, shell = _shell(dtype)
+    phi, theta, r = dist.local_grids(shell)
+    f = dist.Field(name="f", bases=shell.meridional_basis)
+    f["g"] = 2.0 + 0.5 * np.cos(theta) * (1 + r)
+    u = dist.Field(name="u", bases=shell)
+    u_target = dist.Field(name="u_target", bases=shell)
+    u_target["g"] = (np.cos(theta) * r
+                     + np.sin(theta) * np.sin(phi) * (r - 1.0) ** 2)
+    F = (f * u_target).evaluate()
+    problem = d3.LBVP([u], namespace=locals())
+    problem.add_equation("f*u = F")
+    solver = problem.build_solver()
+    solver.solve()
+    err = np.abs(np.asarray(u["g"]) - np.asarray(u_target["g"])).max()
+    assert err < 1e-9
