@@ -115,9 +115,18 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
                         # layout-coupled separable basis (e.g. a Fourier
                         # axis an LHS NCC varies along): the whole-axis
                         # matrix is the block diagonal of the per-group
-                        # blocks in group order
-                        factors.append(sp.block_diag(
-                            [sparsify(b) for b in descr[1]], format="csr"))
+                        # blocks in group order — except for embeddings
+                        # FROM a constant axis (operand basis None), whose
+                        # single input slot feeds every group: stack the
+                        # per-group columns instead
+                        if basis is None:
+                            factors.append(sp.vstack(
+                                [sparsify(b) for b in descr[1]],
+                                format="csr"))
+                        else:
+                            factors.append(sp.block_diag(
+                                [sparsify(b) for b in descr[1]],
+                                format="csr"))
                     else:
                         factors.append(sparsify(descr[1][group[axis]]))
                 elif kind == "gblocks":
