@@ -1079,7 +1079,18 @@ class TraceOperator(LinearOperator):
         return [(tensor_factor, [None] * operand.domain.dim)]
 
 
-class TransposeComponents(LinearOperator):
+def TransposeComponents(operand, indices=(0, 1)):
+    """Swap two tensor indices (reference: core/operators.py:1849).
+    Spherical regularity-component bases need the per-ell intertwined
+    transpose; everywhere else the coefficient components are a kron over
+    indices and a plain permutation is exact."""
+    if any(getattr(b, "regularity", False) for b in operand.domain.bases):
+        from .spherical3d import SphericalTransposeComponents
+        return SphericalTransposeComponents(operand, indices)
+    return CartesianTransposeComponents(operand, indices)
+
+
+class CartesianTransposeComponents(LinearOperator):
     """Swap two tensor indices (reference: core/operators.py:1849)."""
 
     name = "TransposeComponents"
@@ -1089,7 +1100,7 @@ class TransposeComponents(LinearOperator):
         super().__init__(operand)
 
     def rebuild(self, new_args):
-        return TransposeComponents(new_args[0], self.indices)
+        return CartesianTransposeComponents(new_args[0], self.indices)
 
     def _build_metadata(self):
         operand = self.args[0]

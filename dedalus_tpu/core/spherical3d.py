@@ -1156,6 +1156,77 @@ class SphericalTrace(SphericalEllOperator):
         return jnp.einsum("ii...->...", data)
 
 
+class SphericalTransposeComponents(LinearOperator):
+    """
+    Index transpose for tensors on shell/ball (regularity-component)
+    bases. The regularity intertwiner Q(ell) is NOT a kron over tensor
+    indices, so a plain component permutation is wrong; the transpose in
+    coefficient space is the per-ell sandwich Q(ell)^T P_swap Q(ell)
+    with P_swap the index swap in the (kron-structured) spin frame
+    (reference: core/operators.py:1870 TransposeComponents with
+    radial_basis intertwiners). Entry-decomposed into one-hot tensor
+    factors with per-ell colatitude blocks, like SphericalLift.
+    """
+
+    name = "TransposeComponents"
+    natural_layout = "g"
+
+    def __init__(self, operand, indices=(0, 1)):
+        self.indices = indices
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return SphericalTransposeComponents(new_args[0], self.indices)
+
+    def _basis(self, operand):
+        for b in operand.domain.bases:
+            if getattr(b, "regularity", False):
+                return b
+        raise ValueError("Operand has no 3D spherical basis.")
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        i, j = self.indices
+        ts = list(operand.tensorsig)
+        ts[i], ts[j] = ts[j], ts[i]
+        self.domain = operand.domain
+        self.tensorsig = tuple(ts)
+        self.dtype = operand.dtype
+
+    def terms(self):
+        operand = self.operand
+        basis = self._basis(operand)
+        az = basis.first_axis
+        colat = az + 1
+        rank = spherical_rank(operand.tensorsig, basis.cs)
+        ncomp = 3 ** rank
+        tshape = operand.tshape
+        perm = np.arange(ncomp).reshape(tshape)
+        perm = np.swapaxes(perm, *self.indices).ravel()
+        P = np.zeros((ncomp, ncomp))
+        P[np.arange(ncomp), perm] = 1.0
+        Q = q_stack(basis.Ntheta, rank)          # (Ntheta, spin, reg)
+        M = np.einsum("lsi,st,ltj->lij", Q, P, Q)  # Q^T P Q per ell
+        dim = operand.domain.dim
+        terms = []
+        for i in range(ncomp):
+            for j in range(ncomp):
+                col = M[:, i, j]
+                if not np.any(np.abs(col) > 1e-14):
+                    continue
+                factor = np.zeros((ncomp, ncomp))
+                factor[i, j] = 1.0
+                descrs = [None] * dim
+                descrs[colat] = ("blocks", col.reshape(-1, 1, 1))
+                terms.append((factor, descrs))
+        return terms
+
+    def ev_impl(self, ctx):
+        data = ev(self.operand, ctx, "g")
+        i, j = self.indices
+        return jnp.swapaxes(data, i, j)
+
+
 class SphericalSpinTrace(LinearOperator):
     """Trace of rank-2 spherical-signature tensors on S2 (boundary) bases,
     where components are stored in the 3D spin frame: the spin metric
