@@ -584,27 +584,39 @@ class MatrixStructure:
 
     def __init__(self, layout, variables, equations):
         self.layout = layout
-        self.ok = (len(layout.coupled_axes) == 1)
-        self.reason = None if self.ok else "not exactly one coupled axis"
+        caxes = list(layout.coupled_axes)
+        self.ok = len(caxes) in (1, 2)
+        self.reason = None if self.ok else \
+            f"{len(caxes)} coupled axes (banded supports 1 or 2)"
         if not self.ok:
             return
-        caxis = layout.coupled_axes[0]
         var_offsets, eq_sizes, S = _system_sizes(layout, equations, variables)
         self.S = S
+        self.n_caxes = len(caxes)
 
         def base_order(items):
-            """items: [(domain, tensorsig)] -> (by_mode, uncoupled) indices."""
+            """items: [(domain, tensorsig)] -> (by_mode, uncoupled) indices.
+            With two coupled axes (e.g. Chebyshev x Chebyshev, reference:
+            core/subsystems.py:493-598 sparse coupled sets), modes are the
+            FLATTENED (outer, inner) coupled slots — the banded machinery
+            then sees one super-axis whose band is wide but whose occupied
+            diagonals stay sparse (kron structure)."""
             by_mode = None
             uncoupled = []
             offset = 0
             for domain, tsig in items:
                 shape = layout.slot_shape(domain, tsig)
                 n_slots = int(np.prod(shape))
-                basis = domain.bases[caxis]
-                if basis is None:
+                present = [ax for ax in caxes if domain.bases[ax] is not None]
+                if not present:
+                    uncoupled.extend(range(offset, offset + n_slots))
+                elif len(present) < len(caxes):
+                    # partial extent (e.g. an x-boundary tau field on a
+                    # 2-coupled-axis domain): modes along the missing axis
+                    # collapse; treat every slot as uncoupled (pinned)
                     uncoupled.extend(range(offset, offset + n_slots))
                 else:
-                    Nc = shape[1 + caxis]
+                    Nc = int(np.prod([shape[1 + ax] for ax in caxes]))
                     if by_mode is None:
                         by_mode = [[] for _ in range(Nc)]
                     elif len(by_mode) != Nc:
@@ -612,7 +624,9 @@ class MatrixStructure:
                         self.reason = "mismatched coupled sizes"
                         return None, None
                     idx = np.arange(n_slots).reshape(shape)
-                    idx = np.moveaxis(idx, 1 + caxis, 0).reshape(Nc, -1)
+                    idx = np.moveaxis(idx, [1 + ax for ax in caxes],
+                                      list(range(len(caxes))))
+                    idx = idx.reshape(Nc, -1)
                     for m in range(Nc):
                         by_mode[m].extend((offset + idx[m]).tolist())
                 offset += n_slots
@@ -631,6 +645,14 @@ class MatrixStructure:
         self._rows_int = np.array([i for m in rows_by_mode for i in m])
         self._rows_unc = np.array(rows_unc, dtype=int)
         self.n_modes = len(rows_by_mode)
+        # inner-axis mode count (window sizing for 2-coupled-axis systems)
+        self._inner_modes = 1
+        if self.n_caxes == 2:
+            for v in variables:
+                if all(v.domain.bases[ax] is not None for ax in caxes):
+                    shape = layout.slot_shape(v.domain, v.tensorsig)
+                    self._inner_modes = shape[1 + caxes[-1]]
+                    break
         self._cols_by_mode = cols_by_mode
         self._cols_unc = np.array(cols_unc, dtype=int)
         self._row_mode = -np.ones(S, dtype=int)
@@ -683,6 +705,10 @@ class MatrixStructure:
             qual_r = vmax[self._rows_int][:, self.col_perm].multiply(qual_r)
         Q = sp.coo_matrix(qual_r)
         window = 16 * max(8, len(self._rows_int) // self.n_modes)
+        if getattr(self, "n_caxes", 1) > 1:
+            # two flattened coupled axes: outer-axis couplings sit a full
+            # inner extent apart, so the matching window must span them
+            window = min(window * max(self._inner_modes, 1), self.S)
         near = np.abs(Q.col - Q.row) <= window
         Qr = sp.csr_matrix((Q.data[near], (Q.row[near], Q.col[near])),
                            shape=Q.shape)
@@ -690,13 +716,26 @@ class MatrixStructure:
         match = -np.ones(nr, dtype=int)
         col_taken = np.zeros(S, dtype=bool)
         indptr, indices, data = Qr.indptr, Qr.indices, Qr.data
+        # With two flattened coupled axes, stability requires aligning on
+        # a DOMINANT entry: a far (outer-axis) coupling that is merely a
+        # perturbation (an ell-coupled NCC term) turns the block
+        # elimination into an exponentially growing outer recurrence, so
+        # NCC-forced couplings gate candidates to within a factor of the
+        # row's largest magnitude. Two GENUINE coupled bases (a rectangle's
+        # Dxx vs Dzz) are same-order principals — there the plain
+        # highest-offset rule is the consistent (stable) alignment, and
+        # magnitude-gating would mix alignments row by row (n^2-dependent
+        # relative sizes) and destabilize the elimination.
+        ncc_forced = bool(getattr(self.layout, "forced_coupled", None))
+        sig_frac = 0.3 if (getattr(self, "n_caxes", 1) > 1
+                           and ncc_forced) else 1e-10
         for i in range(nr - 1, -1, -1):
             cand = indices[indptr[i]:indptr[i + 1]]
             w = data[indptr[i]:indptr[i + 1]]
             free = ~col_taken[cand]
             if free.any():
                 cand, w = cand[free], w[free]
-                sig = w >= 1e-10 * w.max()
+                sig = w >= sig_frac * w.max()
                 c = cand[sig].max()
                 match[i] = c
                 col_taken[c] = True
@@ -776,12 +815,21 @@ class MatrixStructure:
         q = max(self.kl, -(-(self.ku + 1) // 2), -(-(self.kl + self.ku) // 2), 1)
         self.q = int(-(-q // 8) * 8) if q > 8 else max(q, 1)
         self.NB = -(-S // self.q)
-        # nd caps: relative (structure isn't really banded) and absolute
-        # (the matvec unrolls nd slice-mul-adds into the jitted step, and
-        # block size q tracks the band, so very wide bands lose to dense)
-        if nd > band_cutoff * S or nd > 384 or self.NB < min_blocks:
+        # Caps. The lattice width (nd) may legitimately be large for two
+        # flattened coupled axes (kron terms land a full inner extent
+        # apart) — what the per-step matvec unrolls is the number of
+        # OCCUPIED diagonals, so cap that; the relative cap rejects
+        # structures where the blocked factorization (storage ~ 4 S q)
+        # cannot beat dense (~ S^2).
+        from ..tools.config import config
+        max_diags = int(config["linear algebra"].get(
+            "BANDED_MAX_DIAGS", "384"))
+        n_occ = len(np.unique(d))
+        if (nd > band_cutoff * S or n_occ > max_diags
+                or self.NB < min_blocks or 8 * self.q > S):
             self.ok = False
-            self.reason = f"band too wide ({nd} diagonals for S={S})"
+            self.reason = (f"band too wide ({n_occ} occupied of {nd} "
+                           f"diagonals for S={S}, q={self.q})")
         if self.t_pins > max(64, 0.25 * S):
             self.ok = False
             self.reason = f"too many pinned rows ({self.t_pins} of {S})"

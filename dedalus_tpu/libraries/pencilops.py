@@ -139,8 +139,8 @@ class BandedOps:
         self.col_perm = np.asarray(st.col_perm)
         self.pos_col = np.argsort(self.col_perm)  # orig index -> permuted pos
         self.pin_pos = np.asarray(st.pinned_positions)
-        # static block-gather indices: block[o][i, ri, ci] reads
-        # bands[:, o*q + ci - ri + kl, i*q + ri]
+        # static block-gather indices: block[o][ri, ci] reads
+        # bands[:, o*q + ci - ri + kl, block_row*q + ri]
         q, NB, kl = self.q, self.NB, self.kl
         ri = np.arange(q)[:, None]
         ci = np.arange(q)[None, :]
@@ -148,10 +148,7 @@ class BandedOps:
         for o in (-1, 0, 1):
             d = o * q + ci - ri + kl                 # (q, q)
             valid = (d >= 0) & (d < self.nd)
-            rows = np.arange(NB)[:, None, None] * q + ri[None]   # (NB, q, q)
-            self._blk_idx[o] = (np.where(valid, d, 0)[None].repeat(NB, 0),
-                                rows + 0 * ci[None],
-                                valid)
+            self._blk_idx[o] = (np.where(valid, d, 0), valid)
 
     # ------------------------------------------------------------ host side
 
@@ -238,8 +235,7 @@ class BandedOps:
         ri = np.broadcast_to(np.arange(q)[:, None], (q, q))
         out = {}
         for o in (-1, 0, 1):
-            d_idx, _, valid = self._blk_idx[o]
-            d = d_idx[0]                                     # (q, q)
+            d, valid = self._blk_idx[o]                      # (q, q)
             blk = chunk[:, d, ri] * jnp.asarray(valid, dtype=chunk.dtype)
             out[o] = blk
         return out[0], out[-1], out[1]

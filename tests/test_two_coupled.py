@@ -1,0 +1,137 @@
+"""
+Two-coupled-axis (Chebyshev x Chebyshev) structured solves
+(reference: dedalus/core/subsystems.py:493-598 — arbitrary coupled sets
+via sparse SuperLU; here the two coupled axes flatten into one banded
+super-axis whose occupied diagonals stay sparse under kron structure,
+solved by the same blocked windowed-pivoting LU as single-axis problems).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.libraries.pencilops import BandedOps
+
+# NOTE: a tau-less "u + dxx(u) = F" operator problem is NOT a usable test:
+# the conversion diagonals decay like n^-2 while the strictly-upper D^2
+# entries grow like n^3, so the triangular system's condition number is
+# astronomical. All tests below use proper tau formulations.
+
+
+def _build_poisson_rect(Nx, Nz, matsolver):
+    """Rectangle Poisson with tau lines on both axes (the corner modes
+    close through the lifted tau columns)."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.ChebyshevT(coords["x"], size=Nx, bounds=(0, 1))
+    zb = d3.ChebyshevT(coords["z"], size=Nz, bounds=(0, 1))
+    x, z = dist.local_grids(xb, zb)
+    u = dist.Field(name="u", bases=(xb, zb))
+    tx1 = dist.Field(name="tx1", bases=zb)
+    tx2 = dist.Field(name="tx2", bases=zb)
+    tz1 = dist.Field(name="tz1", bases=xb)
+    tz2 = dist.Field(name="tz2", bases=xb)
+    # exact solution vanishing on the boundary
+    u_ex = np.sin(np.pi * x) * np.sin(np.pi * z) * np.exp(x)
+    rhs = dist.Field(name="rhs", bases=(xb, zb))
+    lap_ex = (np.exp(x) * np.sin(np.pi * z)
+              * ((1 - np.pi ** 2) * np.sin(np.pi * x)
+                 + 2 * np.pi * np.cos(np.pi * x))
+              - np.pi ** 2 * np.sin(np.pi * x) * np.sin(np.pi * z)
+              * np.exp(x))
+    rhs["g"] = lap_ex
+    liftx = lambda A, n: d3.Lift(A, xb.derivative_basis(2), n)
+    liftz = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+    problem = d3.LBVP([u, tx1, tx2, tz1, tz2], namespace=locals())
+    problem.add_equation("lap(u) + liftx(tx1,-1) + liftx(tx2,-2)"
+                         " + liftz(tz1,-1) + liftz(tz2,-2) = rhs")
+    problem.add_equation("u(x=0) = 0")
+    problem.add_equation("u(x=1) = 0")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("u(z=1) = 0")
+    solver = problem.build_solver(matsolver=matsolver)
+    return solver, u, u_ex
+
+
+def test_poisson_rectangle_dense():
+    solver, u, u_ex = _build_poisson_rect(24, 24, "dense")
+    solver.solve()
+    assert np.abs(np.asarray(u["g"]) - u_ex).max() < 1e-8
+
+
+def test_poisson_rectangle_banded_matches_dense():
+    # large enough that the flattened band beats dense (q << S)
+    solver_d, u_d, u_ex = _build_poisson_rect(48, 48, "dense")
+    solver_d.solve()
+    ref = np.asarray(u_d["g"]).copy()
+    solver_b, u_b, _ = _build_poisson_rect(48, 48, "banded")
+    assert isinstance(solver_b.ops, BandedOps), solver_b._banded_reason
+    solver_b.solve()
+    sol = np.asarray(u_b["g"])
+    assert np.abs(sol - u_ex).max() < 1e-7
+    assert np.abs(sol - ref).max() < 1e-8
+
+
+def test_shell_theta_ncc_ivp_banded_matches_dense():
+    """Well-posed 2-coupled-axis IVP: shell diffusion with a
+    theta-dependent conductivity NCC (ell x r coupled pencils, the
+    rotating-convection-class structure; no rectangle corner modes)."""
+    def build(matsolver):
+        coords = d3.SphericalCoordinates("phi", "theta", "r")
+        dist = d3.Distributor(coords, dtype=np.float64)
+        shell = d3.ShellBasis(coords, shape=(8, 40, 24), radii=(0.5, 1.5),
+                              dtype=np.float64)
+        phi, theta, r = dist.local_grids(shell)
+        T = dist.Field(name="T", bases=shell)
+        tau1 = dist.Field(name="tau1", bases=shell.outer_surface)
+        tau2 = dist.Field(name="tau2", bases=shell.outer_surface)
+        kap = dist.Field(name="kap", bases=shell.meridional_basis)
+        kap["g"] = 1.0 + 0.4 * np.cos(theta) + 0.2 * r * np.cos(theta) ** 2
+        lift_basis = shell.derivative_basis(1)
+        lift = lambda A: d3.Lift(A, lift_basis, -1)
+        rvec = dist.VectorField(coords, bases=shell.meridional_basis)
+        rvec["g"][2] = np.broadcast_to(r, rvec["g"][2].shape)
+        grad_T = d3.grad(T) + rvec * lift(tau1)
+        problem = d3.IVP([T, tau1, tau2], namespace=locals())
+        problem.add_equation(
+            "dt(T) - div(kap*grad_T) + lift(tau2) = 0")
+        problem.add_equation("T(r=0.5) = 0")
+        problem.add_equation("T(r=1.5) = 0")
+        solver = problem.build_solver(d3.SBDF2, matsolver=matsolver)
+        T["g"] = (np.sin(np.pi * (r - 0.5))
+                  * (1 + 0.3 * np.cos(theta)
+                     + 0.2 * np.sin(theta) * np.cos(phi)))
+        return solver, T
+
+    s_d, T_d = build("dense")
+    for _ in range(5):
+        s_d.step(2e-3)
+    ref = np.asarray(T_d["g"]).copy()
+    assert np.isfinite(ref).all()
+    s_b, T_b = build("banded")
+    assert isinstance(s_b.ops, BandedOps), s_b._banded_reason
+    for _ in range(5):
+        s_b.step(2e-3)
+    sol = np.asarray(T_b["g"])
+    assert np.isfinite(sol).all()
+    assert np.abs(sol - ref).max() < 1e-11 * max(np.abs(ref).max(), 1.0)
+
+
+def test_poisson_rectangle_banded_at_scale():
+    """128^2 two-Chebyshev Poisson: the AUTO path must pick the banded
+    representation (dense would be (G,S,S) ~ 2.2 GB) and solve to
+    spectral accuracy — the memory-order-below-dense demonstration."""
+    from dedalus_tpu.tools.config import config
+    old = config["linear algebra"].get("BANDED_MAX_DIAGS", "384")
+    config["linear algebra"]["BANDED_MAX_DIAGS"] = "768"
+    try:
+        solver, u, u_ex = _build_poisson_rect(128, 128, "auto")
+    finally:
+        config["linear algebra"]["BANDED_MAX_DIAGS"] = old
+    assert isinstance(solver.ops, BandedOps), solver._banded_reason
+    st = solver.structure
+    band_bytes = sum(v["bands"].nbytes for v in solver._matrices.values())
+    dense_bytes = 1 * st.S * st.S * 8
+    assert band_bytes < dense_bytes / 20
+    solver.solve()
+    assert np.abs(np.asarray(u["g"]) - u_ex).max() < 1e-8
