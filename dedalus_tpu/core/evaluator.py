@@ -179,6 +179,21 @@ class Handler:
             cache = self._task_cache = {"key": key,
                                         "runner": self._compile_tasks()}
         arrays = cache["runner"]()
+        import jax
+        if jax.process_count() > 1:
+            # multi-process world: device arrays spanning processes are
+            # gathered collectively to a full copy on every process
+            # (reference: per-process files + merge or gather modes,
+            # dedalus/core/evaluator.py:656-846 — here the gather mode);
+            # host arrays / single-process arrays are already global
+            from ..parallel import multihost
+
+            def to_global(v):
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    return multihost.process_allgather(v)
+                return np.asarray(v)
+
+            return {name: to_global(v) for name, v in arrays.items()}
         return {name: np.asarray(v) for name, v in arrays.items()}
 
     def process(self, **kw):
@@ -213,30 +228,50 @@ class FileHandler(Handler):
         self.write_num = 0
         self.current_file = None
         self.writes_in_set = 0
-        os.makedirs(self.base_path, exist_ok=True)
+        from ..parallel import multihost
+        self._primary = multihost.is_primary()
+        if self._primary:
+            os.makedirs(self.base_path, exist_ok=True)
         if self.mode == "append":
-            # continue set and write numbering from existing output
-            # (reference: core/evaluator.py:415-438 append-mode bookkeeping)
-            from ..tools.post import get_assigned_sets
-            existing = get_assigned_sets(self.base_path)
-            if existing:
-                import h5py
-                self.set_num = int(existing[-1].stem.rsplit("_s", 1)[1])
-                # scan back past empty/partial sets (e.g. from a crashed
-                # run) so write_number stays globally unique
-                for path in reversed(existing):
-                    with h5py.File(path, "r") as f:
-                        if "scales/write_number" in f and len(f["scales/write_number"]):
-                            self.write_num = int(np.asarray(f["scales/write_number"])[-1])
-                            break
-                # resume the last set if it still has room, instead of
-                # opening a fresh under-filled set on every restart
-                with h5py.File(existing[-1], "r") as f:
-                    writes = (len(f["scales/write_number"])
-                              if "scales/write_number" in f else 0)
-                if writes < self.max_writes:
-                    self.current_file = str(existing[-1])
-                    self.writes_in_set = writes
+            # continue set and write numbering from existing output;
+            # only the primary scans the (shared) filesystem, then the
+            # bookkeeping is broadcast so every process numbers writes
+            # identically (reference: core/evaluator.py:415-438)
+            resume = 0
+            if self._primary:
+                self._scan_existing_sets()
+                resume = int(self.current_file is not None)
+            state = multihost.broadcast_from_primary(
+                np.array([self.set_num, self.write_num,
+                          self.writes_in_set, resume], dtype=np.int64))
+            self.set_num, self.write_num, self.writes_in_set, resume = (
+                int(v) for v in state)
+            if resume and self.current_file is None:
+                self.current_file = str(
+                    self.base_path
+                    / f"{self.base_path.name}_s{self.set_num}.h5")
+
+    def _scan_existing_sets(self):
+        from ..tools.post import get_assigned_sets
+        existing = get_assigned_sets(self.base_path)
+        if existing:
+            import h5py
+            self.set_num = int(existing[-1].stem.rsplit("_s", 1)[1])
+            # scan back past empty/partial sets (e.g. from a crashed
+            # run) so write_number stays globally unique
+            for path in reversed(existing):
+                with h5py.File(path, "r") as f:
+                    if "scales/write_number" in f and len(f["scales/write_number"]):
+                        self.write_num = int(np.asarray(f["scales/write_number"])[-1])
+                        break
+            # resume the last set if it still has room, instead of
+            # opening a fresh under-filled set on every restart
+            with h5py.File(existing[-1], "r") as f:
+                writes = (len(f["scales/write_number"])
+                          if "scales/write_number" in f else 0)
+            if writes < self.max_writes:
+                self.current_file = str(existing[-1])
+                self.writes_in_set = writes
 
     def _new_file(self):
         import h5py
@@ -245,9 +280,10 @@ class FileHandler(Handler):
         name = f"{self.base_path.name}_s{self.set_num}.h5"
         path = self.base_path / name
         self.current_file = str(path)
-        with h5py.File(path, "w") as f:
-            f.create_group("tasks")
-            f.create_group("scales")
+        if self._primary:
+            with h5py.File(path, "w") as f:
+                f.create_group("tasks")
+                f.create_group("scales")
         return path
 
     def process(self, iteration=0, wall_time=0.0, sim_time=0.0, timestep=None, **kw):
@@ -256,7 +292,11 @@ class FileHandler(Handler):
             self._new_file()
         self.write_num += 1
         self.writes_in_set += 1
+        # collective: every process participates in evaluation/gather;
+        # only the primary touches the file below
         results = self.evaluate_tasks()
+        if not self._primary:
+            return
         with h5py.File(self.current_file, "a") as f:
             scales = f["scales"]
             for key, val in [("sim_time", sim_time), ("wall_time", wall_time),

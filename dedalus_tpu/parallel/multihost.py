@@ -101,3 +101,15 @@ def process_allgather(x):
         return np.asarray(x)
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def broadcast_from_primary(values):
+    """Broadcast a flat numeric array from process 0 to all processes
+    (reference: rank-0 state scattered through COMM_WORLD; used for
+    append-mode output bookkeeping so only the primary scans the shared
+    filesystem)."""
+    values = np.asarray(values)
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.broadcast_one_to_all(values))

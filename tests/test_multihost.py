@@ -98,3 +98,96 @@ def test_two_process_sharded_step(tmp_path):
     # both processes agree on the global norm
     norms = [out.split("norm=")[1].split()[0] for _, out, _ in outs]
     assert norms[0] == norms[1]
+
+
+OUTPUT_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO"])
+from dedalus_tpu.parallel import multihost as mh
+
+pid = int(sys.argv[1])
+out_dir = sys.argv[2]
+mh.initialize(coordinator_address=os.environ["COORD"], num_processes=2,
+              process_id=pid)
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.parallel import distribute_solver
+
+mesh = mh.device_mesh()
+coords = d3.CartesianCoordinates("x", "z")
+dist = d3.Distributor(coords, dtype=np.float64)
+xb = d3.RealFourier(coords["x"], size=32, bounds=(0, 4.0), dealias=3/2)
+zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1.0), dealias=3/2)
+u = dist.Field(name="u", bases=(xb, zb))
+t1 = dist.Field(name="t1", bases=xb)
+t2 = dist.Field(name="t2", bases=xb)
+lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+problem = d3.IVP([u, t1, t2], namespace=locals())
+problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+problem.add_equation("u(z=0) = 0")
+problem.add_equation("u(z=1) = 0")
+solver = problem.build_solver(d3.SBDF2)
+x, z = dist.local_grids(xb, zb)
+u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+distribute_solver(solver, mesh)
+
+# analysis file: primary-gated writes backed by collective allgather
+# (reference: tests_parallel/test_output_parallel.py:48-59)
+snaps = solver.evaluator.add_file_handler(out_dir, iter=2)
+snaps.add_task(u, name="u")
+snaps.add_task(d3.Differentiate(u, coords["x"]), name="ux")
+for _ in range(4):
+    solver.step(1e-3)   # writes land after iterations 2 and 4
+mh.barrier("writes_done")
+
+# check the file against locally evaluated (gathered) task data
+u.change_scales(1)
+u_now = np.asarray(u["g"])  # field data is process-locally global
+import h5py
+with h5py.File(os.path.join(out_dir, os.path.basename(out_dir) + "_s1.h5"),
+               "r") as f:
+    wn = np.asarray(f["scales/write_number"])
+    data = np.asarray(f["tasks/u"])
+assert len(wn) == 3, wn          # initial write + iters 2 and 4
+err = np.abs(data[-1] - u_now).max()
+assert err < 1e-12, err
+mh.barrier("checked")
+print(f"OUTPUT_OK {pid} writes={len(wn)}", flush=True)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_MULTIHOST") == "1",
+                    reason="multihost disabled")
+def test_two_process_file_output(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["COORD"] = f"localhost:{_free_port()}"
+    env["REPO"] = repo
+    env.pop("JAX_PLATFORMS", None)
+    script = tmp_path / "worker_out.py"
+    script.write_text(OUTPUT_WORKER)
+    out_dir = tmp_path / "snap_mh"
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(out_dir)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost output workers timed out")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n{err[-2000:]}"
+        assert "OUTPUT_OK" in out
+    # exactly one file set, written once (no double-writes from rank 1)
+    files = sorted(out_dir.glob("*.h5"))
+    assert len(files) == 1, files
