@@ -1,119 +1,403 @@
 """
-Plotting helpers for grid data (reference: dedalus/extras/plot_tools.py).
+Plotting helpers for grid data (reference: dedalus/extras/plot_tools.py —
+same public surface, original implementation).
 
-A compact subset of the reference surface: quad-mesh edge construction
-from basis grids, `plot_bot_2d` for fields/arrays, and a simple
-`MultiFigure` axes grid. Requires matplotlib (imported lazily).
+Covers the reference's plotting toolkit so its example plot scripts port
+unchanged:
+
+  * `FieldWrapper` / `DimWrapper` — h5py-dataset facade over live Fields
+  * `plot_bot`, `plot_bot_2d`, `plot_bot_3d` — quadmesh plots with a
+    top-mounted colorbar, from h5py datasets or Fields
+  * `MultiFigure`, `Box`, `Frame` — paper-layout figure grids with
+    image/pad/margin arithmetic
+  * `quad_mesh`, `get_1d_vertices`, `pad_limits`, `get_plane` — mesh and
+    limit helpers for pcolormesh-style plotting
+
+matplotlib is imported lazily so headless installs only pay for it when
+plotting.
 """
 
 import numpy as np
 
 
-def quad_mesh(x, y):
-    """Cell-edge meshes for pcolormesh from cell-center grids
+# ----------------------------------------------------------------------
+# Field facade (mimic the h5py dataset interface)
+
+class DimWrapper:
+    """Dimension-scale facade for one axis of a Field
+    (reference: extras/plot_tools.py DimWrapper)."""
+
+    def __init__(self, field, axis):
+        self.field = field
+        self.axis = axis
+
+    @property
+    def label(self):
+        tdim = len(self.field.tensorsig)
+        if self.axis < tdim:
+            return "component"
+        coord_axis = self.axis - tdim
+        basis = self.field.domain.bases[coord_axis]
+        if basis is None:
+            return f"const_{coord_axis}"
+        sub = coord_axis - basis.first_axis
+        if basis.dim == 1:
+            return basis.coord.name
+        return basis.cs.names[sub]
+
+    def __getitem__(self, scale):
+        """Grid points for this axis; `scale` may be 0 (natural scales) or
+        a float scale factor."""
+        tdim = len(self.field.tensorsig)
+        if self.axis < tdim:
+            return np.arange(self.field.tensorsig[self.axis].dim)
+        coord_axis = self.axis - tdim
+        basis = self.field.domain.bases[coord_axis]
+        if basis is None:
+            return np.zeros(1)
+        factor = 1.0 if (scale == 0 or scale is None) else float(scale)
+        sub = coord_axis - basis.first_axis
+        if basis.dim == 1:
+            return np.ravel(basis.global_grid(factor))
+        grids = basis.global_grids((factor,) * basis.dim)
+        return np.ravel(grids[sub])
+
+
+class FieldWrapper:
+    """h5py-dataset facade over a live Field, so the same plotting entry
+    points accept Fields and datasets (reference: extras/plot_tools.py
+    FieldWrapper)."""
+
+    def __init__(self, field):
+        self.field = field
+        self.name = getattr(field, "name", "field")
+
+    @property
+    def shape(self):
+        return np.asarray(self.field["g"]).shape
+
+    @property
+    def dims(self):
+        return [DimWrapper(self.field, axis)
+                for axis in range(len(self.shape))]
+
+    def __getitem__(self, slices):
+        return np.asarray(self.field["g"])[slices]
+
+
+# ----------------------------------------------------------------------
+# Mesh helpers
+
+def get_1d_vertices(grid, cut_edges=False):
+    """Vertices dividing a 1d grid: interior vertices at midpoints; edge
+    vertices tight to the grid (cut_edges) or reflected past it
+    (reference: extras/plot_tools.py get_1d_vertices)."""
+    grid = np.asarray(grid)
+    if grid.ndim != 1:
+        raise ValueError("grid must be 1d array.")
+    if grid.size == 1:
+        return np.array([grid[0] - 0.5, grid[0] + 0.5])
+    mid = 0.5 * (grid[:-1] + grid[1:])
+    if cut_edges:
+        lo, hi = grid[0], grid[-1]
+    else:
+        lo = grid[0] - (mid[0] - grid[0])
+        hi = grid[-1] + (grid[-1] - mid[-1])
+    return np.concatenate([[lo], mid, [hi]])
+
+
+def quad_mesh(x, y, cut_x_edges=False, cut_y_edges=False):
+    """(xmesh, ymesh) vertex arrays for plt.pcolormesh from cell-center
+    grids: x along the LAST mesh axis, y along the first
     (reference: extras/plot_tools.py quad_mesh)."""
-    x, y = np.asarray(x).ravel(), np.asarray(y).ravel()
+    xvert = get_1d_vertices(np.ravel(x), cut_edges=cut_x_edges)
+    yvert = get_1d_vertices(np.ravel(y), cut_edges=cut_y_edges)
+    xmesh = np.broadcast_to(xvert[None, :], (yvert.size, xvert.size)).copy()
+    ymesh = np.broadcast_to(yvert[:, None], (yvert.size, xvert.size)).copy()
+    return xmesh, ymesh
 
-    def edges(c):
-        if c.size == 1:
-            return np.array([c[0] - 0.5, c[0] + 0.5])
-        mid = 0.5 * (c[:-1] + c[1:])
-        return np.concatenate([[c[0] - (mid[0] - c[0])], mid,
-                               [c[-1] + (c[-1] - mid[-1])]])
 
-    xe, ye = edges(x), edges(y)
-    return np.meshgrid(xe, ye, indexing="ij")
+def pad_limits(xgrid, ygrid, xpad=0.0, ypad=0.0, square=None):
+    """[x0, x1, y0, y1] plot limits with fractional padding; optionally
+    extended to a square aspect within axes `square`
+    (reference: extras/plot_tools.py pad_limits)."""
+    xgrid = np.asarray(xgrid)
+    ygrid = np.asarray(ygrid)
+    dx = xgrid.max() - xgrid.min()
+    dy = ygrid.max() - ygrid.min()
+    x0, x1 = xgrid.min() - xpad * dx, xgrid.max() + xpad * dx
+    y0, y1 = ygrid.min() - ypad * dy, ygrid.max() + ypad * dy
+    if square is not None:
+        axes = square
+        pos = axes.get_position()
+        ax_aspect = ((pos.height * axes.figure.get_figheight())
+                     / (pos.width * axes.figure.get_figwidth()))
+        im_w, im_h = (x1 - x0), (y1 - y0)
+        if im_h / im_w > ax_aspect:
+            extra = im_h / ax_aspect - im_w
+            x0 -= extra / 2
+            x1 += extra / 2
+        else:
+            extra = im_w * ax_aspect - im_h
+            y0 -= extra / 2
+            y1 += extra / 2
+    return [x0, x1, y0, y1]
+
+
+def get_plane(dset, xaxis, yaxis, slices, xscale=0, yscale=0, **kw):
+    """
+    (xmesh, ymesh, data) for one 2d plane of a dataset: grids sorted
+    ascending, data arranged to (y, x)
+    (reference: extras/plot_tools.py get_plane).
+    """
+    slices = tuple(slices)
+    xgrid = np.asarray(dset.dims[xaxis][xscale])[slices[xaxis]]
+    ygrid = np.asarray(dset.dims[yaxis][yscale])[slices[yaxis]]
+    xsort = np.argsort(xgrid)
+    ysort = np.argsort(ygrid)
+    xmesh, ymesh = quad_mesh(xgrid[xsort], ygrid[ysort], **kw)
+    data = np.asarray(dset[slices])
+    if xaxis < yaxis:
+        data = data.T
+    data = data[ysort][:, xsort]
+    return xmesh, ymesh, data
+
+
+# ----------------------------------------------------------------------
+# plot_bot family
+
+def plot_bot(dset, image_axes, data_slices, image_scales=(0, 0), clim=None,
+             even_scale=False, cmap="RdBu_r", axes=None, figkw={},
+             title=None, func=None, visible_axes=True):
+    """
+    Quadmesh plot of a 2d slice of a dataset or Field, colorbar on top
+    (reference: extras/plot_tools.py plot_bot — same parameters).
+
+    image_axes: (xaxis, yaxis) data axes for the image x and y.
+    data_slices: per-axis ints/slices selecting the plane.
+    image_scales: per-axis grid scales (0 = natural, or scale factors).
+    func: optional (xmesh, ymesh, data) -> (xmesh, ymesh, data) hook.
+    """
+    import matplotlib.pyplot as plt
+    import matplotlib.ticker as mticker
+    from ..core.field import Field
+    if isinstance(dset, Field):
+        dset = FieldWrapper(dset)
+    xaxis, yaxis = image_axes
+    xscale, yscale = image_scales
+    xmesh, ymesh, data = get_plane(dset, xaxis, yaxis, data_slices,
+                                   xscale, yscale)
+    data = np.asarray(data).real
+    if func is not None:
+        xmesh, ymesh, data = func(xmesh, ymesh, data)
+    if axes is None:
+        fig = plt.figure(**figkw)
+        axes = fig.add_subplot(1, 1, 1)
+    # carve the parent axes into an image box and a thin top colorbar box
+    pos = axes.get_position()
+    fig = axes.figure
+
+    def sub_rect(left, bottom, width, height):
+        return [pos.x0 + left * pos.width, pos.y0 + bottom * pos.height,
+                width * pos.width, height * pos.height]
+
+    paxes = fig.add_axes(sub_rect(0.03, 0.0, 0.94, 0.94))
+    caxes = fig.add_axes(sub_rect(0.03, 0.95, 0.94, 0.05))
+    axes.set_axis_off()
+    if clim is None:
+        if even_scale:
+            lim = max(abs(np.nanmin(data)), abs(np.nanmax(data))) or 1.0
+            clim = (-lim, lim)
+        else:
+            clim = (np.nanmin(data), np.nanmax(data))
+    im = paxes.pcolormesh(xmesh, ymesh, data, cmap=cmap, vmin=clim[0],
+                          vmax=clim[1], zorder=1)
+    paxes.axis(pad_limits(xmesh, ymesh))
+    paxes.tick_params(length=0, width=0)
+    cbar = fig.colorbar(im, cax=caxes, orientation="horizontal",
+                        ticks=mticker.MaxNLocator(nbins=5))
+    cbar.outline.set_visible(False)
+    caxes.xaxis.set_ticks_position("top")
+    if title is None:
+        title = getattr(dset, "name", None)
+        if title and "/" in str(title):
+            title = str(title).rsplit("/", 1)[1]
+    caxes.set_xlabel(title)
+    caxes.xaxis.set_label_position("top")
+    if visible_axes:
+        paxes.set_xlabel(_dim_label(dset, xaxis))
+        paxes.set_ylabel(_dim_label(dset, yaxis))
+    else:
+        paxes.set_xticks([])
+        paxes.set_yticks([])
+    return paxes, caxes
+
+
+def _dim_label(dset, axis):
+    dim = dset.dims[axis]
+    label = getattr(dim, "label", "")
+    return label or str(axis)
+
+
+def plot_bot_2d(dset, transpose=False, **kw):
+    """plot_bot for 2d datasets: full-extent slices, axes (0, 1) or
+    transposed (reference: extras/plot_tools.py plot_bot_2d)."""
+    image_axes = (1, 0) if transpose else (0, 1)
+    data_slices = (slice(None), slice(None))
+    return plot_bot(dset, image_axes, data_slices, **kw)
+
+
+def plot_bot_3d(dset, normal_axis, normal_index, transpose=False, **kw):
+    """plot_bot for 3d datasets: slice along `normal_axis` (int or dim
+    label) at `normal_index` (reference: extras/plot_tools.py
+    plot_bot_3d)."""
+    from ..core.field import Field
+    if isinstance(dset, Field):
+        dset = FieldWrapper(dset)
+    if isinstance(normal_axis, str):
+        for i, dim in enumerate(dset.dims):
+            if getattr(dim, "label", None) == normal_axis:
+                normal_axis = i
+                break
+        else:
+            raise ValueError(f"Axis name not found: {normal_axis!r}")
+    image_axes = [0, 1, 2]
+    image_axes.remove(normal_axis)
+    if transpose:
+        image_axes = image_axes[::-1]
+    data_slices = [slice(None), slice(None), slice(None)]
+    data_slices[normal_axis] = normal_index
+    return plot_bot(dset, tuple(image_axes), tuple(data_slices), **kw)
+
+
+# ----------------------------------------------------------------------
+# Figure layout arithmetic
+
+class Box:
+    """2d extent vector for image layout arithmetic: supports +, scalar
+    and elementwise *, /, and xbox/ybox projections
+    (reference: extras/plot_tools.py Box)."""
+
+    def __init__(self, x, y):
+        self.x = float(x)
+        self.y = float(y)
+
+    @property
+    def xbox(self):
+        return Box(self.x, 0.0)
+
+    @property
+    def ybox(self):
+        return Box(0.0, self.y)
+
+    def __add__(self, other):
+        if isinstance(other, Box):
+            return Box(self.x + other.x, self.y + other.y)
+        return NotImplemented
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __mul__(self, other):
+        if isinstance(other, Box):
+            return Box(self.x * other.x, self.y * other.y)
+        return Box(self.x * other, self.y * other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        b = self * other
+        self.x, self.y = b.x, b.y
+        return self
+
+    def __truediv__(self, other):
+        if isinstance(other, Box):
+            return Box(self.x / other.x, self.y / other.y)
+        return Box(self.x / other, self.y / other)
+
+
+class Frame:
+    """Padding frame (top, bottom, left, right) combinable with boxes:
+    frame + box = padded box (reference: extras/plot_tools.py Frame)."""
+
+    def __init__(self, top, bottom, left, right):
+        self.top = float(top)
+        self.bottom = float(bottom)
+        self.left = float(left)
+        self.right = float(right)
+
+    @property
+    def bottom_left(self):
+        return Box(self.left, self.bottom)
+
+    @property
+    def top_right(self):
+        return Box(self.right, self.top)
+
+    def __add__(self, other):
+        if isinstance(other, Box):
+            return Box(self.left + other.x + self.right,
+                       self.bottom + other.y + self.top)
+        return NotImplemented
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __mul__(self, scale):
+        return Frame(self.top * scale, self.bottom * scale,
+                     self.left * scale, self.right * scale)
+
+    def __imul__(self, scale):
+        self.top *= scale
+        self.bottom *= scale
+        self.left *= scale
+        self.right *= scale
+        return self
 
 
 class MultiFigure:
-    """Grid of axes with uniform padding
-    (reference: extras/plot_tools.py MultiFigure)."""
+    """
+    Grid of image cells in one figure, sized from Box/Frame arithmetic
+    (reference: extras/plot_tools.py MultiFigure — same parameters).
 
-    def __init__(self, nrows, ncols, width=4.0, height=3.0, pad=0.4):
+    nrows/ncols image cells of shape `image` (a Box), each wrapped in
+    `pad` (a Frame), the whole array wrapped in `margin` (a Frame),
+    all scaled so the figure dimensions come out integral.
+    """
+
+    def __init__(self, nrows, ncols, image, pad, margin, scale=1.0, **kw):
         import matplotlib.pyplot as plt
-        self.nrows, self.ncols = nrows, ncols
-        self.figure, self.axes = plt.subplots(
-            nrows, ncols, figsize=(ncols * width, nrows * height),
-            squeeze=False)
-        self.figure.subplots_adjust(wspace=pad, hspace=pad)
+        subfig = pad + image
+        fig = margin + nrows * subfig.ybox + ncols * subfig.xbox
+        # integral figure dims: snap the height scale up, absorb the
+        # leftover width into the margins
+        intscale = np.ceil(scale * fig.y) / fig.y
+        extra_w = np.ceil(intscale * fig.x) - intscale * fig.x
+        image *= intscale
+        pad *= intscale
+        margin *= intscale
+        margin.left += extra_w / 2
+        margin.right += extra_w / 2
+        subfig = pad + image
+        fig = margin + nrows * subfig.ybox + ncols * subfig.xbox
+        self.figure = plt.figure(figsize=(int(np.rint(fig.x)),
+                                          int(np.rint(fig.y))), **kw)
+        self.nrows = nrows
+        self.ncols = ncols
+        self.image = image
+        self.pad = pad
+        self.margin = margin
+        self.fig = fig
 
-    def add_axes(self, i, j):
-        return self.axes[i][j]
-
-
-def plot_bot_3d(dset, normal_axis, index, axes=None, title=None,
-                cmap="RdBu_r", even_scale=False, visible_axes=True, **kw):
-    """
-    pcolormesh of one slice of an h5py task dataset along `normal_axis`
-    (typically 0 = the write/time axis), using the file's attached
-    dimension scales for coordinates (reference:
-    extras/plot_tools.py plot_bot_3d; our file handler attaches scales at
-    dataset creation, core/evaluator.py)."""
-    import matplotlib.pyplot as plt
-    data = np.asarray(np.take(dset, index, axis=normal_axis))
-    # coordinate grids from the remaining dims' attached scales
-    grids = []
-    for d in range(len(dset.shape)):
-        if d == normal_axis:
-            continue
-        dim = dset.dims[d]
-        if len(dim) and dim[0].shape[0] == dset.shape[d] and dset.shape[d] > 1:
-            grids.append(np.asarray(dim[0]))
-        elif dset.shape[d] > 1:
-            grids.append(np.arange(dset.shape[d]))
-    data = np.squeeze(data)
-    if data.ndim != 2 or len(grids) < 2:
-        raise ValueError("plot_bot_3d slice is not 2D.")
-    x, y = grids[-2], grids[-1]
-    if axes is None:
-        _, axes = plt.subplots()
-    xm, ym = quad_mesh(x, y)
-    if even_scale:
-        lim = np.abs(data).max() or 1.0
-        kw.setdefault("vmin", -lim)
-        kw.setdefault("vmax", lim)
-    mesh = axes.pcolormesh(xm, ym, np.asarray(data).real, cmap=cmap, **kw)
-    if title:
-        axes.set_title(title)
-    if not visible_axes:
-        axes.set_xticks([])
-        axes.set_yticks([])
-    return mesh
-
-
-def plot_bot_2d(field_or_data, x=None, y=None, axes=None, title=None,
-                cmap="RdBu_r", **kw):
-    """
-    pcolormesh of a 2D field's grid data (reference:
-    extras/plot_tools.py plot_bot / plot_bot_2d). Accepts a Field (grids
-    inferred from its bases) or a plain array with x/y grids.
-    """
-    import matplotlib.pyplot as plt
-    data = field_or_data
-    if hasattr(field_or_data, "domain"):
-        field = field_or_data
-        field.change_scales(1)
-        data = np.asarray(field["g"])
-        bases = [b for b in field.domain.bases if b is not None]
-        if x is None or y is None:
-            grids = []
-            seen = set()
-            for b in bases:
-                if id(b) in seen:
-                    continue
-                seen.add(id(b))
-                if b.dim == 1:
-                    grids.append(b.global_grid(1.0))
-                else:
-                    grids.extend(b.global_grids((1.0,) * b.dim))
-            if len(grids) != 2:
-                raise ValueError("plot_bot_2d requires a 2D field.")
-            x, y = grids
-    if axes is None:
-        _, axes = plt.subplots()
-    xm, ym = quad_mesh(x, y)
-    mesh = axes.pcolormesh(xm, ym, np.asarray(data).real, cmap=cmap, **kw)
-    plt.colorbar(mesh, ax=axes)
-    if title:
-        axes.set_title(title)
-    return mesh
+    def add_axes(self, i, j, rect=(0, 0, 1, 1), **kw):
+        """Axes within image cell (i, j); `rect` = (left, bottom, width,
+        height) in fractions of the image box."""
+        irev = self.nrows - 1 - i
+        subfig = self.pad + self.image
+        offset = (self.margin.bottom_left + irev * subfig.ybox
+                  + j * subfig.xbox + self.pad.bottom_left)
+        start = (offset + Box(rect[0], rect[1]) * self.image) / self.fig
+        shape = Box(rect[2], rect[3]) * self.image / self.fig
+        return self.figure.add_axes([start.x, start.y, shape.x, shape.y],
+                                    **kw)

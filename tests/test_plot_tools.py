@@ -1,0 +1,113 @@
+"""
+Plotting extras (reference: dedalus/extras/plot_tools.py and the
+example plot scripts built on it): mesh construction, plane extraction,
+the plot_bot family on Fields and HDF5 output files, and MultiFigure
+layout arithmetic. Rendered against the Agg backend.
+"""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.extras import plot_tools as pt
+
+
+def test_vertices_and_quad_mesh():
+    g = np.array([0.0, 1.0, 3.0])
+    v = pt.get_1d_vertices(g)
+    assert np.allclose(v, [-0.5, 0.5, 2.0, 4.0])
+    v = pt.get_1d_vertices(g, cut_edges=True)
+    assert np.allclose(v, [0.0, 0.5, 2.0, 3.0])
+    xm, ym = pt.quad_mesh(np.arange(3.0), np.arange(4.0))
+    assert xm.shape == ym.shape == (5, 4)
+    assert np.allclose(xm[0], [-0.5, 0.5, 1.5, 2.5])
+
+
+def test_pad_limits():
+    lims = pt.pad_limits(np.array([0.0, 1.0]), np.array([0.0, 2.0]),
+                         xpad=0.1, ypad=0.0)
+    assert np.allclose(lims, [-0.1, 1.1, 0.0, 2.0])
+
+
+def _make_field():
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 2 * np.pi))
+    zb = d3.ChebyshevT(coords["z"], size=12, bounds=(0, 1))
+    u = dist.Field(name="u", bases=(xb, zb))
+    x, z = dist.local_grids(xb, zb)
+    u["g"] = np.sin(x) * z * (1 - z)
+    return u
+
+
+def test_field_wrapper_and_get_plane():
+    u = _make_field()
+    w = pt.FieldWrapper(u)
+    assert w.shape == (16, 12)
+    assert w.dims[0].label == "x"
+    assert w.dims[1].label == "z"
+    xm, ym, data = pt.get_plane(w, 0, 1, (slice(None), slice(None)))
+    assert data.shape == (12, 16)   # arranged (y, x)
+    assert xm.shape == (13, 17)
+
+
+def test_plot_bot_2d_field(tmp_path):
+    import matplotlib.pyplot as plt
+    u = _make_field()
+    paxes, caxes = pt.plot_bot_2d(u, even_scale=True, title="u")
+    paxes.figure.savefig(tmp_path / "f.png", dpi=40)
+    plt.close("all")
+
+
+def test_plot_bot_3d_from_file(tmp_path):
+    """End-to-end: file handler output -> plot_bot_3d renders a frame
+    (the examples/plot_snapshots.py pipeline)."""
+    import h5py
+    import matplotlib.pyplot as plt
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 2 * np.pi))
+    zb = d3.ChebyshevT(coords["z"], size=12, bounds=(0, 1))
+    u = dist.Field(name="u", bases=(xb, zb))
+    t1 = dist.Field(name="t1", bases=xb)
+    t2 = dist.Field(name="t2", bases=xb)
+    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+    problem = d3.IVP([u, t1, t2], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = 0")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("u(z=1) = 0")
+    solver = problem.build_solver(d3.SBDF2)
+    x, z = dist.local_grids(xb, zb)
+    u["g"] = np.sin(np.pi * z) * np.cos(x)
+    snaps = solver.evaluator.add_file_handler(tmp_path / "snaps", iter=1)
+    snaps.add_task(u, name="u")
+    for _ in range(2):
+        solver.step(1e-3)
+    path = tmp_path / "snaps" / "snaps_s1.h5"
+    with h5py.File(path, "r") as f:
+        dset = f["tasks"]["u"]
+        fig = plt.figure(figsize=(4, 3))
+        axes = fig.add_subplot(1, 1, 1)
+        pt.plot_bot_3d(dset, 0, 0, axes=axes, even_scale=True,
+                       visible_axes=False)
+        fig.savefig(tmp_path / "frame.png", dpi=40)
+    plt.close("all")
+
+
+def test_multifigure_layout(tmp_path):
+    import matplotlib.pyplot as plt
+    image = pt.Box(2.0, 2.0)
+    pad = pt.Frame(0.2, 0.2, 0.2, 0.2)
+    margin = pt.Frame(0.1, 0.1, 0.1, 0.1)
+    mfig = pt.MultiFigure(2, 3, image, pad, margin, scale=1.0)
+    ax = mfig.add_axes(0, 0, (0.1, 0.1, 0.8, 0.8))
+    ax.plot([0, 1], [0, 1])
+    ax2 = mfig.add_axes(1, 2, (0, 0, 1, 1))
+    ax2.plot([0, 1], [1, 0])
+    w, h = mfig.figure.get_size_inches()
+    assert float(w).is_integer() and float(h).is_integer()
+    mfig.figure.savefig(tmp_path / "mf.png", dpi=30)
+    plt.close("all")
