@@ -168,3 +168,71 @@ def test_rotating_convection_evp_quick():
     solver.solve_sparse(subproblem, 5, 963.765)
     ev = solver.eigenvalues[0]
     assert abs(ev - 963.765) < 40.0
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_lap_meridional_ncc_shell(dtype):
+    """ncc(theta,r)*Lap(u) round-trip with ncc = z^2 = (r cos theta)^2 —
+    jointly theta/radius-dependent (reference:
+    tests/test_lbvp.py:515 test_lap_meridional_ncc_shell)."""
+    coords, dist, shell = _shell(dtype, Nphi=16, Ntheta=8, Nr=16,
+                                 radii=(0.5, 1.5))
+    phi, theta, r = dist.local_grids(shell)
+    x = r * np.sin(theta) * np.cos(phi)
+    z = r * np.cos(theta)
+    r0, r1 = 0.5, 1.5
+    u = dist.Field(name="u", bases=shell)
+    v = dist.Field(name="v", bases=shell)
+    tau1 = dist.Field(name="tau1", bases=shell.S2_basis())
+    tau2 = dist.Field(name="tau2", bases=shell.S2_basis())
+    ncc = dist.Field(name="ncc", bases=shell.meridional_basis)
+    v["g"] = x ** 2 + z ** 2
+    ncc["g"] = z ** 2
+    lift = lambda A, n: d3.Lift(A, shell.derivative_basis(2), n)
+    F = (ncc * d3.lap(v)).evaluate()
+    vr0 = v(r=r0).evaluate()
+    vr1 = v(r=r1).evaluate()
+    problem = d3.LBVP([u, tau1, tau2], namespace=locals())
+    problem.add_equation("ncc*lap(u) + lift(tau1,-1) + lift(tau2,-2) = F")
+    problem.add_equation("u(r=0.5) = vr0")
+    problem.add_equation("u(r=1.5) = vr1")
+    solver = problem.build_solver()
+    solver.solve()
+    assert np.allclose(np.asarray(u["g"]), np.asarray(v["g"]), atol=1e-8)
+
+
+def test_lap_2dncc_vector_shell():
+    """Meridional + radial NCCs against a VECTOR Laplacian — the case the
+    reference marks xfail ("Radial NCCs don't work in meridional problems
+    for vectors", tests/test_lbvp.py:573); the quadrature-built coupled
+    assembly handles it."""
+    dtype = np.complex128
+    coords, dist, shell = _shell(dtype, Nphi=8, Ntheta=8, Nr=16,
+                                 radii=(0.5, 1.5))
+    phi, theta, r = dist.local_grids(shell)
+    x = r * np.sin(theta) * np.cos(phi)
+    z = r * np.cos(theta)
+    u = dist.VectorField(coords, name="u", bases=shell)
+    v = dist.VectorField(coords, name="v", bases=shell)
+    tau1 = dist.VectorField(coords, name="tau1", bases=shell.S2_basis())
+    tau2 = dist.VectorField(coords, name="tau2", bases=shell.S2_basis())
+    ez = dist.VectorField(coords, name="ez", bases=shell.meridional_basis)
+    ez["g"][1] = -np.sin(theta)
+    ez["g"][2] = np.cos(theta)
+    ncc_m = dist.Field(name="ncc_m", bases=shell.meridional_basis)
+    ncc_r = dist.Field(name="ncc_r", bases=shell.radial_basis)
+    v["g"] = (x ** 2 + z ** 2) * np.asarray(ez["g"])
+    ncc_m["g"] = z ** 2
+    ncc_r["g"] = r ** 2
+    lift = lambda A, n: d3.Lift(A, shell.derivative_basis(2), n)
+    F = (ncc_r * d3.lap(v) + ncc_m * d3.lap(v)).evaluate()
+    vr0 = v(r=0.5).evaluate()
+    vr1 = v(r=1.5).evaluate()
+    problem = d3.LBVP([u, tau1, tau2], namespace=locals())
+    problem.add_equation(
+        "ncc_r*lap(u) + ncc_m*lap(u) + lift(tau1,-1) + lift(tau2,-2) = F")
+    problem.add_equation("u(r=0.5) = vr0")
+    problem.add_equation("u(r=1.5) = vr1")
+    solver = problem.build_solver()
+    solver.solve()
+    assert np.allclose(np.asarray(u["g"]), np.asarray(v["g"]), atol=1e-8)
