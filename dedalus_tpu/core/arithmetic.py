@@ -747,8 +747,11 @@ class ProductBase(Future):
         cache = getattr(self, "_sph_gen_cache", None)
         if cache is not None and cache.get("version") == version:
             return cache
+        from .spherical3d import ShellBasis, spherical_rank
         spin_prof, tol = self.sph_ncc_angular_profile(ncc, basis, basis.cs)
         spins = component_spins(ncc.tensorsig, basis.cs)
+        rank_n = spherical_rank(ncc.tensorsig, basis.cs)
+        shell = isinstance(basis, ShellBasis)
         Lmax_n = ncc_basis.Lmax
         Ntheta_n = spin_prof.shape[1]
         terms = {}
@@ -769,15 +772,35 @@ class ProductBase(Future):
                 if np.abs(coeffs.imag).max() < 1e-13 * max(
                         np.abs(coeffs).max(), 1e-300):
                     coeffs = coeffs.real
-                B = sparsify(basis.radial_multiplication_matrix(
-                    ncc_basis.scalar_radial_coeffs(coeffs),
-                    ncc_basis.k, k_out=0), 1e-12)
-                rows.append((L, B))
+                if shell:
+                    # shell: radial space is ell-independent — one
+                    # multiplication matrix per (a, L)
+                    B = sparsify(basis.radial_multiplication_matrix(
+                        ncc_basis.scalar_radial_coeffs(coeffs),
+                        ncc_basis.k, k_out=0), 1e-12)
+                    rows.append((L, B))
+                else:
+                    # ball: Zernike spaces are ell-indexed; store the
+                    # profile's Zernike coefficients (the minimal smooth
+                    # envelope degree has parity L + rank and vanishing
+                    # order >= L - rank) and build per-(ell, ell') pair
+                    # matrices lazily at assembly
+                    l_env = max(L - rank_n, (L + rank_n) % 2)
+                    if np.iscomplexobj(coeffs):
+                        rc = (ncc_basis.scalar_radial_coeffs(
+                                  coeffs.real, l_env=l_env)
+                              + 1j * ncc_basis.scalar_radial_coeffs(
+                                  coeffs.imag, l_env=l_env))
+                    else:
+                        rc = ncc_basis.scalar_radial_coeffs(coeffs,
+                                                            l_env=l_env)
+                    rows.append((L, (rc, l_env)))
                 max_L = max(max_L, L)
             if rows:
                 terms[a] = rows
         cache = self._sph_gen_cache = {"version": version, "terms": terms,
-                                       "spins": spins, "max_L": max_L}
+                                       "spins": spins, "max_L": max_L,
+                                       "pair_cache": {}}
         return cache
 
     def _sph_coupled_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
@@ -801,9 +824,8 @@ class ProductBase(Future):
             raise NonlinearOperatorError(
                 "Curvilinear NCCs require shell/ball bases on both factors.")
         if not isinstance(basis, ShellBasis):
-            raise NonlinearOperatorError(
-                "Colatitude-dependent NCCs are currently supported on the "
-                "shell only (ball ell-coupled NCCs not implemented).")
+            return self._sph_coupled_ncc_matrix_ball(subproblem, ncc,
+                                                     operand, ncc_index)
         layout = subproblem.layout
         az = basis.first_axis
         gs = layout.sep_widths[az]
@@ -885,6 +907,130 @@ class ProductBase(Future):
             # slot layout is (component, azimuthal pair, ell, n): interleave
             # the gs identity between the component and ell kron positions
             total = _interleave_gs(total, nout, nin, gs, Ntheta * Nr)
+        return sp.csr_matrix(total)
+
+    def _sph_coupled_ncc_matrix_ball(self, subproblem, ncc, operand,
+                                     ncc_index):
+        """
+        Ball variant of the ell-coupled NCC assembly: Zernike radial
+        spaces are ell-indexed, so the kron(W, B) factorization of the
+        shell does not apply — each (ell', ell) block combines the SWSH
+        triple-product coupling with a PER-PAIR radial multiplication
+        matrix mapping Z^(ell + t_in) -> Z^(ell' + t_out)
+        (reference: the l-coupled Zernike Clenshaw couplings,
+        dedalus/core/basis.py:4101 + core/arithmetic.py:359-406).
+        """
+        from .spherical3d import q_stack, spherical_rank, reg_totals
+        from .curvilinear import component_spins
+        from ..libraries import sphere as swsh
+        basis = self._spherical_regularity_basis(operand)
+        ncc_basis = self._spherical_regularity_basis(ncc)
+        layout = subproblem.layout
+        az = basis.first_axis
+        gs = layout.sep_widths[az]
+        ms = basis.group_m()
+        g = subproblem.group[az]
+        m = int(ms[g])
+        Lmax = basis.Lmax
+        Ntheta, Nr = basis.Ntheta, basis.Nr
+        rank_in = spherical_rank(operand.tensorsig, basis.cs)
+        rank_out = spherical_rank(self.tensorsig, basis.cs)
+        nin, nout = 3 ** rank_in, 3 ** rank_out
+        shape = (nout * gs * Ntheta * Nr, nin * gs * Ntheta * Nr)
+        if basis.complex and g == basis.Nphi // 2:
+            return sp.csr_matrix(shape)  # Nyquist: all slots invalid
+        T_spin = self._spin_bilinear_map(ncc, operand, ncc_index)
+        data = self._sph_ncc_general_data(ncc, operand, basis, ncc_basis,
+                                          ncc_index)
+        s_in = component_spins(operand.tensorsig, basis.cs)
+        s_out = component_spins(self.tensorsig, basis.cs)
+        s_ncc = data["spins"]
+        t_in = reg_totals(rank_in)
+        t_out = reg_totals(rank_out)
+        Qi = q_stack(Ntheta, rank_in)
+        Qo = q_stack(Ntheta, rank_out)
+        pair_cache = data["pair_cache"]
+        flat_terms = [(a, L, payload)
+                      for a, rows in data["terms"].items()
+                      for L, payload in rows]
+        max_L = data["max_L"]
+        X0 = Ntheta * Nr
+        rows_l, cols_l, vals_l = [], [], []
+        for lp in range(Ntheta):            # ell' (output)
+            for l in range(max(0, lp - max_L),
+                           min(Ntheta, lp + max_L + 1)):   # ell (input)
+                # angular x tensor coefficient per (gamma, beta, term)
+                A3 = np.zeros((nout, nin, len(flat_terms)), dtype=complex)
+                for ti, (a, L, payload) in enumerate(flat_terms):
+                    sa = int(s_ncc[a])
+                    for c in range(nout):
+                        sc = int(s_out[c])
+                        for b in range(nin):
+                            t = T_spin[c, a, b]
+                            if abs(t) < 1e-13:
+                                continue
+                            sb = int(s_in[b])
+                            W = swsh.triple_product_matrix(Lmax, m, sc,
+                                                           sa, sb, L)
+                            r0 = swsh.lmin(m, sc)
+                            c0 = swsh.lmin(m, sb)
+                            if (lp < r0 or l < c0
+                                    or lp - r0 >= W.shape[0]
+                                    or l - c0 >= W.shape[1]):
+                                continue
+                            w = W[lp - r0, l - c0]
+                            if w == 0.0:
+                                continue
+                            A3[:, :, ti] += (t * w) * np.outer(
+                                Qo[lp][c], Qi[l][b])
+                if not np.abs(A3).any():
+                    continue
+                for gam in range(nout):
+                    for bet in range(nin):
+                        coefs = A3[gam, bet]
+                        if not np.abs(coefs).any():
+                            continue
+                        blk = None
+                        for ti, (a, L, payload) in enumerate(flat_terms):
+                            cf = coefs[ti]
+                            if abs(cf) < 1e-14:
+                                continue
+                            rc, l_env = payload
+                            key = (id(rc), int(t_in[bet]), int(t_out[gam]),
+                                   l, lp)
+                            B = pair_cache.get(key)
+                            if B is None:
+                                B = sparsify(basis.ncc_radial_pair_matrix(
+                                    rc, ncc_basis.k, l_env, t_in[bet],
+                                    t_out[gam], l, lp, k_out=0), 1e-12)
+                                pair_cache[key] = B
+                            term = cf * B
+                            blk = term if blk is None else blk + term
+                        if blk is None or blk.nnz == 0:
+                            continue
+                        coo = blk.tocoo()
+                        rows_l.append(gam * X0 + lp * Nr + coo.row)
+                        cols_l.append(bet * X0 + l * Nr + coo.col)
+                        vals_l.append(coo.data)
+        if rows_l:
+            total = sp.csr_matrix(
+                (np.concatenate(vals_l),
+                 (np.concatenate(rows_l), np.concatenate(cols_l))),
+                shape=(nout * X0, nin * X0))
+        else:
+            total = sp.csr_matrix((nout * X0, nin * X0), dtype=complex)
+        total = total.tocoo().tocsr()
+        if total.nnz and np.abs(total.imag).max() < 1e-13 * max(
+                np.abs(total).max(), 1e-300):
+            total = total.real
+        elif total.nnz and not is_complex_dtype(self.dtype):
+            if np.abs(total.imag).max() > 1e-10 * np.abs(total).max():
+                raise NonlinearOperatorError(
+                    "This NCC product assembles complex couplings; use a "
+                    "complex dtype, or move the term to the RHS.")
+            total = total.real
+        if gs > 1:
+            total = _interleave_gs(total, nout, nin, gs, X0)
         return sp.csr_matrix(total)
 
     def _assemble_ncc_matrix(self, subproblem, ncc, operand, tensor_factor_fn):

@@ -566,23 +566,29 @@ class BallBasis(Basis):
         az_axis = self.first_axis
         colat_axis = az_axis + 1
         gs = self.sub_group_shape(0)
-        if az_axis not in sep_widths or colat_axis not in sep_widths:
+        if az_axis not in sep_widths:
             raise NotImplementedError(
-                "Ball angular axes must be pencil (group) axes.")
+                "Ball azimuth must be a pencil (group) axis.")
         ms = self.group_m()
         m = ms[group[az_axis]]
-        ell = group[colat_axis]
-        comp_ok = valid_regularities(ell, rank) & (ell >= abs(m))
+        if colat_axis in sep_widths:
+            ells = np.array([group[colat_axis]])
+        else:
+            # layout-coupled colatitude (theta-dependent NCC)
+            ells = np.arange(self.Ntheta)
         n = np.arange(self.Nr)
-        n_ok = n >= self._nmin(ell)
-        mask = comp_ok[:, None, None, None] & n_ok[None, None, None, :]
-        mask = np.broadcast_to(mask, (ncomp, gs, 1, self.Nr)).copy()
+        mask = np.zeros((ncomp, gs, ells.size, self.Nr), dtype=bool)
+        for i, ell in enumerate(ells):
+            comp_ok = valid_regularities(int(ell), rank) & (ell >= abs(m))
+            n_ok = n >= self._nmin(int(ell))
+            mask[:, :, i, :] = (comp_ok[:, None, None]
+                                & n_ok[None, None, :])
         if self.complex and group[az_axis] == self.Nphi // 2:
             mask[:] = False  # Nyquist
-        if (not self.complex) and rank <= 1 and ell == 0:
+        if (not self.complex) and rank <= 1:
             # Drop msin slots at ell == 0 for real scalars and vectors
             # (reference: core/basis.py:4301)
-            mask[:, 1, :, :] = False
+            mask[:, 1, ells == 0, :] = False
         return mask
 
     # ------------------------------------------------- radial matrix stacks
@@ -877,6 +883,42 @@ class BallBasis(Basis):
                              extra=Nf + 16)
         out = np.zeros((self.Nr, self.Nr))
         out[nmin:, nmin:] = M
+        return out
+
+    def ncc_radial_pair_matrix(self, f_radial_coeffs, f_k, f_lenv, t_in,
+                               t_out, ell_in, ell_out, k_out=0):
+        """
+        (Nr, Nr): multiplication by one angular mode's radial profile
+        (Zernike coefficients `f_radial_coeffs` at envelope degree
+        `f_lenv`, level k of this basis), mapping regtotal-`t_in`
+        components at harmonic `ell_in` to regtotal-`t_out` components at
+        harmonic `ell_out`, level `k_out`. The ell-COUPLED generalization
+        of `ncc_radial_matrix` needed by theta-dependent NCC products
+        (reference: the l-coupled Zernike Clenshaw couplings of
+        core/basis.py:4101 + core/arithmetic.py:359-406).
+        """
+        nmin_in = self._nmin(int(ell_in))
+        nmin_out = self._nmin(int(ell_out))
+        n_in = self.Nr - nmin_in
+        n_out = self.Nr - nmin_out
+        l_in = int(ell_in) + int(t_in)
+        l_out = int(ell_out) + int(t_out)
+        if n_in <= 0 or n_out <= 0 or l_in < 0 or l_out < 0:
+            return np.zeros((self.Nr, self.Nr))
+        f_coeffs = np.asarray(f_radial_coeffs)
+        if not np.iscomplexobj(f_coeffs):
+            f_coeffs = f_coeffs.astype(np.float64)
+        Nf = f_coeffs.shape[-1]
+
+        def values(z):
+            fvals = f_coeffs @ zernike.polynomials(3, Nf, self.alpha + f_k,
+                                                   int(f_lenv), z)
+            return fvals * zernike.polynomials(3, n_in, self.a_k, l_in, z)
+
+        M = zernike._project(3, n_out, self.alpha + k_out, l_out, values,
+                             n_in, extra=Nf + self.Nr + 16)
+        out = np.zeros((self.Nr, self.Nr), dtype=M.dtype)
+        out[nmin_out:, nmin_in:] = M
         return out
 
 

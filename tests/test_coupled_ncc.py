@@ -236,3 +236,56 @@ def test_lap_2dncc_vector_shell():
     solver = problem.build_solver()
     solver.solve()
     assert np.allclose(np.asarray(u["g"]), np.asarray(v["g"]), atol=1e-8)
+
+
+def _ball(dtype, Nphi=8, Ntheta=8, Nr=8):
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=dtype)
+    ball = d3.BallBasis(coords, shape=(Nphi, Ntheta, Nr), radius=1.0,
+                        dtype=dtype)
+    return coords, dist, ball
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_ball_scalar_ncc_theta_radial(dtype):
+    """f(theta, r)*u on the BALL (ell-coupled Zernike pair matrices)."""
+    coords, dist, ball = _ball(dtype)
+    phi, theta, r = dist.local_grids(ball)
+    z = r * np.cos(theta)
+    f = dist.Field(name="f", bases=ball.meridional_basis)
+    f["g"] = 2.0 + z ** 2 + 0.3 * z
+    u = dist.Field(name="u", bases=ball)
+    x = r * np.sin(theta) * np.cos(phi)
+    u["g"] = x ** 2 + 0.5 * z + 0.2 * z ** 2
+    _check_expr(dist, (f * u), u)
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_ball_vector_ncc_times_scalar(dtype):
+    """ez * u on the ball: spin-mixing with per-(ell, ell') radial maps."""
+    coords, dist, ball = _ball(dtype)
+    phi, theta, r = dist.local_grids(ball)
+    ez = dist.VectorField(coords, name="ez", bases=ball.meridional_basis)
+    ez["g"][1] = -np.sin(theta)
+    ez["g"][2] = np.cos(theta)
+    u = dist.Field(name="u", bases=ball)
+    z = r * np.cos(theta)
+    u["g"] = z + 0.3 * (r * np.sin(theta)) ** 2 * np.cos(2 * phi)
+    _check_expr(dist, (ez * u), u)
+
+
+def test_ball_cross_ncc_vector_complex():
+    """cross(ez, v) on the ball (Coriolis term of rotating ball flows,
+    e.g. the libration example class)."""
+    dtype = np.complex128
+    coords, dist, ball = _ball(dtype)
+    phi, theta, r = dist.local_grids(ball)
+    ez = dist.VectorField(coords, name="ez", bases=ball.meridional_basis)
+    ez["g"][1] = -np.sin(theta)
+    ez["g"][2] = np.cos(theta)
+    v = dist.VectorField(coords, name="v", bases=ball)
+    z = r * np.cos(theta)
+    v["g"][0] = r * np.sin(theta) * np.sin(phi)
+    v["g"][1] = z * np.sin(theta)
+    v["g"][2] = 0.4 * z + 0.1 * r ** 2
+    _check_expr(dist, d3.cross(ez, v), v)
