@@ -355,9 +355,18 @@ class ProductBase(Future):
 
     def _polar_spin_basis(self, operand):
         from .curvilinear import SpinBasisMixin
+        from .sphere import SphereBasis
         for b in operand.domain.bases:
             if (b is not None and b.dim == 2 and isinstance(b, SpinBasisMixin)
+                    and not isinstance(b, SphereBasis)
                     and not getattr(b, "regularity", False)):
+                return b
+        return None
+
+    def _s2_basis(self, operand):
+        from .sphere import SphereBasis
+        for b in operand.domain.bases:
+            if isinstance(b, SphereBasis):
                 return b
         return None
 
@@ -567,6 +576,8 @@ class ProductBase(Future):
 
     def _sph_cs(self, operand):
         basis = self._spherical_regularity_basis(operand)
+        if basis is None:
+            basis = self._s2_basis(operand)
         return basis.cs
 
     def _sph_ncc_setup(self, ncc, operand, ncc_index):
@@ -706,9 +717,8 @@ class ProductBase(Future):
         and the coupled assembly.
         """
         from .curvilinear import recombination_matrix
-        from .spherical3d import spherical_rank
-        rank_n = spherical_rank(ncc.tensorsig, basis.cs)
-        ncomp = 3 ** rank_n
+        rank_n = len(ncc.tensorsig)
+        ncomp = int(np.prod(ncc.tshape, dtype=int)) if ncc.tshape else 1
         ncc.change_scales(1)
         grid = np.asarray(ncc["g"])
         flat = grid.reshape((ncomp,) + grid.shape[rank_n:])
@@ -1033,6 +1043,98 @@ class ProductBase(Future):
             total = _interleave_gs(total, nout, nin, gs, X0)
         return sp.csr_matrix(total)
 
+    def _s2_coupled_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
+        """
+        Pencil matrix of a product with an axisymmetric NCC on the
+        standalone 2D SPHERE (e.g. a zonal background U(theta) in a
+        linearized shallow-water problem): the surface analogue of the
+        shell/ball paths — SWSH triple-product couplings with scalar
+        (L-mode) coefficients, no radial factor. Sphere coefficients are
+        already spin components, so no Q intertwiner sandwich is needed
+        (reference: dedalus/core/arithmetic.py:359-406 restricted to S2).
+        """
+        from .curvilinear import component_spins
+        from ..libraries import sphere as swsh
+        basis = self._s2_basis(operand)
+        ncc_basis = self._s2_basis(ncc)
+        if basis is None or ncc_basis is None:
+            raise NonlinearOperatorError(
+                "S2 NCC products require sphere bases on both factors.")
+        layout = subproblem.layout
+        az = basis.first_axis
+        colat = az + 1
+        if subproblem.group[colat] is not None:
+            raise NonlinearOperatorError(
+                "S2 NCC products require the colatitude coupled "
+                "(standalone sphere problems).")
+        gs = layout.sep_widths[az]
+        ms = basis.group_m()
+        g = subproblem.group[az]
+        m = int(ms[g])
+        Lmax = basis.Lmax
+        Ntheta = basis.Ntheta
+        nin = int(np.prod(operand.tshape, dtype=int)) if operand.tshape else 1
+        nout = int(np.prod(self.tshape, dtype=int)) if self.tshape else 1
+        shape = (nout * gs * Ntheta, nin * gs * Ntheta)
+        if basis.complex and g == basis.Nphi // 2:
+            return sp.csr_matrix(shape)  # Nyquist
+        T_spin = self._spin_bilinear_map(ncc, operand, ncc_index)
+        spin_prof, tol = self.sph_ncc_angular_profile(ncc, basis, basis.cs)
+        s_ncc = component_spins(ncc.tensorsig, basis.cs)
+        s_in = component_spins(operand.tensorsig, basis.cs)
+        s_out = component_spins(self.tensorsig, basis.cs)
+        total = sp.csr_matrix((nout * Ntheta, nin * Ntheta), dtype=complex)
+        for a in range(spin_prof.shape[0]):
+            pa = spin_prof[a][:, 0]
+            if np.abs(pa).max() <= tol:
+                continue
+            sa = int(s_ncc[a])
+            F = swsh.forward_matrix(ncc_basis.Lmax, 0, sa) @ pa
+            l0 = swsh.lmin(0, sa)
+            for c in range(nout):
+                sc = int(s_out[c])
+                for b in range(nin):
+                    t = T_spin[c, a, b]
+                    if abs(t) < 1e-13:
+                        continue
+                    sb = int(s_in[b])
+                    if sc != sa + sb:
+                        raise ValueError(
+                            "Spin balance violated in S2 NCC assembly.")
+                    blk = None
+                    for i in range(F.shape[0]):
+                        if abs(F[i]) <= tol:
+                            continue
+                        L = l0 + i
+                        W = swsh.triple_product_matrix(Lmax, m, sc, sa,
+                                                       sb, L)
+                        if W.size == 0 or np.abs(W).max() == 0.0:
+                            continue
+                        emb = np.zeros((Ntheta, Ntheta))
+                        r0 = swsh.lmin(m, sc)
+                        c0 = swsh.lmin(m, sb)
+                        emb[r0:r0 + W.shape[0], c0:c0 + W.shape[1]] = W
+                        term = (t * F[i]) * sparsify(emb, 1e-14)
+                        blk = term if blk is None else blk + term
+                    if blk is None:
+                        continue
+                    place = sp.csr_matrix(
+                        (np.ones(1), ([c], [b])), shape=(nout, nin))
+                    total = total + sp.kron(place, blk, format="csr")
+        total = total.tocoo().tocsr()
+        if total.nnz and np.abs(total.imag).max() < 1e-13 * max(
+                np.abs(total).max(), 1e-300):
+            total = total.real
+        elif total.nnz and not is_complex_dtype(self.dtype):
+            if np.abs(total.imag).max() > 1e-10 * np.abs(total).max():
+                raise NonlinearOperatorError(
+                    "This S2 NCC product assembles complex couplings; use "
+                    "a complex dtype, or move the term to the RHS.")
+            total = total.real
+        if gs > 1:
+            total = _interleave_gs(total, nout, nin, gs, Ntheta)
+        return sp.csr_matrix(total)
+
     def _assemble_ncc_matrix(self, subproblem, ncc, operand, tensor_factor_fn):
         """
         Sum over NCC components: kron(tensor_factor(comp), axis factors).
@@ -1099,6 +1201,12 @@ class MultiplyFields(ProductBase):
         if self._spherical_regularity_basis(ncc) is not None:
             M = self._spherical_ncc_matrix(subproblem, ncc, operand,
                                            ncc_index)
+            op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
+            return {var: M @ mat for var, mat in op_mats.items()}
+        if (self._s2_basis(ncc) is not None
+                and self._spherical_regularity_basis(operand) is None):
+            M = self._s2_coupled_ncc_matrix(subproblem, ncc, operand,
+                                            ncc_index)
             op_mats = operand_expression_matrices(operand, subproblem, vars, **kw)
             return {var: M @ mat for var, mat in op_mats.items()}
         pol = self._polar_spin_basis(ncc)
@@ -1243,6 +1351,13 @@ class DotProduct(ProductBase):
         if self._spherical_regularity_basis(ncc) is not None:
             M = self._spherical_ncc_matrix(subproblem, ncc, operand,
                                            ncc_index)
+            op_mats = operand_expression_matrices(operand, subproblem, vars,
+                                                  **kw)
+            return {var: M @ mat for var, mat in op_mats.items()}
+        if (self._s2_basis(ncc) is not None
+                and self._spherical_regularity_basis(operand) is None):
+            M = self._s2_coupled_ncc_matrix(subproblem, ncc, operand,
+                                            ncc_index)
             op_mats = operand_expression_matrices(operand, subproblem, vars,
                                                   **kw)
             return {var: M @ mat for var, mat in op_mats.items()}

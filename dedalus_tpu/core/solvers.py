@@ -231,8 +231,10 @@ class SolverBase:
         structure = MatrixStructure(self.layout, self.variables, equations)
         row_valid_all = np.array([m[0] for m in masks])
         col_valid_all = np.array([m[1] for m in masks])
+        spec = self.matsolver if isinstance(self.matsolver, str) else ""
         structure.finalize(acc.union, acc.qualified(), row_valid_all,
-                           col_valid_all, vmax=acc.vmax)
+                           col_valid_all, vmax=acc.vmax,
+                           allow_uneconomic=(spec.lower() == "banded"))
         if not structure.ok:
             self._banded_reason = structure.reason
             return (coo_store, masks)

@@ -660,7 +660,8 @@ class MatrixStructure:
             self._row_mode[rows] = m
 
     def finalize(self, union_pat, qual_pat, row_valid_all, col_valid_all,
-                 vmax=None, band_cutoff=0.5, min_blocks=2):
+                 vmax=None, band_cutoff=0.5, min_blocks=2,
+                 allow_uneconomic=False):
         """
         Complete the structure from sparsity patterns (scipy bool CSR, SxS,
         original ordering) and per-group validity masks (G, S). Sets
@@ -825,8 +826,9 @@ class MatrixStructure:
         max_diags = int(config["linear algebra"].get(
             "BANDED_MAX_DIAGS", "384"))
         n_occ = len(np.unique(d))
+        uneconomic = (8 * self.q > S) and not allow_uneconomic
         if (nd > band_cutoff * S or n_occ > max_diags
-                or self.NB < min_blocks or 8 * self.q > S):
+                or self.NB < min_blocks or uneconomic):
             self.ok = False
             self.reason = (f"band too wide ({n_occ} occupied of {nd} "
                            f"diagonals for S={S}, q={self.q})")

@@ -289,3 +289,72 @@ def test_ball_cross_ncc_vector_complex():
     v["g"][1] = z * np.sin(theta)
     v["g"][2] = 0.4 * z + 0.1 * r ** 2
     _check_expr(dist, d3.cross(ez, v), v)
+
+
+def _s2(dtype, Nphi=8, Ntheta=8):
+    coords = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(coords, dtype=dtype)
+    # dealias 3/2: the grid-evaluation reference must be alias-free for
+    # the top-ell rows to match the exact projection
+    basis = d3.SphereBasis(coords, shape=(Nphi, Ntheta), dtype=dtype,
+                           radius=1.0, dealias=(3 / 2, 3 / 2))
+    return coords, dist, basis
+
+
+def _check_s2_expr(dist, expr, operand):
+    eq = {"domain": expr.domain, "tensorsig": tuple(expr.tensorsig),
+          "L": expr}
+    layout = PencilLayout(dist, [operand], [eq])
+    sps = build_subproblems(layout)
+    Xin = np.asarray(layout.gather(operand.coeff_data(), operand.domain,
+                                   operand.tensorsig))
+    out = expr.evaluate()
+    Xout = np.asarray(layout.gather(out.coeff_data(), out.domain,
+                                    out.tensorsig))
+    scale = max(np.abs(Xout).max(), 1e-12)
+    for sp in sps:
+        mats = expr.expression_matrices(sp, [operand])
+        y = mats[operand] @ Xin[sp.index]
+        valid = layout.valid_mask(expr.domain, tuple(expr.tensorsig),
+                                  sp.group).ravel()
+        err = np.abs(y - Xout[sp.index])[valid].max(initial=0.0) / scale
+        assert err < 2e-10, (sp.group, err)
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_s2_scalar_ncc(dtype):
+    """f(theta)*u on the standalone sphere (zonal background class,
+    beyond the MulCosine special case)."""
+    coords, dist, basis = _s2(dtype)
+    phi, theta = dist.local_grids(basis)
+    f = dist.Field(name="f", bases=basis)
+    f["g"] = 2.0 + np.cos(theta) + 0.5 * np.sin(theta) ** 2 + 0 * phi
+    u = dist.Field(name="u", bases=basis)
+    u["g"] = np.cos(theta) + np.sin(theta) * np.cos(phi)
+    _check_s2_expr(dist, (f * u), u)
+
+
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_s2_dot_meridional_ncc(dtype):
+    """dot(f(theta) etheta, v) on the sphere (real spin couplings)."""
+    coords, dist, basis = _s2(dtype)
+    phi, theta = dist.local_grids(basis)
+    w = dist.VectorField(coords, name="w", bases=basis)
+    w["g"][1] = np.sin(theta) * np.cos(theta) + 0 * phi
+    v = dist.VectorField(coords, name="v", bases=basis)
+    v["g"][0] = np.sin(theta) * np.sin(phi)
+    v["g"][1] = np.sin(theta) * np.cos(theta)
+    _check_s2_expr(dist, d3.dot(w, v), v)
+
+
+def test_s2_zonal_flow_ncc_complex():
+    """U(theta) ephi * u: azimuthal NCC directions assemble complex spin
+    couplings — supported for complex dtype (linear stability analyses)."""
+    dtype = np.complex128
+    coords, dist, basis = _s2(dtype)
+    phi, theta = dist.local_grids(basis)
+    U = dist.VectorField(coords, name="U", bases=basis)
+    U["g"][0] = np.sin(theta) ** 2 + 0 * phi
+    u = dist.Field(name="u", bases=basis)
+    u["g"] = np.cos(theta) + np.sin(theta) * np.exp(1j * phi).real
+    _check_s2_expr(dist, (U * u), u)
