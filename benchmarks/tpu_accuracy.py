@@ -1,0 +1,70 @@
+"""
+On-chip accuracy check: heat-equation decay vs the exact solution at the
+bench dtype (f32 on TPU), appended to benchmarks/results.jsonl. Pairs
+with benchmarks/accuracy_f32.py (which prices f32 vs f64 on CPU): this
+script demonstrates the spectral-convergence floor ON the accelerator
+itself (reference: f64 end-to-end; BENCHMARKS.md dtype policy).
+
+Run: python benchmarks/tpu_accuracy.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[acc {time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def heat_error(N, dtype, steps=200):
+    import dedalus_tpu.public as d3
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=dtype)
+    xb = d3.RealFourier(xcoord, size=N, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - dx(dx(u)) = 0")
+    solver = problem.build_solver(d3.RK443)
+    x = dist.local_grids(xb)[0]
+    u["g"] = np.sin(3 * x) + 0.5 * np.cos(5 * x)
+    dt = 1e-4
+    solver.step_many(steps, dt)
+    t = steps * dt
+    exact = (np.exp(-9 * t) * np.sin(3 * x)
+             + 0.5 * np.exp(-25 * t) * np.cos(5 * x))
+    return float(np.abs(np.asarray(u["g"]) - exact).max())
+
+
+def main():
+    import jax
+    backend = jax.default_backend()
+    dtype = np.float32 if backend != "cpu" else np.float64
+    mark(f"backend={backend} dtype={np.dtype(dtype).name}")
+    errs = {}
+    for N in (32, 64, 128):
+        errs[N] = heat_error(N, dtype)
+        mark(f"N={N}: max err {errs[N]:.3e}")
+    from __graft_entry__ import _append_result
+    record = {
+        "case": "tpu_heat_exact",
+        "backend": backend,
+        "dtype": np.dtype(dtype).name,
+        **{f"err_N{N}": e for N, e in errs.items()},
+    }
+    _append_result(record)
+    print(record)
+    # resolution-independent floor: spectral convergence bottoms out at
+    # the dtype roundoff, not a power law
+    assert errs[128] < (2e-5 if dtype == np.float32 else 1e-8), errs
+
+
+if __name__ == "__main__":
+    main()

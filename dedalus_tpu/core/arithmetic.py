@@ -215,10 +215,21 @@ def Multiply(a, b):
 def _interleave_gs(M, nout, nin, gs, X):
     """
     Lift a matrix over (component x X) index spaces to (component x gs x X)
-    with identity action on the gs (azimuthal cos/sin pair) axis, matching
-    the slot ordering component-major > pair > coupled axes.
+    on the gs (azimuthal cos/sin pair) axis, matching the slot ordering
+    component-major > pair > coupled axes. A real matrix acts identically
+    on both pair slots (kron with I2); a complex matrix acts through its
+    real 2x2 pair representation Re (x) I2 + Im (x) J — the same
+    convention the transforms use for the spin recombination
+    (curvilinear.real_pair_matrix).
     """
-    K = sp.kron(M, sp.identity(gs), format="csr")  # ordering (comp, X, j)
+    if np.iscomplexobj(M.data if sp.issparse(M) else M):
+        from .curvilinear import PAIR_J
+        Mr = M.real
+        Mi = M.imag
+        K = (sp.kron(Mr, sp.identity(gs), format="csr")
+             + sp.kron(Mi, sp.csr_matrix(PAIR_J), format="csr"))
+    else:
+        K = sp.kron(M, sp.identity(gs), format="csr")  # ordering (comp, X, j)
 
     def perm(ncomp):
         comp = np.repeat(np.arange(ncomp), gs * X)
@@ -909,13 +920,15 @@ class ProductBase(Future):
         if np.abs(total.imag).max() < 1e-13 * max(np.abs(total).max()
                                                   if total.nnz else 0.0, 1e-300):
             total = total.real
-        elif not is_complex_dtype(self.dtype):
+        elif not is_complex_dtype(self.dtype) and gs < 2:
             raise NonlinearOperatorError(
                 "This NCC product assembles complex couplings (e.g. a cross "
-                "product); use a complex dtype, or move the term to the RHS.")
+                "product) with no azimuthal pair slots to carry them; use a "
+                "complex dtype, or move the term to the RHS.")
         if gs > 1:
             # slot layout is (component, azimuthal pair, ell, n): interleave
-            # the gs identity between the component and ell kron positions
+            # between the component and ell kron positions (complex
+            # couplings act through the real 2x2 pair representation)
             total = _interleave_gs(total, nout, nin, gs, Ntheta * Nr)
         return sp.csr_matrix(total)
 
@@ -1033,12 +1046,11 @@ class ProductBase(Future):
         if total.nnz and np.abs(total.imag).max() < 1e-13 * max(
                 np.abs(total).max(), 1e-300):
             total = total.real
-        elif total.nnz and not is_complex_dtype(self.dtype):
-            if np.abs(total.imag).max() > 1e-10 * np.abs(total).max():
-                raise NonlinearOperatorError(
-                    "This NCC product assembles complex couplings; use a "
-                    "complex dtype, or move the term to the RHS.")
-            total = total.real
+        elif total.nnz and not is_complex_dtype(self.dtype) and gs < 2:
+            raise NonlinearOperatorError(
+                "This NCC product assembles complex couplings with no "
+                "azimuthal pair slots to carry them; use a complex dtype, "
+                "or move the term to the RHS.")
         if gs > 1:
             total = _interleave_gs(total, nout, nin, gs, X0)
         return sp.csr_matrix(total)
@@ -1125,12 +1137,11 @@ class ProductBase(Future):
         if total.nnz and np.abs(total.imag).max() < 1e-13 * max(
                 np.abs(total).max(), 1e-300):
             total = total.real
-        elif total.nnz and not is_complex_dtype(self.dtype):
-            if np.abs(total.imag).max() > 1e-10 * np.abs(total).max():
-                raise NonlinearOperatorError(
-                    "This S2 NCC product assembles complex couplings; use "
-                    "a complex dtype, or move the term to the RHS.")
-            total = total.real
+        elif total.nnz and not is_complex_dtype(self.dtype) and gs < 2:
+            raise NonlinearOperatorError(
+                "This S2 NCC product assembles complex couplings with no "
+                "azimuthal pair slots to carry them; use a complex dtype, "
+                "or move the term to the RHS.")
         if gs > 1:
             total = _interleave_gs(total, nout, nin, gs, Ntheta)
         return sp.csr_matrix(total)

@@ -102,9 +102,11 @@ def test_dot_ncc_vector(dtype):
     _check_expr(dist, d3.dot(ez, v), v)
 
 
-def test_cross_ncc_vector_complex():
-    """cross(ez, v): the Coriolis coupling (complex dtype)."""
-    dtype = np.complex128
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_cross_ncc_vector(dtype):
+    """cross(ez, v): the Coriolis coupling. Complex dtype carries the
+    +-i spin couplings directly; real dtype carries them through the
+    azimuthal (cos, sin) pair representation."""
     coords, dist, shell = _shell(dtype)
     phi, theta, r = dist.local_grids(shell)
     ez = _ez(dist, coords, shell)
@@ -274,10 +276,10 @@ def test_ball_vector_ncc_times_scalar(dtype):
     _check_expr(dist, (ez * u), u)
 
 
-def test_ball_cross_ncc_vector_complex():
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_ball_cross_ncc_vector(dtype):
     """cross(ez, v) on the ball (Coriolis term of rotating ball flows,
     e.g. the libration example class)."""
-    dtype = np.complex128
     coords, dist, ball = _ball(dtype)
     phi, theta, r = dist.local_grids(ball)
     ez = dist.VectorField(coords, name="ez", bases=ball.meridional_basis)
@@ -347,10 +349,11 @@ def test_s2_dot_meridional_ncc(dtype):
     _check_s2_expr(dist, d3.dot(w, v), v)
 
 
-def test_s2_zonal_flow_ncc_complex():
+@pytest.mark.parametrize("dtype", [np.complex128, np.float64])
+def test_s2_zonal_flow_ncc(dtype):
     """U(theta) ephi * u: azimuthal NCC directions assemble complex spin
-    couplings — supported for complex dtype (linear stability analyses)."""
-    dtype = np.complex128
+    couplings, carried by the pair representation for real dtype
+    (linear stability analyses around zonal flows)."""
     coords, dist, basis = _s2(dtype)
     phi, theta = dist.local_grids(basis)
     U = dist.VectorField(coords, name="U", bases=basis)
