@@ -69,11 +69,14 @@ def _axis_identity(basis, sep_width=None, sub_axis=0):
     return sp.identity(basis.coeff_size(sub_axis), format="csr")
 
 
-def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subproblem):
+def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out,
+                          subproblem, out_domain=None):
     """
     Kron-assemble the pencil matrix of one operator at one group.
     `subproblem.group` is a full-length per-axis tuple (group index on
-    separable axes, None elsewhere).
+    separable axes, None elsewhere). `out_domain` (when given) marks axes
+    the OUTPUT is constant along — on layout-coupled axes, per-group
+    "blocks" reduce (hstack) instead of block-diagonalizing there.
     """
     group = subproblem.group
     sep_widths = subproblem.layout.sep_widths  # {axis: group_shape}
@@ -115,12 +118,19 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out, subprobl
                         # layout-coupled separable basis (e.g. a Fourier
                         # axis an LHS NCC varies along): the whole-axis
                         # matrix is the block diagonal of the per-group
-                        # blocks in group order — except for embeddings
-                        # FROM a constant axis (operand basis None), whose
-                        # single input slot feeds every group: stack the
-                        # per-group columns instead
+                        # blocks in group order — except embeddings FROM a
+                        # constant axis (operand basis None: stack the
+                        # per-group columns) and reductions TO a constant
+                        # axis (output basis None: concatenate the
+                        # per-group rows)
+                        out_const = (out_domain is not None
+                                     and out_domain.bases[axis] is None)
                         if basis is None:
                             factors.append(sp.vstack(
+                                [sparsify(b) for b in descr[1]],
+                                format="csr"))
+                        elif out_const:
+                            factors.append(sp.hstack(
                                 [sparsify(b) for b in descr[1]],
                                 format="csr"))
                         else:
@@ -236,7 +246,8 @@ class LinearOperator(Future):
     def subproblem_matrix(self, subproblem):
         return assemble_group_matrix(
             self.terms(), self.operand.domain,
-            self.operand.tshape, self.tshape, subproblem)
+            self.operand.tshape, self.tshape, subproblem,
+            out_domain=self.domain)
 
     def ev_impl(self, ctx):
         data = ev(self.operand, ctx, "c")

@@ -25,7 +25,7 @@ from .subsystems import (PencilLayout, build_subproblems, build_matrices,
                          assemble_group_coos, MatrixStructure,
                          build_banded_arrays, gather_state, scatter_state,
                          row_valid_masks, merge_conditional_equations,
-                         active_member)
+                         active_member, state_key)
 from .future import EvalContext, ev
 from . import timesteppers as timesteppers_mod
 from ..libraries import pencilops
@@ -318,7 +318,7 @@ class SolverBase:
 
     def gather_fields(self, fields=None):
         fields = fields or self.variables
-        arrays = {v.name: v.coeff_data() for v in fields}
+        arrays = {state_key(v): v.coeff_data() for v in fields}
         return gather_state(self.layout, fields, arrays)
 
     def scatter_fields(self, X, fields=None):
@@ -327,7 +327,7 @@ class SolverBase:
         fields = fields or self.variables
         arrays = scatter_state(self.layout, fields, X)
         for v in fields:
-            v.preset_coeff(arrays[v.name])
+            v.preset_coeff(arrays[state_key(v)])
             v.mark_modified()
 
     def defer_scatter(self, X):
@@ -342,7 +342,7 @@ class SolverBase:
             def pull():
                 if "arrays" not in cache:
                     cache["arrays"] = scatter_state(layout, variables, X)
-                var.preset_coeff(cache["arrays"][var.name])
+                var.preset_coeff(cache["arrays"][state_key(var)])
             return pull
 
         for v in variables:
@@ -417,7 +417,7 @@ class SolverBase:
             subs = {}
             if X is not None:
                 arrays = scatter_state(layout, variables, X)
-                subs = {var: arrays[var.name] for var in variables}
+                subs = {var: arrays[state_key(var)] for var in variables}
             if time_field is not None:
                 subs[time_field] = jnp.reshape(jnp.asarray(t, dtype=self.real_dtype),
                                                (1,) * dim)
@@ -534,9 +534,10 @@ class InitialValueSolver(SolverBase):
                     for v in variables:
                         scales = tuple(v.domain.dealias)
                         tdim = len(v.tensorsig)
-                        g = transform_to_grid(arrays[v.name], v.domain, scales,
+                        g = transform_to_grid(arrays[state_key(v)], v.domain,
+                                              scales,
                                               tdim, tensorsig=v.tensorsig)
-                        out[v.name] = transform_to_coeff(g, v.domain, scales,
+                        out[state_key(v)] = transform_to_coeff(g, v.domain, scales,
                                                          tdim,
                                                          tensorsig=v.tensorsig)
                     return gather_state(layout, variables, out)
@@ -798,7 +799,7 @@ class NonlinearBoundaryValueSolver(SolverBase):
         self._last_perturbation = dX
         arrays = scatter_state(self.layout, self.variables, dX)
         for var, pert in zip(self.problem.variables, self.variables):
-            var.preset_coeff(var.coeff_data() + damping * arrays[pert.name])
+            var.preset_coeff(var.coeff_data() + damping * arrays[state_key(pert)])
             var.mark_modified()
         self.iteration += 1
 
@@ -932,7 +933,7 @@ class EigenvalueSolver(SolverBase):
         X[subproblem.index] = self.eigenvectors[:, index]
         arrays = scatter_state(self.layout, self.variables, jnp.asarray(X))
         for var in self.variables:
-            data = arrays[var.name]
+            data = arrays[state_key(var)]
             if not np.iscomplexobj(np.asarray(var.data)):
                 data = data.real
             var.preset_coeff(jnp.asarray(data).astype(var.data.dtype))

@@ -1004,9 +1004,18 @@ def build_banded_arrays(coo_store, structure, names, dtype, drop_tol=0.0,
     return out
 
 
+def state_key(v):
+    """Dict key for a state field: unnamed fields (e.g. tau fields created
+    without name=, as in the reference examples) must not collide on
+    name=None."""
+    return v.name if v.name is not None else f"_anon_{id(v):x}"
+
+
 def gather_state(layout, variables, arrays):
-    """Stack per-variable coeff arrays into the (G, S) state vector."""
-    parts = [layout.gather(arrays[v.name], v.domain, v.tensorsig) for v in variables]
+    """Stack per-variable coeff arrays into the (G, S) state vector,
+    keyed by `state_key`."""
+    parts = [layout.gather(arrays[state_key(v)], v.domain, v.tensorsig)
+             for v in variables]
     return jnp.concatenate(parts, axis=1)
 
 
@@ -1016,7 +1025,8 @@ def scatter_state(layout, variables, X):
     offset = 0
     for v in variables:
         size = layout.slot_size(v.domain, v.tensorsig)
-        out[v.name] = layout.scatter(X[:, offset:offset + size], v.domain, v.tensorsig)
+        out[state_key(v)] = layout.scatter(X[:, offset:offset + size],
+                                           v.domain, v.tensorsig)
         offset += size
     return out
 
