@@ -175,6 +175,55 @@ def build_shallow_water(Nphi, Ntheta, dtype):
     return solver, 300.0 * second
 
 
+def build_rotconv_ivp(Nphi, Ntheta, Nr, dtype):
+    """Rotating Boussinesq convection in a shell (IVP): the ell-coupled
+    Coriolis NCC makes every per-m pencil a (theta x r)-coupled system on
+    the flattened banded path — the 3D curvilinear flagship
+    (reference formulation: examples/evp_shell_rotating_convection)."""
+    import dedalus_tpu.public as d3
+    Ri, Ro = 0.35, 1.0
+    Ekman, Prandtl, Rayleigh = 1e-3, 1.0, 3e5
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=dtype)
+    shell = d3.ShellBasis(coords, shape=(Nphi, Ntheta, Nr), radii=(Ri, Ro),
+                          dtype=dtype)
+    sphere = shell.outer_surface
+    phi, theta, r = dist.local_grids(shell)
+    u = dist.VectorField(coords, name="u", bases=shell)
+    p = dist.Field(name="p", bases=shell)
+    T = dist.Field(name="T", bases=shell)
+    tau_u1 = dist.VectorField(coords, bases=sphere)
+    tau_u2 = dist.VectorField(coords, bases=sphere)
+    tau_T1 = dist.Field(bases=sphere)
+    tau_T2 = dist.Field(bases=sphere)
+    tau_p = dist.Field()
+    rvec = dist.VectorField(coords, bases=shell.meridional_basis)
+    rvec["g"][2] = np.broadcast_to(r, rvec["g"][2].shape)
+    ez = dist.VectorField(coords, bases=shell.meridional_basis)
+    ez["g"][1] = -np.sin(theta)
+    ez["g"][2] = np.cos(theta)
+    lift_basis = shell.derivative_basis(1)
+    lift = lambda A: d3.Lift(A, lift_basis, -1)
+    grad_u = d3.grad(u) + rvec * lift(tau_u1)
+    grad_T = d3.grad(T) + rvec * lift(tau_T1)
+    problem = d3.IVP([p, u, T, tau_u1, tau_u2, tau_T1, tau_T2, tau_p],
+                     namespace=locals())
+    problem.add_equation("trace(grad_u) + tau_p = 0")
+    problem.add_equation("dt(u) + (1/Ekman)*cross(ez, u) + grad(p) "
+                         "- Rayleigh*T*rvec - div(grad_u) + lift(tau_u2) "
+                         "= - u@grad(u)")
+    problem.add_equation("dt(T) - dot(rvec,u)/Prandtl - div(grad_T)/Prandtl "
+                         "+ lift(tau_T2) = - u@grad(T)")
+    problem.add_equation("u(r=0.35) = 0")
+    problem.add_equation("u(r=1.0) = 0")
+    problem.add_equation("T(r=0.35) = 0")
+    problem.add_equation("T(r=1.0) = 0")
+    problem.add_equation("integ(p) = 0")
+    solver = problem.build_solver(d3.RK222)
+    T.fill_random("g", seed=3, scale=1e-4)
+    return solver, 1e-4
+
+
 CONFIGS = {
     "kdv1024": lambda dt_: build_kdv(1024, dt_),
     "shear512": lambda dt_: build_shear(512, dt_),
@@ -183,6 +232,7 @@ CONFIGS = {
     "rb2048x1024": lambda dt_: build_rb(2048, 1024, dt_, matsolver="banded"),
     "rb3d_128": lambda dt_: build_rb3d(128, 128, 64, dt_),
     "sw_ell255": lambda dt_: build_shallow_water(512, 256, dt_),
+    "rotconv32": lambda dt_: build_rotconv_ivp(64, 32, 32, dt_),
 }
 
 # measured steps per config (big builds measure fewer)

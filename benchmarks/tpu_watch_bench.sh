@@ -49,7 +49,14 @@ for i in $(seq 1 "$MAX_ITERS"); do
         run_config kdv1024 900 || continue
         run_config shear512 1500 || continue
         run_config sw_ell255 2400 || continue
+        if [ ! -f benchmarks/.auto_bench_done_accuracy ]; then
+            log "running tpu_accuracy (timeout 900s)"
+            timeout -k 10 900 setsid python benchmarks/tpu_accuracy.py \
+                >> "$LOG" 2>&1 && touch benchmarks/.auto_bench_done_accuracy
+            probe || continue
+        fi
         run_config rb2048x1024 3600 || continue
+        run_config rotconv32 2400 || continue
         log "sweep complete"
         touch "$MARKER"
         exit 0
