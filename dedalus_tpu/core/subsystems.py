@@ -687,6 +687,11 @@ class MatrixStructure:
             [c for m in range(self.n_modes)
              for c in list(self._cols_by_mode[m]) + unc_by_mode[m]],
             dtype=int)
+        # mode of each permuted column position (outer-block matching)
+        self._col_pos_mode = np.array(
+            [m for m in range(self.n_modes)
+             for _ in list(self._cols_by_mode[m]) + unc_by_mode[m]],
+            dtype=int)
         pos_col = np.argsort(self.col_perm)
         # Stage A: greedy structural matching of coupled-equation rows to
         # columns. Rows are processed from the highest mode down, each
@@ -717,27 +722,37 @@ class MatrixStructure:
         match = -np.ones(nr, dtype=int)
         col_taken = np.zeros(S, dtype=bool)
         indptr, indices, data = Qr.indptr, Qr.indices, Qr.data
-        # With two flattened coupled axes, stability requires aligning on
-        # a DOMINANT entry: a far (outer-axis) coupling that is merely a
-        # perturbation (an ell-coupled NCC term) turns the block
-        # elimination into an exponentially growing outer recurrence, so
-        # NCC-forced couplings gate candidates to within a factor of the
-        # row's largest magnitude. Two GENUINE coupled bases (a rectangle's
-        # Dxx vs Dzz) are same-order principals — there the plain
-        # highest-offset rule is the consistent (stable) alignment, and
-        # magnitude-gating would mix alignments row by row (n^2-dependent
-        # relative sizes) and destabilize the elimination.
+        # With two flattened coupled axes, stability requires a CONSISTENT
+        # alignment choice. For NCC-forced couplings (ell-coupled shell/
+        # ball problems) the principal operator is the inner (radial) one:
+        # every outer-axis (dl != 0) coupling is a physical side term
+        # (Coriolis, anisotropic conductivity, ...) whose magnitude can be
+        # anything — aligning on it turns the block elimination into an
+        # exponentially growing outer recurrence (1/Ekman-scaled Coriolis
+        # entries defeated a magnitude gate). So restrict each row's
+        # candidates to columns in its OWN outer-mode block (exact mode
+        # comparison; flat-offset windows leak neighbouring blocks). Two
+        # GENUINE coupled bases (a rectangle's Dxx vs Dzz) are same-order
+        # principals and keep the plain highest-offset rule.
         ncc_forced = bool(getattr(self.layout, "forced_coupled", None))
-        sig_frac = 0.3 if (getattr(self, "n_caxes", 1) > 1
-                           and ncc_forced) else 1e-10
+        outer_match = (getattr(self, "n_caxes", 1) > 1 and ncc_forced)
+        if outer_match:
+            inner = max(self._inner_modes, 1)
+            cand_outer = self._col_pos_mode // inner
         for i in range(nr - 1, -1, -1):
             cand = indices[indptr[i]:indptr[i + 1]]
             w = data[indptr[i]:indptr[i + 1]]
             free = ~col_taken[cand]
             if free.any():
                 cand, w = cand[free], w[free]
-                sig = w >= sig_frac * w.max()
-                c = cand[sig].max()
+                sig = w >= 1e-10 * w.max()
+                cand = cand[sig]
+                if outer_match:
+                    row_outer = self._row_mode[self._rows_int[i]] // inner
+                    near = cand_outer[cand] == row_outer
+                    if near.any():
+                        cand = cand[near]
+                c = cand.max()
                 match[i] = c
                 col_taken[c] = True
         row_pos = -np.ones(S, dtype=int)     # orig row index -> position

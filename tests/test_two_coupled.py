@@ -135,3 +135,57 @@ def test_poisson_rectangle_banded_at_scale():
     assert band_bytes < dense_bytes / 20
     solver.solve()
     assert np.abs(np.asarray(u["g"]) - u_ex).max() < 1e-8
+
+
+def test_shell_coriolis_ivp_banded_matches_dense():
+    """Coriolis-dominant regime (1/Ekman >> radial operator magnitudes):
+    the alignment must stay on the radial principal regardless of entry
+    magnitudes (regression: a magnitude-gated matching aligned on the
+    1/Ekman-scaled dl=+-1 Coriolis couplings and diverged)."""
+    def build(matsolver):
+        coords = d3.SphericalCoordinates("phi", "theta", "r")
+        dist = d3.Distributor(coords, dtype=np.float64)
+        shell = d3.ShellBasis(coords, shape=(8, 40, 16), radii=(0.35, 1.0),
+                              dtype=np.float64)
+        sphere = shell.outer_surface
+        phi, theta, r = dist.local_grids(shell)
+        u = dist.VectorField(coords, name="u", bases=shell)
+        p = dist.Field(name="p", bases=shell)
+        tau_u1 = dist.VectorField(coords, name="tau_u1", bases=sphere)
+        tau_u2 = dist.VectorField(coords, name="tau_u2", bases=sphere)
+        tau_p = dist.Field(name="tau_p")
+        Ekman = 1e-3
+        rvec = dist.VectorField(coords, name="rvec",
+                                bases=shell.meridional_basis)
+        rvec["g"][2] = np.broadcast_to(r, rvec["g"][2].shape)
+        ez = dist.VectorField(coords, name="ez",
+                              bases=shell.meridional_basis)
+        ez["g"][1] = -np.sin(theta)
+        ez["g"][2] = np.cos(theta)
+        lift_basis = shell.derivative_basis(1)
+        lift = lambda A: d3.Lift(A, lift_basis, -1)
+        grad_u = d3.grad(u) + rvec * lift(tau_u1)
+        problem = d3.IVP([p, u, tau_u1, tau_u2, tau_p], namespace=locals())
+        problem.add_equation("trace(grad_u) + tau_p = 0")
+        problem.add_equation(
+            "dt(u) + (1/Ekman)*cross(ez, u) + grad(p) - div(grad_u)"
+            " + lift(tau_u2) = 0")
+        problem.add_equation("u(r=0.35) = 0")
+        problem.add_equation("u(r=1.0) = 0")
+        problem.add_equation("integ(p) = 0")
+        solver = problem.build_solver(d3.RK222, matsolver=matsolver)
+        u.fill_random("g", seed=11, scale=1e-3)
+        return solver, u
+
+    s_d, u_d = build("dense")
+    for _ in range(4):
+        s_d.step(1e-4)
+    ref = np.asarray(u_d["g"]).copy()
+    assert np.isfinite(ref).all()
+    s_b, u_b = build("banded")
+    assert isinstance(s_b.ops, BandedOps), s_b._banded_reason
+    for _ in range(4):
+        s_b.step(1e-4)
+    sol = np.asarray(u_b["g"])
+    assert np.isfinite(sol).all()
+    assert np.abs(sol - ref).max() < 1e-10 * max(np.abs(ref).max(), 1.0)
