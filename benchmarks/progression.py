@@ -315,6 +315,7 @@ def main():
                 print(json.dumps(record), flush=True)
                 _append_result(record)
         sys.exit(1 if failures else 0)
+    failed = False
     for name in names:
         if name not in CONFIGS:
             mark(f"unknown config {name}; skipping")
@@ -324,8 +325,13 @@ def main():
         except Exception as e:
             record = {"config": name, "error": repr(e)}
             mark(f"{name}: FAILED {e!r}")
+            failed = True
         print(json.dumps(record), flush=True)
         _append_result(record)
+    # a recorded-error run must NOT look successful to the sweep (a
+    # round-4 remote-compile outage marked rb2048x1024 done with no data)
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
