@@ -881,12 +881,27 @@ class ProductBase(Future):
 
         total = sp.csr_matrix((nout * Ntheta * Nr, nin * Ntheta * Nr),
                               dtype=complex)
-        for c in range(nout):
-            sc = int(s_out[c])
-            # rows of the Q_out sandwich for spin component c
-            R_c = sp.vstack([
+        # Q sandwiches are m-independent: cache across the group sweep
+        # (the per-m cost is then only the W couplings, which are
+        # themselves cached by (m, spins, L))
+        qcache = data.setdefault("q_sandwich", {})
+        key_R = ("R", rank_out, Ntheta, Nr)
+        key_C = ("C", rank_in, Ntheta, Nr)
+        if key_R not in qcache:
+            qcache[key_R] = [sp.vstack([
                 sparse_kron(sp.diags(Qo[:, c, gam]), I_r)
                 for gam in range(nout)], format="csr")
+                for c in range(nout)]
+        if key_C not in qcache:
+            qcache[key_C] = [sp.hstack([
+                sparse_kron(sp.diags(Qi[:, b, bet]), I_r)
+                for bet in range(nin)], format="csr")
+                for b in range(nin)]
+        R_all = qcache[key_R]
+        C_all = qcache[key_C]
+        for c in range(nout):
+            sc = int(s_out[c])
+            R_c = R_all[c]
             for b in range(nin):
                 sb = int(s_in[b])
                 A_cb = None
@@ -908,9 +923,7 @@ class ProductBase(Future):
                         A_cb = term if A_cb is None else A_cb + term
                 if A_cb is None:
                     continue
-                C_b = sp.hstack([
-                    sparse_kron(sp.diags(Qi[:, b, bet]), I_r)
-                    for bet in range(nin)], format="csr")
+                C_b = C_all[b]
                 total = total + R_c @ A_cb @ C_b
         # Canonicalize BEFORE any derived views: .imag/.real of a
         # non-canonical CSR share index arrays with the parent, and
