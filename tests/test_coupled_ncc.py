@@ -361,3 +361,23 @@ def test_s2_zonal_flow_ncc(dtype):
     u = dist.Field(name="u", bases=basis)
     u["g"] = np.cos(theta) + np.sin(theta) * np.exp(1j * phi).real
     _check_s2_expr(dist, (U * u), u)
+
+
+def test_rotating_convection_evp_full():
+    """Full-resolution (64x64) rotating convection: the critical m=13
+    eigenvalue matches Marti, Calkins & Julien (2016) Table 1 to ~1e-5
+    relative (963.772 vs 963.765 stress-free; the reference docstring
+    quotes 'several digits of precision' at this resolution)."""
+    import pathlib
+    import sys
+    sys.argv = ["rotating_convection"]
+    src = (pathlib.Path(__file__).parent.parent / "examples"
+           / "rotating_convection.py").read_text()
+    ns = {}
+    exec(src.split("if __name__")[0], ns)
+    solver = ns["solver"]
+    subproblem = solver.subproblems_by_group[(13, None, None)]
+    solver.solve_sparse(subproblem, 3, 963.765)
+    ev = solver.eigenvalues[0]
+    assert abs(ev.real - 963.765) < 0.05, ev
+    assert abs(ev.imag) < 0.05, ev
