@@ -49,9 +49,11 @@ class SolverBase:
         if matsolver is None:
             matsolver = config["linear algebra"].get("MATRIX_SOLVER", "auto")
         self.matsolver = matsolver
-        # parity kwarg (reference: solvers accept ncc_cutoff for Clenshaw
-        # truncation); here NCC matrices are quadrature-built and sparsified
-        # at fixed tolerance, so the value only gates sparsify cutoffs
+        # API-parity kwarg (reference: solvers accept ncc_cutoff for
+        # Clenshaw truncation). NCC matrices here are quadrature-built and
+        # sparsified at fixed tolerances (arithmetic.NCC_ANGULAR_CUTOFF,
+        # sparsify defaults), so the value is accepted but currently
+        # unused.
         self.ncc_cutoff = ncc_cutoff
         self.layout = PencilLayout(self.dist, self.variables,
                                    problem.equations)
