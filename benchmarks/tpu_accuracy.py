@@ -59,6 +59,19 @@ def main():
         "dtype": np.dtype(dtype).name,
         **{f"err_N{N}": e for N, e in errs.items()},
     }
+    if backend != "cpu":
+        # f64-on-accelerator opt-in: the Fourier transforms route through
+        # the matrix-MMT path on TPU (no c128), so f64 runs on emulated
+        # double-precision matmuls where the backend supports them —
+        # demonstrating the reference's f64 spectral-convergence floor
+        # on-chip (BENCHMARKS.md dtype policy; reference is f64-native).
+        try:
+            e64 = heat_error(64, np.float64)
+            record["err_N64_f64_onchip"] = e64
+            mark(f"f64-on-chip N=64: max err {e64:.3e}")
+        except Exception as exc:
+            record["f64_onchip_error"] = repr(exc)[:200]
+            mark(f"f64-on-chip unsupported: {exc!r}")
     _append_result(record)
     print(record)
     # resolution-independent floor: spectral convergence bottoms out at
