@@ -55,8 +55,8 @@ for i in $(seq 1 "$MAX_ITERS"); do
                 >> "$LOG" 2>&1 && touch benchmarks/.auto_bench_done_accuracy
             probe || continue
         fi
-        run_config rb2048x1024 3600 || continue
         run_config rotconv32 2400 || continue
+        run_config rb2048x1024 3600 || continue
         log "sweep complete"
         touch "$MARKER"
         exit 0
