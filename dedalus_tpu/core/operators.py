@@ -144,8 +144,17 @@ def assemble_group_matrix(terms, operand_domain, tshape_in, tshape_out,
                     # different (separable) axis
                     _, group_axis, stack = descr
                     if group[group_axis] is None:
+                        # selector axis layout-coupled: each group's block
+                        # acts identically on that group's pair slots
+                        # (e.g. the real (cos, sin) azimuth pair), so the
+                        # joint factor is blockdiag_g(I_gs (x) B_g)
+                        gb = operand_domain.bases[group_axis]
+                        gsub = group_axis - gb.first_axis
+                        gw = gb.sub_group_shape(gsub)
+                        eye_g = sp.identity(gw, format="csr")
                         factors.append(sp.block_diag(
-                            [sparsify(b) for b in stack], format="csr"))
+                            [sp.kron(eye_g, sparsify(b), format="csr")
+                             for b in stack], format="csr"))
                     else:
                         factors.append(sparsify(stack[group[group_axis]]))
                 else:

@@ -42,7 +42,8 @@ class SolverBase:
     matrices = ("L",)
     lazy_ok = False   # EVP: per-group on-demand assembly at large sizes
 
-    def __init__(self, problem, matsolver=None, ncc_cutoff=None, **kw):
+    def __init__(self, problem, matsolver=None, ncc_cutoff=None,
+                 matrix_coupling=None, **kw):
         self.problem = problem
         self.dist = problem.dist
         self.variables = self.matrix_variables(problem)
@@ -56,7 +57,8 @@ class SolverBase:
         # unused.
         self.ncc_cutoff = ncc_cutoff
         self.layout = PencilLayout(self.dist, self.variables,
-                                   problem.equations)
+                                   problem.equations,
+                                   matrix_coupling=matrix_coupling)
         self.equations = merge_conditional_equations(problem.equations,
                                                      self.dist, self.layout)
         self.subproblems = build_subproblems(self.layout)

@@ -112,12 +112,19 @@ def _ncc_forced_coupled_axes(variables, equations):
 class PencilLayout:
     """Global pencil structure shared by all subproblems of a problem."""
 
-    def __init__(self, dist, variables, equations):
+    def __init__(self, dist, variables, equations, matrix_coupling=None):
         self.dist = dist
         dim = dist.dim
         sep_basis = [None] * dim      # (basis, sub_axis)
         coupled_basis = [None] * dim  # (basis, sub_axis)
         self.forced_coupled = _ncc_forced_coupled_axes(variables, equations)
+        if matrix_coupling is not None:
+            # reference parity: solvers accept matrix_coupling (per-axis
+            # bools) to force axes coupled beyond what NCC detection
+            # requires (reference: core/solvers.py matrix_coupling kwarg)
+            for axis, forced in enumerate(matrix_coupling):
+                if forced:
+                    self.forced_coupled.add(axis)
         domains = [v.domain for v in variables] + [eq["domain"] for eq in equations]
         for domain in domains:
             for axis, basis in enumerate(domain.bases):

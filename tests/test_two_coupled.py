@@ -189,3 +189,39 @@ def test_shell_coriolis_ivp_banded_matches_dense():
     sol = np.asarray(u_b["g"])
     assert np.isfinite(sol).all()
     assert np.abs(sol - ref).max() < 1e-10 * max(np.abs(ref).max(), 1.0)
+
+
+def test_matrix_coupling_forced_disk():
+    """Reference-parity matrix_coupling kwarg: the disk Poisson solved
+    with a FORCED azimuthal coupling (one flattened (m x r) pencil)
+    matches the separable per-m solve (reference: tests parametrize
+    azimuth_coupling on polar LBVPs)."""
+    def build(**kw):
+        coords = d3.PolarCoordinates("phi", "r")
+        dist = d3.Distributor(coords, dtype=np.float64)
+        disk = d3.DiskBasis(coords, shape=(8, 16), dtype=np.float64,
+                            radius=1.0)
+        phi, r = dist.local_grids(disk)
+        u = dist.Field(name="u", bases=disk)
+        tau = dist.Field(name="tau", bases=disk.edge)
+        rhs = dist.Field(name="rhs", bases=disk)
+        x = r * np.cos(phi)
+        y = r * np.sin(phi)
+        u_ex = (1 - r ** 2) * (1 + 0.5 * x + 0.3 * y)
+        # lap((1-r^2) v) = -4 v + 2 grad(1-r^2).grad(v), v harmonic
+        rhs["g"] = -4.0 - 4.0 * x - 2.4 * y
+        lift = lambda A: d3.Lift(A, disk, -1)
+        problem = d3.LBVP([u, tau], namespace=locals())
+        problem.add_equation("lap(u) + lift(tau) = rhs")
+        problem.add_equation("u(r=1) = 0")
+        solver = problem.build_solver(**kw)
+        return solver, u, u_ex
+
+    s_sep, u_sep, u_ex = build()
+    s_sep.solve()
+    assert np.abs(np.asarray(u_sep["g"]) - u_ex).max() < 1e-10
+    s_cpl, u_cpl, _ = build(matrix_coupling=[True, True])
+    assert s_cpl.pencil_shape[0] == 1  # one flattened pencil
+    s_cpl.solve()
+    assert np.abs(np.asarray(u_cpl["g"])
+                  - np.asarray(u_sep["g"])).max() < 1e-11
