@@ -1233,6 +1233,53 @@ parseables["azimuthal"] = Azimuthal
 parseables["angular"] = Angular
 
 
+class SphericalEllProduct(LinearOperator):
+    """
+    Multiplication by a function of the spherical-harmonic degree:
+    out(ell) = ell_func(ell) * in(ell), ell-diagonal on sphere/shell/ball
+    bases (reference: core/operators.py:4119 SphericalEllProduct — used
+    e.g. for degree-dependent hyperdiffusion).
+    """
+
+    name = "SphericalEllProduct"
+
+    def __init__(self, operand, cs, ell_func):
+        self.cs = cs
+        self.ell_func = ell_func
+        super().__init__(operand)
+
+    def rebuild(self, new_args):
+        return SphericalEllProduct(new_args[0], self.cs, self.ell_func)
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        self.domain = operand.domain
+        self.tensorsig = tuple(operand.tensorsig)
+        self.dtype = operand.dtype
+
+    def _sph_basis(self):
+        from .sphere import SphereBasis
+        for b in self.operand.domain.bases:
+            if b is not None and (isinstance(b, SphereBasis)
+                                  or getattr(b, "regularity", False)):
+                return b
+        raise ValueError("SphericalEllProduct requires a sphere/shell/"
+                         "ball basis.")
+
+    def terms(self):
+        basis = self._sph_basis()
+        colat = basis.first_axis + 1
+        dim = self.operand.domain.dim
+        vals = np.array([float(self.ell_func(ell))
+                         for ell in range(basis.Ntheta)])
+        descrs = [None] * dim
+        descrs[colat] = ("blocks", vals.reshape(-1, 1, 1))
+        return [(None, descrs)]
+
+
+parseables["SphericalEllProduct"] = SphericalEllProduct
+
+
 # ----------------------------------------------------------------------
 # Grid-space nonlinear operators
 

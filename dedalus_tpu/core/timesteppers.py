@@ -576,3 +576,18 @@ class RK443(RungeKuttaIMEX):
                   [0., -1/2, 1/2, 1/2, 0.],
                   [0., 3/2, -3/2, 1/2, 1/2]])
     c = np.array([0., 1/2, 2/3, 1/2, 1.])
+
+
+@add_scheme
+class RKGFY(RungeKuttaIMEX):
+    """2nd-order 2-stage IMEX RK of Hollerbach & Marti (published
+    tableau; reference keeps it unregistered at core/timesteppers.py:715
+    — registered here for completeness)."""
+    stages = 2
+    A = np.array([[0., 0., 0.],
+                  [1., 0., 0.],
+                  [0.5, 0.5, 0.]])
+    H = np.array([[0., 0., 0.],
+                  [0.5, 0.5, 0.],
+                  [0.5, 0., 0.5]])
+    c = np.array([0., 1., 1.])

@@ -30,13 +30,14 @@ from .core.operators import (
     Differentiate, Convert, Interpolate, Integrate, Average,
     LiftFactory as Lift, LiftTau,
     Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents,
-    SkewFactory as Skew, Radial, Azimuthal, Angular,
+    SkewFactory as Skew, Radial, Azimuthal, Angular, SphericalEllProduct,
     TimeDerivative, UnaryGridFunction, GeneralFunction, GridWrapper as Grid,
     CoeffWrapper as Coeff, dt)
 from .core.arithmetic import Add, Multiply, DotProduct, CrossProduct, Power
-from .core.timesteppers import (schemes, CNAB1, SBDF1, CNAB2, MCNAB2, SBDF2,
-                                CNLF2, SBDF3, SBDF4, RK111, RK222, RK443,
-                                RKSMR)
+from .core.timesteppers import (schemes, add_scheme, MultistepIMEX,
+                                RungeKuttaIMEX, CNAB1, SBDF1, CNAB2, MCNAB2,
+                                SBDF2, CNLF2, SBDF3, SBDF4, RK111, RK222,
+                                RK443, RKSMR, RKGFY)
 from .core.solvers import (InitialValueSolver, LinearBoundaryValueSolver,
                            NonlinearBoundaryValueSolver, EigenvalueSolver)
 from .core.evaluator import Evaluator
@@ -46,6 +47,34 @@ from .extras.flow_tools import CFL, GlobalFlowProperty, GlobalArrayReducer
 cross = CrossProduct
 dot = DotProduct
 trans = TransposeComponents
+
+# long-form aliases (reference exports both spellings)
+InitialValueProblem = IVP
+LinearBoundaryValueProblem = LBVP
+NonlinearBoundaryValueProblem = NLBVP
+EigenvalueProblem = EVP
+Chebyshev = ChebyshevT
+Component = Radial  # reference Component(operand, index) defaults radial
+RadialComponent = Radial
+AzimuthalComponent = Azimuthal
+AngularComponent = Angular
+
+
+def VectorField(dist, *args, **kw):
+    """Module-level field factories (reference: core/field.py exports);
+    equivalent to the Distributor methods."""
+    return dist.VectorField(*args, **kw)
+
+
+def TensorField(dist, *args, **kw):
+    return dist.TensorField(*args, **kw)
+
+
+def ScalarField(dist, *args, **kw):
+    return dist.Field(*args, **kw)
+
+
+from .tools.post import load_tasks_to_xarray
 grad = Gradient
 div = Divergence
 lap = Laplacian

@@ -296,3 +296,24 @@ def test_shell_diffusion_ivp():
     assert E1 < E0
     assert np.abs(u(r=RI).evaluate()["g"]).max() < 1e-12
     assert np.abs(u(r=RO).evaluate()["g"]).max() < 1e-12
+
+
+def test_spherical_ell_product_shell_lhs():
+    """SphericalEllProduct on the shell, used on an LHS (per-(m, ell)
+    pencil matrices): hyperdiffusion-style ell scaling."""
+    coords = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    shell = d3.ShellBasis(coords, shape=(8, 8, 8), radii=(0.5, 1.5),
+                          dtype=np.float64)
+    phi, theta, r = dist.local_grids(shell)
+    u = dist.Field(name="u", bases=shell)
+    u_target = dist.Field(name="u_target", bases=shell)
+    u_target["g"] = np.cos(theta) * r + np.sin(theta) * np.cos(phi)
+    ellp = lambda A: d3.SphericalEllProduct(A, coords, lambda l: 1 + l * l)
+    F = ellp(u_target).evaluate()
+    problem = d3.LBVP([u], namespace=locals())
+    problem.add_equation("ellp(u) = F")
+    solver = problem.build_solver()
+    solver.solve()
+    err = np.abs(np.asarray(u["g"]) - np.asarray(u_target["g"])).max()
+    assert err < 1e-12

@@ -228,3 +228,18 @@ def test_shallow_water_f32_finite():
     L = solver._matrices["L"]
     vals = np.abs(np.asarray(L)[np.asarray(L) != 0])
     assert vals.min() > 1e-30
+
+
+def test_spherical_ell_product():
+    """SphericalEllProduct(u, cs, f): ell-diagonal multiplication; with
+    f = ell(ell+1) it must equal -lap on the unit sphere (reference:
+    core/operators.py:4119)."""
+    cs = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    b = d3.SphereBasis(cs, shape=(8, 8), dtype=np.float64, radius=1.0)
+    phi, theta = dist.local_grids(b)
+    u = dist.Field(name="u", bases=b)
+    u["g"] = np.cos(theta) + np.sin(theta) * np.cos(phi)
+    out = d3.SphericalEllProduct(u, cs, lambda l: l * (l + 1)).evaluate()
+    lap = d3.lap(u).evaluate()
+    assert np.abs(np.asarray(out["g"]) + np.asarray(lap["g"])).max() < 1e-12
