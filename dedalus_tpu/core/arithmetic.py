@@ -284,15 +284,77 @@ class ProductBase(Future):
                     "LHS coefficient fields must be constant along separable axes.")
         return ncc_index, ncc, self.args[op_index]
 
-    def _ncc_axis_matrices(self, ncc, comp_index, operand):
-        """Per-axis matrices multiplying by ncc component `comp_index`."""
-        dist = self.dist
-        descrs = []
+    def _ncc_axis_terms(self, ncc, comp_index, operand):
+        """
+        [(scalar, descrs)] kron terms multiplying by ncc component
+        `comp_index`. NCCs varying JOINTLY along several 1-D axes (e.g. a
+        2-D background state U(x, z), reference:
+        tests/test_cartesian_ncc.py:89 test_eval_fourier_jacobi_ncc)
+        expand modally along the first varying axis — exact by linearity
+        of the multiplication matrices in the NCC coefficients — with one
+        kron term per significant mode (the reference reaches the same
+        couplings through nested Clenshaw, core/arithmetic.py:406).
+        """
+        bases = list(ncc.domain.bases)
+        if ncc.tensorsig and any(
+                b is not None and b.dim in (2, 3)
+                and hasattr(b, "radial_multiplication_matrix")
+                for b in bases):
+            raise NonlinearOperatorError(
+                "Tensor-valued NCCs on curvilinear bases route through the "
+                "spin/regularity assembly paths, not the per-axis path.")
         coeffs = np.asarray(ncc["c"])  # host transform of NCC data
         ccomp = coeffs[comp_index]
+        return self._ncc_axis_terms_from(ccomp, bases, operand)
+
+    def _ncc_axis_terms_from(self, ccomp, bases, operand):
+        """Recursive helper of `_ncc_axis_terms` operating on an explicit
+        coefficient array and per-axis basis list."""
+        one_d = [ax for ax in range(self.dist.dim)
+                 if bases[ax] is not None and bases[ax].dim == 1
+                 and ccomp.shape[ax] > 1]
+        if len(one_d) < 2:
+            return [self._ncc_axis_matrices_from(ccomp, bases, operand)]
+        a1 = one_d[0]
+        nb = bases[a1]
+        ob = operand.domain.bases[a1]
+        n1 = ccomp.shape[a1]
+        tol = 1e-12 * max(np.abs(ccomp).max(), 1e-300)
+        sub_bases = list(bases)
+        sub_bases[a1] = None
+        terms = []
+        for j in range(n1):
+            slice_j = np.take(ccomp, [j], axis=a1)
+            if np.abs(slice_j).max() <= tol:
+                continue
+            e_j = np.zeros(n1)
+            e_j[j] = 1.0
+            if ob is None:
+                descr_j = ("full", sparsify(e_j.reshape(-1, 1), 1e-13))
+            elif isinstance(nb, Jacobi):
+                descr_j = ("full", sparsify(
+                    ob.multiplication_matrix(e_j, nb, dk_out=-ob.k), 1e-13))
+            elif hasattr(nb, "multiplication_matrix") and nb.separable:
+                descr_j = ("full", sparsify(
+                    ob.multiplication_matrix(e_j, nb), 1e-13))
+            else:
+                raise NonlinearOperatorError(
+                    f"LHS NCCs may not vary along basis {nb!r}.")
+            for scalar, descrs in self._ncc_axis_terms_from(
+                    slice_j, sub_bases, operand):
+                descrs = list(descrs)
+                descrs[a1] = descr_j
+                terms.append((scalar, descrs))
+        return terms
+
+    def _ncc_axis_matrices_from(self, ccomp, ncc_bases, operand):
+        """Per-axis matrices for a single-varying-axis coefficient array
+        (`ncc_bases`: the NCC's per-axis basis list, None = constant)."""
+        dist = self.dist
+        descrs = []
         axis = 0
         while axis < dist.dim:
-            nb = ncc.domain.bases[axis]
+            nb = ncc_bases[axis]
             ob = operand.domain.bases[axis]
             if nb is None:
                 descrs.append(None)  # constant along axis: scalar handled below
@@ -314,10 +376,8 @@ class ProductBase(Future):
                 # identity on the angular axes (m=0 [, ell=0] only), a radial
                 # multiplication matrix on the coupled axis (reference:
                 # coupled-only NCC requirement, core/arithmetic.py:359).
-                if ncc.tensorsig:
-                    raise NonlinearOperatorError(
-                        "Tensor-valued NCCs on curvilinear bases are not "
-                        "supported yet; only scalar NCCs.")
+                # (Tensor-valued curvilinear NCCs route through the spin/
+                # regularity paths before reaching here.)
                 r_axis = axis + nb.dim - 1
                 moved = np.moveaxis(ccomp, r_axis, -1)
                 tol = 1e-10 * max(np.abs(ccomp).max(), 1e-300)
@@ -1171,19 +1231,21 @@ class ProductBase(Future):
         total = None
         comp_indices = list(np.ndindex(*ncc.tshape)) if ncc.tshape else [()]
         for comp in comp_indices:
-            scalar, descrs = self._ncc_axis_matrices(ncc, comp, operand)
-            factors = [tensor_factor_fn(comp)]
-            for axis, descr in enumerate(descrs):
-                ob = operand_domain.bases[axis]
-                if descr is None:
-                    sub = 0 if ob is None else axis - ob.first_axis
-                    factors.append(_axis_identity(ob, sep_widths.get(axis), sub))
-                else:
-                    factors.append(descr[1])
-            mat = sparse_kron(*factors)
-            if scalar is not None:
-                mat = scalar * mat
-            total = mat if total is None else total + mat
+            for scalar, descrs in self._ncc_axis_terms(ncc, comp, operand):
+                factors = [tensor_factor_fn(comp)]
+                for axis, descr in enumerate(descrs):
+                    ob = operand_domain.bases[axis]
+                    if descr is None:
+                        sub = 0 if ob is None else axis - ob.first_axis
+                        factors.append(_axis_identity(ob,
+                                                      sep_widths.get(axis),
+                                                      sub))
+                    else:
+                        factors.append(descr[1])
+                mat = sparse_kron(*factors)
+                if scalar is not None:
+                    mat = scalar * mat
+                total = mat if total is None else total + mat
         return total
 
 

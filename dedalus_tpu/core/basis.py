@@ -129,7 +129,9 @@ class Basis(metaclass=CachedClass):
         if axis in sep_widths:
             ax_mask = self.valid_elements()[group[axis]]
         else:
-            ax_mask = self.valid_elements()
+            # layout-coupled axis: the whole-axis slot is the flattened
+            # (group, pair) coefficient run
+            ax_mask = np.ravel(self.valid_elements())
         return np.broadcast_to(ax_mask[None], (ncomp,) + ax_mask.shape)
 
     # --- group structure (separable axes); coupled bases override ---

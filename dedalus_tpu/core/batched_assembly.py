@@ -256,7 +256,10 @@ def _batched_ncc_matrices(expr, layout, vars):
     comp_indices = list(np.ndindex(*ncc.tshape)) if ncc.tshape else [()]
     my_terms = []
     for comp in comp_indices:
-        scalar, descrs = expr._ncc_axis_matrices(ncc, comp, operand)
+        ncc_terms = expr._ncc_axis_terms(ncc, comp, operand)
+        if len(ncc_terms) != 1:
+            raise BatchUnsupported("jointly-varying (multi-axis) NCC")
+        scalar, descrs = ncc_terms[0]
         bterms = _convert_descrs(layout, operand.domain,
                                  [(tensor_factor_fn(comp), descrs)])
         if scalar is not None:

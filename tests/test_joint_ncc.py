@@ -1,0 +1,90 @@
+"""
+Jointly-varying (multi-axis) Cartesian NCCs (reference:
+tests/test_cartesian_ncc.py:89 test_eval_fourier_jacobi_ncc): a 2-D
+background state f(x, z) on the LHS expands modally along its first
+varying axis; each mode contributes one kron term — exact by linearity
+of the multiplication matrices.
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.core.subsystems import PencilLayout, build_subproblems
+
+
+def _check(dist, expr, operand):
+    eq = {"domain": expr.domain, "tensorsig": tuple(expr.tensorsig),
+          "L": expr}
+    layout = PencilLayout(dist, [operand], [eq])
+    sps = build_subproblems(layout)
+    Xin = np.asarray(layout.gather(operand.coeff_data(), operand.domain,
+                                   operand.tensorsig))
+    out = expr.evaluate()
+    Xout = np.asarray(layout.gather(out.coeff_data(), out.domain,
+                                    out.tensorsig))
+    scale = max(np.abs(Xout).max(), 1e-12)
+    for sp in sps:
+        mats = expr.expression_matrices(sp, [operand])
+        y = mats[operand] @ Xin[sp.index]
+        valid = layout.valid_mask(expr.domain, tuple(expr.tensorsig),
+                                  sp.group).ravel()
+        err = np.abs(y - Xout[sp.index])[valid].max(initial=0.0) / scale
+        assert err < 2e-10, (sp.group, err)
+    return layout
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_joint_fourier_jacobi_ncc(dtype):
+    """f(x, z) * u with RealFourier x Chebyshev: the x axis is forced
+    coupled and the joint structure expands over x modes."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=dtype)
+    xb = (d3.RealFourier if dtype == np.float64 else d3.ComplexFourier)(
+        coords["x"], size=12, bounds=(0, 2 * np.pi), dealias=2)
+    zb = d3.ChebyshevT(coords["z"], size=10, bounds=(0, 1), dealias=2)
+    x, z = dist.local_grids(xb, zb)
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = 2.0 + np.sin(x) * z ** 2 + 0.3 * np.cos(2 * x) * z
+    u = dist.Field(name="u", bases=(xb, zb))
+    u["g"] = np.cos(x) * (1 - z) + 0.5 * np.sin(2 * x) * z ** 2
+    layout = _check(dist, (f * u), u)
+    assert 0 not in layout.sep_widths  # x axis forced coupled
+
+
+def test_joint_jacobi_jacobi_ncc():
+    """f(x, z) * u with Chebyshev x Chebyshev (two genuinely coupled
+    axes) — matrix equals grid product."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.ChebyshevT(coords["x"], size=12, bounds=(0, 1), dealias=2)
+    zb = d3.ChebyshevT(coords["z"], size=10, bounds=(0, 1), dealias=2)
+    x, z = dist.local_grids(xb, zb)
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = 1.0 + 0.5 * x * z + 0.2 * x ** 2 * z ** 2
+    u = dist.Field(name="u", bases=(xb, zb))
+    u["g"] = np.sin(2 * x) * (1 - z ** 2)
+    _check(dist, (f * u), u)
+
+
+def test_joint_ncc_lbvp_roundtrip():
+    """Solve (2 + 0.5 sin(x) z) u = F for a known u (2-D variable
+    coefficient on the LHS — the linearized-background problem class)."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=12, bounds=(0, 2 * np.pi),
+                        dealias=2)
+    zb = d3.ChebyshevT(coords["z"], size=10, bounds=(0, 1), dealias=2)
+    x, z = dist.local_grids(xb, zb)
+    f = dist.Field(name="f", bases=(xb, zb))
+    f["g"] = 2.0 + 0.5 * np.sin(x) * z
+    u = dist.Field(name="u", bases=(xb, zb))
+    u_target = dist.Field(name="u_target", bases=(xb, zb))
+    u_target["g"] = np.cos(x) * z + 0.3 * np.sin(2 * x) * (1 - z)
+    F = (f * u_target).evaluate()
+    problem = d3.LBVP([u], namespace=locals())
+    problem.add_equation("f*u = F")
+    solver = problem.build_solver()
+    solver.solve()
+    err = np.abs(np.asarray(u["g"]) - np.asarray(u_target["g"])).max()
+    assert err < 1e-10
