@@ -319,7 +319,7 @@ class ProductBase(Future):
         nb = bases[a1]
         ob = operand.domain.bases[a1]
         n1 = ccomp.shape[a1]
-        tol = 1e-12 * max(np.abs(ccomp).max(), 1e-300)
+        tol = self._ncc_data_cutoff(ccomp) * max(np.abs(ccomp).max(), 1e-300)
         sub_bases = list(bases)
         sub_bases[a1] = None
         terms = []
@@ -684,7 +684,8 @@ class ProductBase(Future):
             # Validate: only the all-radial component, angularly constant.
             grid = np.asarray(ncc["g"])
             flat = grid.reshape((ncomp_n,) + grid.shape[rank_n:])
-            tol = 1e-10 * max(np.abs(flat).max(), 1e-300)
+            tol = self._ncc_data_cutoff(flat) * max(np.abs(flat).max(),
+                                                    1e-300)
             for c in range(ncomp_n):
                 if c != radial_flat and np.abs(flat[c]).max() > tol:
                     raise NonlinearOperatorError(
@@ -778,6 +779,18 @@ class ProductBase(Future):
     NCC_ANGULAR_CUTOFF = 1e-10
 
     @staticmethod
+    def _ncc_data_cutoff(arr):
+        """Relative significance cutoff for NCC data, scaled to the data's
+        own precision: f32 field data carries ~1e-7-relative roundoff in
+        every expansion coefficient, and treating that as structure
+        poisons both the angular-constancy classification (forcing
+        spurious ell coupling) and the band detection (a near-full
+        lattice of junk couplings)."""
+        real = np.asarray(arr).real.dtype
+        eps = np.finfo(real).eps if np.issubdtype(real, np.floating) else 0.0
+        return max(ProductBase.NCC_ANGULAR_CUTOFF, 50 * eps)
+
+    @staticmethod
     def sph_ncc_angular_profile(ncc, basis, cs):
         """
         Classify a spherical NCC's angular structure from its grid data.
@@ -795,7 +808,8 @@ class ProductBase(Future):
         flat = grid.reshape((ncomp,) + grid.shape[rank_n:])
         if flat.ndim == 3:  # standalone S2: insert a trivial radial axis
             flat = flat[..., None]
-        tol = ProductBase.NCC_ANGULAR_CUTOFF * max(np.abs(flat).max(), 1e-300)
+        tol = ProductBase._ncc_data_cutoff(flat) * max(np.abs(flat).max(),
+                                                       1e-300)
         if np.abs(flat - flat[:, :1]).max() > tol:
             raise NonlinearOperatorError(
                 "LHS NCCs on spherical bases must be axisymmetric (constant "
