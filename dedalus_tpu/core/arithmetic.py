@@ -212,6 +212,28 @@ def Multiply(a, b):
     return MultiplyFields(a, b)
 
 
+def _filter_rel(mat, rel):
+    """Drop entries of a sparse matrix below rel * max|entry|, REAL and
+    IMAGINARY parts independently: source-precision residue often rides
+    as a tiny real part on a large purely-imaginary coupling (or vice
+    versa), and the azimuthal pair representation would otherwise spread
+    it into spurious cross-pair couplings. (sparsify() passes sparse
+    inputs through untouched, so totals need this explicit filter.)"""
+    mat = mat.tocoo()
+    if mat.nnz == 0:
+        return mat.tocsr()
+    cut = rel * np.abs(mat.data).max()
+    if np.iscomplexobj(mat.data):
+        re = np.where(np.abs(mat.data.real) >= cut, mat.data.real, 0.0)
+        im = np.where(np.abs(mat.data.imag) >= cut, mat.data.imag, 0.0)
+        data = re + 1j * im
+    else:
+        data = np.where(np.abs(mat.data) >= cut, mat.data, 0.0)
+    keep = data != 0
+    return sp.csr_matrix((data[keep], (mat.row[keep], mat.col[keep])),
+                         shape=mat.shape)
+
+
 def _interleave_gs(M, nout, nin, gs, X):
     """
     Lift a matrix over (component x X) index spaces to (component x gs x X)
@@ -364,12 +386,14 @@ class ProductBase(Future):
                 ax_coeffs = np.moveaxis(ccomp, axis, -1)
                 assert ax_coeffs.size == ax_coeffs.shape[-1], \
                     "NCCs coupling multiple axes are not supported yet."
+                cut = self._ncc_sparsify_cutoff(ax_coeffs)
                 if ob is None:
                     # operand constant along axis: column embedding the NCC
-                    descrs.append(("full", sparsify(ax_coeffs.reshape(-1, 1), 1e-12)))
+                    descrs.append(("full", sparsify(ax_coeffs.reshape(-1, 1),
+                                                    cut)))
                 else:
                     M = ob.multiplication_matrix(ax_coeffs.ravel(), nb, dk_out=-ob.k)
-                    descrs.append(("full", sparsify(M, 1e-12)))
+                    descrs.append(("full", sparsify(M, cut)))
                 axis += 1
             elif nb.dim in (2, 3) and hasattr(nb, "radial_multiplication_matrix"):
                 # Angularly-constant NCC over a polar/spherical basis:
@@ -394,7 +418,8 @@ class ProductBase(Future):
                         "is not supported yet.")
                 M = ob.radial_multiplication_matrix(radial_coeffs, nb.k, k_out=0)
                 descrs.extend([None] * (nb.dim - 1))  # angular identities
-                descrs.append(("full", sparsify(M, 1e-12)))
+                descrs.append(("full", sparsify(
+                    M, self._ncc_sparsify_cutoff(radial_coeffs))))
                 axis += nb.dim
             elif hasattr(nb, "multiplication_matrix") and nb.separable:
                 # Fourier-type NCC on a layout-coupled periodic axis:
@@ -403,11 +428,13 @@ class ProductBase(Future):
                 ax_coeffs = np.moveaxis(ccomp, axis, -1)
                 assert ax_coeffs.size == ax_coeffs.shape[-1], \
                     "NCCs coupling multiple axes are not supported yet."
+                cut = self._ncc_sparsify_cutoff(ax_coeffs)
                 if ob is None:
-                    descrs.append(("full", sparsify(ax_coeffs.reshape(-1, 1), 1e-12)))
+                    descrs.append(("full", sparsify(ax_coeffs.reshape(-1, 1),
+                                                    cut)))
                 else:
                     M = ob.multiplication_matrix(ax_coeffs.ravel(), nb)
-                    descrs.append(("full", sparsify(M, 1e-12)))
+                    descrs.append(("full", sparsify(M, cut)))
                 axis += 1
             else:
                 raise NonlinearOperatorError(
@@ -700,6 +727,8 @@ class ProductBase(Future):
             cache = self._sph_ncc_cache = {"coeffs": profile_coeffs,
                                            "version": version}
         return {"basis": basis, "ncc_basis": ncc_basis, "cache": cache,
+                "sparsify_cutoff":
+                    self._ncc_sparsify_cutoff(np.dtype(ncc.dtype)),
                 "rank_n": rank_n, "rank_in": rank_in,
                 "rank_out": spherical_rank(self.tensorsig, basis.cs),
                 "T_spin": self._spin_bilinear_map(ncc, operand, ncc_index),
@@ -737,7 +766,8 @@ class ProductBase(Future):
                 if M is None:
                     M = sparsify(basis.ncc_radial_matrix(
                         cache["coeffs"], setup["ncc_basis"].k, totals_in[j],
-                        totals_out[i], ell, k_out=0, l_env=rank_n), 1e-12)
+                        totals_out[i], ell, k_out=0, l_env=rank_n),
+                        setup["sparsify_cutoff"])
                     cache[key] = M
                 out.append((i, j, C[i, j], M))
         return out
@@ -779,16 +809,39 @@ class ProductBase(Future):
     NCC_ANGULAR_CUTOFF = 1e-10
 
     @staticmethod
-    def _ncc_data_cutoff(arr):
+    def _ncc_real_eps(arr_or_dtype):
+        """Machine epsilon of the SOURCE data precision. Accepts an array
+        or a dtype; complex dtypes resolve to their real component. The
+        source dtype matters because expansions get promoted to f64/c128
+        on the host — the promotion launders the f32-level roundoff that
+        the cutoffs must track."""
+        if isinstance(arr_or_dtype, (np.dtype, type)):
+            dt = np.dtype(arr_or_dtype)
+        else:
+            dt = np.asarray(arr_or_dtype).dtype
+        dt = np.dtype(dt)
+        if dt.kind == "c":
+            dt = np.dtype(np.float32) if dt.itemsize == 8                 else np.dtype(np.float64)
+        return np.finfo(dt).eps if dt.kind == "f" else 0.0
+
+    @staticmethod
+    def _ncc_sparsify_cutoff(arr_or_dtype):
+        """Relative sparsify threshold for matrices BUILT from NCC data:
+        f32-sourced coefficient vectors carry ~eps-relative junk in every
+        entry, which would otherwise populate spurious matrix diagonals
+        and defeat band detection."""
+        return max(1e-12, 10 * ProductBase._ncc_real_eps(arr_or_dtype))
+
+    @staticmethod
+    def _ncc_data_cutoff(arr_or_dtype):
         """Relative significance cutoff for NCC data, scaled to the data's
         own precision: f32 field data carries ~1e-7-relative roundoff in
         every expansion coefficient, and treating that as structure
         poisons both the angular-constancy classification (forcing
         spurious ell coupling) and the band detection (a near-full
         lattice of junk couplings)."""
-        real = np.asarray(arr).real.dtype
-        eps = np.finfo(real).eps if np.issubdtype(real, np.floating) else 0.0
-        return max(ProductBase.NCC_ANGULAR_CUTOFF, 50 * eps)
+        return max(ProductBase.NCC_ANGULAR_CUTOFF,
+                   50 * ProductBase._ncc_real_eps(arr_or_dtype))
 
     @staticmethod
     def sph_ncc_angular_profile(ncc, basis, cs):
@@ -872,7 +925,8 @@ class ProductBase(Future):
                     # multiplication matrix per (a, L)
                     B = sparsify(basis.radial_multiplication_matrix(
                         ncc_basis.scalar_radial_coeffs(coeffs),
-                        ncc_basis.k, k_out=0), 1e-12)
+                        ncc_basis.k, k_out=0),
+                        self._ncc_sparsify_cutoff(np.dtype(ncc.dtype)))
                     rows.append((L, B))
                 else:
                     # ball: Zernike spaces are ell-indexed; store the
@@ -1004,8 +1058,13 @@ class ProductBase(Future):
         # canonicalizing the view in place corrupts the parent
         # (scipy _with_data aliasing).
         total = total.tocoo().tocsr()
-        if np.abs(total.imag).max() < 1e-13 * max(np.abs(total).max()
-                                                  if total.nnz else 0.0, 1e-300):
+        # imaginary parts at the SOURCE dtype's roundoff are residue, not
+        # couplings (f32 data leaves ~1e-7-relative imag junk whose pair-J
+        # representation would litter the band structure)
+        imag_tol = max(1e-13, 100 * self._ncc_real_eps(np.dtype(ncc.dtype)))
+        total = _filter_rel(total, self._ncc_sparsify_cutoff(np.dtype(ncc.dtype)))
+        if total.nnz and np.abs(total.imag).max() < imag_tol * max(
+                np.abs(total).max(), 1e-300):
             total = total.real
         elif not is_complex_dtype(self.dtype) and gs < 2:
             raise NonlinearOperatorError(
@@ -1112,7 +1171,8 @@ class ProductBase(Future):
                             if B is None:
                                 B = sparsify(basis.ncc_radial_pair_matrix(
                                     rc, ncc_basis.k, l_env, t_in[bet],
-                                    t_out[gam], l, lp, k_out=0), 1e-12)
+                                    t_out[gam], l, lp, k_out=0),
+                                    self._ncc_sparsify_cutoff(np.dtype(ncc.dtype)))
                                 pair_cache[key] = B
                             term = cf * B
                             blk = term if blk is None else blk + term
@@ -1130,7 +1190,9 @@ class ProductBase(Future):
         else:
             total = sp.csr_matrix((nout * X0, nin * X0), dtype=complex)
         total = total.tocoo().tocsr()
-        if total.nnz and np.abs(total.imag).max() < 1e-13 * max(
+        imag_tol = max(1e-13, 100 * self._ncc_real_eps(np.dtype(ncc.dtype)))
+        total = _filter_rel(total, self._ncc_sparsify_cutoff(np.dtype(ncc.dtype)))
+        if total.nnz and np.abs(total.imag).max() < imag_tol * max(
                 np.abs(total).max(), 1e-300):
             total = total.real
         elif total.nnz and not is_complex_dtype(self.dtype) and gs < 2:
@@ -1221,7 +1283,9 @@ class ProductBase(Future):
                         (np.ones(1), ([c], [b])), shape=(nout, nin))
                     total = total + sp.kron(place, blk, format="csr")
         total = total.tocoo().tocsr()
-        if total.nnz and np.abs(total.imag).max() < 1e-13 * max(
+        imag_tol = max(1e-13, 100 * self._ncc_real_eps(np.dtype(ncc.dtype)))
+        total = _filter_rel(total, self._ncc_sparsify_cutoff(np.dtype(ncc.dtype)))
+        if total.nnz and np.abs(total.imag).max() < imag_tol * max(
                 np.abs(total).max(), 1e-300):
             total = total.real
         elif total.nnz and not is_complex_dtype(self.dtype) and gs < 2:

@@ -228,9 +228,26 @@ class SolverBase:
                 scale = max(scale, max((np.abs(v).max() if len(v) else 0.0
                                         for _, _, v in coos.values()), default=0.0))
         tol_abs = tol * (scale or 1.0)
+        # Per-ROW relative significance, scaled to the pencil precision:
+        # f32-sourced data breaks exact cancellations at ~eps32-relative
+        # levels, leaving junk far below its row's real structure yet
+        # above the GLOBAL cutoff when one term (e.g. a Rayleigh-scaled
+        # buoyancy) inflates the global scale. Row-relative filtering
+        # separates the two cleanly in both precisions.
+        eps_p = np.finfo(self.real_dtype).eps
+        row_frac = max(tol, 10.0 * eps_p)
         for coos, (row_valid, col_valid) in zip(coo_store, masks):
-            pat = {k: (r[np.abs(v) > tol_abs], c[np.abs(v) > tol_abs],
-                       v[np.abs(v) > tol_abs]) for k, (r, c, v) in coos.items()}
+            rowmax = np.zeros(S)
+            for r, c, v in coos.values():
+                if len(r):
+                    np.maximum.at(rowmax, r, np.abs(v))
+            pat = {}
+            for k, (r, c, v) in coos.items():
+                # row-significant AND above the global assembly-dirt floor
+                # (dirt-only rows would otherwise self-certify)
+                keep = (np.abs(v) >= row_frac * rowmax[r]) \
+                    & (np.abs(v) > tol_abs)
+                pat[k] = (r[keep], c[keep], v[keep])
             acc.add_group(pat, row_valid, col_valid)
         structure = MatrixStructure(self.layout, self.variables, equations)
         row_valid_all = np.array([m[0] for m in masks])
@@ -255,9 +272,10 @@ class SolverBase:
         host_dtype = (np.complex128 if is_complex_dtype(self.pencil_dtype)
                       else np.float64)
         try:
-            self._matrices = build_banded_arrays(coo_store, structure, names,
-                                                 host_dtype, drop_tol=tol_abs,
-                                                 closures=closures)
+            self._matrices = build_banded_arrays(
+                coo_store, structure, names, host_dtype,
+                drop_tol=max(tol_abs, row_frac * (scale or 1.0)),
+                closures=closures)
         except ValueError as exc:
             self._banded_reason = str(exc)
             return (coo_store, masks)
