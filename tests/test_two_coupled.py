@@ -137,16 +137,20 @@ def test_poisson_rectangle_banded_at_scale():
     assert np.abs(np.asarray(u["g"]) - u_ex).max() < 1e-8
 
 
-def test_shell_coriolis_ivp_banded_matches_dense():
+@pytest.mark.parametrize("dtype", [np.float64, np.float32])
+def test_shell_coriolis_ivp_banded_matches_dense(dtype):
     """Coriolis-dominant regime (1/Ekman >> radial operator magnitudes):
     the alignment must stay on the radial principal regardless of entry
     magnitudes (regression: a magnitude-gated matching aligned on the
-    1/Ekman-scaled dl=+-1 Coriolis couplings and diverged)."""
+    1/Ekman-scaled dl=+-1 Coriolis couplings and diverged). The f32
+    variant additionally locks in the dtype-aware NCC cutoffs + row-
+    relative band detection (f32 data noise must not widen the band or
+    force the dense path)."""
     def build(matsolver):
         coords = d3.SphericalCoordinates("phi", "theta", "r")
-        dist = d3.Distributor(coords, dtype=np.float64)
+        dist = d3.Distributor(coords, dtype=dtype)
         shell = d3.ShellBasis(coords, shape=(8, 40, 16), radii=(0.35, 1.0),
-                              dtype=np.float64)
+                              dtype=dtype)
         sphere = shell.outer_surface
         phi, theta, r = dist.local_grids(shell)
         u = dist.VectorField(coords, name="u", bases=shell)
@@ -188,7 +192,8 @@ def test_shell_coriolis_ivp_banded_matches_dense():
         s_b.step(1e-4)
     sol = np.asarray(u_b["g"])
     assert np.isfinite(sol).all()
-    assert np.abs(sol - ref).max() < 1e-10 * max(np.abs(ref).max(), 1.0)
+    rtol = 1e-10 if dtype == np.float64 else 2e-4
+    assert np.abs(sol - ref).max() < rtol * max(np.abs(ref).max(), 1.0)
 
 
 def test_matrix_coupling_forced_disk():
