@@ -1,0 +1,134 @@
+"""
+sw_ell255 step-phase microbenchmark: where does the time go?
+
+Round-4 finding (VERDICT weak #2): sw_ell255 ran at 18.6M mode-stages/s vs
+541M for shear512 on the same chip — a ~29x gap with no profile to localize
+it. This script times the step's constituent device programs separately
+(the exact split-mode pieces the fused step composes, so the breakdown sums
+to the step):
+
+    mx0         M @ X batched banded matvec
+    stage_eval  L @ X matvec + full RHS evaluation (SWSH transforms both
+                ways + nonlinear products)
+    stage_solve banded LU substitution sweeps + Woodbury correction
+    step        the full RK222 step (2 stages) for reference
+
+Appends {"case": "sw_profile", ...} to benchmarks/results.jsonl.
+
+Run: python benchmarks/profile_sw.py [Nphi Ntheta]  (default 512 256)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[swprof {time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def time_fn(fn, *args, reps=30):
+    """Median wall time of fn(*args) with device sync, after one warmup."""
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from progression import build_shallow_water
+    from __graft_entry__ import _append_result
+
+    Nphi = int(sys.argv[1]) if len(sys.argv) > 2 else 512
+    Ntheta = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    backend = jax.default_backend()
+    dtype = np.float32 if backend != "cpu" else np.float64
+    mark(f"building SW {Nphi}x{Ntheta} (backend={backend})")
+    solver, dt = build_shallow_water(Nphi, Ntheta, dtype)
+    G, S = solver.pencil_shape
+    mark(f"built; pencils (G={G}, S={S}), ops={type(solver.ops).__name__}")
+
+    # warmup steps compile + factor the LHS
+    for _ in range(3):
+        solver.step(dt)
+    solver.X.block_until_ready()
+    finite = bool(np.all(np.isfinite(np.asarray(solver.X))))
+    mark(f"warmup done; finite={finite}")
+
+    ts = solver.timestepper
+    M, L, X = solver.M_mat, solver.L_mat, solver.X
+    rd = solver.real_dtype
+    extra = solver.rhs_extra()
+    auxs = ts._lhs_aux
+    if auxs is None:
+        raise RuntimeError("timestepper has no factored LHS after warmup")
+    dtj = jnp.asarray(float(dt), dtype=rd)
+    tj = jnp.asarray(float(solver.sim_time), dtype=rd)
+
+    res = {"case": "sw_profile", "backend": backend,
+           "config": f"sw_{Nphi}x{Ntheta}",
+           "pencil_shape": [int(G), int(S)],
+           "ops": type(solver.ops).__name__}
+
+    mark("timing mx0 (M@X matvec)")
+    res["mx0_ms"] = 1e3 * time_fn(ts._mx0, M, X)
+    MX0 = ts._mx0(M, X)
+
+    mark("timing stage_eval (L@X + RHS: transforms + nonlinear)")
+    res["stage_eval_ms"] = 1e3 * time_fn(ts._stage_eval, M, L, X, tj, extra)
+    LX, F = ts._stage_eval(M, L, X, tj, extra)
+
+    mark("timing rhs_only (eval_F alone)")
+    from dedalus_tpu.tools.jitlift import lifted_jit
+    rhs_jit = lifted_jit(lambda X_, t_, e_: solver.eval_F(X_, t_, e_))
+    res["rhs_only_ms"] = 1e3 * time_fn(rhs_jit, X, tj, extra)
+
+    mark("timing stage_solve (banded substitution + Woodbury)")
+    res["stage_solve_ms"] = 1e3 * time_fn(
+        ts._stage_solve, 1, MX0, [F], [LX], dtj, auxs[0], M, L)
+
+    mark("timing full step (fused or split as configured)")
+    t0 = time.perf_counter()
+    n_steps = 10
+    solver.step_many(n_steps, dt)
+    solver.X.block_until_ready()
+    # step_many compiles on first call with this n: measure second call
+    t0 = time.perf_counter()
+    solver.step_many(n_steps, dt)
+    solver.X.block_until_ready()
+    res["step_ms"] = 1e3 * (time.perf_counter() - t0) / n_steps
+
+    stages = getattr(ts, "stages", 2)
+    accounted = (res["mx0_ms"]
+                 + stages * (res["stage_eval_ms"] + res["stage_solve_ms"]))
+    res["accounted_ms"] = round(accounted, 3)
+    for k in ("mx0_ms", "stage_eval_ms", "rhs_only_ms", "stage_solve_ms",
+              "step_ms"):
+        res[k] = round(res[k], 3)
+    res["finite_after_warmup"] = finite
+    res["ts"] = round(time.time(), 1)
+    print(json.dumps(res), flush=True)
+    _append_result(res)
+    mark(f"breakdown: step={res['step_ms']}ms vs accounted={res['accounted_ms']}ms "
+         f"(mx0={res['mx0_ms']}, eval={res['stage_eval_ms']} "
+         f"[rhs {res['rhs_only_ms']}], solve={res['stage_solve_ms']} per stage)")
+
+
+if __name__ == "__main__":
+    main()

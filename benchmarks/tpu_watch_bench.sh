@@ -1,13 +1,18 @@
 #!/bin/bash
-# TPU watcher: probe the chip every ~2.5 min; the moment it becomes
-# claimable, run the BASELINE progression benchmarks (one hard-timeout,
-# process-group-killed subprocess per config — round 2's wedge was a
-# leaked chip-holding child) and record to benchmarks/results.jsonl.
-# Stops after one successful sweep (marker file) or MAX_ITERS probes.
+# TPU watcher: probe the chip (each cycle is ~60s sleep + up to 90s
+# probe, so ~2.5 min while unavailable); the moment it becomes claimable,
+# run the BASELINE progression benchmarks PRIZE-FIRST (rb2048x1024
+# north-star, then sw_ell255, then rotconv32 — the three unproven
+# configs — before refreshing the already-proven small ones). One
+# hard-timeout, process-group-killed subprocess per config — round 2's
+# wedge was a leaked chip-holding child. Records go to
+# benchmarks/results.jsonl. The sweep-complete marker is only written
+# when EVERY config has its own done marker, so a timed-out prize config
+# is retried on the next claimable window. MAX_ITERS=600 ≈ 25h ceiling.
 cd "$(dirname "$0")/.." || exit 1
 LOG=benchmarks/auto_bench.log
 MARKER=benchmarks/.auto_bench_done
-MAX_ITERS=${MAX_ITERS:-250}
+MAX_ITERS=${MAX_ITERS:-600}
 
 log() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
 
@@ -17,8 +22,17 @@ probe() {
         2>/dev/null | grep -q PROBE_OK
 }
 
-run_config() {
-    name=$1; tmo=$2
+ALL_NAMES="rb2048x1024 sw_ell255 sw_profile rotconv32 rb256x64 kdv1024 shear512 accuracy"
+
+all_done() {
+    for n in $ALL_NAMES; do
+        [ -f "benchmarks/.auto_bench_done_$n" ] || return 1
+    done
+    return 0
+}
+
+run_script() {
+    name=$1; tmo=$2; shift 2
     # per-config marker: a sweep resumed after a mid-sweep chip loss must
     # not burn the window re-measuring (and re-recording) finished configs
     done_marker="benchmarks/.auto_bench_done_$name"
@@ -27,8 +41,7 @@ run_config() {
         return 0
     fi
     log "running $name (timeout ${tmo}s)"
-    timeout -k 10 "$tmo" setsid python benchmarks/progression.py "$name" \
-        >> "$LOG" 2>&1
+    timeout -k 10 "$tmo" setsid "$@" >> "$LOG" 2>&1
     rc=$?
     log "$name finished rc=$rc"
     [ "$rc" -eq 0 ] && touch "$done_marker"
@@ -41,25 +54,30 @@ run_config() {
     return 0
 }
 
+run_config() {
+    run_script "$1" "$2" python benchmarks/progression.py "$1"
+}
+
 for i in $(seq 1 "$MAX_ITERS"); do
     [ -f "$MARKER" ] && exit 0
     if probe; then
-        log "TPU CLAIMABLE (probe $i) — starting benchmark sweep"
+        log "TPU CLAIMABLE (probe $i) — starting PRIZE-FIRST benchmark sweep"
+        # --- the three unproven configs (VERDICT round-4 items 1, 2, 4) ---
+        run_config rb2048x1024 4500 || continue
+        run_config sw_ell255 2400 || continue
+        run_script sw_profile 1200 python benchmarks/profile_sw.py || continue
+        run_config rotconv32 2400 || continue
+        # --- refresh the proven configs with this-round timestamps ---
         run_config rb256x64 1500 || continue
         run_config kdv1024 900 || continue
         run_config shear512 1500 || continue
-        run_config sw_ell255 2400 || continue
-        if [ ! -f benchmarks/.auto_bench_done_accuracy ]; then
-            log "running tpu_accuracy (timeout 900s)"
-            timeout -k 10 900 setsid python benchmarks/tpu_accuracy.py \
-                >> "$LOG" 2>&1 && touch benchmarks/.auto_bench_done_accuracy
-            probe || continue
+        run_script accuracy 1200 python benchmarks/tpu_accuracy.py || continue
+        if all_done; then
+            log "sweep complete (all configs recorded)"
+            touch "$MARKER"
+            exit 0
         fi
-        run_config rotconv32 2400 || continue
-        run_config rb2048x1024 3600 || continue
-        log "sweep complete"
-        touch "$MARKER"
-        exit 0
+        log "sweep pass finished with unrecorded configs; will retry on next window"
     else
         log "probe $i: unavailable"
     fi

@@ -24,6 +24,8 @@ __version__ = "0.1.0"
 
 # Double precision is the house dtype of spectral methods (the reference is
 # float64/complex128 end-to-end). Enable x64 before any jax import users run.
+import logging
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
@@ -31,3 +33,39 @@ jax.config.update("jax_enable_x64", True)
 from .tools.logging import setup_logging
 
 setup_logging()
+
+
+def _setup_compilation_cache():
+    """Enable the persistent XLA compilation cache (config [compilation]).
+
+    Compiled step/factor programs are reused across runs and processes,
+    cutting time-to-first-step on warm builds (cold RB 256x64 spends most
+    of its build in XLA; see BENCHMARKS.md build-time breakdown)."""
+    import os
+    from .tools.config import config
+    cache_dir = config["compilation"].get("CACHE_DIR", "").strip()
+    if not cache_dir:
+        return
+    cache_dir = os.path.expanduser(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        min_secs = config["compilation"].getfloat("CACHE_MIN_COMPILE_SECS",
+                                                  fallback=1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_secs)
+        # cache regardless of entry size (large factor programs are the
+        # expensive ones)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # enabling the dir comes LAST: a failure above must not leave the
+        # cache active with unconfigured thresholds
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception as exc:  # unwritable dir, older jax: run uncached
+        try:
+            jax.config.update("jax_compilation_cache_dir", "")
+        except Exception:
+            pass
+        logging.getLogger(__name__).warning(
+            f"persistent compilation cache disabled: {exc!r}")
+
+
+_setup_compilation_cache()
