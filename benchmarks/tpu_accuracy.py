@@ -1,9 +1,14 @@
 """
-On-chip accuracy check: heat-equation decay vs the exact solution at the
-bench dtype (f32 on TPU), appended to benchmarks/results.jsonl. Pairs
-with benchmarks/accuracy_f32.py (which prices f32 vs f64 on CPU): this
-script demonstrates the spectral-convergence floor ON the accelerator
-itself (reference: f64 end-to-end; BENCHMARKS.md dtype policy).
+On-chip accuracy + emulated-f64 sweep, appended to benchmarks/results.jsonl.
+
+Three tiers on the accelerator (reference is f64 end-to-end; BENCHMARKS.md
+dtype policy; VERDICT round-4 item 3):
+  1. native bench dtype (f32 on TPU): heat-decay error vs exact —
+     demonstrates the spectral-convergence floor at the bench precision;
+  2. native f64 (XLA:TPU software f64, where supported): same check;
+  3. emulated f64 (double-double pair path, core/ddstep.DDIVPRunner):
+     heat error + KdV mass drift at f64 grade, plus the measured
+     slowdown factor vs the f32 path on the same problem.
 
 Run: python benchmarks/tpu_accuracy.py
 """
@@ -23,7 +28,7 @@ def mark(msg):
     print(f"[acc {time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def heat_error(N, dtype, steps=200):
+def build_heat(N, dtype, scheme=None):
     import dedalus_tpu.public as d3
     xcoord = d3.Coordinate("x")
     dist = d3.Distributor(xcoord, dtype=dtype)
@@ -32,15 +37,100 @@ def heat_error(N, dtype, steps=200):
     dx = lambda A: d3.Differentiate(A, xcoord)
     problem = d3.IVP([u], namespace=locals())
     problem.add_equation("dt(u) - dx(dx(u)) = 0")
-    solver = problem.build_solver(d3.RK443)
+    solver = problem.build_solver(scheme or d3.RK443)
     x = dist.local_grids(xb)[0]
     u["g"] = np.sin(3 * x) + 0.5 * np.cos(5 * x)
+    return solver, u, x
+
+
+def heat_exact(x, t):
+    return (np.exp(-9 * t) * np.sin(3 * x)
+            + 0.5 * np.exp(-25 * t) * np.cos(5 * x))
+
+
+def heat_error(N, dtype, steps=200):
+    import dedalus_tpu.public as d3  # noqa: F401
+    solver, u, x = build_heat(N, dtype)
     dt = 1e-4
     solver.step_many(steps, dt)
-    t = steps * dt
-    exact = (np.exp(-9 * t) * np.sin(3 * x)
-             + 0.5 * np.exp(-25 * t) * np.cos(5 * x))
-    return float(np.abs(np.asarray(u["g"]) - exact).max())
+    return float(np.abs(np.asarray(u["g"]) - heat_exact(x, steps * dt)).max())
+
+
+def build_kdv(N, dtype):
+    import dedalus_tpu.public as d3
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=dtype)
+    xbasis = d3.RealFourier(xcoord, size=N, bounds=(0, 10), dealias=3 / 2)
+    u = dist.Field(name="u", bases=xbasis)
+    a, b = 1e-4, 2e-4
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - a*dx(dx(u)) - b*dx(dx(dx(u))) = - u*dx(u)")
+    x = dist.local_grids(xbasis)[0]
+    n = 20
+    u["g"] = np.log(1 + np.cosh(n) ** 2 / np.cosh(n * (x - 3)) ** 2) / (2 * n)
+    solver = problem.build_solver(d3.SBDF2)
+    return solver, u
+
+
+def dd_sweep(record):
+    """Emulated-f64 (double-double) on-accelerator checks + timing."""
+    import dedalus_tpu.public as d3
+    from dedalus_tpu.core.ddstep import DDIVPRunner, maybe_dd_runner
+    from dedalus_tpu.tools.config import config
+    old = config["linear algebra"].get("MATRIX_SOLVER", "auto")
+    config["linear algebra"]["MATRIX_SOLVER"] = "dense"
+    try:
+        # heat: dd trajectory vs exact decay (f64-grade floor)
+        N, dt, steps = 64, 1e-3, 200
+        solver, u, x = build_heat(N, np.float64, scheme=d3.SBDF2)
+        runner = maybe_dd_runner(solver) or DDIVPRunner(solver)
+        runner.sync_state()   # ICs were set after build_solver
+        for _ in range(steps):
+            runner.step(dt)
+        runner.push_state()
+        err = float(np.abs(np.asarray(u["g"], np.float64)
+                           - heat_exact(x, steps * dt)).max())
+        record["dd_heat_err_N64"] = err
+        mark(f"dd heat N=64: max err {err:.3e} (SBDF2 dt={dt})")
+
+        # KdV: mass conservation at f64 grade + dd-vs-f32 step cost
+        N = 256
+        solver64, u64 = build_kdv(N, np.float64)
+        runner = maybe_dd_runner(solver64) or DDIVPRunner(solver64)
+        runner.sync_state()   # ICs were set after build_solver
+        mass0 = float(np.mean(u64["g"]))
+        n_steps = 200
+        runner.step(5e-4)            # compile (factor + step program)
+        runner.step(5e-4)            # order-2 factor (ramp) before timing
+        t0 = time.time()
+        for _ in range(n_steps):
+            runner.step(5e-4)
+        dd_sps = n_steps / (time.time() - t0)
+        runner.push_state()
+        mass1 = float(np.mean(u64["g"]))
+        record["dd_kdv_mass_drift"] = abs(mass1 - mass0) / abs(mass0)
+        record["dd_kdv_steps_per_sec"] = round(dd_sps, 2)
+        mark(f"dd KdV mass drift {record['dd_kdv_mass_drift']:.3e}, "
+             f"{dd_sps:.1f} steps/s")
+
+        # f32 reference cost on the same problem/scheme (per-step host
+        # dispatch like the dd runner, for a like-for-like slowdown)
+        solver32, _ = build_kdv(N, np.float32)
+        solver32.step(5e-4)
+        t0 = time.time()
+        for _ in range(n_steps):
+            solver32.step(5e-4)
+        f32_sps = n_steps / (time.time() - t0)
+        record["f32_kdv_steps_per_sec"] = round(f32_sps, 2)
+        record["dd_slowdown_vs_f32"] = round(f32_sps / dd_sps, 2)
+        mark(f"f32 KdV {f32_sps:.1f} steps/s -> dd slowdown "
+             f"{record['dd_slowdown_vs_f32']}x")
+    except Exception as exc:
+        record["dd_error"] = repr(exc)[:300]
+        mark(f"dd sweep failed: {exc!r}")
+    finally:
+        config["linear algebra"]["MATRIX_SOLVER"] = old
 
 
 def main():
@@ -60,23 +150,29 @@ def main():
         **{f"err_N{N}": e for N, e in errs.items()},
     }
     if backend != "cpu":
-        # f64-on-accelerator opt-in: the Fourier transforms route through
-        # the matrix-MMT path on TPU (no c128), so f64 runs on emulated
-        # double-precision matmuls where the backend supports them —
-        # demonstrating the reference's f64 spectral-convergence floor
-        # on-chip (BENCHMARKS.md dtype policy; reference is f64-native).
+        # native f64 on the accelerator (XLA software f64), where supported
         try:
             e64 = heat_error(64, np.float64)
             record["err_N64_f64_onchip"] = e64
-            mark(f"f64-on-chip N=64: max err {e64:.3e}")
+            mark(f"native f64 on-chip N=64: max err {e64:.3e}")
         except Exception as exc:
             record["f64_onchip_error"] = repr(exc)[:200]
-            mark(f"f64-on-chip unsupported: {exc!r}")
+            mark(f"native f64 on-chip unsupported: {exc!r}")
+    # emulated f64 (double-double): runs on every backend; on TPU this is
+    # the dtype=np.float64 fast path (core/ddstep.maybe_dd_runner)
+    dd_sweep(record)
+    record["ts"] = round(time.time(), 1)
     _append_result(record)
     print(record)
     # resolution-independent floor: spectral convergence bottoms out at
     # the dtype roundoff, not a power law
     assert errs[128] < (2e-5 if dtype == np.float32 else 1e-8), errs
+    # dd path must deliver f64-grade results wherever it ran
+    if "dd_heat_err_N64" in record:
+        assert record["dd_heat_err_N64"] < 1e-5, record
+    if "dd_kdv_mass_drift" in record:
+        assert record["dd_kdv_mass_drift"] < 1e-10, record
+    assert "dd_error" not in record, record
 
 
 if __name__ == "__main__":

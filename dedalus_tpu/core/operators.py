@@ -506,6 +506,89 @@ class InterpolateCartesian(LinearOperator):
         return [(None, descrs)]
 
 
+class AzimuthalInterpolate(Future):
+    """
+    Interpolation at phi = position on a curvilinear basis (disk, annulus,
+    sphere, shell, ball), evaluated in GRID space: the uniform azimuth
+    grid is contracted with the exact trigonometric interpolation row and
+    the result is broadcast back as a phi-CONSTANT field on the same
+    domain — this framework's meridional representation (meridional_basis
+    aliases the full basis; a phi-constant field transforms to m=0 modes
+    only). Tensor components come out in the coordinate frame at
+    phi = position.
+
+    Parity note (reference: core/operators.py:1037 Interpolate): the
+    reference also admits azimuthal interpolation in equation LHS
+    matrices; here the m-mixing has no per-group pencil matrix, so this
+    operator is RHS/output-only (expression_matrices raises).
+    """
+
+    name = "interp"
+    natural_layout = "g"
+
+    _row_cache = {}
+
+    def __init__(self, operand, basis, position):
+        self.basis = basis
+        self.position = float(position)
+        super().__init__(operand)
+
+    @property
+    def operand(self):
+        return self.args[0]
+
+    def rebuild(self, new_args):
+        return AzimuthalInterpolate(new_args[0], self.basis, self.position)
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        self.domain = operand.domain
+        self.tensorsig = operand.tensorsig
+        self.dtype = operand.dtype
+
+    def __repr__(self):
+        return f"interp({self.args[0]}, phi={self.position})"
+
+    @classmethod
+    def _interp_row(cls, Ng, phi0, complex_dtype):
+        """Exact trig-interpolation row over Ng uniform azimuth samples:
+        row @ samples = f(phi0) for any f band-limited to the grid."""
+        key = (Ng, round(phi0, 15), complex_dtype)
+        if key not in cls._row_cache:
+            phis = 2 * np.pi * np.arange(Ng) / Ng
+            if complex_dtype:
+                ms = np.fft.fftfreq(Ng, d=1.0 / Ng)
+                G = np.exp(1j * phis[:, None] * ms[None, :])
+                c = np.exp(1j * phi0 * ms)
+            else:
+                M = Ng // 2
+                cols = [np.cos(m * phis) for m in range(M + 1)]
+                cols += [np.sin(m * phis) for m in range(1, M)]
+                G = np.stack(cols, axis=1)
+                c = np.concatenate([[np.cos(m * phi0) for m in range(M + 1)],
+                                    [np.sin(m * phi0) for m in range(1, M)]])
+            row = c @ np.linalg.pinv(G)
+            cls._row_cache[key] = np.ascontiguousarray(row)
+        return cls._row_cache[key]
+
+    def ev_impl(self, ctx):
+        data = ev(self.operand, ctx, "g")
+        ax = self.tdim + self.basis.first_axis
+        Ng = data.shape[ax]
+        row = self._interp_row(Ng, self.position,
+                               np.iscomplexobj(np.zeros(0, self.dtype)))
+        from ..tools.jitlift import device_constant
+        r = device_constant(row, dtype=data.dtype)
+        val = jnp.tensordot(data, r, axes=[[ax], [0]])
+        val = jnp.expand_dims(val, ax)
+        return jnp.broadcast_to(val, data.shape)
+
+    def expression_matrices(self, subproblem, vars, **kw):
+        raise NotImplementedError(
+            "Azimuthal interpolation mixes azimuthal groups and has no "
+            "per-pencil matrix; use it on the RHS or in output tasks.")
+
+
 @parseable("interp", "Interpolate")
 def Interpolate(operand, coord, position):
     if np.isscalar(operand):
@@ -514,18 +597,21 @@ def Interpolate(operand, coord, position):
     basis = operand.domain.get_basis(coord)
     if basis is None:
         return operand
+    from .coords import AzimuthalCoordinate
     if getattr(basis, "regularity", False):
         from .spherical3d import SphericalInterpolate
+        if isinstance(coord, AzimuthalCoordinate):
+            return AzimuthalInterpolate(operand, basis, position)
         if coord != basis.coordsystem.radius:
             raise NotImplementedError(
-                "Only radial interpolation is supported on shell/ball bases.")
+                "Colatitude interpolation is not supported on shell/ball "
+                "bases (radial and azimuthal are).")
         return SphericalInterpolate(operand, position)
     from .polar import PolarInterpolate
     from .curvilinear import SpinBasisMixin
     if isinstance(basis, SpinBasisMixin):
-        from .coords import AzimuthalCoordinate
         if isinstance(coord, AzimuthalCoordinate):
-            raise NotImplementedError("Azimuthal interpolation on curvilinear bases.")
+            return AzimuthalInterpolate(operand, basis, position)
         return PolarInterpolate(operand, position)
     return InterpolateCartesian(operand, coord, position)
 
@@ -1174,20 +1260,16 @@ def Radial(operand, index=0):
     if _spherical_cs(operand.tensorsig[index]):
         from .spherical3d import SphericalComponent
         return SphericalComponent(operand, "radial", index)
-    if index != 0:
-        raise NotImplementedError("Component extraction only supports index=0.")
     from .polar import PolarComponent
-    return PolarComponent(operand, "radial")
+    return PolarComponent(operand, "radial", index)
 
 
 def Azimuthal(operand, index=0):
     if _spherical_cs(operand.tensorsig[index]):
         from .spherical3d import SphericalComponent
         return SphericalComponent(operand, "azimuthal", index)
-    if index != 0:
-        raise NotImplementedError("Component extraction only supports index=0.")
     from .polar import PolarComponent
-    return PolarComponent(operand, "azimuthal")
+    return PolarComponent(operand, "azimuthal", index)
 
 
 def Trace(operand):
@@ -1219,10 +1301,8 @@ def Angular(operand, index=0):
     if _spherical_cs(operand.tensorsig[index]):
         from .spherical3d import SphericalComponent
         return SphericalComponent(operand, "angular", index)
-    if index != 0:
-        raise NotImplementedError("Component extraction only supports index=0.")
     from .polar import PolarComponent
-    return PolarComponent(operand, "azimuthal")
+    return PolarComponent(operand, "azimuthal", index)
 
 
 parseables["trace"] = parseables["Trace"] = Trace

@@ -1003,24 +1003,27 @@ class PolarComponent(LinearOperator):
     name = "Comp"
     natural_layout = "g"
 
-    def __init__(self, operand, which):
+    def __init__(self, operand, which, index=0):
         self.which = which  # 'radial' | 'azimuthal'
+        self.index = int(index)
         self.comp_index = {"azimuthal": 0, "radial": 1}[which]
         super().__init__(operand)
 
     def rebuild(self, new_args):
-        return PolarComponent(new_args[0], self.which)
+        return PolarComponent(new_args[0], self.which, self.index)
 
     def _build_metadata(self):
         operand = self.args[0]
-        self.cs = operand.tensorsig[0]
+        self.cs = operand.tensorsig[self.index]
+        ts = list(operand.tensorsig)
+        ts.pop(self.index)
         self.domain = operand.domain
-        self.tensorsig = tuple(operand.tensorsig[1:])
+        self.tensorsig = tuple(ts)
         self.dtype = operand.dtype
 
     def ev_impl(self, ctx):
         data = ev(self.operand, ctx, "g")
-        return data[self.comp_index]
+        return data[(slice(None),) * self.index + (self.comp_index,)]
 
     def terms(self):
         operand = self.operand
@@ -1042,12 +1045,15 @@ class PolarComponent(LinearOperator):
         # u_phi = (i u_- - i u_+)/sqrt(2)
         if az_basis is None:
             raise ValueError("Component extraction needs an S1/polar basis.")
-        rest = int(np.prod(operand.tshape[1:], dtype=int)) if operand.tshape[1:] else 1
+        before = int(np.prod(operand.tshape[:self.index], dtype=int)) \
+            if operand.tshape[:self.index] else 1
+        after = int(np.prod(operand.tshape[self.index + 1:], dtype=int)) \
+            if operand.tshape[self.index + 1:] else 1
         if self.which == "radial":
             row = np.array([[1.0, 1.0]]) / np.sqrt(2)
         else:
             row = np.array([[1j, -1j]]) / np.sqrt(2)
-        factor = np.kron(row, np.identity(rest))
+        factor = np.kron(np.identity(before), np.kron(row, np.identity(after)))
         dim = operand.domain.dim
         raw = [(factor, [None] * dim)]
         complex_dtype = isinstance(az_basis, S1ComplexBasis)

@@ -506,6 +506,24 @@ class InitialValueSolver(SolverBase):
         self.evaluator = Evaluator(self)
         self.dt = None
         self._project_state = None
+        # float64 on an accelerator: route stepping through the emulated-
+        # f64 (double-double) path where the problem is supported — XLA's
+        # native software f64 has no MXU path, so the dd runner's int8
+        # Ozaki matmuls + f32-factor/dd-refined solves are the fast f64
+        # (config [execution] EMULATED_F64 = auto|never; core/ddstep.py)
+        self._dd = None
+        if (np.dtype(self.pencil_dtype) == np.dtype(np.float64)
+                and jax.default_backend() in ("tpu", "axon")
+                and config["execution"].get(
+                    "EMULATED_F64", "auto").lower() != "never"):
+            from .ddstep import DDIVPRunner, DDUnsupportedError
+            try:
+                self._dd = DDIVPRunner(self)
+                logger.info("float64 on accelerator: emulated-f64 "
+                            "(double-double) step path active")
+            except DDUnsupportedError as exc:
+                logger.info(f"float64 on accelerator: dd path unavailable "
+                            f"({exc}); stepping in native XLA f64")
         # Profiling (reference: core/solvers.py:546-561,780-806 cProfile
         # phases; here a jax.profiler trace of the run phase + per-phase
         # wall times dumped at log_stats)
@@ -567,6 +585,47 @@ class InitialValueSolver(SolverBase):
             self._project_state = lifted_jit(project)
         self.X = self._project_state(self.X)
 
+    def _dd_advance(self, n, dt):
+        """Advance n steps on the emulated-f64 (double-double) path: sync
+        user field edits into the dd state, step, and install lazy field
+        pulls that materialize f64 data on access. The f32 Hermitian
+        re-projection cadence is skipped here — a f32 grid roundtrip would
+        truncate the dd state (the dd-supported problem set is Cartesian
+        real-storage, which has no Hermitian drift to project out)."""
+        dd = self._dd
+        if self.fields_dirty():
+            dd.X = dd._gather_dd()
+        for _ in range(n):
+            dd.step(dt)
+        self.X = dd.X.hi   # f32 view: finite checks, harness inspection
+        self.sim_time = dd.sim_time
+        layout, variables = self.layout, self.variables
+        Xdd = dd.X
+        cache = {}
+
+        def make_pull(var):
+            def pull():
+                if "arrays" not in cache:
+                    his = scatter_state(layout, variables, Xdd.hi)
+                    los = scatter_state(layout, variables, Xdd.lo)
+                    cache["arrays"] = {
+                        k: (np.asarray(his[k], np.float64)
+                            + np.asarray(los[k], np.float64))
+                        for k in his}
+                var.preset_coeff(jnp.asarray(cache["arrays"][state_key(var)]))
+            return pull
+
+        for v in variables:
+            v.install_pull(make_pull(v))
+        self.snapshot_versions()
+        self.problem.sim_time = self.sim_time
+        self.iteration += n
+        self.dt = dt
+        self.evaluator.evaluate_scheduled(
+            iteration=self.iteration,
+            wall_time=time_mod.time() - self.start_time,
+            sim_time=self.sim_time, timestep=dt)
+
     def _stop_trace(self):
         if self._trace_active:
             jax.profiler.stop_trace()
@@ -593,6 +652,9 @@ class InitialValueSolver(SolverBase):
             raise ValueError("Invalid timestep.")
         if self.iteration == self.warmup_iterations:
             self._end_warmup()
+        if self._dd is not None:
+            self._dd_advance(1, dt)
+            return
         # pick up user modifications of the state fields (version-tracked)
         if self.fields_dirty():
             self.X = self.gather_fields()
@@ -630,6 +692,10 @@ class InitialValueSolver(SolverBase):
             return
         if self.iteration <= self.warmup_iterations < self.iteration + n:
             self._end_warmup()
+        if self._dd is not None:
+            # per-step dispatch (no scan block yet on the dd path)
+            self._dd_advance(n, dt)
+            return
         if self.fields_dirty():
             self.X = self.gather_fields()
         cadence = self.enforce_real_cadence

@@ -1,0 +1,175 @@
+"""
+Azimuthal interpolation on curvilinear bases + Component index > 0
+(VERDICT round-4 item 7; reference: dedalus/core/operators.py:1037
+Interpolate, :2160-2283 Component family).
+
+Azimuthal interpolation is grid-exact for band-limited data: the result
+is a phi-constant field whose values equal the operand evaluated at
+phi = position (tensor components in the coordinate frame there).
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+
+
+PHI0 = 0.73
+
+
+def grid_at_phi(field, phi0, axis):
+    """Oracle: spectrally interpolate field['g'] to phi0 along `axis`
+    with numpy (complex DFT evaluation — exact for band-limited data)."""
+    g = np.asarray(field["g"], dtype=np.complex128)
+    Ng = g.shape[axis]
+    coeffs = np.fft.fft(g, axis=axis) / Ng
+    ms = np.fft.fftfreq(Ng, d=1.0 / Ng)
+    phase = np.exp(1j * ms * phi0)
+    shape = [1] * g.ndim
+    shape[axis] = Ng
+    val = (coeffs * phase.reshape(shape)).sum(axis=axis)
+    if not np.iscomplexobj(np.asarray(field["g"])):
+        val = val.real
+    return val
+
+
+def test_disk_azimuthal_interpolation_scalar():
+    cs = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    disk = d3.DiskBasis(cs, shape=(24, 16), dtype=np.float64, radius=1.5)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = x ** 2 + 2 * x * y - y ** 2 + 3
+    out = d3.Interpolate(f, cs["phi"], PHI0).evaluate()
+    expected = grid_at_phi(f, PHI0, axis=0)
+    got = np.asarray(out["g"])
+    # phi-constant result equal to f(phi0, r) at every phi slot
+    assert np.abs(got - expected[None, :]).max() < 1e-12
+
+
+def test_disk_azimuthal_interpolation_vector():
+    cs = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    disk = d3.DiskBasis(cs, shape=(24, 16), dtype=np.float64, radius=1.5)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    u = dist.VectorField(cs, name="u", bases=disk)
+    ux, uy = 2 * x * y, x ** 2 - y ** 2 + 1
+    u["g"] = np.array([-np.sin(phi) * ux + np.cos(phi) * uy,
+                       np.cos(phi) * ux + np.sin(phi) * uy])
+    out = d3.Interpolate(u, cs["phi"], PHI0).evaluate()
+    expected = grid_at_phi(u, PHI0, axis=1)     # tensor axis leads
+    got = np.asarray(out["g"])
+    assert np.abs(got - expected[:, None, :]).max() < 1e-12
+
+
+def test_annulus_azimuthal_interpolation():
+    cs = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    ann = d3.AnnulusBasis(cs, shape=(24, 16), dtype=np.float64,
+                          radii=(0.5, 2.0))
+    phi, r = dist.local_grids(ann)
+    f = dist.Field(name="f", bases=ann)
+    f["g"] = np.cos(3 * phi) * r ** 2 + np.sin(phi) / r
+    out = d3.Interpolate(f, cs["phi"], PHI0).evaluate()
+    expected = grid_at_phi(f, PHI0, axis=0)
+    assert np.abs(np.asarray(out["g"]) - expected[None, :]).max() < 1e-12
+
+
+def test_sphere_azimuthal_interpolation():
+    cs = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    sph = d3.SphereBasis(cs, shape=(24, 12), dtype=np.float64, radius=1.0)
+    phi, theta = dist.local_grids(sph)
+    f = dist.Field(name="f", bases=sph)
+    f["g"] = (1 + np.cos(theta) ** 2) * (1 + 0.3 * np.cos(2 * phi)
+                                         + 0.2 * np.sin(phi))
+    out = d3.Interpolate(f, cs["phi"], PHI0).evaluate()
+    expected = grid_at_phi(f, PHI0, axis=0)
+    assert np.abs(np.asarray(out["g"]) - expected[None, :]).max() < 1e-12
+
+
+def test_shell_azimuthal_interpolation():
+    cs = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    shell = d3.ShellBasis(cs, shape=(12, 8, 8), dtype=np.float64,
+                          radii=(0.6, 1.4))
+    phi, theta, r = dist.local_grids(shell)
+    f = dist.Field(name="f", bases=shell)
+    f["g"] = (r ** 2 * np.sin(theta) ** 2 * np.cos(2 * phi)
+              + r * np.cos(theta) + 1)
+    out = d3.Interpolate(f, cs["phi"], PHI0).evaluate()
+    expected = grid_at_phi(f, PHI0, axis=0)
+    assert np.abs(np.asarray(out["g"]) - expected[None]).max() < 1e-11
+
+
+def test_azimuthal_interpolation_rejected_on_lhs():
+    cs = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    disk = d3.DiskBasis(cs, shape=(16, 8), dtype=np.float64, radius=1.0)
+    f = dist.Field(name="f", bases=disk)
+    tau = dist.Field(name="tau")
+    problem = d3.LBVP([f, tau], namespace=locals())
+    with pytest.raises(Exception):
+        problem.add_equation("interp(f, phi=0.5) + tau = 1")
+        problem.build_solver()
+
+
+# ------------------------------------------------- Component index > 0
+
+def test_polar_component_index1_rank2():
+    """Extract the SECOND index's components of a rank-2 disk tensor on
+    the RHS and compare against direct grid slices (grid storage is
+    coordinate components: axis order (phi, r))."""
+    cs = d3.PolarCoordinates("phi", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    disk = d3.DiskBasis(cs, shape=(24, 16), dtype=np.float64, radius=1.5)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    T = dist.TensorField(cs, name="T", bases=disk)
+    Tc = np.array([[x * y + 0 * r, x ** 2 + 0 * r],
+                   [y ** 2 + 0 * r, x + y + 0 * r]])
+    R = np.array([[-np.sin(phi) + 0 * r, np.cos(phi) + 0 * r],
+                  [np.cos(phi) + 0 * r, np.sin(phi) + 0 * r]])
+    T["g"] = np.einsum("ia...,ab...,jb...->ij...", R, Tc, R)
+    g = np.array(T["g"])
+    # grid-layout oracle (coordinate components of smooth tensors are not
+    # regular scalars, so a coeff roundtrip through .evaluate() converges
+    # only spectrally — the extraction itself is an exact grid selection)
+    from dedalus_tpu.core.future import EvalContext
+    rad1 = np.asarray(d3.Radial(T, index=1).ev(EvalContext(), "g"))
+    azi1 = np.asarray(d3.Azimuthal(T, index=1).ev(EvalContext(), "g"))
+    rad0 = np.asarray(d3.Radial(T, index=0).ev(EvalContext(), "g"))
+    assert np.abs(rad1 - g[:, 1]).max() < 1e-12
+    assert np.abs(azi1 - g[:, 0]).max() < 1e-12
+    assert np.abs(rad0 - g[1]).max() < 1e-12
+    # end-to-end .evaluate() additionally projects onto the disk's
+    # regular function space; coordinate columns of smooth tensors are
+    # generally NOT regular vectors (e.g. a*e_r has m=3 content at r^2),
+    # so the projection converges spectrally rather than reproducing the
+    # grid selection exactly — same semantics at every index
+    out1 = d3.Radial(T, index=1).evaluate()
+    out0 = d3.Radial(T, index=0).evaluate()
+    assert np.abs(np.asarray(out1["g"]) - g[:, 1]).max() < 0.05
+    assert np.abs(np.asarray(out0["g"]) - g[1]).max() < 0.05
+
+
+def test_spherical_component_index1_rank2():
+    """S2 boundary fields (spin storage with a constant selection matrix;
+    interiors use regularity storage and are excluded by construction)."""
+    cs = d3.SphericalCoordinates("phi", "theta", "r")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    sphere = d3.SphereBasis(cs.S2coordsys, shape=(12, 8), dtype=np.float64,
+                            radius=1.0)
+    u = dist.VectorField(cs, name="u", bases=sphere)
+    v = dist.VectorField(cs, name="v", bases=sphere)
+    phi, theta = dist.local_grids(sphere)
+    u["g"][2] = 1 + 0.1 * np.cos(theta) + 0 * phi
+    u["g"][1] = np.sin(theta) + 0 * phi
+    v["g"][2] = 0.5 + 0 * theta + 0 * phi
+    v["g"][0] = np.sin(theta) * np.cos(phi)
+    T = (u * v).evaluate()            # rank 2 spherical tensor on S2
+    Tg = np.asarray(T["g"])
+    rad1 = d3.Radial(T, index=1).evaluate()
+    assert np.abs(np.asarray(rad1["g"]) - Tg[:, 2]).max() < 1e-10

@@ -1,0 +1,157 @@
+"""
+Emulated-f64 (double-double) IVP stepping oracles.
+
+Each test runs the SAME problem twice: native f64 (the CPU reference
+path, matching the reference framework's precision) and the DDIVPRunner
+f32-pair path. The dd trajectory must track the f64 trajectory far below
+the f32 error floor (~1e-7): agreement at ~1e-12 proves transforms,
+matvecs, RHS nonlinearities, and the refined implicit solve all run at
+emulated-f64 precision. (VERDICT round-4 item 3.)
+"""
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.core.ddstep import DDIVPRunner, DDUnsupportedError
+from dedalus_tpu.tools.config import config
+
+
+@pytest.fixture(autouse=True)
+def dense_path():
+    old = config["linear algebra"].get("MATRIX_SOLVER", "auto")
+    config["linear algebra"]["MATRIX_SOLVER"] = "dense"
+    yield
+    config["linear algebra"]["MATRIX_SOLVER"] = old
+
+
+def build_heat(N, dtype):
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=dtype)
+    xbasis = d3.RealFourier(xcoord, size=N, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xbasis)
+    kappa = 0.1
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - kappa*dx(dx(u)) = 0")
+    x = dist.local_grids(xbasis)[0]
+    return problem, u, x
+
+
+def test_heat_dd_matches_f64():
+    N, dt, n_steps = 64, 1e-3, 200
+    problem, u, x = build_heat(N, np.float64)
+    u["g"] = np.sin(3 * x) + 0.5 * np.cos(7 * x)
+    solver = problem.build_solver(d3.SBDF2)
+    runner = DDIVPRunner(solver)
+    for _ in range(n_steps):
+        solver.step(dt)
+        runner.step(dt)
+    X64 = np.asarray(solver.X, dtype=np.float64)
+    Xdd = runner.state_f64()
+    scale = np.abs(X64).max()
+    assert np.abs(Xdd - X64).max() / scale < 1e-11
+    # and both must match the exact decay
+    runner.push_state()
+    t = n_steps * dt
+    exact = (np.exp(-0.1 * 9 * t) * np.sin(3 * x)
+             + 0.5 * np.exp(-0.1 * 49 * t) * np.cos(7 * x))
+    assert np.abs(u["g"] - exact).max() < 1e-5   # SBDF2 O(dt^2) time error
+
+
+def build_kdv(N, dtype):
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=dtype)
+    xbasis = d3.RealFourier(xcoord, size=N, bounds=(0, 10), dealias=3 / 2)
+    u = dist.Field(name="u", bases=xbasis)
+    a, b = 1e-4, 2e-4
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - a*dx(dx(u)) - b*dx(dx(dx(u))) = - u*dx(u)")
+    x = dist.local_grids(xbasis)[0]
+    n = 20
+    u["g"] = np.log(1 + np.cosh(n) ** 2 / np.cosh(n * (x - 3)) ** 2) / (2 * n)
+    return problem, u
+
+
+def test_kdv_dd_matches_f64():
+    # nonlinear RHS: dd transforms + dealiased product + dd matvec chain
+    N, dt, n_steps = 256, 5e-4, 100
+    problem, u = build_kdv(N, np.float64)
+    solver = problem.build_solver(d3.SBDF2)
+    runner = DDIVPRunner(solver)
+    for _ in range(n_steps):
+        solver.step(dt)
+        runner.step(dt)
+    X64 = np.asarray(solver.X, dtype=np.float64)
+    Xdd = runner.state_f64()
+    scale = np.abs(X64).max()
+    assert np.abs(Xdd - X64).max() / scale < 1e-10
+
+
+def test_kdv_dd_mass_conservation():
+    # f32 stepping drifts mass at ~1e-8 (BENCHMARKS.md); dd must hold
+    # f64-grade drift. Mass = the mean (cos-0) Fourier coefficient.
+    N, dt, n_steps = 256, 5e-4, 200
+    problem, u = build_kdv(N, np.float64)
+    solver = problem.build_solver(d3.SBDF2)
+    runner = DDIVPRunner(solver)
+    mass0 = float(np.mean(u["g"]))   # uniform-grid mean = integral / L
+    for _ in range(n_steps):
+        runner.step(dt)
+    runner.push_state()
+    mass1 = float(np.mean(u["g"]))
+    assert abs(mass1 - mass0) / abs(mass0) < 1e-12
+
+
+def test_rk_scheme_rejected():
+    problem, u, x = build_heat(32, np.float64)
+    solver = problem.build_solver(d3.RK222)
+    with pytest.raises(DDUnsupportedError):
+        DDIVPRunner(solver)
+
+
+def test_forcing_update_mid_run():
+    # non-variable RHS fields must be dynamic inputs: updating a forcing
+    # between steps changes the trajectory (review finding — baking them
+    # as trace-time constants silently froze the first step's forcing)
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=np.float64)
+    xb = d3.RealFourier(xcoord, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    F = dist.Field(name="F", bases=xb)
+    dx = lambda A: d3.Differentiate(A, xcoord)
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - dx(dx(u)) = F")
+    x = dist.local_grids(xb)[0]
+    F["g"] = np.sin(x)
+    solver = problem.build_solver(d3.SBDF2)
+    runner = DDIVPRunner(solver)
+    runner.step(1e-3)
+    X1 = runner.state_f64().copy()
+    F["g"] = 5 * np.cos(2 * x)
+    runner.step(1e-3)
+    X2 = runner.state_f64()
+    # rerun with the forcing never updated: trajectories must differ
+    solver2 = problem.build_solver(d3.SBDF2)
+    F["g"] = np.sin(x)
+    runner2 = DDIVPRunner(solver2)
+    runner2.step(1e-3)
+    assert np.abs(runner2.state_f64() - X1).max() < 1e-12
+    runner2.step(1e-3)
+    assert np.abs(runner2.state_f64() - X2).max() > 1e-6
+
+
+def test_unsupported_rhs_detected_at_construction():
+    # a dd-unsupported RHS node must raise at DDIVPRunner construction
+    # (where the solver's auto-wiring can fall back to native f64)
+    xcoord = d3.Coordinate("x")
+    dist = d3.Distributor(xcoord, dtype=np.float64)
+    xb = d3.RealFourier(xcoord, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    sin = np.sin
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) = sin(u)")   # UnaryGridFunction: no dd
+    solver = problem.build_solver(d3.SBDF2)
+    with pytest.raises(DDUnsupportedError):
+        DDIVPRunner(solver)
