@@ -18,12 +18,13 @@ arithmetic (libraries/doubledouble.py):
     matrices, Add / pointwise products elementwise, grid<->coeff
     transforms through each basis's MMT ("matrix" library) plan.
 
-Selection: `maybe_dd_runner(solver)` returns a runner when the solver's
-pencil dtype is float64 and the backend is a TPU — the `dtype=np.float64`
-TPU opt-in — and None otherwise (native f64 on CPU). Scope guards raise
-`DDUnsupportedError` naming the node for trees outside the supported set
-(curvilinear group stacks, tensor factors); Cartesian scalar/vector
-problems on Fourier/Jacobi bases are covered.
+Selection: `InitialValueSolver` auto-wires a runner for float64 pencils
+on a TPU backend under `[execution] EMULATED_F64 = auto`, falling back
+to native XLA f64 when construction raises `DDUnsupportedError`
+(non-multistep schemes, non-dense pencil paths, RHS nodes outside the
+dd set — validated by an abstract trace at construction). Cartesian
+scalar/vector problems on Fourier/Jacobi bases are covered;
+`maybe_dd_runner(solver)` is the explicit hook with the same rules.
 """
 
 import logging
@@ -33,8 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ..libraries.doubledouble import (
-    DD, dd_from_f64, dd_to_f64, dd_add, dd_sub, dd_neg, dd_mul,
-    dd_mul_f32, dd_matmul, dd_slices_from_f64, dd_zeros)
+    DD, dd_from_f64, dd_to_f64, dd_split_host, dd_add, dd_sub, dd_neg,
+    dd_mul, dd_mul_f32, dd_matmul, dd_slices_from_f64, dd_zeros)
 from ..tools.jitlift import lifted_jit, device_constant
 
 logger = logging.getLogger(__name__)
@@ -57,10 +58,7 @@ def _dd_scalar(x):
 def _dd_vector(xs):
     """Host float sequence -> DD of f32 vectors (exact per-entry split);
     dynamic program inputs, one per-entry scalar via dd indexing."""
-    xs = np.asarray(xs, dtype=np.float64)
-    hi = xs.astype(np.float32)
-    lo = (xs - hi.astype(np.float64)).astype(np.float32)
-    return DD(jnp.asarray(hi), jnp.asarray(lo))
+    return dd_from_f64(xs)
 
 
 # ------------------------------------------------------------- dd kernels
@@ -97,9 +95,7 @@ class _HostConstCache:
         if key not in self.pairs:
             A = np.asarray(M.toarray() if hasattr(M, "toarray") else M,
                            dtype=np.float64)
-            hi = A.astype(np.float32)
-            lo = (A - hi.astype(np.float64)).astype(np.float32)
-            self.pairs[key] = (hi, lo)
+            self.pairs[key] = dd_split_host(A)
             self._register(self.pairs, key, M)
         return self.pairs[key]
 
@@ -223,9 +219,7 @@ class DDEvalContext:
             coeff = self.subs[field]
         else:
             # non-variable input (parameter/forcing): exact host split
-            host = np.asarray(field.require_coeff_space(), dtype=np.float64)
-            hi = host.astype(np.float32)
-            lo = (host - hi.astype(np.float64)).astype(np.float32)
+            hi, lo = dd_split_host(np.asarray(field.require_coeff_space()))
             coeff = DD(device_constant(hi), device_constant(lo))
         if layout == "c":
             out = coeff
@@ -366,11 +360,9 @@ class DDIVPRunner:
         layout, variables = self.solver.layout, self.solver.variables
         his, los = {}, {}
         for v in variables:
-            host = np.asarray(v.require_coeff_space(), dtype=np.float64)
-            hi = host.astype(np.float32)
-            los[state_key(v)] = jnp.asarray(
-                (host - hi.astype(np.float64)).astype(np.float32))
+            hi, lo = dd_split_host(np.asarray(v.require_coeff_space()))
             his[state_key(v)] = jnp.asarray(hi)
+            los[state_key(v)] = jnp.asarray(lo)
         # gather_state is pure data movement: exact componentwise
         return DD(gather_state(layout, variables, his),
                   gather_state(layout, variables, los))
@@ -398,6 +390,19 @@ class DDIVPRunner:
         dirty tracking)."""
         self.X = self._gather_dd()
 
+    def reset_history(self, sim_time):
+        """Restart the multistep ramp from `sim_time` with the current
+        state (checkpoint restart / discontinuous state edit: the stored
+        histories predate the new state)."""
+        G, S = self.shape
+        zero = dd_zeros((self.steps, G, S))
+        self.F_hist = zero
+        self.MX_hist = zero
+        self.LX_hist = zero
+        self.dt_hist = []
+        self.iteration = 0
+        self.sim_time = float(sim_time)
+
     def _extras_dd(self):
         """Current dd data of the RHS's non-variable field inputs,
         version-cached (host split only when a field changed)."""
@@ -405,10 +410,8 @@ class DDIVPRunner:
         for f in self._extra_fields:
             cached = self._extra_cache.get(id(f))
             if cached is None or cached[0] != f._version:
-                host = np.asarray(f.require_coeff_space(), dtype=np.float64)
-                hi = host.astype(np.float32)
-                lo = (host - hi.astype(np.float64)).astype(np.float32)
-                cached = (f._version, DD(jnp.asarray(hi), jnp.asarray(lo)))
+                cached = (f._version,
+                          dd_from_f64(np.asarray(f.require_coeff_space())))
                 self._extra_cache[id(f)] = cached
             out.append(cached[1])
         return out
@@ -599,11 +602,18 @@ def maybe_dd_runner(solver):
     """The dtype=np.float64-on-accelerator selection hook: the solver's
     auto-wired runner (InitialValueSolver constructs one when the backend
     is a TPU and [execution] EMULATED_F64 = auto), or a fresh DDIVPRunner
-    under the same conditions, else None."""
+    under the same conditions, else None (including EMULATED_F64 = never
+    and problems outside the dd-supported set)."""
+    from ..tools.config import config
     existing = getattr(solver, "_dd", None)
     if existing is not None:
         return existing
+    if config["execution"].get("EMULATED_F64", "auto").lower() == "never":
+        return None
     if (np.dtype(solver.pencil_dtype) == np.dtype(np.float64)
             and jax.default_backend() in ("tpu", "axon")):
-        return DDIVPRunner(solver)
+        try:
+            return DDIVPRunner(solver)
+        except DDUnsupportedError:
+            return None
     return None

@@ -551,23 +551,27 @@ class AzimuthalInterpolate(Future):
 
     @classmethod
     def _interp_row(cls, Ng, phi0, complex_dtype):
-        """Exact trig-interpolation row over Ng uniform azimuth samples:
-        row @ samples = f(phi0) for any f band-limited to the grid."""
+        """Exact trig-interpolation row over Ng uniform azimuth samples
+        (closed-form Dirichlet kernel, O(Ng)): row @ samples = f(phi0)
+        for any f band-limited to the grid. Even Ng carries a half-weight
+        (cosine-only) Nyquist mode, matching real-DFT storage."""
         key = (Ng, round(phi0, 15), complex_dtype)
         if key not in cls._row_cache:
             phis = 2 * np.pi * np.arange(Ng) / Ng
+            delta = phi0 - phis
             if complex_dtype:
                 ms = np.fft.fftfreq(Ng, d=1.0 / Ng)
-                G = np.exp(1j * phis[:, None] * ms[None, :])
-                c = np.exp(1j * phi0 * ms)
+                row = np.exp(1j * ms[None, :] * delta[:, None]).sum(1) / Ng
             else:
-                M = Ng // 2
-                cols = [np.cos(m * phis) for m in range(M + 1)]
-                cols += [np.sin(m * phis) for m in range(1, M)]
-                G = np.stack(cols, axis=1)
-                c = np.concatenate([[np.cos(m * phi0) for m in range(M + 1)],
-                                    [np.sin(m * phi0) for m in range(1, M)]])
-            row = c @ np.linalg.pinv(G)
+                if Ng % 2 == 0:
+                    M = Ng // 2
+                    row = (1.0 + 2.0 * sum(np.cos(m * delta)
+                                           for m in range(1, M))
+                           + np.cos(M * delta)) / Ng
+                else:
+                    M = (Ng - 1) // 2
+                    row = (1.0 + 2.0 * sum(np.cos(m * delta)
+                                           for m in range(1, M + 1))) / Ng
             cls._row_cache[key] = np.ascontiguousarray(row)
         return cls._row_cache[key]
 

@@ -594,7 +594,13 @@ class InitialValueSolver(SolverBase):
         real-storage, which has no Hermitian drift to project out)."""
         dd = self._dd
         if self.fields_dirty():
+            # user edit or checkpoint restart: re-gather state AND restart
+            # the multistep ramp from the solver's clock (histories predate
+            # the new state; load_state also resets sim_time/iteration)
             dd.X = dd._gather_dd()
+            dd.reset_history(self.sim_time)
+        elif dd.sim_time != self.sim_time:
+            dd.sim_time = self.sim_time
         for _ in range(n):
             dd.step(dt)
         self.X = dd.X.hi   # f32 view: finite checks, harness inspection

@@ -109,11 +109,19 @@ def _from64(v):
     return DD(hi, lo)
 
 
-def dd_from_f64(x):
-    """Host float64 numpy -> DD of f32 pairs (exact 2-term split)."""
+def dd_split_host(x):
+    """Host float64 numpy -> (hi, lo) f32 NUMPY pair (exact split). The
+    single implementation of the split convention — device-array callers
+    use dd_from_f64/_from64, which share it semantically."""
     x = np.asarray(x, dtype=np.float64)
     hi = x.astype(np.float32)
     lo = (x - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def dd_from_f64(x):
+    """Host float64 numpy -> DD of f32 pairs (exact 2-term split)."""
+    hi, lo = dd_split_host(x)
     return DD(jnp.asarray(hi), jnp.asarray(lo))
 
 
@@ -213,10 +221,12 @@ def _exact_pow2(n):
 def _exponent_scale(mag):
     """For f64 mag = max |value| along the contraction axis: returns an
     exact power-of-two f64 s with s * mag <= 1/2 (1 where mag == 0).
-    Kept within f32's exponent range so downstream f32 scales stay
-    finite (dd(f32) magnitudes are bounded by ~1e38 anyway)."""
+    Lines whose magnitude exceeds the f32-representable scale range
+    (|v| >= 2^125, where the needed s would clip) poison to NaN so a
+    blown-up state reads as non-finite instead of int8-wrapped garbage."""
     _, e = jnp.frexp(mag)
     s = _exact_pow2(-(e + 1)).astype(_F64)
+    s = jnp.where(mag >= 2.0 ** 125, jnp.float64(np.nan), s)
     return jnp.where(mag > 0, s, jnp.float64(1.0))
 
 

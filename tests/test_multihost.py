@@ -191,3 +191,148 @@ def test_two_process_file_output(tmp_path):
     # exactly one file set, written once (no double-writes from rank 1)
     files = sorted(out_dir.glob("*.h5"))
     assert len(files) == 1, files
+
+
+RESTART_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO"])
+from dedalus_tpu.parallel import multihost as mh
+
+pid = int(sys.argv[1])
+ckpt_dir = sys.argv[2]
+mh.initialize(coordinator_address=os.environ["COORD"], num_processes=2,
+              process_id=pid)
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.parallel import distribute_solver
+
+mesh = mh.device_mesh()
+
+def build():
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=32, bounds=(0, 4.0), dealias=3/2)
+    zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1.0), dealias=3/2)
+    u = dist.Field(name="u", bases=(xb, zb))
+    t1 = dist.Field(name="t1", bases=xb)
+    t2 = dist.Field(name="t2", bases=xb)
+    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+    problem = d3.IVP([u, t1, t2], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("u(z=1) = 0")
+    solver = problem.build_solver(d3.SBDF1)
+    x, z = dist.local_grids(xb, zb)
+    u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+    distribute_solver(solver, mesh)
+    return solver
+
+dt = 1e-3
+# uninterrupted sharded run: 10 steps
+s1 = build()
+for _ in range(10):
+    s1.step(dt)
+X_ref = mh.process_allgather(s1.X)
+
+# checkpointed sharded run: 5 steps + checkpoint write (primary-gated)
+s2 = build()
+h = s2.evaluator.add_file_handler(ckpt_dir, iter=5)
+h.add_tasks(s2.state, layout="g")
+for _ in range(5):
+    s2.step(dt)
+s2.evaluator.evaluate_handlers([h], iteration=s2.iteration,
+                               sim_time=s2.sim_time, timestep=dt)
+mh.barrier("ckpt_written")
+
+# restart into a FRESH sharded solver on both processes; 5 more steps
+s3 = build()
+import glob
+files = sorted(glob.glob(os.path.join(ckpt_dir, "*.h5")))
+assert files, "no checkpoint written"
+write, dt_loaded = s3.load_state(files[-1])
+assert s3.iteration == 5
+assert dt_loaded == dt
+for _ in range(5):
+    s3.step(dt)
+X_restart = mh.process_allgather(s3.X)
+err = np.abs(X_restart - X_ref).max()
+assert err < 1e-12, err
+norm = float(np.linalg.norm(X_restart))
+mh.barrier("restart_checked")
+print(f"RESTART_OK {pid} norm={norm:.12e}", flush=True)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_MULTIHOST") == "1",
+                    reason="multihost disabled")
+def test_two_process_checkpoint_restart(tmp_path):
+    """Sharded checkpoint write + restart across 2 real processes
+    reproduces the uninterrupted sharded trajectory, and both agree with
+    a SINGLE-process run of the same problem (reference pattern:
+    tests_parallel/test_output_parallel.py + core/solvers.py:632)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["COORD"] = f"localhost:{_free_port()}"
+    env["REPO"] = repo
+    env.pop("JAX_PLATFORMS", None)
+    script = tmp_path / "worker_restart.py"
+    script.write_text(RESTART_WORKER)
+    ckpt_dir = tmp_path / "ckpt_mh"
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(ckpt_dir)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost restart workers timed out")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n{err[-2000:]}"
+        assert "RESTART_OK" in out
+    norms = [out.split("norm=")[1].split()[0] for _, out, _ in outs]
+    assert norms[0] == norms[1]
+    # single-process oracle of the same 10-step trajectory
+    single = subprocess.run(
+        [sys.executable, "-c", SINGLE_ORACLE], env={**env, "REPO": repo},
+        capture_output=True, text=True, timeout=600)
+    assert single.returncode == 0, single.stderr[-2000:]
+    norm_single = single.stdout.split("norm=")[1].split()[0]
+    assert abs(float(norm_single) - float(norms[0])) < 1e-10
+
+
+SINGLE_ORACLE = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO"])
+import dedalus_tpu.public as d3
+coords = d3.CartesianCoordinates("x", "z")
+dist = d3.Distributor(coords, dtype=np.float64)
+xb = d3.RealFourier(coords["x"], size=32, bounds=(0, 4.0), dealias=3/2)
+zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1.0), dealias=3/2)
+u = dist.Field(name="u", bases=(xb, zb))
+t1 = dist.Field(name="t1", bases=xb)
+t2 = dist.Field(name="t2", bases=xb)
+lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+problem = d3.IVP([u, t1, t2], namespace=locals())
+problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+problem.add_equation("u(z=0) = 0")
+problem.add_equation("u(z=1) = 0")
+solver = problem.build_solver(d3.SBDF1)
+x, z = dist.local_grids(xb, zb)
+u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+for _ in range(10):
+    solver.step(1e-3)
+print(f"norm={float(np.linalg.norm(np.asarray(solver.X))):.12e}")
+"""
