@@ -327,6 +327,19 @@ class ProductBase(Future):
                 "spin/regularity assembly paths, not the per-axis path.")
         coeffs = np.asarray(ncc["c"])  # host transform of NCC data
         ccomp = coeffs[comp_index]
+        # azimuthally-varying annulus NCC: per-azimuth-mode expansion into
+        # (azimuth convolution) kron (radial multiplication) terms — valid
+        # because the annulus radial space is m-independent. The SAME
+        # classifier that forced the layout's m-coupling decides the route
+        # (subsystems._ncc_forced_coupled_axes).
+        for ax0, nb in enumerate(bases):
+            if (nb is not None and nb.dim == 2
+                    and hasattr(nb, "radial_multiplication_matrix")
+                    and hasattr(nb, "azimuth_basis")
+                    and ax0 == nb.first_axis
+                    and ProductBase.polar_azimuth_varies(ncc, nb)):
+                return self._polar_coupled_azimuth_terms(
+                    ccomp, bases, operand, ax0)
         return self._ncc_axis_terms_from(ccomp, bases, operand)
 
     def _ncc_axis_terms_from(self, ccomp, bases, operand):
@@ -367,6 +380,68 @@ class ProductBase(Future):
                 descrs = list(descrs)
                 descrs[a1] = descr_j
                 terms.append((scalar, descrs))
+        return terms
+
+    def _polar_coupled_azimuth_terms(self, ccomp, bases, operand, ax0):
+        """Kron terms of an azimuthally-VARYING annulus NCC (scalar data;
+        reference: the geometry-generic NCC pipeline admits phi-dependent
+        polar NCCs, dedalus/core/arithmetic.py:359-406): one term per
+        significant azimuth mode j,
+
+            (azimuth convolution of mode j) kron (radial mult of f_j(r)),
+
+        assembled onto the layout-COUPLED azimuth axis (whole-axis
+        convolution matrices, like Fourier-coupled Cartesian NCCs). The
+        annulus radial space is m-independent, so the radial factor is a
+        single multiplication matrix per mode. Disk NCCs (m-dependent
+        Zernike spaces) route through _disk_ncc_matrix instead."""
+        nb = bases[ax0]
+        r_axis = ax0 + 1
+        ob_pol = operand.domain.bases[ax0]
+        if ob_pol is None or not hasattr(ob_pol, "azimuth_basis"):
+            raise NonlinearOperatorError(
+                "Azimuthally-varying polar NCCs require the operand on a "
+                "polar basis too.")
+        # real-dtype tensor operands store spin-recombined pairs whose
+        # recombination does NOT commute with the azimuth convolution
+        # (reflection-type fold blocks anti-commute with the pair-J), so
+        # the convolution in stored coordinates couples components with
+        # pair slots — outside this kron-term structure
+        if operand.tensorsig and not is_complex_dtype(operand.dtype):
+            raise NonlinearOperatorError(
+                "Azimuthally-varying polar NCCs multiplying TENSOR "
+                "operands require a complex dtype (the real spin-pair "
+                "recombination does not commute with the azimuth "
+                "convolution); use a complex dtype or move the term to "
+                "the RHS.")
+        moved = np.moveaxis(ccomp, (ax0, r_axis), (0, 1))
+        if moved.size != moved.shape[0] * moved.shape[1]:
+            raise NonlinearOperatorError(
+                "Azimuthally-varying polar NCCs may not vary along "
+                "additional axes.")
+        az_r = moved.reshape(moved.shape[0], moved.shape[1])
+        tol = self._ncc_data_cutoff(az_r) * max(np.abs(az_r).max(), 1e-300)
+        dim = self.dist.dim
+        terms = []
+        for j in range(az_r.shape[0]):
+            prof = az_r[j]
+            if np.abs(prof).max() <= tol:
+                continue
+            e_j = np.zeros(ccomp.shape[ax0], dtype=az_r.dtype)
+            e_j[j] = 1.0
+            A = ob_pol.azimuth_basis.multiplication_matrix(
+                e_j, nb.azimuth_basis)
+            R = ob_pol.radial_multiplication_matrix(prof, nb.k, k_out=0)
+            cut = self._ncc_sparsify_cutoff(prof)
+            descrs = [None] * dim
+            descrs[ax0] = ("full", sparsify(A, 1e-14))
+            descrs[r_axis] = ("full", sparsify(R, cut))
+            terms.append((None, descrs))
+        if not terms:
+            descrs = [None] * dim
+            descrs[ax0] = ("full", sp.csr_matrix(
+                (ccomp.shape[ax0], ccomp.shape[ax0])))
+            terms.append((None, descrs))
         return terms
 
     def _ncc_axis_matrices_from(self, ccomp, ncc_bases, operand):
@@ -842,6 +917,21 @@ class ProductBase(Future):
         lattice of junk couplings)."""
         return max(ProductBase.NCC_ANGULAR_CUTOFF,
                    50 * ProductBase._ncc_real_eps(arr_or_dtype))
+
+    @staticmethod
+    def polar_azimuth_varies(ncc, basis):
+        """Shared classifier: does a disk/annulus NCC vary with azimuth?
+        Grid-space, dtype-aware (the SAME decision drives the layout's
+        forced m-coupling in subsystems._ncc_forced_coupled_axes and the
+        term builder's convolution route — a disagreement would assemble
+        whole-axis matrices onto per-m pencils or vice versa)."""
+        grid = np.asarray(ncc["g"])
+        tdim = len(ncc.tensorsig)
+        az = tdim + basis.first_axis
+        moved = np.moveaxis(grid, az, 0)
+        tol = (ProductBase._ncc_data_cutoff(grid)
+               * max(np.abs(grid).max(), 1e-300))
+        return bool(np.abs(moved - moved[:1]).max() > tol)
 
     @staticmethod
     def sph_ncc_angular_profile(ncc, basis, cs):

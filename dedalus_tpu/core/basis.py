@@ -305,21 +305,28 @@ class FourierBase(Basis):
                 return "matrix"
         return library
 
+    def _mult_plan_cls(self):
+        """MMT plan class for this basis: registry lookup walks the MRO so
+        subclasses (e.g. the polar S1 azimuth bases) reuse their Fourier
+        parent's plans."""
+        from .transforms import transform_registry
+        for cls in type(self).__mro__:
+            plan = transform_registry.get((cls.__name__, "matrix"))
+            if plan is not None:
+                return plan
+        raise KeyError(f"No matrix transform plan for {type(self).__name__}")
+
     @CachedMethod
     def _mult_forward_matrix(self, Ng):
         """Cached dense forward MMT on the Ng-point grid: only diag(g)
         varies between multiplication_matrix calls (e.g. the Mathieu
         parameter sweep rebuilds per q), so the O(Ng N^2) construction is
         paid once per (basis, Ng)."""
-        from .transforms import transform_registry
-        plan_cls = transform_registry[(type(self).__name__, "matrix")]
-        return plan_cls.build_forward(self, Ng / self.size)
+        return self._mult_plan_cls().build_forward(self, Ng / self.size)
 
     @CachedMethod
     def _mult_backward_matrix(self, Ng):
-        from .transforms import transform_registry
-        plan_cls = transform_registry[(type(self).__name__, "matrix")]
-        return plan_cls.build_backward(self, Ng / self.size)
+        return self._mult_plan_cls().build_backward(self, Ng / self.size)
 
     def multiplication_matrix(self, ncc_coeffs, ncc_basis=None):
         """

@@ -545,7 +545,15 @@ class AnnulusBasis(SpinBasisMixin, WeightedJacobiRadial, Basis):
             if (not self.complex) and (not tensorsig) and ms[g] == 0:
                 mask[:, 1, :] = False  # minus-sin slot of m=0 for scalars
             return mask
-        raise NotImplementedError("Annulus azimuth must be a pencil axis.")
+        # layout-coupled azimuth (azimuthally-varying NCC): every m group's
+        # slots live in one pencil, group-major pair order
+        ngr = len(ms)
+        mask = np.ones((ncomp, ngr, gs, self.Nr), dtype=bool)
+        if self.complex:
+            mask[:, self.Nphi // 2, :, :] = False  # Nyquist group
+        if (not self.complex) and (not tensorsig):
+            mask[:, np.asarray(ms) == 0, 1, :] = False
+        return mask.reshape(ncomp, ngr * gs, self.Nr)
 
     # -------------------------------------------------- radial transforms
 

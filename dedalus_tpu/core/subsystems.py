@@ -74,6 +74,23 @@ def _ncc_forced_coupled_axes(variables, equations):
         rad = spin_prof[radial_flat]
         return bool(np.abs(rad - rad[:1, :]).max() > tol)
 
+    def couples_azimuth_polar(ncc_expr, basis):
+        """Does a disk/annulus NCC vary with azimuth? Delegates to the
+        SHARED grid-space dtype-aware classifier the term builder uses
+        (arithmetic.ProductBase.polar_azimuth_varies) so layout and
+        assembly can never disagree (reference: azimuthally-varying NCCs
+        make polar subproblems m-coupled, core/arithmetic.py:359-406)."""
+        from .arithmetic import ProductBase
+        from ..tools.exceptions import NonlinearOperatorError
+        try:
+            ncc = ncc_expr if isinstance(ncc_expr, Field) \
+                else ncc_expr.evaluate()
+            return ProductBase.polar_azimuth_varies(ncc, basis)
+        except NonlinearOperatorError:
+            raise
+        except Exception:
+            return True  # cannot classify: couple conservatively
+
     def walk(expr):
         if not isinstance(expr, Future):
             return
@@ -87,12 +104,20 @@ def _ncc_forced_coupled_axes(variables, equations):
                     if basis.dim != 1:
                         # multi-dim (curvilinear) NCC: angularly-constant
                         # radial profiles keep per-(m, ell) pencils;
-                        # theta-dependent data couples the colatitude axis
+                        # theta-dependent data couples the colatitude axis,
+                        # azimuthally-varying polar data couples m
                         colat = basis.first_axis + 1
                         if (basis.dim == 3 and axis == colat
                                 and basis.sub_separable(1)
                                 and couples_colatitude(ncc_sides[0], basis)):
                             forced.add(colat)
+                        from .polar import DiskBasis, AnnulusBasis
+                        az = basis.first_axis
+                        if (isinstance(basis, (DiskBasis, AnnulusBasis))
+                                and axis == az and basis.sub_separable(0)
+                                and couples_azimuth_polar(ncc_sides[0],
+                                                          basis)):
+                            forced.add(az)
                         continue
                     sub = axis - basis.first_axis
                     if basis.sub_separable(sub):
