@@ -130,7 +130,18 @@ def build_rb3d(Nx, Ny, Nz, dtype):
     return solver, 1e-3
 
 
-def build_shallow_water(Nphi, Ntheta, dtype):
+def build_shallow_water(Nphi, Ntheta, dtype, matsolver=None):
+    from dedalus_tpu.tools.config import config as _cfg
+    old_solver = _cfg["linear algebra"].get("MATRIX_SOLVER", "auto")
+    if matsolver is not None:
+        _cfg["linear algebra"]["MATRIX_SOLVER"] = matsolver
+    try:
+        return _build_shallow_water_inner(Nphi, Ntheta, dtype)
+    finally:
+        _cfg["linear algebra"]["MATRIX_SOLVER"] = old_solver
+
+
+def _build_shallow_water_inner(Nphi, Ntheta, dtype):
     import dedalus_tpu.public as d3
     # Simulation units (reference: examples/ivp_sphere_shallow_water/
     # shallow_water.py:24-40): nondimensionalized so R = 1, hour = 1.
@@ -232,6 +243,12 @@ CONFIGS = {
     "rb2048x1024": lambda dt_: build_rb(2048, 1024, dt_, matsolver="banded"),
     "rb3d_128": lambda dt_: build_rb3d(128, 128, 64, dt_),
     "sw_ell255": lambda dt_: build_shallow_water(512, 256, dt_),
+    # dense-forced twin: the banded path's sequential block scans may be
+    # latency-bound on TPU at this shape (round-4: 29x mode-stages/s gap
+    # vs the pure-matmul shear path); a (G,S,S) batched inverse turns
+    # every stage solve into one MXU matmul at ~2.4 GB of HBM
+    "sw_ell255_dense": lambda dt_: build_shallow_water(
+        512, 256, dt_, matsolver="BatchedInverse"),
     "rotconv32": lambda dt_: build_rotconv_ivp(64, 32, 32, dt_),
 }
 
