@@ -581,9 +581,21 @@ class ProductBase(Future):
         flat = grid.reshape((ncomp_n,) + grid.shape[tdim_n:])
         tol = 1e-10 * max(np.abs(flat).max(), 1e-300)
         moved = np.moveaxis(flat, 1 + az_axis, 1)
+        if ProductBase.polar_azimuth_varies(ncc, nb):
+            # azimuthally varying by the SAME classifier that forced the
+            # layout's m-coupling (subsystems._ncc_forced_coupled_axes):
+            # cross-m assembly onto the coupled pencil
+            if subproblem.group[az_axis] is not None:
+                raise NonlinearOperatorError(
+                    "Azimuthally-varying disk NCC reached a per-m pencil; "
+                    "the layout classifier should have coupled azimuth.")
+            return self._disk_coupled_ncc_matrix(subproblem, ncc, operand,
+                                                 moved)
         if np.abs(moved - moved[:, :1]).max() > tol:
             raise NonlinearOperatorError(
-                "LHS NCCs on disk bases must be angularly constant.")
+                "LHS NCCs on disk bases must be angularly constant "
+                "(sub-classifier azimuthal content at the data's own "
+                "precision is treated as roundoff).")
         profiles = moved[:, 0].reshape(ncomp_n, -1)   # (ncomp_n, Ngr2)
         U_in = recombination_matrix(tuple(operand.tensorsig), cs)
         U_out = recombination_matrix(tuple(self.tensorsig), cs)
@@ -618,6 +630,92 @@ class ProductBase(Future):
                     terms.append((E, descrs))
         return assemble_group_matrix(terms, operand.domain, operand.tshape,
                                      self.tshape, subproblem)
+
+    def _disk_coupled_ncc_matrix(self, subproblem, ncc, operand, moved):
+        """
+        m-COUPLED pencil matrix of an azimuthally-varying DISK NCC
+        (scalar data; reference: the geometry-generic NCC pipeline,
+        dedalus/core/arithmetic.py:359-406, whose polar tests are
+        axisymmetric). The NCC expands into azimuth modes j with radial
+        2x-quadrature profiles f_j(r); each mode contributes, per operand
+        spin component s,
+
+            A_j[slots(m_out), slots(m_in)] (x) F_s[m_out] diag(f_j) B_s[m_in]
+
+        with A_j the whole-axis azimuth convolution of basis mode j and
+        F/B the per-m Zernike quadrature stacks (the radial spaces are
+        m-dependent, so every coupled (m_out, m_in) pair gets its own
+        radial block). Scalar NCCs only; tensor OPERANDS require a
+        complex dtype (the real spin-pair recombination does not commute
+        with the azimuth convolution — same limit as the annulus path).
+        """
+        from .curvilinear import component_spins
+        nb = self._polar_spin_basis(ncc)
+        ob = self._polar_spin_basis(operand)
+        if ncc.tensorsig:
+            raise NonlinearOperatorError(
+                "Azimuthally-varying disk NCCs must be scalar fields; "
+                "move tensor-valued azimuthal backgrounds to the RHS.")
+        real = not is_complex_dtype(self.dtype)
+        if real and operand.tensorsig:
+            raise NonlinearOperatorError(
+                "Azimuthally-varying disk NCCs multiplying TENSOR "
+                "operands require a complex dtype (the real spin-pair "
+                "recombination does not commute with the azimuth "
+                "convolution); use a complex dtype or move the term to "
+                "the RHS.")
+        az_axis = nb.first_axis
+        out_basis = self.domain.bases[az_axis]
+        prof = moved[0].reshape(moved.shape[1], -1)       # (Ng_az, Ngr)
+        # azimuth-mode expansion through the NCC basis's own forward MMT
+        Af = np.asarray(nb.azimuth_basis._mult_forward_matrix(prof.shape[0]))
+        modes = Af @ prof                                  # (Naz_ncc, Ngr)
+        tol = (self._ncc_data_cutoff(modes)
+               * max(np.abs(modes).max(), 1e-300))
+        gs = ob.sub_group_shape(0)
+        G = ob.sub_n_groups(0)
+        Nr = ob.Nr
+        cs = ob.cs
+        s_in = component_spins(tuple(operand.tensorsig), cs) \
+            if operand.tensorsig else np.zeros(1, dtype=int)
+        ncomp = len(s_in)
+        naz = G * gs
+        dtype = complex if (not real) else float
+        # azimuth convolutions are spin-independent: build once per mode
+        conv = []                                    # [(j, A_j)]
+        for j in range(modes.shape[0]):
+            if np.abs(modes[j]).max() <= tol:
+                continue
+            e_j = np.zeros(nb.shape[0])
+            e_j[j] = 1.0
+            A_j = ob.azimuth_basis.multiplication_matrix(
+                e_j, nb.azimuth_basis)
+            conv.append((j, np.asarray(
+                A_j.todense() if sp.issparse(A_j) else A_j)))
+        spin_mats = {}
+        for s in sorted(set(int(v) for v in s_in)):
+            F = np.asarray(out_basis.radial_forward_stack(s, 2.0))
+            B = np.asarray(ob.radial_backward_stack(s, 2.0))
+            M = np.zeros((naz * Nr, naz * Nr), dtype=dtype)
+            for j, A_j in conv:
+                prof_j = modes[j]
+                for go in range(G):
+                    Rrow = None
+                    for gi in range(G):
+                        az2 = A_j[go * gs:(go + 1) * gs,
+                                  gi * gs:(gi + 1) * gs]
+                        if np.abs(az2).max() < 1e-14:
+                            continue
+                        if Rrow is None:
+                            Rrow = F[go] * prof_j[None, :]
+                        R = Rrow @ B[gi]                   # (Nr, Nr)
+                        blk = np.kron(az2, R)
+                        M[go * gs * Nr:(go + 1) * gs * Nr,
+                          gi * gs * Nr:(gi + 1) * gs * Nr] += blk
+            spin_mats[s] = sparsify(M, 1e-14)
+        # component-diagonal (scalar NCC): block-diagonal over components
+        return sp.csr_matrix(sp.block_diag(
+            [spin_mats[int(s_in[c])] for c in range(ncomp)], format="csr"))
 
     def _polar_tensor_ncc_matrix(self, subproblem, ncc, operand, ncc_index):
         """

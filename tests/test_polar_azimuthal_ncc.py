@@ -127,21 +127,55 @@ def test_annulus_azimuthal_ncc_lbvp():
     assert np.abs(u["g"] - u_true).max() < 1e-10
 
 
-def test_disk_azimuthal_ncc_unsupported_message():
-    """Disk m-coupled NCCs need per-(m_out, m_in) Zernike stacks — until
-    implemented the failure must be a clear NonlinearOperatorError, not a
-    wrong answer."""
-    from dedalus_tpu.tools.exceptions import NonlinearOperatorError
+def _disk(dtype, Nphi=12, Nr=8):
     coords = d3.PolarCoordinates("phi", "r")
-    dist = d3.Distributor(coords, dtype=np.float64)
-    disk = d3.DiskBasis(coords, shape=(12, 8), dtype=np.float64, radius=1.0)
+    dist = d3.Distributor(coords, dtype=dtype)
+    disk = d3.DiskBasis(coords, shape=(Nphi, Nr), dtype=dtype, radius=1.0,
+                        dealias=2)
+    return coords, dist, disk
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_disk_scalar_ncc_phi_r(dtype):
+    """f(phi, r) * u on the DISK: per-(m_out, m_in) Zernike radial blocks
+    under the whole-axis azimuth convolution."""
+    coords, dist, disk = _disk(dtype)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = 1.0 + 0.5 * x + 0.3 * (x * y - y)
+    u = dist.Field(name="u", bases=disk)
+    u["g"] = x ** 2 - y ** 2 + y + 0.5
+    _check_expr(dist, (f * u), u)
+
+
+def test_disk_scalar_ncc_times_vector_complex():
+    """Disk scalar azimuthal NCC times a vector operand (complex dtype)."""
+    coords, dist, disk = _disk(np.complex128)
+    phi, r = dist.local_grids(disk)
+    x, y = r * np.cos(phi), r * np.sin(phi)
+    f = dist.Field(name="f", bases=disk)
+    f["g"] = 1.0 + 0.4 * y
+    u = dist.VectorField(coords, name="u", bases=disk)
+    ux, uy = x * y, x ** 2 - y ** 2 + 0.5
+    u["g"] = np.array([-np.sin(phi) * ux + np.cos(phi) * uy,
+                       np.cos(phi) * ux + np.sin(phi) * uy])
+    _check_expr(dist, (f * u), u)
+
+
+def test_disk_vector_real_dtype_clear_error():
+    """REAL-dtype tensor operands on the disk: clear failure (same
+    recombination/convolution non-commutation as the annulus)."""
+    from dedalus_tpu.tools.exceptions import NonlinearOperatorError
+    coords, dist, disk = _disk(np.float64)
     phi, r = dist.local_grids(disk)
     f = dist.Field(name="f", bases=disk)
     f["g"] = 1.0 + 0.5 * r * np.cos(phi)
-    u = dist.Field(name="u", bases=disk)
-    u["g"] = r * np.sin(phi) + 1.0
+    u = dist.VectorField(coords, name="u", bases=disk)
+    u["g"] = np.array([np.sin(phi) * r, np.cos(phi) * r])
     expr = f * u
-    eq = {"domain": expr.domain, "tensorsig": (), "L": expr}
+    eq = {"domain": expr.domain, "tensorsig": tuple(expr.tensorsig),
+          "L": expr}
     layout = PencilLayout(dist, [u], [eq])
     sps = build_subproblems(layout)
     with pytest.raises(NonlinearOperatorError):
