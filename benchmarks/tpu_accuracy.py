@@ -126,6 +126,25 @@ def dd_sweep(record):
         record["dd_slowdown_vs_f32"] = round(f32_sps / dd_sps, 2)
         mark(f"f32 KdV {f32_sps:.1f} steps/s -> dd slowdown "
              f"{record['dd_slowdown_vs_f32']}x")
+
+        # flagship 2-D problem through the dd path (vector fields, taus,
+        # LHS NCCs, DotProduct RHS, RK222)
+        from dedalus_tpu.extras.bench_problems import build_rb_solver
+        rb_solver, _b = build_rb_solver(64, 16, np.float64)
+        rb_runner = maybe_dd_runner(rb_solver) or DDIVPRunner(rb_solver)
+        rb_runner.sync_state()
+        rb_runner.step(1e-3)
+        rb_runner.step(1e-3)
+        t0 = time.time()
+        rb_steps = 50
+        for _ in range(rb_steps):
+            rb_runner.step(1e-3)
+        record["dd_rb64_steps_per_sec"] = round(
+            rb_steps / (time.time() - t0), 2)
+        rb_finite = bool(np.all(np.isfinite(rb_runner.state_f64())))
+        record["dd_rb64_finite"] = rb_finite
+        mark(f"dd RB 64x16 {record['dd_rb64_steps_per_sec']} steps/s, "
+             f"finite={rb_finite}")
     except Exception as exc:
         record["dd_error"] = repr(exc)[:300]
         mark(f"dd sweep failed: {exc!r}")

@@ -1655,16 +1655,22 @@ class DotProduct(ProductBase):
                         T[al * nr_ + ro, j * nr_ + ro, al * d + j] = 1.0
         return T
 
-    def ev_impl(self, ctx):
-        a, b = self.args
-        da = ev(a, ctx, "g")
-        db = ev(b, ctx, "g")
-        ta, tb = a.tdim, b.tdim
-        # subscripts: left tensor letters + contraction + ellipsis
+    @staticmethod
+    def contraction_subscripts(ta, tb):
+        """einsum subscripts contracting the left factor's LAST tensor
+        index with the right factor's FIRST (shared with the dd
+        interpreter, core/ddstep.py)."""
         letters = "abcdefghijklm"
         l_sub = letters[:ta - 1] + "z" + "..."
         r_sub = "z" + letters[ta - 1:ta - 1 + tb - 1] + "..."
         o_sub = letters[:ta - 1] + letters[ta - 1:ta - 1 + tb - 1] + "..."
+        return l_sub, r_sub, o_sub
+
+    def ev_impl(self, ctx):
+        a, b = self.args
+        da = ev(a, ctx, "g")
+        db = ev(b, ctx, "g")
+        l_sub, r_sub, o_sub = self.contraction_subscripts(a.tdim, b.tdim)
         return jnp.einsum(f"{l_sub},{r_sub}->{o_sub}", da, db)
 
     def expression_matrices(self, subproblem, vars, **kw):

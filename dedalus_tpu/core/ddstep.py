@@ -21,9 +21,12 @@ arithmetic (libraries/doubledouble.py):
 Selection: `InitialValueSolver` auto-wires a runner for float64 pencils
 on a TPU backend under `[execution] EMULATED_F64 = auto`, falling back
 to native XLA f64 when construction raises `DDUnsupportedError`
-(non-multistep schemes, non-dense pencil paths, RHS nodes outside the
-dd set — validated by an abstract trace at construction). Cartesian
-scalar/vector problems on Fourier/Jacobi bases are covered;
+(non-dense pencil paths, RHS nodes outside the dd set — validated by an
+abstract trace at construction). Multistep AND Runge-Kutta IMEX schemes
+are covered; the dd interpreter handles linear operators (full/blocks
+descriptor terms and tensor factors), Add, pointwise and dot products —
+enough for Cartesian scalar/vector problems through full 2-D
+Rayleigh-Benard (tests/test_ddstep.py tracks native f64 at ~1e-10).
 `maybe_dd_runner(solver)` is the explicit hook with the same rules.
 """
 
@@ -167,7 +170,15 @@ def dd_apply_term(data, tensor_factor, axis_descrs, tshape_in, tshape_out):
                 f"dd evaluation of '{kind}' operator terms (curvilinear "
                 "group stacks) is not supported.")
     if tensor_factor is not None:
-        raise DDUnsupportedError("dd evaluation of tensor-factor operators")
+        # (ncomp_out, ncomp_in) host factor on the flattened tensor axes;
+        # small and exact in f64 value space
+        from ..libraries.doubledouble import _to64, _from64
+        factor = np.asarray(tensor_factor, dtype=np.float64)
+        spatial = out.hi.shape[tdim_in:]
+        nin = int(np.prod(tshape_in, dtype=int)) if tshape_in else 1
+        v = _to64(out).reshape((nin,) + spatial)
+        w = jnp.tensordot(jnp.asarray(factor), v, axes=(1, 0))
+        return _from64(w.reshape(tuple(tshape_out) + spatial))
     if tuple(tshape_in) != tuple(tshape_out):
         raise DDUnsupportedError("dd tensor shape change")
     return out
@@ -286,6 +297,19 @@ def _dd_ev_impl(node, ctx):
         sh = da.hi.shape[:ta] + (1,) * tb + da.hi.shape[ta:]
         return dd_mul(DD(da.hi.reshape(sh), da.lo.reshape(sh)), db)
 
+    from .arithmetic import DotProduct
+    if isinstance(node, DotProduct):
+        # grid-space contraction over one tensor index; the contraction
+        # dim is tiny (coordinate dimension), exact in f64 value space
+        from ..libraries.doubledouble import _to64, _from64
+        a, b = node.args
+        da = dd_ev(a, ctx, "g")
+        db = dd_ev(b, ctx, "g")
+        l_sub, r_sub, o_sub = DotProduct.contraction_subscripts(
+            a.tdim, b.tdim)
+        return _from64(jnp.einsum(f"{l_sub},{r_sub}->{o_sub}",
+                                  _to64(da), _to64(db)))
+
     if isinstance(node, LinearOperator):
         data = dd_ev(node.operand, ctx, "c")
         total = None
@@ -314,22 +338,27 @@ class DDIVPRunner:
             runner.step(dt)
         runner.push_state()                 # write dd state back to fields
 
-    Supports MultistepIMEX schemes (the scheme class is taken from the
-    solver's timestepper). The wrapped solver is left untouched except by
-    push_state().
+    Supports MultistepIMEX and RungeKuttaIMEX schemes (the scheme class
+    is taken from the solver's timestepper). The wrapped solver is left
+    untouched except by push_state().
     """
 
     def __init__(self, solver, refine=2):
-        from .timesteppers import MultistepIMEX
+        from .timesteppers import MultistepIMEX, RungeKuttaIMEX
         self.solver = solver
         self.refine = int(refine)
         ts = solver.timestepper
-        if not isinstance(ts, MultistepIMEX):
+        if isinstance(ts, MultistepIMEX):
+            self.kind = "multistep"
+            self.steps = ts.steps
+        elif isinstance(ts, RungeKuttaIMEX):
+            self.kind = "rk"
+            self.steps = 1
+        else:
             raise DDUnsupportedError(
-                "DDIVPRunner supports multistep IMEX schemes "
-                f"(got {type(ts).__name__}).")
+                "DDIVPRunner supports multistep and Runge-Kutta IMEX "
+                f"schemes (got {type(ts).__name__}).")
         self.scheme = ts
-        self.steps = ts.steps
         ops = solver.ops
         if getattr(ops, "kind", "dense") != "dense":
             raise DDUnsupportedError(
@@ -342,7 +371,8 @@ class DDIVPRunner:
         self.shape = (G, S)
         self.mask_np = np.asarray(solver.valid_row_mask, dtype=np.float32)
         self.X = self._gather_dd()
-        zero = dd_zeros((self.steps, G, S))
+        zero = (dd_zeros((self.steps, G, S)) if self.kind == "multistep"
+                else None)
         self.F_hist = zero
         self.MX_hist = zero
         self.LX_hist = zero
@@ -393,12 +423,13 @@ class DDIVPRunner:
     def reset_history(self, sim_time):
         """Restart the multistep ramp from `sim_time` with the current
         state (checkpoint restart / discontinuous state edit: the stored
-        histories predate the new state)."""
-        G, S = self.shape
-        zero = dd_zeros((self.steps, G, S))
-        self.F_hist = zero
-        self.MX_hist = zero
-        self.LX_hist = zero
+        histories predate the new state; RK keeps no history)."""
+        if self.kind == "multistep":
+            G, S = self.shape
+            zero = dd_zeros((self.steps, G, S))
+            self.F_hist = zero
+            self.MX_hist = zero
+            self.LX_hist = zero
         self.dt_hist = []
         self.iteration = 0
         self.sim_time = float(sim_time)
@@ -554,8 +585,44 @@ class DDIVPRunner:
             Xn = solve_ir(lhs, RHS)
             return Xn, F_hist, MX_hist, LX_hist
 
+        def rk_step_body(X, t, dt, lhs_list, extra_dd):
+            """One IMEX Runge-Kutta step in dd (mirrors the native
+            RungeKuttaIMEX.step_body; tableau entries are exact dd
+            constants closed over — they never change). lhs_list holds
+            one factored LHS per stage (shared auxes alias upstream)."""
+            scheme = self.scheme
+            s = scheme.stages
+            A = np.asarray(scheme.A, dtype=np.float64)
+            H = np.asarray(scheme.H, dtype=np.float64)
+            cvec = np.asarray(scheme.c, dtype=np.float64)
+            MX0 = mx(M_planes, X)
+            Fs, LXs = [], []
+            Xi = X
+            for i in range(1, s + 1):
+                ti = dd_add(t, dd_mul(dt, _dd_scalar(cvec[i - 1])))
+                LXs.append(mx(L_planes, Xi))
+                Fs.append(eval_F_dd(Xi, ti, extra_dd))
+                RHS = MX0
+                for j in range(i):
+                    if A[i, j] != 0.0:
+                        RHS = dd_add(RHS, dd_mul(
+                            dd_mul(dt, _dd_scalar(A[i, j])), Fs[j]))
+                    if H[i, j] != 0.0:
+                        RHS = dd_sub(RHS, dd_mul(
+                            dd_mul(dt, _dd_scalar(H[i, j])), LXs[j]))
+                Xi = solve_ir(lhs_list[i - 1], RHS)
+            return Xi
+
+        def rk_factor(dts):
+            """One factored LHS per UNIQUE implicit diagonal (dts: dd
+            scalars dt*H[i,i] per unique diagonal)."""
+            one = _dd_scalar(1.0)
+            return [factor(one, dth) for dth in dts]
+
         self._factor = lifted_jit(factor)
         self._step = lifted_jit(step_body)
+        self._rk_factor = lifted_jit(rk_factor)
+        self._rk_step = lifted_jit(rk_step_body)
         # validate the RHS tree's dd support NOW (abstract trace): an
         # unsupported node must surface at construction, where the
         # solver's auto-wiring can fall back to native f64 — not at the
@@ -570,6 +637,8 @@ class DDIVPRunner:
         dt = float(dt)
         if not np.isfinite(dt):
             raise ValueError("Invalid timestep.")
+        if self.kind == "rk":
+            return self._rk_advance(dt)
         self.dt_hist = ([dt] + self.dt_hist)[: self.steps]
         order = min(self.iteration + 1, self.steps)
         a, b, c = self.scheme.compute_coefficients(self.dt_hist, order)
@@ -594,6 +663,24 @@ class DDIVPRunner:
         self.X, self.F_hist, self.MX_hist, self.LX_hist = self._step(
             self.X, t_dd, self.F_hist, self.MX_hist, self.LX_hist,
             self._lhs, a_dd, b_dd, c_dd, self._extras_dd())
+        self.sim_time += dt
+        self.iteration += 1
+
+    def _rk_advance(self, dt):
+        scheme = self.scheme
+        H_diag = [float(scheme.H[i, i]) for i in range(1, scheme.stages + 1)]
+        uniq = sorted(set(H_diag))
+        key = ("rk", round(dt, 14))
+        if key != self._lhs_key:
+            # dt * h split exactly once on host (f64), then into dd
+            self._lhs = self._rk_factor([_dd_scalar(dt * h) for h in uniq])
+            self._lhs_key = key
+        lhs_list = [self._lhs[uniq.index(h)] for h in H_diag]
+        t_dd = DD(jnp.float32(self.sim_time),
+                  jnp.float32(self.sim_time
+                              - float(np.float32(self.sim_time))))
+        self.X = self._rk_step(self.X, t_dd, _dd_scalar(dt), lhs_list,
+                               self._extras_dd())
         self.sim_time += dt
         self.iteration += 1
 
