@@ -103,9 +103,12 @@ def dd_sweep(record):
         n_steps = 200
         runner.step(5e-4)            # compile (factor + step program)
         runner.step(5e-4)            # order-2 factor (ramp) before timing
+        runner.step_many(n_steps, 5e-4)   # block compile
+        import jax as _jax
+        _jax.block_until_ready(runner.X.hi)
         t0 = time.time()
-        for _ in range(n_steps):
-            runner.step(5e-4)
+        runner.step_many(n_steps, 5e-4)
+        _jax.block_until_ready(runner.X.hi)
         dd_sps = n_steps / (time.time() - t0)
         runner.push_state()
         mass1 = float(np.mean(u64["g"]))
@@ -118,9 +121,11 @@ def dd_sweep(record):
         # dispatch like the dd runner, for a like-for-like slowdown)
         solver32, _ = build_kdv(N, np.float32)
         solver32.step(5e-4)
+        solver32.step_many(n_steps, 5e-4)   # block compile
+        solver32.X.block_until_ready()
         t0 = time.time()
-        for _ in range(n_steps):
-            solver32.step(5e-4)
+        solver32.step_many(n_steps, 5e-4)
+        solver32.X.block_until_ready()
         f32_sps = n_steps / (time.time() - t0)
         record["f32_kdv_steps_per_sec"] = round(f32_sps, 2)
         record["dd_slowdown_vs_f32"] = round(f32_sps / dd_sps, 2)
@@ -135,10 +140,13 @@ def dd_sweep(record):
         rb_runner.sync_state()
         rb_runner.step(1e-3)
         rb_runner.step(1e-3)
-        t0 = time.time()
         rb_steps = 50
-        for _ in range(rb_steps):
-            rb_runner.step(1e-3)
+        rb_runner.step_many(rb_steps, 1e-3)   # block compile
+        import jax as _jax2
+        _jax2.block_until_ready(rb_runner.X.hi)
+        t0 = time.time()
+        rb_runner.step_many(rb_steps, 1e-3)
+        _jax2.block_until_ready(rb_runner.X.hi)
         record["dd_rb64_steps_per_sec"] = round(
             rb_steps / (time.time() - t0), 2)
         rb_finite = bool(np.all(np.isfinite(rb_runner.state_f64())))

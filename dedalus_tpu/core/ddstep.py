@@ -619,10 +619,33 @@ class DDIVPRunner:
             one = _dd_scalar(1.0)
             return [factor(one, dth) for dth in dts]
 
+        def step_n_body(X, t, F_hist, MX_hist, LX_hist, lhs, a, b, c,
+                        extra_dd, dt_dd, n):
+            """n constant-dt multistep steps in ONE lax.scan dispatch
+            (post-ramp: coefficients are scan-invariant)."""
+            def body(carry, _):
+                Xc, tc, F, MX, LX = carry
+                Xn, F2, MX2, LX2 = step_body(Xc, tc, F, MX, LX, lhs,
+                                             a, b, c, extra_dd)
+                return (Xn, dd_add(tc, dt_dd), F2, MX2, LX2), None
+            carry, _ = jax.lax.scan(
+                body, (X, t, F_hist, MX_hist, LX_hist), None, length=n)
+            return carry
+
+        def rk_step_n_body(X, t, dt, lhs_list, extra_dd, n):
+            def body(carry, _):
+                Xc, tc = carry
+                Xn = rk_step_body(Xc, tc, dt, lhs_list, extra_dd)
+                return (Xn, dd_add(tc, dt)), None
+            carry, _ = jax.lax.scan(body, (X, t), None, length=n)
+            return carry
+
         self._factor = lifted_jit(factor)
         self._step = lifted_jit(step_body)
+        self._step_n = lifted_jit(step_n_body, static_argnums=(11,))
         self._rk_factor = lifted_jit(rk_factor)
         self._rk_step = lifted_jit(rk_step_body)
+        self._rk_step_n = lifted_jit(rk_step_n_body, static_argnums=(5,))
         # validate the RHS tree's dd support NOW (abstract trace): an
         # unsupported node must surface at construction, where the
         # solver's auto-wiring can fall back to native f64 — not at the
@@ -666,19 +689,65 @@ class DDIVPRunner:
         self.sim_time += dt
         self.iteration += 1
 
-    def _rk_advance(self, dt):
+    def step_many(self, n, dt):
+        """Advance n constant-dt steps with ONE device dispatch per block
+        (lax.scan; small problems are host-latency bound at one dispatch
+        per step). Multistep startup-ramp steps run individually first."""
+        n = int(n)
+        dt = float(dt)
+        if n <= 0:
+            return
+        if self.kind == "rk":
+            lhs_list, t_dd = self._rk_prepare(dt)
+            self.X, _ = self._rk_step_n(
+                self.X, t_dd, _dd_scalar(dt), lhs_list,
+                self._extras_dd(), n)
+            self.sim_time += n * dt
+            self.iteration += n
+            return
+        # ramp to steady order, then scan
+        while n > 0 and (self.iteration < self.steps
+                         or self.dt_hist != [dt] * self.steps):
+            self.step(dt)
+            n -= 1
+        if n <= 0:
+            return
+        a, b, c = self.scheme.compute_coefficients([dt] * self.steps,
+                                                   self.steps)
+        a0, b0 = float(a[0]), float(b[0])
+        key = (round(a0, 14), round(b0, 14))
+        if key != self._lhs_key:
+            self._lhs = self._factor(_dd_scalar(a0), _dd_scalar(b0))
+            self._lhs_key = key
+        t_dd = DD(jnp.float32(self.sim_time),
+                  jnp.float32(self.sim_time
+                              - float(np.float32(self.sim_time))))
+        carry = self._step_n(
+            self.X, t_dd, self.F_hist, self.MX_hist, self.LX_hist,
+            self._lhs, _dd_vector(np.asarray(a, float)),
+            _dd_vector(np.asarray(b, float)),
+            _dd_vector(np.asarray(c, float)), self._extras_dd(),
+            _dd_scalar(dt), n)
+        self.X, _, self.F_hist, self.MX_hist, self.LX_hist = carry
+        self.sim_time += n * dt
+        self.iteration += n
+
+    def _rk_prepare(self, dt):
         scheme = self.scheme
         H_diag = [float(scheme.H[i, i]) for i in range(1, scheme.stages + 1)]
         uniq = sorted(set(H_diag))
         key = ("rk", round(dt, 14))
         if key != self._lhs_key:
-            # dt * h split exactly once on host (f64), then into dd
             self._lhs = self._rk_factor([_dd_scalar(dt * h) for h in uniq])
             self._lhs_key = key
         lhs_list = [self._lhs[uniq.index(h)] for h in H_diag]
         t_dd = DD(jnp.float32(self.sim_time),
                   jnp.float32(self.sim_time
                               - float(np.float32(self.sim_time))))
+        return lhs_list, t_dd
+
+    def _rk_advance(self, dt):
+        lhs_list, t_dd = self._rk_prepare(dt)
         self.X = self._rk_step(self.X, t_dd, _dd_scalar(dt), lhs_list,
                                self._extras_dd())
         self.sim_time += dt
