@@ -117,9 +117,12 @@ def dd_sweep(record):
         mark(f"dd KdV mass drift {record['dd_kdv_mass_drift']:.3e}, "
              f"{dd_sps:.1f} steps/s")
 
-        # f32 reference cost on the same problem/scheme (per-step host
-        # dispatch like the dd runner, for a like-for-like slowdown)
+        # f32 reference cost on the same problem/scheme, measured as the
+        # same scan-block dispatch the dd runner uses (ramp consumed
+        # BEFORE the warm-up block so the timed block's scan length
+        # matches and no compile lands inside the timing window)
         solver32, _ = build_kdv(N, np.float32)
+        solver32.step(5e-4)
         solver32.step(5e-4)
         solver32.step_many(n_steps, 5e-4)   # block compile
         solver32.X.block_until_ready()

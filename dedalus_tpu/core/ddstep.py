@@ -656,6 +656,22 @@ class DDIVPRunner:
 
     # -------------------------------------------------------------- stepping
 
+    def _lhs_for(self, a0, b0):
+        """Factored LHS for a0*M + b0*L, cached on the rounded-coefficient
+        key (native pattern, timesteppers.py: float noise in recomputed
+        coefficients must not trigger spurious refactors)."""
+        key = (round(float(a0), 14), round(float(b0), 14))
+        if key != self._lhs_key:
+            self._lhs = self._factor(_dd_scalar(a0), _dd_scalar(b0))
+            self._lhs_key = key
+        return self._lhs
+
+    def _t_dd(self):
+        """Current sim_time as an exact dd scalar."""
+        return DD(jnp.float32(self.sim_time),
+                  jnp.float32(self.sim_time
+                              - float(np.float32(self.sim_time))))
+
     def step(self, dt):
         dt = float(dt)
         if not np.isfinite(dt):
@@ -671,21 +687,11 @@ class DDIVPRunner:
         a = np.concatenate([np.asarray(a, float), np.zeros(s + 1 - len(a))])
         b = np.concatenate([np.asarray(b, float), np.zeros(s + 1 - len(b))])
         c = np.concatenate([np.asarray(c, float), np.zeros(s - len(c))])
-        a0, b0 = float(a[0]), float(b[0])
-        # rounded key (native pattern, timesteppers.py): float noise in
-        # recomputed coefficients must not trigger spurious refactors
-        key = (round(a0, 14), round(b0, 14))
-        if key != self._lhs_key:
-            self._lhs = self._factor(_dd_scalar(a0), _dd_scalar(b0))
-            self._lhs_key = key
-        a_dd = _dd_vector(a)
-        b_dd = _dd_vector(b)
-        c_dd = _dd_vector(c)
-        t_dd = DD(jnp.float32(self.sim_time),
-                  jnp.float32(self.sim_time - float(np.float32(self.sim_time))))
+        lhs = self._lhs_for(a[0], b[0])
         self.X, self.F_hist, self.MX_hist, self.LX_hist = self._step(
-            self.X, t_dd, self.F_hist, self.MX_hist, self.LX_hist,
-            self._lhs, a_dd, b_dd, c_dd, self._extras_dd())
+            self.X, self._t_dd(), self.F_hist, self.MX_hist, self.LX_hist,
+            lhs, _dd_vector(a), _dd_vector(b), _dd_vector(c),
+            self._extras_dd())
         self.sim_time += dt
         self.iteration += 1
 
@@ -695,6 +701,8 @@ class DDIVPRunner:
         per step). Multistep startup-ramp steps run individually first."""
         n = int(n)
         dt = float(dt)
+        if not np.isfinite(dt):
+            raise ValueError("Invalid timestep.")
         if n <= 0:
             return
         if self.kind == "rk":
@@ -714,17 +722,10 @@ class DDIVPRunner:
             return
         a, b, c = self.scheme.compute_coefficients([dt] * self.steps,
                                                    self.steps)
-        a0, b0 = float(a[0]), float(b[0])
-        key = (round(a0, 14), round(b0, 14))
-        if key != self._lhs_key:
-            self._lhs = self._factor(_dd_scalar(a0), _dd_scalar(b0))
-            self._lhs_key = key
-        t_dd = DD(jnp.float32(self.sim_time),
-                  jnp.float32(self.sim_time
-                              - float(np.float32(self.sim_time))))
+        lhs = self._lhs_for(a[0], b[0])
         carry = self._step_n(
-            self.X, t_dd, self.F_hist, self.MX_hist, self.LX_hist,
-            self._lhs, _dd_vector(np.asarray(a, float)),
+            self.X, self._t_dd(), self.F_hist, self.MX_hist, self.LX_hist,
+            lhs, _dd_vector(np.asarray(a, float)),
             _dd_vector(np.asarray(b, float)),
             _dd_vector(np.asarray(c, float)), self._extras_dd(),
             _dd_scalar(dt), n)
@@ -741,10 +742,7 @@ class DDIVPRunner:
             self._lhs = self._rk_factor([_dd_scalar(dt * h) for h in uniq])
             self._lhs_key = key
         lhs_list = [self._lhs[uniq.index(h)] for h in H_diag]
-        t_dd = DD(jnp.float32(self.sim_time),
-                  jnp.float32(self.sim_time
-                              - float(np.float32(self.sim_time))))
-        return lhs_list, t_dd
+        return lhs_list, self._t_dd()
 
     def _rk_advance(self, dt):
         lhs_list, t_dd = self._rk_prepare(dt)

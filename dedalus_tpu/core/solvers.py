@@ -601,7 +601,9 @@ class InitialValueSolver(SolverBase):
             dd.reset_history(self.sim_time)
         elif dd.sim_time != self.sim_time:
             dd.sim_time = self.sim_time
-        for _ in range(n):
+        if n > 1:
+            dd.step_many(n, dt)   # one lax.scan dispatch per block
+        else:
             dd.step(dt)
         self.X = dd.X.hi   # f32 view: finite checks, harness inspection
         self.sim_time = dd.sim_time
@@ -699,8 +701,7 @@ class InitialValueSolver(SolverBase):
         if self.iteration <= self.warmup_iterations < self.iteration + n:
             self._end_warmup()
         if self._dd is not None:
-            # per-step dispatch (no scan block yet on the dd path)
-            self._dd_advance(n, dt)
+            self._dd_advance(n, dt)   # blocked via DDIVPRunner.step_many
             return
         if self.fields_dirty():
             self.X = self.gather_fields()
