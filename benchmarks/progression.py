@@ -130,15 +130,19 @@ def build_rb3d(Nx, Ny, Nz, dtype):
     return solver, 1e-3
 
 
-def build_shallow_water(Nphi, Ntheta, dtype, matsolver=None):
+def build_shallow_water(Nphi, Ntheta, dtype, matsolver=None, min_q=None):
     from dedalus_tpu.tools.config import config as _cfg
     old_solver = _cfg["linear algebra"].get("MATRIX_SOLVER", "auto")
+    old_q = _cfg["linear algebra"].get("BANDED_MIN_Q", "0")
     if matsolver is not None:
         _cfg["linear algebra"]["MATRIX_SOLVER"] = matsolver
+    if min_q is not None:
+        _cfg["linear algebra"]["BANDED_MIN_Q"] = str(min_q)
     try:
         return _build_shallow_water_inner(Nphi, Ntheta, dtype)
     finally:
         _cfg["linear algebra"]["MATRIX_SOLVER"] = old_solver
+        _cfg["linear algebra"]["BANDED_MIN_Q"] = old_q
 
 
 def _build_shallow_water_inner(Nphi, Ntheta, dtype):
@@ -249,6 +253,11 @@ CONFIGS = {
     # every stage solve into one MXU matmul at ~2.4 GB of HBM
     "sw_ell255_dense": lambda dt_: build_shallow_water(
         512, 256, dt_, matsolver="BatchedInverse"),
+    # re-blocked banded twin: q>=128 cuts the solve scans to ~1/8 the
+    # sequential steps (latency-bound on TPU; [linear algebra]
+    # BANDED_MIN_Q)
+    "sw_ell255_q128": lambda dt_: build_shallow_water(
+        512, 256, dt_, matsolver="banded", min_q=128),
     "rotconv32": lambda dt_: build_rotconv_ivp(64, 32, 32, dt_),
 }
 

@@ -82,8 +82,8 @@ class BandedMatrix:
     """
 
     def __init__(self, bands, Vt, dsel):
-        self.bands = bands    # (G, len(dsel), n_pad)
-        self.Vt = Vt          # (G, t, n_pad) or None
+        self.bands = bands    # (G, len(dsel), n_store) — ASSEMBLED width
+        self.Vt = Vt          # (G, t, n_store) or None
         self.dsel = tuple(int(d) for d in dsel)
 
     def tree_flatten(self):
@@ -100,9 +100,12 @@ class BandedOps:
     Banded + pinned-row pencil operators.
 
     Host representation per matrix name (core/subsystems.build_banded_arrays):
-        bands : (G, D, n_pad)  diagonals of the matched (true-banded) rows,
-                offsets -kl..ku; bands[g, d, p] = A'[g, p, p + d - kl]
-        Vt    : (G, t, n_pad)  true content of the pinned rows
+        bands : (G, D, n_store)  diagonals of the matched (true-banded)
+                rows, offsets -kl..ku; bands[g, d, p] = A'[g, p, p+d-kl].
+                n_store is the ASSEMBLED width (structural NB*q); factor
+                transients and solves run at the re-blocked width n_pad
+                >= n_store when BANDED_MIN_Q raises q.
+        Vt    : (G, t, n_store)  true content of the pinned rows
 
     with A' the row/column-permuted matrix. The represented matrix is
     A' = B + sum_i e_{p_i} Vt_i^T where B carries zero rows at the pin
@@ -126,10 +129,19 @@ class BandedOps:
         # Chosen at factor time (needs G and the dtype); solve re-derives
         # the count from the aux's shapes — this attr is diagnostic only.
         self._g_chunks = 1
-        self.q = st.q
-        self.NB = st.NB
+        # Re-blocking: the factorization/solve scans run NB sequential
+        # steps; on TPU each step is latency-bound, so BANDED_MIN_Q
+        # re-blocks the SAME banded lattice with larger q (fewer, fatter
+        # scan steps feeding the MXU). The band STORAGE keeps its
+        # assembled width (n_store); factor transients pad to the
+        # re-blocked width. q only has to satisfy kl, ku <= q, which
+        # growing q preserves.
+        min_q = int(config["linear algebra"].get("BANDED_MIN_Q", "0"))
         self.n = st.S                  # true system size
-        self.n_pad = st.NB * st.q
+        self.n_store = st.NB * st.q    # band-array width as assembled
+        self.q = max(st.q, min_q) if min_q else st.q
+        self.n_pad = -(-self.n_store // self.q) * self.q
+        self.NB = self.n_pad // self.q
         self.t = st.t_pins
         self.kl = st.kl
         self.ku = st.ku
@@ -175,12 +187,13 @@ class BandedOps:
     def densify_host(self, host_arrs, g):
         """Reconstruct the original-ordering dense (S, S) matrix (host)."""
         S = self.n
-        Ap = np.zeros((self.n_pad, self.n_pad), dtype=host_arrs["bands"].dtype)
+        W = host_arrs["bands"].shape[-1]
+        Ap = np.zeros((W, W), dtype=host_arrs["bands"].dtype)
         bands = host_arrs["bands"][g]
         dsel = host_arrs.get("dsel", range(self.nd))
         for i, d in enumerate(dsel):
             off = d - self.kl
-            rr = np.arange(max(0, -off), min(self.n_pad, self.n_pad - off))
+            rr = np.arange(max(0, -off), min(W, W - off))
             Ap[rr, rr + off] = bands[i, rr]
         if self.t:
             Ap[self.pin_pos, :] += host_arrs["Vt"][g]
@@ -198,27 +211,28 @@ class BandedOps:
         G = A.bands.shape[0]
         dtype = A.bands.dtype
         full = jnp.zeros((G, self.nd, self.n_pad), dtype=dtype)
-        full = full.at[:, np.asarray(A.dsel), :].set(a * A.bands)
-        if self.t:
-            Vt = (a * A.Vt if A.Vt is not None
-                  else jnp.zeros((G, self.t, self.n_pad), dtype=dtype))
-        else:
-            Vt = jnp.zeros((G, 0, self.n_pad), dtype=dtype)
+        full = full.at[:, np.asarray(A.dsel), :self.n_store].set(a * A.bands)
+        Vt = jnp.zeros((G, self.t, self.n_pad), dtype=dtype)
+        if self.t and A.Vt is not None:
+            Vt = Vt.at[:, :, :self.n_store].set(a * A.Vt)
         return full, Vt
 
     def _band_mv(self, bands, dsel, x):
-        """y[g, p] = sum_{d in dsel} bands[g, i, p] * x[g, p + d - kl]."""
+        """y[g, p] = sum_{d in dsel} bands[g, i, p] * x[g, p + d - kl];
+        width follows the band ARRAY (assembled storage, not the
+        re-blocked factor width)."""
+        width = bands.shape[-1]
         xpad = jnp.pad(x, ((0, 0), (self.kl, self.ku)))
         y = jnp.zeros_like(x)
         for i, d in enumerate(dsel):
             y = y + bands[:, i, :] * jax.lax.slice_in_dim(
-                xpad, d, d + self.n_pad, axis=1)
+                xpad, d, d + width, axis=1)
         return y
 
     def matvec(self, A, X):
         """Full A @ X in the ORIGINAL slot ordering; X (G, S)."""
         xp = X[:, self.col_perm]
-        xp = jnp.pad(xp, ((0, 0), (0, self.n_pad - self.n)))
+        xp = jnp.pad(xp, ((0, 0), (0, A.bands.shape[-1] - self.n)))
         yp = self._band_mv(A.bands, A.dsel, xp)
         if self.t and A.Vt is not None:
             pin_vals = jnp.einsum("gtn,gn->gt", A.Vt, xp)
@@ -446,6 +460,21 @@ class BandedOps:
                                (bands_c, Vt_c))
         return self._aux_from_core(core, refine_aux)
 
+    def _combine_ml(self, mb, lb, mv, lv, g, a, b, dM, dL, dtype):
+        """a*M + b*L as a full-lattice (bands, Vt) pair at the re-blocked
+        factor width (the SINGLE implementation shared by the fused and
+        incremental factor paths; inputs are assembled-width slabs)."""
+        ns = self.n_store
+        bands = jnp.zeros((g, self.nd, self.n_pad), dtype=dtype)
+        bands = bands.at[:, dM, :ns].add(a * mb)
+        bands = bands.at[:, dL, :ns].add(b * lb)
+        Vt = jnp.zeros((g, self.t, self.n_pad), dtype=dtype)
+        if mv is not None:
+            Vt = Vt.at[:, :, :ns].add(a * mv)
+        if lv is not None:
+            Vt = Vt.at[:, :, :ns].add(b * lv)
+        return bands, Vt
+
     def factor(self, A):
         """Factor a matrix already resident in banded storage."""
         bands, Vt = self.expand(A)
@@ -464,16 +493,10 @@ class BandedOps:
         dM = np.asarray(M.dsel)
         dL = np.asarray(L.dsel)
 
+        ns = self.n_store
+
         def combine(mb, lb, mv, lv, g):
-            bands = jnp.zeros((g, self.nd, self.n_pad), dtype=dtype)
-            bands = bands.at[:, dM, :].add(a * mb)
-            bands = bands.at[:, dL, :].add(b * lb)
-            Vt = jnp.zeros((g, self.t, self.n_pad), dtype=dtype)
-            if mv is not None:
-                Vt = Vt + a * mv
-            if lv is not None:
-                Vt = Vt + b * lv
-            return bands, Vt
+            return self._combine_ml(mb, lb, mv, lv, g, a, b, dM, dL, dtype)
 
         # M and L themselves are NOT stored in the aux: the jitted factor
         # would return copies of both full band stores; the refinement
@@ -485,14 +508,14 @@ class BandedOps:
             G_pad = C * Gc
             has_mv = M.Vt is not None
             has_lv = L.Vt is not None
-            xs = [self._pad_groups(M.bands, G_pad).reshape(C, Gc, -1, self.n_pad),
-                  self._pad_groups(L.bands, G_pad).reshape(C, Gc, -1, self.n_pad)]
+            xs = [self._pad_groups(M.bands, G_pad).reshape(C, Gc, -1, ns),
+                  self._pad_groups(L.bands, G_pad).reshape(C, Gc, -1, ns)]
             if has_mv:
                 xs.append(self._pad_groups(M.Vt, G_pad).reshape(
-                    C, Gc, self.t, self.n_pad))
+                    C, Gc, self.t, ns))
             if has_lv:
                 xs.append(self._pad_groups(L.Vt, G_pad).reshape(
-                    C, Gc, self.t, self.n_pad))
+                    C, Gc, self.t, ns))
 
             def one(xs):
                 mb, lb = xs[0], xs[1]
@@ -552,24 +575,20 @@ class BandedOps:
         a = jnp.asarray(a, dtype=rd)
         b = jnp.asarray(b, dtype=rd)
 
+        ns = self.n_store
+
         def chunk_core(mb, lb, mv, lv, a, b):
-            bands = jnp.zeros((Gc, self.nd, self.n_pad), dtype=dtype)
-            bands = bands.at[:, dM, :].add(a * mb)
-            bands = bands.at[:, dL, :].add(b * lb)
-            Vt = jnp.zeros((Gc, self.t, self.n_pad), dtype=dtype)
-            if mv is not None:
-                Vt = Vt + a * mv
-            if lv is not None:
-                Vt = Vt + b * lv
+            bands, Vt = self._combine_ml(mb, lb, mv, lv, Gc, a, b,
+                                         dM, dL, dtype)
             return self._factor_core(bands, Vt)
 
         shapes = jax.eval_shape(
             chunk_core,
-            jax.ShapeDtypeStruct((Gc, len(dM), self.n_pad), dtype),
-            jax.ShapeDtypeStruct((Gc, len(dL), self.n_pad), dtype),
-            jax.ShapeDtypeStruct((Gc, self.t, self.n_pad), dtype)
+            jax.ShapeDtypeStruct((Gc, len(dM), ns), dtype),
+            jax.ShapeDtypeStruct((Gc, len(dL), ns), dtype),
+            jax.ShapeDtypeStruct((Gc, self.t, ns), dtype)
             if has_mv else None,
-            jax.ShapeDtypeStruct((Gc, self.t, self.n_pad), dtype)
+            jax.ShapeDtypeStruct((Gc, self.t, ns), dtype)
             if has_lv else None,
             jax.ShapeDtypeStruct((), rd), jax.ShapeDtypeStruct((), rd))
         store = jax.tree.map(

@@ -281,3 +281,31 @@ def test_refined_inverse_matches_lu_f64():
     scale = np.abs(Xl).max()
     assert scale > 1e-6
     assert np.abs(Xl - Xr).max() / scale < 1e-9
+
+
+def test_banded_min_q_reblocking_equivalence():
+    """BANDED_MIN_Q re-blocks the same banded lattice with larger q
+    (fewer, fatter scan steps for TPU latency); the solve must agree with
+    the structural-q path to rounding."""
+    import numpy as np
+    from dedalus_tpu.tools.config import config
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+
+    def run(min_q):
+        old_s = config["linear algebra"].get("MATRIX_SOLVER", "auto")
+        old_q = config["linear algebra"].get("BANDED_MIN_Q", "0")
+        config["linear algebra"]["MATRIX_SOLVER"] = "banded"
+        config["linear algebra"]["BANDED_MIN_Q"] = str(min_q)
+        try:
+            solver, b = build_rb_solver(64, 32, np.float64)
+            for _ in range(5):
+                solver.step(1e-3)
+            return np.asarray(solver.X, np.float64), solver.ops
+        finally:
+            config["linear algebra"]["MATRIX_SOLVER"] = old_s
+            config["linear algebra"]["BANDED_MIN_Q"] = old_q
+
+    X0, ops0 = run(0)
+    X1, ops1 = run(128)
+    assert ops1.q == 128 and ops1.NB < ops0.NB
+    assert np.abs(X1 - X0).max() / np.abs(X0).max() < 1e-11
