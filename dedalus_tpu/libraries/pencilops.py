@@ -135,13 +135,15 @@ class BandedOps:
         # scan steps feeding the MXU). The band STORAGE keeps its
         # assembled width (n_store); factor transients pad to the
         # re-blocked width. q only has to satisfy kl, ku <= q, which
-        # growing q preserves.
-        min_q = int(config["linear algebra"].get("BANDED_MIN_Q", "0"))
+        # growing q preserves. 'auto' grows q by doubling on TPU backends
+        # while the per-factor slab stays under BANDED_Q_BUDGET_GB (a
+        # system already over budget — e.g. the north-star RB 2048x1024 —
+        # keeps its structural q); the final q is chosen at first factor,
+        # when the group count is known (_ensure_q).
+        self._min_q_cfg = config["linear algebra"].get(
+            "BANDED_MIN_Q", "0").strip().lower()
         self.n = st.S                  # true system size
         self.n_store = st.NB * st.q    # band-array width as assembled
-        self.q = max(st.q, min_q) if min_q else st.q
-        self.n_pad = -(-self.n_store // self.q) * self.q
-        self.NB = self.n_pad // self.q
         self.t = st.t_pins
         self.kl = st.kl
         self.ku = st.ku
@@ -151,16 +153,47 @@ class BandedOps:
         self.col_perm = np.asarray(st.col_perm)
         self.pos_col = np.argsort(self.col_perm)  # orig index -> permuted pos
         self.pin_pos = np.asarray(st.pinned_positions)
+        self._set_q(st.q if self._min_q_cfg in ("0", "auto", "")
+                    else max(st.q, int(self._min_q_cfg)))
+
+    def _set_q(self, q):
+        """(Re)derive the blocking-dependent geometry for block size q."""
+        self.q = int(q)
+        self.n_pad = -(-self.n_store // self.q) * self.q
+        self.NB = self.n_pad // self.q
         # static block-gather indices: block[o][ri, ci] reads
         # bands[:, o*q + ci - ri + kl, block_row*q + ri]
-        q, NB, kl = self.q, self.NB, self.kl
-        ri = np.arange(q)[:, None]
-        ci = np.arange(q)[None, :]
+        ri = np.arange(self.q)[:, None]
+        ci = np.arange(self.q)[None, :]
         self._blk_idx = {}
         for o in (-1, 0, 1):
-            d = o * q + ci - ri + kl                 # (q, q)
+            d = o * self.q + ci - ri + self.kl       # (q, q)
             valid = (d >= 0) & (d < self.nd)
             self._blk_idx[o] = (np.where(valid, d, 0), valid)
+
+    def _ensure_q(self, G, itemsize):
+        """Finalize the re-blocking once the group count is known (first
+        factor): 'auto' doubles q while the persistent factor slab
+        (panelLU + U12, 2 * 2q*q per block row) stays under
+        BANDED_Q_BUDGET_GB and q <= 256, on TPU backends only."""
+        if self._min_q_cfg != "auto":
+            return
+        import jax
+        if jax.default_backend() not in ("tpu", "axon"):
+            return
+        budget = float(config["linear algebra"].get(
+            "BANDED_Q_BUDGET_GB", "2.0")) * 1e9
+
+        def slab_bytes(q):
+            nb = -(-self.n_store // q)
+            return G * nb * (2 * q * q) * 2 * itemsize
+
+        q = self.q
+        while (2 * q <= 256 and slab_bytes(2 * q) <= budget
+               and 2 * q < self.n_store):
+            q *= 2
+        if q != self.q:
+            self._set_q(q)
 
     # ------------------------------------------------------------ host side
 
@@ -477,6 +510,7 @@ class BandedOps:
 
     def factor(self, A):
         """Factor a matrix already resident in banded storage."""
+        self._ensure_q(A.bands.shape[0], A.bands.dtype.itemsize)
         bands, Vt = self.expand(A)
         return self._factor_impl(bands, Vt, {"A": A})
 
@@ -488,6 +522,7 @@ class BandedOps:
         at large S)."""
         G = M.bands.shape[0]
         dtype = M.bands.dtype
+        self._ensure_q(G, dtype.itemsize)
         C, Gc = self._pick_chunks(G, dtype.itemsize)
         self._g_chunks = C
         dM = np.asarray(M.dsel)
@@ -538,6 +573,7 @@ class BandedOps:
         scan temps). Engaged automatically when the factor output alone
         exceeds BANDED_INCREMENTAL_GB (the RB 2048x1024 regime: ~5.5 GB of
         factors on a 16 GB chip)."""
+        self._ensure_q(G, itemsize)
         mode = config["linear algebra"].get(
             "BANDED_FACTOR_MODE", "auto").lower()
         if mode in ("fused", "incremental"):
@@ -563,6 +599,7 @@ class BandedOps:
         b = b_scale
         G = M.bands.shape[0]
         dtype = M.bands.dtype
+        self._ensure_q(G, dtype.itemsize)
         C, Gc = self._pick_chunks(G, dtype.itemsize)
         C = max(C, 2)  # incremental mode implies chunked aux layout
         Gc = -(-G // C)
