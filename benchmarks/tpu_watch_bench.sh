@@ -22,7 +22,7 @@ probe() {
         2>/dev/null | grep -q PROBE_OK
 }
 
-ALL_NAMES="rb2048x1024 sw_ell255 sw_ell255_dense sw_ell255_q128 sw_profile rotconv32 rb256x64 kdv1024 shear512 accuracy"
+ALL_NAMES="rb2048x1024 sw_ell255 sw_ell255_dense sw_profile rotconv32 rb256x64 kdv1024 shear512 accuracy"
 
 all_done() {
     for n in $ALL_NAMES; do
@@ -66,7 +66,6 @@ for i in $(seq 1 "$MAX_ITERS"); do
         run_config rb2048x1024 4500 || continue
         run_config sw_ell255 2400 || continue
         run_config sw_ell255_dense 2400 || continue
-        run_config sw_ell255_q128 2400 || continue
         run_script sw_profile 1200 python benchmarks/profile_sw.py || continue
         run_config rotconv32 2400 || continue
         # --- refresh the proven configs with this-round timestamps ---
