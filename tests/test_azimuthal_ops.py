@@ -173,3 +173,21 @@ def test_spherical_component_index1_rank2():
     Tg = np.asarray(T["g"])
     rad1 = d3.Radial(T, index=1).evaluate()
     assert np.abs(np.asarray(rad1["g"]) - Tg[:, 2]).max() < 1e-10
+
+
+def test_sphere_colatitude_interpolation():
+    """theta=const interpolation on the sphere (PolarInterpolate over the
+    SWSH per-m interpolation stacks): exact at collocation points,
+    output on the S1 azimuth basis."""
+    cs = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    sph = d3.SphereBasis(cs, shape=(16, 8), dtype=np.float64, radius=1.0)
+    phi, theta = dist.local_grids(sph)
+    f = dist.Field(name="f", bases=sph)
+    f["g"] = ((1 + np.cos(theta) ** 2) * (1 + 0.3 * np.cos(2 * phi))
+              + np.sin(theta) * np.sin(phi))
+    th_grid = theta.ravel()
+    out = d3.Interpolate(f, cs["theta"], float(th_grid[3])).evaluate()
+    assert out.domain.bases[1] is None         # colatitude removed
+    fg = np.asarray(f["g"])
+    assert np.abs(np.asarray(out["g"]).ravel() - fg[:, 3]).max() < 1e-12
