@@ -705,6 +705,74 @@ def Integrate(operand, coords=None):
     return out
 
 
+class AzimuthalAverage(LinearOperator):
+    """
+    Average over the azimuth of a curvilinear basis: the m = 0 projection
+    (reference: core/basis.py:5202 AzimuthalAverage family — identity on
+    the m = 0 group, zero elsewhere). Output is phi-constant on the same
+    domain (this framework's meridional representation; transforms to
+    m = 0 content only). LHS-capable: per-m blocks are constant.
+    """
+
+    name = "azavg"
+
+    def __init__(self, operand, basis):
+        self.basis = basis
+        super().__init__(operand)
+
+    @property
+    def operand(self):
+        return self.args[0]
+
+    def rebuild(self, new_args):
+        return AzimuthalAverage(new_args[0], self.basis)
+
+    def _build_metadata(self):
+        operand = self.args[0]
+        self.domain = operand.domain
+        self.tensorsig = operand.tensorsig
+        self.dtype = operand.dtype
+
+    def terms(self):
+        basis = self.basis
+        if hasattr(basis, "group_m"):
+            ms = np.asarray(basis.group_m())
+            gs = basis.sub_group_shape(0)
+        else:
+            # 1-D azimuthal basis (S1 edge fields): group 0 is m = 0
+            ms = np.arange(basis.n_groups)
+            gs = basis.group_shape
+        blocks = np.zeros((len(ms), gs, gs))
+        blocks[ms == 0] = np.eye(gs)
+        descrs = [None] * self.operand.domain.dim
+        descrs[basis.first_axis] = ("blocks", blocks)
+        return [(None, descrs)]
+
+
+@parseable("azavg", "AzimuthalAverage")
+def AzimuthalAverageFactory(operand, coord=None):
+    if np.isscalar(operand):
+        return operand
+    from .coords import AzimuthalCoordinate
+    if coord is not None:
+        coord = _resolve_coord(operand, coord)
+        if not isinstance(coord, AzimuthalCoordinate):
+            raise ValueError("AzimuthalAverage requires an azimuthal "
+                             "coordinate.")
+        basis = operand.domain.get_basis(coord)
+    else:
+        def is_azimuthal(b):
+            if b.dim >= 2:
+                return isinstance(b.coordsystem.coords[0],
+                                  AzimuthalCoordinate)
+            return isinstance(getattr(b, "coord", None), AzimuthalCoordinate)
+        basis = next((b for b in operand.domain.bases
+                      if b is not None and is_azimuthal(b)), None)
+    if basis is None:
+        raise ValueError("Operand has no azimuthal basis.")
+    return AzimuthalAverage(operand, basis)
+
+
 @parseable("ave", "Average")
 def Average(operand, coords=None):
     if np.isscalar(operand):

@@ -28,6 +28,7 @@ from .core.problems import IVP, LBVP, NLBVP, EVP
 from .core.operators import (
     AdvectiveCFL,
     Differentiate, Convert, Interpolate, Integrate, Average,
+    AzimuthalAverageFactory as AzimuthalAverage,
     LiftFactory as Lift, LiftTau,
     Gradient, Divergence, Laplacian, Curl, Trace, TransposeComponents,
     SkewFactory as Skew, Radial, Azimuthal, Angular, SphericalEllProduct,
@@ -89,3 +90,16 @@ interp = Interpolate
 radial = Radial
 azimuthal = Azimuthal
 angular = Angular
+# reference-parity aliases (reference: core/operators.py:1028 interpolate,
+# :1449 convert; Transpose as the TransposeComponents shorthand)
+Transpose = TransposeComponents
+convert = Convert
+
+
+def interpolate(arg, **positions):
+    """Iterated interpolation: interpolate(f, x=0.5, z=1.0) (reference:
+    core/operators.py:1028)."""
+    for coord, position in positions.items():
+        arg = Interpolate(arg, coord, position)
+    return arg
+

@@ -191,3 +191,41 @@ def test_sphere_colatitude_interpolation():
     assert out.domain.bases[1] is None         # colatitude removed
     fg = np.asarray(f["g"])
     assert np.abs(np.asarray(out["g"]).ravel() - fg[:, 3]).max() < 1e-12
+
+
+def test_azimuthal_average_sphere_and_annulus():
+    """AzimuthalAverage = the m=0 projection (reference:
+    core/basis.py:5202): matches the phi-mean of the grid data."""
+    cs = d3.S2Coordinates("phi", "theta")
+    dist = d3.Distributor(cs, dtype=np.float64)
+    sph = d3.SphereBasis(cs, shape=(16, 8), dtype=np.float64, radius=1.0)
+    phi, theta = dist.local_grids(sph)
+    f = dist.Field(name="f", bases=sph)
+    f["g"] = np.cos(theta) ** 2 * (1 + 0.4 * np.cos(3 * phi)) + np.sin(phi)
+    out = d3.AzimuthalAverage(f).evaluate()
+    mean = np.asarray(f["g"]).mean(axis=0)
+    assert np.abs(np.asarray(out["g"]) - mean[None, :]).max() < 1e-12
+
+    csp = d3.PolarCoordinates("phi", "r")
+    distp = d3.Distributor(csp, dtype=np.float64)
+    ann = d3.AnnulusBasis(csp, shape=(16, 8), dtype=np.float64,
+                          radii=(0.5, 2.0))
+    phi, r = distp.local_grids(ann)
+    g = distp.Field(name="g", bases=ann)
+    g["g"] = r ** 2 * (1 + np.sin(2 * phi)) + np.cos(phi) / r
+    out = d3.AzimuthalAverage(g).evaluate()
+    mean = np.asarray(g["g"]).mean(axis=0)
+    assert np.abs(np.asarray(out["g"]) - mean[None, :]).max() < 1e-12
+
+
+def test_interpolate_convert_aliases():
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=16, bounds=(0, 2 * np.pi))
+    f = dist.Field(name="f", bases=xb)
+    x = dist.local_grids(xb)[0]
+    f["g"] = np.sin(x)
+    out = d3.interpolate(f, x=0.5).evaluate()
+    assert abs(float(np.asarray(out["g"]).ravel()[0]) - np.sin(0.5)) < 1e-12
+    assert d3.Transpose is d3.TransposeComponents
+    assert d3.convert is d3.Convert
