@@ -131,9 +131,12 @@ def _run_child(env, timeout, tag):
     return None, f"{tag} child rc={proc.returncode}"
 
 
-def _recent_tpu_row(config=None, max_age_hours=14):
+def _recent_tpu_row(config=None, max_age_hours=48):
     """Latest finite backend=tpu row for `config` (default rb256x64) from
-    results.jsonl recorded within this round's window."""
+    results.jsonl recorded within the recent measurement window (48h:
+    wide enough to span a round whose chip window opened early — or the
+    previous round's sweep when the chip stayed unclaimable throughout,
+    as rows carry their own measured_ts provenance)."""
     import time
     config = config or f"rb{NX}x{NZ}"
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
