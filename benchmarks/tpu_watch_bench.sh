@@ -22,7 +22,7 @@ probe() {
         2>/dev/null | grep -q PROBE_OK
 }
 
-ALL_NAMES="rb2048x1024 sw_ell255 sw_ell255_dense sw_profile rotconv32 rb256x64 kdv1024 shear512 accuracy"
+ALL_NAMES="rb2048x1024 sw_ell255 sw_ell255_dense sw_profile rotconv32 rb256x64 kdv1024 shear512 accuracy rb3d_128"
 
 all_done() {
     for n in $ALL_NAMES; do
@@ -73,6 +73,7 @@ for i in $(seq 1 "$MAX_ITERS"); do
         run_config kdv1024 900 || continue
         run_config shear512 1500 || continue
         run_script accuracy 1200 python benchmarks/tpu_accuracy.py || continue
+        run_config rb3d_128 2400 || continue
         if all_done; then
             log "sweep complete (all configs recorded)"
             touch "$MARKER"
