@@ -37,7 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # Shared wedge-defense helpers (probe subprocess, plugin-strip env) live in
 # __graft_entry__ so bench.py and the dryrun use identical logic.
 from __graft_entry__ import (_append_result, _kill_group, _probe_devices,
-                             _probe_backend_retrying,
+                             _probe_backend_retrying, _sanitize_jax_platforms,
                              _strip_plugin_env)  # noqa: E402
 
 
@@ -96,12 +96,27 @@ def run_benchmark():
     mark(f"measured {steps_per_sec:.2f} steps/s")
 
     assert np.all(np.isfinite(np.asarray(solver.X))), "non-finite state"
-    return {
+    record = {
         "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec_{np.dtype(dtype).name}_{backend}",
         "value": round(steps_per_sec, 3),
         "unit": "steps/sec",
         "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
     }
+    # Attach the sampled per-phase breakdown (tools/metrics.py; default-on,
+    # cadence-gated so it never blocked inside the measured region)
+    try:
+        metrics_rec = solver.flush_metrics()
+    except Exception as exc:
+        mark(f"metrics flush failed (non-fatal): {exc}")
+        metrics_rec = None
+    if metrics_rec and metrics_rec.get("phase_samples"):
+        record["phase_total_sec"] = metrics_rec["phase_total_sec"]
+        record["phase_sum_frac"] = metrics_rec["phase_sum_frac"]
+        record["phase_samples"] = metrics_rec["phase_samples"]
+        if metrics_rec.get("device_mem_peak_bytes"):
+            record["device_mem_peak_bytes"] = \
+                metrics_rec["device_mem_peak_bytes"]
+    return record
 
 
 def _run_child(env, timeout, tag):
@@ -187,10 +202,16 @@ def main():
 
     errors = []
     mark(f"probing backend JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r}")
+    # One shared env dict: the probe sanitizes JAX_PLATFORMS (and strips
+    # unknown platforms it fails on) IN PLACE, so the measurement child
+    # inherits the working platform list — records never carry a
+    # bogus-platform init error for an entry the probe already routed
+    # around.
+    probe_env = _sanitize_jax_platforms(dict(os.environ))
     # several cheap probes spread over ~5 minutes: a transiently busy chip
     # should not forfeit the round (round-2 failure mode: two 240s probes
     # in one wedged window -> CPU fallback recorded as the official number)
-    backend, info = _probe_backend_retrying(dict(os.environ))
+    backend, info = _probe_backend_retrying(probe_env)
     ok = backend is not None
     if not ok:
         info = f"device probe failed after retries: {info}"
@@ -198,7 +219,7 @@ def main():
         info = backend
     if ok:
         mark(f"backend probe ok: {info}")
-        record, err = _run_child(os.environ, 2400, "default-backend")
+        record, err = _run_child(probe_env, 2400, "default-backend")
         if record is not None:
             _attach_progression(record)
             _log_result(record)

@@ -1,12 +1,14 @@
 """
 Command-line interface (reference: dedalus/__main__.py:1-45):
 
-    python -m dedalus_tpu test          # run the test suite
-    python -m dedalus_tpu bench         # run the benchmark (bench.py)
-    python -m dedalus_tpu get_config    # print the resolved configuration
-    python -m dedalus_tpu get_examples  # print the examples directory
+    python -m dedalus_tpu test            # run the test suite
+    python -m dedalus_tpu bench           # run the benchmark (bench.py)
+    python -m dedalus_tpu get_config      # print the resolved configuration
+    python -m dedalus_tpu get_examples    # print the examples directory
+    python -m dedalus_tpu report F.jsonl  # summarize a metrics JSONL file
 """
 
+import json
 import pathlib
 import sys
 
@@ -14,7 +16,9 @@ import sys
 def test():
     import pytest
     root = pathlib.Path(__file__).parent.parent
-    sys.exit(pytest.main([str(root / "tests"), "-q"]))
+    # tier-1 semantics: slow-marked tests (long timing runs) are opt-in
+    # via pytest directly
+    sys.exit(pytest.main([str(root / "tests"), "-q", "-m", "not slow"]))
 
 
 def bench():
@@ -42,7 +46,8 @@ def cov():
     root = pathlib.Path(__file__).parent.parent
     rc = subprocess.run(
         [sys.executable, "-m", "coverage", "run", "--source=dedalus_tpu",
-         "-m", "pytest", str(root / "tests"), "-q"], cwd=root).returncode
+         "-m", "pytest", str(root / "tests"), "-q", "-m", "not slow"],
+        cwd=root).returncode
     subprocess.run([sys.executable, "-m", "coverage", "report"], cwd=root)
     sys.exit(rc)
 
@@ -57,9 +62,61 @@ def get_examples():
     print(root)
 
 
+def report():
+    """Summarize a metrics JSONL file (tools/metrics.py records; bench rows
+    from benchmarks/results.jsonl are listed briefly)."""
+    from .tools.metrics import format_phase_table
+    if len(sys.argv) < 3:
+        print("usage: python -m dedalus_tpu report <metrics.jsonl>",
+              file=sys.stderr)
+        sys.exit(2)
+    path = pathlib.Path(sys.argv[2])
+    try:
+        lines = path.read_text().splitlines()
+    except OSError as exc:
+        print(f"report: cannot read {path}: {exc}", file=sys.stderr)
+        sys.exit(1)
+    n_metrics = n_other = n_bad = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            n_bad += 1
+            continue
+        if record.get("kind") == "step_metrics":
+            n_metrics += 1
+            ident = " ".join(
+                f"{k}={record[k]}" for k in ("config", "backend", "dtype")
+                if record.get(k) is not None)
+            print(f"[{n_metrics}] {ident or 'step_metrics'}: "
+                  f"{record.get('iterations', 0)} iters, "
+                  f"{record.get('steps_per_sec', 0.0)} steps/s, "
+                  f"{record.get('phase_samples', 0)} samples "
+                  f"(cadence {record.get('sample_cadence', '?')})")
+            # format_phase_table's first line repeats the sample count
+            # already printed in the header above
+            for tline in format_phase_table(record, indent="    ")[1:]:
+                print(tline)
+        else:
+            n_other += 1
+            ident = record.get("metric") or record.get("config") or "record"
+            val = record.get("value")
+            unit = record.get("unit", "")
+            extra = f" = {val} {unit}".rstrip() if val is not None else ""
+            print(f"(other) {ident}{extra}")
+    print(f"{n_metrics} metrics record(s), {n_other} other, "
+          f"{n_bad} unparsable")
+    if n_metrics == 0 and n_other == 0:
+        sys.exit(1)
+
+
 def main():
     commands = {"test": test, "bench": bench, "cov": cov,
-                "get_config": get_config, "get_examples": get_examples}
+                "get_config": get_config, "get_examples": get_examples,
+                "report": report}
     if len(sys.argv) < 2 or sys.argv[1] not in commands:
         print(f"usage: python -m dedalus_tpu [{'|'.join(commands)}]",
               file=sys.stderr)

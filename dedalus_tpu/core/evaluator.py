@@ -133,7 +133,8 @@ class Handler:
         fields = sorted(atoms, key=lambda f: (f.name or "", id(f)))
 
         def fn(arrays):
-            with mesh_transforms(dist.mesh):
+            from ..tools.metrics import trace_scope
+            with mesh_transforms(dist.mesh), trace_scope("evaluator", "tasks"):
                 return fn_body(arrays)
 
         def fn_body(arrays):

@@ -29,6 +29,7 @@ from .subsystems import (PencilLayout, build_subproblems, build_matrices,
 from .future import EvalContext, ev
 from . import timesteppers as timesteppers_mod
 from ..libraries import pencilops
+from ..tools import metrics as metrics_mod
 from ..tools.config import config
 from ..tools.general import is_complex_dtype
 
@@ -436,6 +437,10 @@ class SolverBase:
                 return eval_F_body(X, t, extra_arrays)
 
         def eval_F_body(X, t=None, extra_arrays=None):
+            with metrics_mod.trace_scope("evaluator", "rhs"):
+                return eval_F_inner(X, t, extra_arrays)
+
+        def eval_F_inner(X, t=None, extra_arrays=None):
             subs = {}
             if X is not None:
                 arrays = scatter_state(layout, variables, X)
@@ -480,7 +485,8 @@ class InitialValueSolver(SolverBase):
 
     def __init__(self, problem, timestepper, matsolver=None,
                  enforce_real_cadence=100, warmup_iterations=10,
-                 profile=None, profile_directory=None, **kw):
+                 profile=None, profile_directory=None, metrics=None,
+                 metrics_file=None, sample_cadence=None, **kw):
         init_t0 = time_mod.time()
         super().__init__(problem, matsolver=matsolver, **kw)
         self.M_mat = self.ops.to_device(self._matrices["M"], self.pencil_dtype)
@@ -534,6 +540,15 @@ class InitialValueSolver(SolverBase):
         self.profile_directory = pathlib.Path(
             profile_directory
             or config["profiling"].get("PROFILE_DIRECTORY", "profiles"))
+        # Step-loop metrics (tools/metrics.py): counters + sampled phase
+        # timers + memory watermark; default-on per [profiling] config,
+        # cadence-gated so off-cadence steps never sync the device.
+        self.metrics = metrics_mod.resolve(
+            metrics, sink=metrics_file, cadence=sample_cadence,
+            meta={"backend": jax.default_backend(),
+                  "dtype": str(np.dtype(self.pencil_dtype)),
+                  "pencil_shape": list(self.pencil_shape)})
+        self._metrics_warm_pending = False
         self._setup_time = time_mod.time() - init_t0
         self._trace_active = False
 
@@ -560,6 +575,11 @@ class InitialValueSolver(SolverBase):
         still projects accumulated drift out of non-representable modes
         (curvilinear triangular truncation, Nyquist slots).
         """
+        self.X = self._ensure_project()(self.X)
+
+    def _ensure_project(self):
+        """The jitted dealiased-roundtrip projection of the state (shared
+        by enforce_hermitian_symmetry and the transform phase probe)."""
         if self._project_state is None:
             from .field import (transform_to_grid, transform_to_coeff,
                                 mesh_transforms)
@@ -583,7 +603,7 @@ class InitialValueSolver(SolverBase):
                     return gather_state(layout, variables, out)
 
             self._project_state = lifted_jit(project)
-        self.X = self._project_state(self.X)
+        return self._project_state
 
     def _dd_advance(self, n, dt):
         """Advance n steps on the emulated-f64 (double-double) path: sync
@@ -629,6 +649,7 @@ class InitialValueSolver(SolverBase):
         self.problem.sim_time = self.sim_time
         self.iteration += n
         self.dt = dt
+        self.metrics.observe_steps(n)   # dd path: counters only, no probes
         self.evaluator.evaluate_scheduled(
             iteration=self.iteration,
             wall_time=time_mod.time() - self.start_time,
@@ -642,6 +663,17 @@ class InitialValueSolver(SolverBase):
 
     def _end_warmup(self):
         """Record warmup completion; start the profiler trace if enabled."""
+        # Compile + first-run the phase probes BEFORE stamping warmup_time:
+        # probe compilation stays out of the run window (log_stats rate) and
+        # out of any externally measured post-warmup block. step_many-only
+        # drivers hit this before the first block has factored the LHS
+        # (no probes yet): defer the warm sample — and the loop-window
+        # anchor — past that first, compile-bearing block.
+        self._metrics_warm_pending = False
+        if self.metrics.sampling and self._dd is None:
+            if not self._try_sample_phases():
+                self._metrics_warm_pending = self.metrics.sampling
+        self.metrics.reset_loop()
         self.warmup_time = time_mod.time()
         if self.profile and not self._trace_active:
             import atexit
@@ -672,12 +704,14 @@ class InitialValueSolver(SolverBase):
         if self.enforce_real_cadence:
             if self.iteration % self.enforce_real_cadence < self.timestepper.steps:
                 self.enforce_hermitian_symmetry()
-        self.timestepper.step(dt)
+        with metrics_mod.annotate("dedalus/step"):
+            self.timestepper.step(dt)
         self.defer_scatter(self.X)
         self.snapshot_versions()
         self.problem.sim_time = self.sim_time
         self.iteration += 1
         self.dt = dt
+        self._metrics_tick(1)
         self.evaluator.evaluate_scheduled(
             iteration=self.iteration, wall_time=time_mod.time() - self.start_time,
             sim_time=self.sim_time, timestep=dt)
@@ -711,16 +745,99 @@ class InitialValueSolver(SolverBase):
             if (n >= cadence or r < self.timestepper.steps
                     or (cadence - r) < n):
                 self.enforce_hermitian_symmetry()
-        self.timestepper.step_many(n, dt)
+        with metrics_mod.annotate("dedalus/step_many"):
+            self.timestepper.step_many(n, dt)
         self.defer_scatter(self.X)
         self.snapshot_versions()
         self.problem.sim_time = self.sim_time
         self.iteration += n
         self.dt = dt
+        self.metrics.inc("step_many_blocks")
+        self._metrics_tick(n)
         self.evaluator.evaluate_scheduled(
             iteration=self.iteration,
             wall_time=time_mod.time() - self.start_time,
             sim_time=self.sim_time, timestep=dt)
+
+    # -------------------------------------------------------------- metrics
+
+    def _metrics_tick(self, n):
+        """Per-step metrics hook: count iterations (non-blocking) and run
+        the cadence-gated phase sample (the only point that syncs the
+        device, and only every SAMPLE_CADENCE-th post-warmup iteration)."""
+        m = self.metrics
+        if not m.enabled:
+            return
+        m.observe_steps(n)
+        if not (m.sampling and self._dd is None
+                and self.warmup_time is not None):
+            return
+        if getattr(self, "_metrics_warm_pending", False):
+            # deferred warm compile (step_many-only driver): sample now and
+            # re-anchor the loop window — the block just finished carried
+            # the step jit compile and must stay out of per-step rates
+            self._metrics_warm_pending = False
+            self._try_sample_phases()
+            m.reset_loop()
+            return
+        if m.due():
+            self._try_sample_phases()
+
+    def _try_sample_phases(self):
+        """_sample_phases with a telemetry firewall: probe failure disables
+        sampling (with a warning) instead of killing the simulation.
+        Returns whether a sample was recorded."""
+        try:
+            return self._sample_phases()
+        except Exception as exc:
+            logger.warning(f"metrics phase sampling disabled: {exc}")
+            self.metrics.sampling = False
+            return False
+
+    def _sample_phases(self):
+        """
+        One phase sample: drain outstanding dispatches, then wall-time the
+        already-compiled step pieces (timestepper phase probes + the
+        dealiased transform roundtrip) on the current state, bracketing
+        `block_until_ready`. The transform share of the RHS evaluation is
+        measured by the roundtrip probe and subtracted out so
+        transform/evaluator/matsolve/transpose sum to ~one step. On fused
+        multi-device steps the all_to_all collectives execute inside the
+        eval/solve probes, so their cost rides in evaluator/matsolve and
+        `transpose` stays 0 — profiler traces (dedalus/transpose/...)
+        are the per-collective attribution tool there. Returns True when
+        a sample was recorded (False: probes not available yet).
+        """
+        m = self.metrics
+        probes = self.timestepper.phase_probes()
+        if probes is None:
+            return False
+        with metrics_mod.annotate("dedalus/metrics/sample"):
+            jax.block_until_ready(self.X)
+            scale = float(getattr(self.timestepper, "stages", 1) or 1)
+            proj = self._ensure_project()
+            times = {name: m.time_thunk(name, thunk) * s
+                     for name, (thunk, s) in probes.items()}
+            trans = m.time_thunk("transform", lambda: proj(self.X)) * scale
+            rhs = times.get("rhs_eval", 0.0)
+            trans = min(trans, rhs) if rhs else trans
+            m.add_phase_sample({
+                "transform": trans,
+                "evaluator": max(rhs - trans, 0.0),
+                "matsolve": times.get("matsolve", 0.0),
+                "transpose": times.get("transpose", 0.0),
+            })
+        return True
+
+    def flush_metrics(self, extra=None):
+        """Block on the state (so the loop window covers the device tail of
+        the final dispatch) and flush one telemetry record — appended to
+        the JSONL sink when one is configured. Returns the record dict."""
+        try:
+            jax.block_until_ready(self.X)
+        except Exception:
+            pass
+        return self.metrics.flush(extra=extra)
 
     def evolve(self, timestep_function=None, log_cadence=100):
         """Run the main loop to completion (reference: core/solvers.py:713)."""
@@ -804,9 +921,17 @@ class InitialValueSolver(SolverBase):
                            "mode_stages_per_sec": rate})
         else:
             logger.info(f"Total time: {total:{format}} sec")
+        record = None
+        if self.metrics.enabled:
+            record = self.flush_metrics()
+            if record and record.get("phase_samples"):
+                for line in metrics_mod.format_phase_table(record):
+                    logger.info(line)
         if self.profile:
             import json
             os.makedirs(self.profile_directory, exist_ok=True)
+            if record:
+                phases["step_metrics"] = record
             with open(self.profile_directory / "phase_times.json", "w") as f:
                 json.dump(phases, f, indent=2)
 

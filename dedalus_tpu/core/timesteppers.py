@@ -141,9 +141,10 @@ class MultistepIMEX:
 
         def advance_body(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
                          lhs_aux):
-            Fn, MXn, LXn = eval_parts(M, L, X, t, extra)
-            return update_solve(Fn, MXn, LXn, F_hist, MX_hist, LX_hist,
-                                a, b, c, lhs_aux, M, L)
+            with jax.named_scope("dedalus/step/advance"):
+                Fn, MXn, LXn = eval_parts(M, L, X, t, extra)
+                return update_solve(Fn, MXn, LXn, F_hist, MX_hist, LX_hist,
+                                    a, b, c, lhs_aux, M, L)
 
         def _advance_n(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
                        n, dt, lhs_aux):
@@ -249,6 +250,54 @@ class MultistepIMEX:
         solver.X = X
         solver.sim_time = float(solver.sim_time) + n * float(dt)
         self.iteration += n
+
+    def phase_probes(self):
+        """Measurement thunks re-running the already-compiled step pieces
+        (eval vs. solve) on a snapshot of the current state — no state
+        mutation: {name: (thunk, per-step scale)}. None until the first
+        step has factored the LHS. Probe inputs are cached per LHS key:
+        dense/banded compute time is value-independent, so stale values
+        time the same programs without re-deriving fresh stage inputs each
+        sample — but a dt/coefficient change drops the cache so the
+        superseded factorization (the largest device allocation) is not
+        pinned by the thunk closures. The cache does pin a handful of
+        state-sized buffers (X snapshot, eval parts, the history tuple)
+        for the run — a few (G, S) arrays, small next to the factors and
+        band/dense stores."""
+        if self._lhs_aux is None or not self.dt_hist:
+            return None
+        cache = getattr(self, "_probe_cache", None)
+        if cache is not None and cache[0] != self._lhs_key:
+            cache = None
+        if cache is None:
+            solver = self.solver
+            rd = solver.real_dtype
+            s = self.steps
+            M, L, X = solver.M_mat, solver.L_mat, solver.X
+            t = jnp.asarray(float(solver.sim_time), dtype=rd)
+            extra = solver.rhs_extra()
+            a, b, c = self.compute_coefficients(
+                self.dt_hist, min(s, max(self.iteration, 1)))
+            a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+            b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+            c = np.concatenate([c, np.zeros(s - len(c))])
+            aj, bj, cj = (jnp.asarray(v, dtype=rd) for v in (a, b, c))
+            Fn, MXn, LXn = self._eval_parts(M, L, X, t, extra)
+            jax.block_until_ready((Fn, MXn, LXn))
+            hists = (self.F_hist, self.MX_hist, self.LX_hist)
+            lhs_aux = self._lhs_aux
+
+            def eval_thunk():
+                return self._eval_parts(M, L, X, t, extra)
+
+            def solve_thunk():
+                return self._update_solve(Fn, MXn, LXn, *hists,
+                                          aj, bj, cj, lhs_aux, M, L)
+
+            cache = self._probe_cache = (
+                self._lhs_key, {"rhs_eval": (eval_thunk, 1.0),
+                                "matsolve": (solve_thunk, 1.0)})
+        return cache[1]
 
 
 @add_scheme
@@ -426,10 +475,12 @@ class RungeKuttaIMEX:
             Fs = []
             Xi = X0
             for i in range(1, s + 1):
-                LXi, Fi = stage_eval(M, L, Xi, t0 + c[i - 1] * dt, extra)
-                LXs.append(LXi)
-                Fs.append(Fi)
-                Xi = stage_solve(i, MX0, Fs, LXs, dt, lhs_auxs[i - 1], M, L)
+                with jax.named_scope(f"dedalus/step/stage{i}"):
+                    LXi, Fi = stage_eval(M, L, Xi, t0 + c[i - 1] * dt, extra)
+                    LXs.append(LXi)
+                    Fs.append(Fi)
+                    Xi = stage_solve(i, MX0, Fs, LXs, dt, lhs_auxs[i - 1],
+                                     M, L)
             return Xi
 
         def _step_n(M, L, X0, t0, dt, extra, lhs_auxs, n):
@@ -514,6 +565,45 @@ class RungeKuttaIMEX:
                                 solver.rhs_extra(), self._lhs_aux, int(n))
         solver.sim_time = float(solver.sim_time) + n * float(dt)
         self.iteration += n
+
+    def phase_probes(self):
+        """Measurement thunks re-running one already-compiled stage (eval
+        vs. solve) on a snapshot of the current state — no state mutation:
+        {name: (thunk, per-step scale)}, scale = stages. None until the
+        first step has factored the LHS. Stage inputs are cached per LHS
+        key (stage compute time is value-independent); a dt change drops
+        the cache so the superseded factorization is not pinned. The
+        cache does pin a few state-sized buffers (X snapshot, one stage's
+        MX0/LX/F) for the run — small next to the factors."""
+        if self._lhs_aux is None:
+            return None
+        cache = getattr(self, "_probe_cache", None)
+        if cache is not None and cache[0] != self._lhs_key:
+            cache = None
+        if cache is None:
+            solver = self.solver
+            rd = solver.real_dtype
+            M, L, X = solver.M_mat, solver.L_mat, solver.X
+            t = jnp.asarray(float(solver.sim_time), dtype=rd)
+            dtj = jnp.asarray(float(self._lhs_key or 0.0), dtype=rd)
+            extra = solver.rhs_extra()
+            s = float(self.stages)
+            MX0 = self._mx0(M, X)
+            LX1, F1 = self._stage_eval(M, L, X, t, extra)
+            jax.block_until_ready((MX0, LX1, F1))
+            aux0 = self._lhs_aux[0]
+
+            def eval_thunk():
+                return self._stage_eval(M, L, X, t, extra)
+
+            def solve_thunk():
+                return self._stage_solve(1, MX0, [F1], [LX1], dtj, aux0,
+                                         M, L)
+
+            cache = self._probe_cache = (
+                self._lhs_key, {"rhs_eval": (eval_thunk, s),
+                                "matsolve": (solve_thunk, s)})
+        return cache[1]
 
 
 @add_scheme

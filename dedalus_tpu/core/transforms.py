@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from . import meshctx
 
 from ..tools.array import apply_matrix_jax
+from ..tools.metrics import scoped as _scoped
 
 # Registry: {(basis_class_name, library): plan_class}
 transform_registry = {}
@@ -49,7 +50,13 @@ def get_plan(basis, scale, library=None):
             break
     if cls is None:
         raise KeyError(f"No transform plan registered for {key}")
-    return cls(basis, scale)
+    plan = cls(basis, scale)
+    # single choke point for transform trace annotation: every plan built
+    # through the registry gets phase-labeled forward/backward methods
+    label = f"dedalus/transform/{type(basis).__name__}.{cls.library}"
+    plan.forward = _scoped(plan.forward, label + ".fwd")
+    plan.backward = _scoped(plan.backward, label + ".bwd")
+    return plan
 
 
 class TransformPlan:

@@ -14,12 +14,20 @@ Functional API so factorizations flow through jit as pytrees:
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
+from ..tools.metrics import scoped as _scoped
+
 matsolvers = {}
 
 
 def add_solver(cls):
     """Register a solver class by lowercase name (reference:
-    libraries/matsolvers.py:11 add_solver)."""
+    libraries/matsolvers.py:11 add_solver), phase-labeling its factor/solve
+    entry points for profiler traces."""
+    for meth in ("factor", "solve", "solve_multi"):
+        raw = cls.__dict__.get(meth)
+        if isinstance(raw, staticmethod):
+            label = f"dedalus/matsolve/{cls.__name__}.{meth}"
+            setattr(cls, meth, staticmethod(_scoped(raw.__func__, label)))
     matsolvers[cls.__name__.lower()] = cls
     return cls
 

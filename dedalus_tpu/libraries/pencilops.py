@@ -49,7 +49,8 @@ class DenseOps:
         return jnp.asarray(host_mat, dtype=dtype)
 
     def matvec(self, A, X):
-        return jnp.einsum("gij,gj->gi", A, X)
+        with jax.named_scope("dedalus/matsolve/dense.matvec"):
+            return jnp.einsum("gij,gj->gi", A, X)
 
     def lincomb(self, a, A, b, B):
         return a * A + b * B
@@ -58,13 +59,15 @@ class DenseOps:
         return a * A
 
     def factor(self, A):
-        return self.solver_cls.factor(A)
+        with jax.named_scope("dedalus/matsolve/dense.factor"):
+            return self.solver_cls.factor(A)
 
     def factor_lincomb(self, a, A, b, B):
         return self.factor(self.lincomb(a, A, b, B))
 
     def solve(self, aux, rhs, mats=None):
-        return self.solver_cls.solve(aux, rhs)
+        with jax.named_scope("dedalus/matsolve/dense.solve"):
+            return self.solver_cls.solve(aux, rhs)
 
     def densify_host(self, host_mat, g):
         return np.asarray(host_mat[g])
@@ -264,15 +267,16 @@ class BandedOps:
 
     def matvec(self, A, X):
         """Full A @ X in the ORIGINAL slot ordering; X (G, S)."""
-        xp = X[:, self.col_perm]
-        xp = jnp.pad(xp, ((0, 0), (0, A.bands.shape[-1] - self.n)))
-        yp = self._band_mv(A.bands, A.dsel, xp)
-        if self.t and A.Vt is not None:
-            pin_vals = jnp.einsum("gtn,gn->gt", A.Vt, xp)
-            yp = yp.at[:, self.pin_pos].add(pin_vals)
-        # yp[p] = (A @ X)[row_perm[p]]
-        out = jnp.zeros_like(X)
-        return out.at[:, self.row_perm].set(yp[:, :self.n])
+        with jax.named_scope("dedalus/matsolve/banded.matvec"):
+            xp = X[:, self.col_perm]
+            xp = jnp.pad(xp, ((0, 0), (0, A.bands.shape[-1] - self.n)))
+            yp = self._band_mv(A.bands, A.dsel, xp)
+            if self.t and A.Vt is not None:
+                pin_vals = jnp.einsum("gtn,gn->gt", A.Vt, xp)
+                yp = yp.at[:, self.pin_pos].add(pin_vals)
+            # yp[p] = (A @ X)[row_perm[p]]
+            out = jnp.zeros_like(X)
+            return out.at[:, self.row_perm].set(yp[:, :self.n])
 
     def _chunk_blocks(self, chunk):
         """One block-row's (G, D, q) band chunk -> (diag, left, right) blocks
@@ -479,19 +483,20 @@ class BandedOps:
     def _factor_impl(self, bands, Vt, refine_aux):
         """Shared factorization body; refine_aux supplies the residual
         matvec without persisting a combined matrix."""
-        G = bands.shape[0]
-        C, Gc = self._pick_chunks(G, bands.dtype.itemsize)
-        self._g_chunks = C
-        if C == 1:
-            core = self._factor_core(bands, Vt)
-        else:
-            bands_c = self._pad_groups(bands, C * Gc).reshape(
-                C, Gc, self.nd, self.n_pad)
-            Vt_c = self._pad_groups(Vt, C * Gc).reshape(
-                C, Gc, Vt.shape[1], self.n_pad)
-            core = jax.lax.map(lambda xs: self._factor_core(*xs),
-                               (bands_c, Vt_c))
-        return self._aux_from_core(core, refine_aux)
+        with jax.named_scope("dedalus/matsolve/banded.factor"):
+            G = bands.shape[0]
+            C, Gc = self._pick_chunks(G, bands.dtype.itemsize)
+            self._g_chunks = C
+            if C == 1:
+                core = self._factor_core(bands, Vt)
+            else:
+                bands_c = self._pad_groups(bands, C * Gc).reshape(
+                    C, Gc, self.nd, self.n_pad)
+                Vt_c = self._pad_groups(Vt, C * Gc).reshape(
+                    C, Gc, Vt.shape[1], self.n_pad)
+                core = jax.lax.map(lambda xs: self._factor_core(*xs),
+                                   (bands_c, Vt_c))
+            return self._aux_from_core(core, refine_aux)
 
     def _combine_ml(self, mb, lb, mv, lv, g, a, b, dM, dL, dtype):
         """a*M + b*L as a full-lattice (bands, Vt) pair at the re-blocked
@@ -695,10 +700,11 @@ class BandedOps:
         return xp[:, self.pos_col]
 
     def solve(self, aux, rhs, mats=None):
-        x = self._solve_once(aux, rhs)
-        if mats is None and "A" not in aux:
-            return x  # lincomb factor without mats: no refinement possible
-        for _ in range(self.refine):
-            r = rhs - self._aux_matvec(aux, x, mats)
-            x = x + self._solve_once(aux, r)
-        return x
+        with jax.named_scope("dedalus/matsolve/banded.solve"):
+            x = self._solve_once(aux, rhs)
+            if mats is None and "A" not in aux:
+                return x  # lincomb factor without mats: no refinement possible
+            for _ in range(self.refine):
+                r = rhs - self._aux_matvec(aux, x, mats)
+                x = x + self._solve_once(aux, r)
+            return x

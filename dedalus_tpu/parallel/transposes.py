@@ -53,7 +53,10 @@ def all_to_all_transpose(data, axis_in, axis_out, mesh, axis_name,
         return lax.all_to_all(block, axis_name, split_axis=axis_out,
                               concat_axis=axis_in, tiled=True)
 
-    return _transpose(data)
+    # phase label shared with the metrics timers (dedalus/transpose/...,
+    # see tools/metrics.py) so profiler traces attribute the collective
+    with jax.named_scope("dedalus/transpose/all_to_all"):
+        return _transpose(data)
 
 
 class DistributedPencilPipeline:

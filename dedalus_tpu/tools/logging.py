@@ -4,9 +4,12 @@ Logging setup (reference: dedalus/tools/logging.py).
 Process-aware root logger configuration from the [logging] config section:
 stdout handler at `stdout_level` (non-initial processes use
 `nonroot_level`), plus optional per-process file handlers at `file_level`
-under `filename`_p{rank}.log (reference: tools/logging.py:24-47).
+under `filename`_p{rank}.log (reference: tools/logging.py:24-47). File
+handlers are flushed and closed at interpreter exit so per-process logs
+survive abrupt ends of multi-host runs.
 """
 
+import atexit
 import logging
 import os
 import pathlib
@@ -14,7 +17,13 @@ import sys
 
 from .config import config
 
-MPI_RANK = 0  # single-controller JAX; per-process files use jax process index
+
+def process_rank():
+    """This process's rank for logging purposes. Reads JAX_PROCESS_INDEX
+    (set by multi-host launchers) rather than calling jax.process_index():
+    that would initialize the backend at import time (and hang if the
+    accelerator tunnel is down). Single-controller runs are rank 0."""
+    return int(os.environ.get("JAX_PROCESS_INDEX", "0") or 0)
 
 
 def _resolve_level(name):
@@ -24,15 +33,28 @@ def _resolve_level(name):
     return getattr(logging, name.upper())
 
 
+def _close_handlers(handlers):
+    """Detach, flush, and close file handlers at interpreter exit. Mostly
+    belt-and-braces over logging.shutdown (which flushes all live
+    handlers), but detaching FIRST guarantees no later atexit callback
+    logs into a closed stream, and the explicit close survives a
+    `logging.raiseExceptions=False`-style global shutdown ordering."""
+    root = logging.getLogger("dedalus_tpu")
+    for handler in handlers:
+        try:
+            root.removeHandler(handler)
+            handler.flush()
+            handler.close()
+        except Exception:
+            pass
+
+
 def setup_logging(force=False):
     """Configure the dedalus_tpu root logger from config; idempotent."""
     root = logging.getLogger("dedalus_tpu")
     if root.handlers and not force:
         return root
-    # Do NOT call jax.process_index() here: that initializes the backend at
-    # import time (and hangs if the accelerator tunnel is down). Multi-host
-    # launchers set this env var; single-controller runs are rank 0.
-    rank = int(os.environ.get("JAX_PROCESS_INDEX", "0") or 0)
+    rank = process_rank()
     section = config["logging"]
     stdout_level = _resolve_level(
         section.get("stdout_level", "info") if rank == 0
@@ -41,6 +63,7 @@ def setup_logging(force=False):
     formatter = logging.Formatter(
         "%(asctime)s %(name)s %(levelname)s :: %(message)s")
     root.setLevel(logging.DEBUG)
+    added = []
     if stdout_level is not None:
         handler = logging.StreamHandler(sys.stdout)
         handler.setLevel(stdout_level)
@@ -48,9 +71,13 @@ def setup_logging(force=False):
         root.addHandler(handler)
     if file_level is not None:
         path = pathlib.Path(section.get("filename", "logs/dedalus_tpu"))
-        os.makedirs(path.parent, exist_ok=True)
+        # parent must exist BEFORE FileHandler opens the stream
+        path.parent.mkdir(parents=True, exist_ok=True)
         handler = logging.FileHandler(f"{path}_p{rank}.log")
         handler.setLevel(file_level)
         handler.setFormatter(formatter)
         root.addHandler(handler)
+        added.append(handler)
+    if added:
+        atexit.register(_close_handlers, added)
     return root

@@ -1,0 +1,318 @@
+"""
+Step-loop metrics: named counters, phase timers, device-memory watermarks,
+and a JSONL telemetry sink.
+
+Async-dispatch awareness: JAX dispatch is asynchronous, so a host timer
+around a dispatched computation measures enqueue latency, not device work,
+unless the result is blocked on — and blocking every iteration serializes
+the dispatch pipeline. Phase timers therefore bracket `block_until_ready`
+only on sampled iterations (every `SAMPLE_CADENCE`-th step, config section
+[profiling]); off-cadence iterations pay one counter bump and no device
+sync. Sampled phase times are re-measurements of the already-compiled step
+pieces on the current state (the solver supplies the thunks), so sampling
+never perturbs the solution.
+
+Naming scheme: phase timer names are the `jax.named_scope` labels on the
+corresponding traced code, prefixed `dedalus/` — `dedalus/transform/...`,
+`dedalus/matsolve/...`, `dedalus/transpose/...`, `dedalus/evaluator/...`,
+`dedalus/step...` — so per-phase wall aggregates in the JSONL record and
+op rows in a `jax.profiler` trace share one vocabulary.
+
+Flush emits ONE record per call, shaped like `benchmarks/results.jsonl`
+rows (flat JSON object, `ts` + `config`/`backend`/`dtype` keys) with the
+phase breakdown attached; `python -m dedalus_tpu report <file.jsonl>`
+summarizes the records.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import jax
+
+from .config import config
+
+__all__ = ["PHASES", "Counter", "PhaseTimer", "MemoryWatermark", "Metrics",
+           "trace_scope", "annotate", "scoped", "resolve",
+           "format_phase_table"]
+
+# The hot-path phase vocabulary (shared with trace annotations).
+PHASES = ("transform", "matsolve", "transpose", "evaluator")
+
+
+def trace_scope(phase, detail=None):
+    """Named scope for traced code: labels the XLA ops compiled under it so
+    profiler traces group by the same phase names the timers report."""
+    name = f"dedalus/{phase}" + (f"/{detail}" if detail else "")
+    return jax.named_scope(name)
+
+
+def annotate(label, **kwargs):
+    """Host-level profiler annotation (TraceMe row around a dispatch);
+    near-free when no trace is being captured."""
+    return jax.profiler.TraceAnnotation(label, **kwargs)
+
+
+def scoped(fn, label):
+    """Wrap a callable in a jax.named_scope so profiler traces label the
+    ops it compiles with the shared phase vocabulary (the single helper
+    behind the transform-plan and matsolver wrapping)."""
+    def wrapper(*args, **kw):
+        with jax.named_scope(label):
+            return fn(*args, **kw)
+    wrapper.__name__ = getattr(fn, "__name__", "scoped")
+    return wrapper
+
+
+class Counter:
+    """Named monotonic counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+        return self.value
+
+
+class PhaseTimer:
+    """Accumulates sampled per-step seconds for each phase."""
+
+    def __init__(self, phases=PHASES):
+        self.totals = {p: 0.0 for p in phases}
+        self.counts = {p: 0 for p in phases}
+
+    def add(self, phase, seconds):
+        self.totals[phase] = self.totals.get(phase, 0.0) + float(seconds)
+        self.counts[phase] = self.counts.get(phase, 0) + 1
+
+    def mean(self, phase):
+        n = self.counts.get(phase, 0)
+        return self.totals.get(phase, 0.0) / n if n else 0.0
+
+    @property
+    def samples(self):
+        return max(self.counts.values(), default=0)
+
+
+class MemoryWatermark:
+    """Tracks peak device-memory use across samples. Prefers the backend's
+    allocator stats (`device.memory_stats()`, available on TPU/GPU); falls
+    back to summing live device arrays where the backend exposes no stats
+    (CPU)."""
+
+    def __init__(self):
+        self.peak_bytes = 0
+        self.source = None
+
+    def sample(self):
+        current = None
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                current = stats.get("peak_bytes_in_use",
+                                    stats.get("bytes_in_use"))
+                if current is not None:
+                    self.source = "memory_stats"
+        except Exception:
+            current = None
+        if current is None:
+            try:
+                current = sum(int(a.nbytes) for a in jax.live_arrays())
+                self.source = "live_arrays"
+            except Exception:
+                return self.peak_bytes
+        self.peak_bytes = max(self.peak_bytes, int(current))
+        return self.peak_bytes
+
+
+class Metrics:
+    """
+    Registry of counters, one phase timer, and a memory watermark, with
+    cadence-gated sampling and a JSONL sink.
+
+    Loop accounting: `observe_steps(n)` counts iterations and stamps the
+    loop clock (the first call — or `reset_loop()`, which the solver calls
+    at warmup end so compile time stays out of the window — anchors t0).
+    `flush()` turns the sampled per-step phase means into loop-total
+    estimates and appends one JSONL record to `sink` when set.
+    """
+
+    def __init__(self, sample_cadence=200, sink=None, enabled=True,
+                 sampling=True, meta=None):
+        self.enabled = bool(enabled)
+        self.sampling = bool(sampling) and self.enabled
+        self.sample_cadence = int(sample_cadence)
+        self.sink = str(sink) if sink else None
+        self.meta = dict(meta or {})
+        self.counters = {}
+        self.timer = PhaseTimer()
+        self.memory = MemoryWatermark()
+        self.iterations = 0
+        self._loop_t0 = None
+        self._next_due = max(self.sample_cadence, 1)
+        self._warmed = set()
+
+    # ------------------------------------------------------------- counters
+
+    def counter(self, name):
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def inc(self, name, n=1):
+        if not self.enabled:
+            return 0
+        return self.counter(name).inc(n)
+
+    # ----------------------------------------------------------------- loop
+
+    def observe_steps(self, n=1):
+        """Count n completed steps (non-blocking; no device sync)."""
+        if not self.enabled:
+            return
+        if self._loop_t0 is None:
+            self._loop_t0 = time.perf_counter()
+        self.iterations += int(n)
+
+    def reset_loop(self):
+        """Re-anchor the loop window (called at warmup end so compile and
+        ramp time stay out of the per-step accounting)."""
+        self.iterations = 0
+        self._loop_t0 = time.perf_counter()
+        self._next_due = max(self.sample_cadence, 1)
+
+    def loop_wall(self):
+        if self._loop_t0 is None:
+            return 0.0
+        return time.perf_counter() - self._loop_t0
+
+    # ------------------------------------------------------------- sampling
+
+    def due(self):
+        """Whether a phase sample is due at the current iteration count;
+        consuming (the next due point advances by one cadence)."""
+        if not self.sampling or self.sample_cadence <= 0:
+            return False
+        if self.iterations >= self._next_due:
+            self._next_due = self.iterations + self.sample_cadence
+            return True
+        return False
+
+    def time_thunk(self, name, thunk):
+        """Wall-time one thunk, bracketing `block_until_ready`. The first
+        call per name runs untimed (jit compilation / cache warm)."""
+        if name not in self._warmed:
+            jax.block_until_ready(thunk())
+            self._warmed.add(name)
+        t0 = time.perf_counter()
+        jax.block_until_ready(thunk())
+        return time.perf_counter() - t0
+
+    def add_phase_sample(self, seconds_by_phase):
+        """Record one sampled per-step attribution {phase: seconds}."""
+        for phase, sec in seconds_by_phase.items():
+            self.timer.add(phase, sec)
+        self.inc("phase_samples")
+        self.memory.sample()
+
+    # ---------------------------------------------------------------- flush
+
+    def flush(self, extra=None):
+        """Build one telemetry record (and append it to the JSONL sink when
+        configured). Callers should block on outstanding device work first
+        (the solver's `flush_metrics` does) so the loop wall time covers
+        the device tail of the final dispatch."""
+        if not self.enabled:
+            return None
+        self.memory.sample()
+        wall = self.loop_wall()
+        iters = self.iterations
+        phase_mean = {p: self.timer.mean(p) for p in PHASES}
+        phase_total = {p: phase_mean[p] * iters for p in PHASES}
+        phase_sum = sum(phase_total.values())
+        record = {
+            "kind": "step_metrics",
+            "ts": round(time.time(), 1),
+            "iterations": iters,
+            "loop_wall_sec": round(wall, 6),
+            "steps_per_sec": round(iters / wall, 4) if wall > 0 else 0.0,
+            "sample_cadence": self.sample_cadence,
+            "phase_samples": self.timer.samples,
+            "phase_mean_sec": {p: round(v, 6) for p, v in phase_mean.items()},
+            "phase_total_sec": {p: round(v, 6) for p, v in phase_total.items()},
+            "phase_sum_frac": round(phase_sum / wall, 4) if wall > 0 else 0.0,
+            "device_mem_peak_bytes": self.memory.peak_bytes,
+            "mem_source": self.memory.source,
+            "counters": {name: c.value for name, c in self.counters.items()},
+        }
+        record.update(self.meta)
+        if extra:
+            record.update(extra)
+        if self.sink:
+            try:
+                parent = os.path.dirname(os.path.abspath(self.sink))
+                os.makedirs(parent, exist_ok=True)
+                with open(self.sink, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            except OSError as exc:
+                import logging
+                logging.getLogger(__name__).warning(
+                    f"metrics sink {self.sink}: {exc}")
+        return record
+
+
+def resolve(spec=None, sink=None, cadence=None, meta=None):
+    """
+    Resolve a solver's `metrics` argument against the [profiling] config:
+    a Metrics instance passes through (meta keys are merged in); True/None
+    build from config (None respects METRICS_DEFAULT, True forces on);
+    False disables.
+    """
+    if isinstance(spec, Metrics):
+        for key, val in (meta or {}).items():
+            spec.meta.setdefault(key, val)
+        return spec
+    section = config["profiling"]
+    if spec is None:
+        enabled = section.getboolean("METRICS_DEFAULT", fallback=True)
+    else:
+        enabled = bool(spec)
+    if cadence is None:
+        cadence = int(section.get("SAMPLE_CADENCE", "200") or 200)
+    if sink is None:
+        sink = section.get("METRICS_FILE", "").strip() or None
+    return Metrics(sample_cadence=cadence, sink=sink, enabled=enabled,
+                   meta=meta)
+
+
+def format_phase_table(record, indent="  "):
+    """Render a flushed record's phase breakdown as aligned text lines
+    (used by `log_stats` and the `report` CLI)."""
+    if not record:
+        return []
+    wall = record.get("loop_wall_sec") or 0.0
+    iters = record.get("iterations") or 0
+    total = record.get("phase_total_sec") or {}
+    mean = record.get("phase_mean_sec") or {}
+    lines = [f"Per-phase wall time ({record.get('phase_samples', 0)} samples,"
+             f" cadence {record.get('sample_cadence', '?')}):"]
+    for phase in PHASES:
+        t = total.get(phase, 0.0)
+        frac = 100.0 * t / wall if wall > 0 else 0.0
+        lines.append(f"{indent}{phase:<10} {mean.get(phase, 0.0):#.4g} s/step"
+                     f"  {t:#.4g} s total  {frac:5.1f}%")
+    psum = sum(total.get(p, 0.0) for p in PHASES)
+    frac = 100.0 * psum / wall if wall > 0 else 0.0
+    lines.append(f"{indent}{'sum':<10} {psum:#.4g} s of {wall:#.4g} s loop"
+                 f" wall ({frac:.1f}%), {iters} iterations")
+    mem = record.get("device_mem_peak_bytes")
+    if mem:
+        lines.append(f"{indent}device memory peak: {mem / 1e9:.3f} GB"
+                     f" ({record.get('mem_source')})")
+    return lines

@@ -1,0 +1,105 @@
+"""
+CLI smoke tests: `get_config` and `report` run in fresh subprocesses so a
+regression in the command-line surface fails tier-1 instead of only
+surfacing on TPU watchers. Also covers the shared backend-probe platform
+sanitization in __graft_entry__.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def _run_cli(args, timeout=120):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([sys.executable, "-m", "dedalus_tpu", *args],
+                          cwd=REPO, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_get_config_subprocess():
+    proc = _run_cli(["get_config"])
+    assert proc.returncode == 0, proc.stderr
+    assert "[profiling]" in proc.stdout
+    assert "SAMPLE_CADENCE" in proc.stdout
+    assert "METRICS_DEFAULT" in proc.stdout
+
+
+def test_report_subprocess(tmp_path):
+    fixture = tmp_path / "metrics.jsonl"
+    records = [
+        {"kind": "step_metrics", "ts": 1.0, "config": "rb_fixture",
+         "backend": "cpu", "dtype": "float32", "iterations": 20,
+         "loop_wall_sec": 2.0, "steps_per_sec": 10.0, "sample_cadence": 5,
+         "phase_samples": 4,
+         "phase_mean_sec": {"transform": 0.03, "matsolve": 0.04,
+                            "transpose": 0.0, "evaluator": 0.02},
+         "phase_total_sec": {"transform": 0.6, "matsolve": 0.8,
+                             "transpose": 0.0, "evaluator": 0.4},
+         "phase_sum_frac": 0.9, "device_mem_peak_bytes": 123456789,
+         "mem_source": "live_arrays", "counters": {"steps": 20}},
+        # a bench-style row rides along in the same file
+        {"config": "rb256x64_bench", "metric": "RB2D_steps_per_sec",
+         "value": 12.3, "unit": "steps/sec", "ts": 2.0},
+    ]
+    fixture.write_text("".join(json.dumps(r) + "\n" for r in records))
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "rb_fixture" in out
+    for phase in ("transform", "matsolve", "transpose", "evaluator"):
+        assert phase in out
+    assert "1 metrics record(s), 1 other" in out
+    assert "RB2D_steps_per_sec" in out
+
+
+def test_report_missing_file():
+    proc = _run_cli(["report", "/nonexistent/metrics.jsonl"])
+    assert proc.returncode != 0
+    assert "cannot read" in proc.stderr
+
+
+def test_report_usage():
+    proc = _run_cli(["report"])
+    assert proc.returncode == 2
+    assert "usage" in proc.stderr
+
+
+def test_unknown_command():
+    proc = _run_cli(["not_a_command"])
+    assert proc.returncode == 2
+    assert "report" in proc.stderr  # listed in usage
+
+
+def _graft():
+    sys.path.insert(0, str(REPO))
+    import __graft_entry__
+    return __graft_entry__
+
+
+def test_sanitize_jax_platforms():
+    graft = _graft()
+    env = {"JAX_PLATFORMS": " tpu, ,cpu,, "}
+    assert graft._sanitize_jax_platforms(env)["JAX_PLATFORMS"] == "tpu,cpu"
+    env = {"JAX_PLATFORMS": " ,, "}
+    assert "JAX_PLATFORMS" not in graft._sanitize_jax_platforms(env)
+    env = {}
+    assert "JAX_PLATFORMS" not in graft._sanitize_jax_platforms(env)
+
+
+def test_probe_strips_unknown_platform():
+    """A probe env naming an unregistered platform falls back cleanly: the
+    bogus entry is stripped (mutating the caller's env, so bench children
+    inherit the fix) and the probe succeeds on the remainder — bench
+    records then never carry an 'Unable to initialize backend' error."""
+    graft = _graft()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "definitely_not_a_backend,cpu"
+    backend, n = graft._probe_devices(env, timeout=90)
+    assert backend == "cpu", n
+    assert env["JAX_PLATFORMS"] == "cpu"

@@ -1,0 +1,246 @@
+"""
+Step-loop metrics (tools/metrics.py): counter/timer/watermark semantics,
+sampling-cadence gating (no device sync off-cadence), JSONL flush
+round-trip, and an instrumented-solver smoke test on the CPU backend.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dedalus_tpu.tools import metrics as metrics_mod
+from dedalus_tpu.tools.metrics import (PHASES, Counter, Metrics,
+                                       MemoryWatermark, PhaseTimer)
+
+
+def test_counter_semantics():
+    c = Counter("steps")
+    assert c.value == 0
+    assert c.inc() == 1
+    assert c.inc(5) == 6
+    m = Metrics(sample_cadence=10)
+    m.inc("a")
+    m.inc("a", 2)
+    assert m.counter("a").value == 3
+    # disabled metrics: counters are inert
+    off = Metrics(enabled=False)
+    off.inc("a", 7)
+    assert off.counter("a").value == 0
+
+
+def test_phase_timer_semantics():
+    t = PhaseTimer()
+    assert set(t.totals) == set(PHASES)
+    t.add("transform", 0.5)
+    t.add("transform", 1.5)
+    t.add("matsolve", 1.0)
+    assert t.mean("transform") == pytest.approx(1.0)
+    assert t.mean("matsolve") == pytest.approx(1.0)
+    assert t.mean("transpose") == 0.0
+    assert t.samples == 2
+
+
+def test_memory_watermark_cpu():
+    import jax.numpy as jnp
+    w = MemoryWatermark()
+    first = w.sample()
+    keep = jnp.zeros((1024, 1024), dtype=jnp.float32)  # 4 MB live
+    second = w.sample()
+    assert second >= first
+    assert w.peak_bytes == max(first, second)
+    assert w.source in ("memory_stats", "live_arrays")
+    del keep
+
+
+def test_sampling_cadence_gating():
+    m = Metrics(sample_cadence=5)
+    fired = []
+    for i in range(1, 21):
+        m.observe_steps(1)
+        if m.due():
+            fired.append(i)
+    assert fired == [5, 10, 15, 20]  # one fire per cadence crossing
+    # block-of-steps crossing: fires once, not per crossed multiple
+    m2 = Metrics(sample_cadence=5)
+    m2.observe_steps(17)
+    assert m2.due()
+    assert not m2.due()
+    # sampling disabled: never due
+    m3 = Metrics(sample_cadence=5, sampling=False)
+    m3.observe_steps(50)
+    assert not m3.due()
+
+
+def test_time_thunk_warms_once_and_blocks():
+    calls = []
+
+    class FakeArray:
+        def block_until_ready(self):
+            calls.append("block")
+            return self
+
+    m = Metrics(sample_cadence=1)
+    thunk = lambda: (calls.append("run"), FakeArray())[1]
+    m.time_thunk("x", thunk)
+    assert calls == ["run", "block", "run", "block"]  # warm + timed
+    calls.clear()
+    m.time_thunk("x", thunk)
+    assert calls == ["run", "block"]  # warmed: single timed run
+
+
+def test_jsonl_flush_roundtrip(tmp_path):
+    sink = tmp_path / "metrics.jsonl"
+    m = Metrics(sample_cadence=2, sink=str(sink),
+                meta={"config": "unit", "backend": "cpu"})
+    m.observe_steps(4)
+    m.add_phase_sample({"transform": 0.01, "matsolve": 0.02,
+                        "transpose": 0.0, "evaluator": 0.005})
+    rec = m.flush(extra={"note": "roundtrip"})
+    assert rec is not None
+    lines = sink.read_text().splitlines()
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert parsed["kind"] == "step_metrics"
+    assert parsed["config"] == "unit"
+    assert parsed["note"] == "roundtrip"
+    assert parsed["iterations"] == 4
+    assert set(parsed["phase_total_sec"]) == set(PHASES)
+    assert parsed["phase_total_sec"]["matsolve"] == pytest.approx(
+        0.02 * 4, rel=1e-3)
+    assert parsed["phase_samples"] == 1
+    assert parsed["ts"] > 0
+    # second flush appends a second record
+    m.flush()
+    assert len(sink.read_text().splitlines()) == 2
+    # disabled metrics flush to nothing
+    assert Metrics(enabled=False).flush() is None
+
+
+def test_resolve_respects_spec_and_config():
+    m = Metrics(sample_cadence=7, meta={"backend": "x"})
+    same = metrics_mod.resolve(m, meta={"backend": "y", "dtype": "f32"})
+    assert same is m
+    assert same.meta["backend"] == "x"      # existing keys win
+    assert same.meta["dtype"] == "f32"      # new keys merge in
+    off = metrics_mod.resolve(False)
+    assert not off.enabled
+    on = metrics_mod.resolve(True, sink=None, cadence=33)
+    assert on.enabled and on.sample_cadence == 33
+
+
+def _instrumented_rb(tmp_path, nx=64, nz=32, cadence=4):
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, b = build_rb_solver(nx, nz, np.float32)
+    solver.warmup_iterations = 2
+    solver.metrics = metrics_mod.resolve(
+        True, sink=str(tmp_path / "m.jsonl"), cadence=cadence,
+        meta={"backend": "cpu", "dtype": "float32", "config": "rb_smoke"})
+    return solver
+
+
+def test_instrumented_step_many_emits_phase_record(tmp_path):
+    """CPU smoke: an instrumented step_many run emits a phase-breakdown
+    JSONL record whose phase sum is commensurate with the loop wall."""
+    solver = _instrumented_rb(tmp_path)
+    dt = 1e-4
+    for _ in range(3):
+        solver.step(dt)   # crosses warmup at iteration 2 -> probes compile
+    solver.step_many(9, dt)
+    rec = solver.flush_metrics()
+    assert rec["iterations"] == 10          # post-warmup window
+    assert rec["phase_samples"] >= 2        # warm sample + >=1 cadence fire
+    assert set(rec["phase_total_sec"]) == set(PHASES)
+    assert rec["phase_total_sec"]["transpose"] == 0.0   # single device
+    for phase in ("transform", "matsolve", "evaluator"):
+        assert rec["phase_total_sec"][phase] > 0.0
+    assert rec["steps_per_sec"] > 0
+    # phase attribution is commensurate with the measured loop wall (the
+    # tight 20% acceptance bound is asserted at bench scale in the slow
+    # test below; tiny problems carry relatively more host overhead)
+    assert 0.2 < rec["phase_sum_frac"] < 1.5
+    # sink got the same record
+    lines = (tmp_path / "m.jsonl").read_text().splitlines()
+    assert json.loads(lines[-1])["phase_total_sec"] == rec["phase_total_sec"]
+    # state untouched by sampling: still finite
+    assert np.all(np.isfinite(np.asarray(solver.X)))
+
+
+def test_no_sampling_off_cadence(tmp_path):
+    """Off-cadence iterations never run phase probes (no block_until_ready
+    beyond the step dispatch): with cadence above the iteration count only
+    the warmup-boundary sample exists."""
+    solver = _instrumented_rb(tmp_path, cadence=1000)
+    calls = []
+    orig = solver._sample_phases
+
+    def spy():
+        calls.append(solver.iteration)
+        return orig()
+
+    solver._sample_phases = spy
+    dt = 1e-4
+    for _ in range(3):
+        solver.step(dt)
+    solver.step_many(5, dt)
+    assert calls == [2]   # the warmup-end compile/sample only
+    rec = solver.flush_metrics()
+    assert rec["phase_samples"] == 1
+
+
+def test_step_many_only_driver_defers_warm(tmp_path):
+    """A driver that only calls step_many crosses warmup before the LHS is
+    factored: the probe warm-up defers past that first (compile-bearing)
+    block and the loop window re-anchors after it, so per-step rates never
+    include jit compile."""
+    solver = _instrumented_rb(tmp_path, cadence=1000)
+    solver.warmup_iterations = 2
+    solver.step_many(6, 1e-4)    # crosses warmup with no factor yet
+    assert not solver._metrics_warm_pending   # warmed after the block
+    assert solver.metrics.sampling
+    solver.step_many(4, 1e-4)
+    rec = solver.flush_metrics()
+    assert rec["phase_samples"] == 1          # the deferred warm sample
+    assert rec["iterations"] == 4             # window excludes block 1
+
+
+def test_metrics_disabled_solver(tmp_path):
+    """metrics=False solvers keep stepping with zero metrics state."""
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, b = build_rb_solver(32, 16, np.float64)
+    solver.metrics = metrics_mod.resolve(False)
+    solver.warmup_iterations = 1
+    for _ in range(3):
+        solver.step(1e-4)
+    assert solver.flush_metrics() is None
+    assert np.all(np.isfinite(np.asarray(solver.X)))
+
+
+def test_log_stats_phase_table(tmp_path, caplog):
+    import logging
+    solver = _instrumented_rb(tmp_path)
+    dt = 1e-4
+    for _ in range(3):
+        solver.step(dt)
+    solver.step_many(5, dt)
+    with caplog.at_level(logging.INFO, logger="dedalus_tpu"):
+        solver.log_stats()
+    text = caplog.text
+    assert "Per-phase wall time" in text
+    for phase in PHASES:
+        assert phase in text
+
+
+@pytest.mark.slow
+def test_rb256_phase_sum_within_20pct(tmp_path):
+    """Acceptance-scale check (RB2D 256x64 f32 CPU): per-phase timings sum
+    to within 20% of the measured loop wall time."""
+    solver = _instrumented_rb(tmp_path, nx=256, nz=64, cadence=10)
+    dt = 1e-4
+    for _ in range(3):
+        solver.step(dt)
+    for _ in range(3):
+        solver.step_many(10, dt)   # one cadence fire per block
+    rec = solver.flush_metrics()
+    assert rec["phase_samples"] >= 3
+    assert 0.8 <= rec["phase_sum_frac"] <= 1.2
