@@ -5,7 +5,8 @@ Command-line interface (reference: dedalus/__main__.py:1-45):
     python -m dedalus_tpu bench           # run the benchmark (bench.py)
     python -m dedalus_tpu get_config      # print the resolved configuration
     python -m dedalus_tpu get_examples    # print the examples directory
-    python -m dedalus_tpu report F.jsonl  # summarize a metrics JSONL file
+    python -m dedalus_tpu report F.jsonl [--last N]  # summarize metrics JSONL
+    python -m dedalus_tpu postmortem DIR  # summarize a health post-mortem
 """
 
 import json
@@ -64,19 +65,33 @@ def get_examples():
 
 def report():
     """Summarize a metrics JSONL file (tools/metrics.py records; bench rows
-    from benchmarks/results.jsonl are listed briefly)."""
+    from benchmarks/results.jsonl listed briefly; health post-mortem
+    records get their own line). Tolerates heterogeneous rows — records
+    from before any given key existed print with defaults rather than
+    crashing. `--last N` restricts to the N most recent parsable rows."""
     from .tools.metrics import format_phase_table
-    if len(sys.argv) < 3:
-        print("usage: python -m dedalus_tpu report <metrics.jsonl>",
-              file=sys.stderr)
+    args = sys.argv[2:]
+    last = None
+    if "--last" in args:
+        i = args.index("--last")
+        try:
+            last = int(args[i + 1])
+        except (IndexError, ValueError):
+            print("report: --last requires an integer", file=sys.stderr)
+            sys.exit(2)
+        args = args[:i] + args[i + 2:]
+    if not args:
+        print("usage: python -m dedalus_tpu report <metrics.jsonl> "
+              "[--last N]", file=sys.stderr)
         sys.exit(2)
-    path = pathlib.Path(sys.argv[2])
+    path = pathlib.Path(args[0])
     try:
         lines = path.read_text().splitlines()
     except OSError as exc:
         print(f"report: cannot read {path}: {exc}", file=sys.stderr)
         sys.exit(1)
-    n_metrics = n_other = n_bad = 0
+    records = []
+    n_bad = 0
     for line in lines:
         line = line.strip()
         if not line:
@@ -86,7 +101,16 @@ def report():
         except ValueError:
             n_bad += 1
             continue
-        if record.get("kind") == "step_metrics":
+        if not isinstance(record, dict):
+            n_bad += 1
+            continue
+        records.append(record)
+    if last is not None:
+        records = records[-last:] if last > 0 else []
+    n_metrics = n_post = n_other = 0
+    for record in records:
+        kind = record.get("kind")
+        if kind == "step_metrics":
             n_metrics += 1
             ident = " ".join(
                 f"{k}={record[k]}" for k in ("config", "backend", "dtype")
@@ -100,23 +124,56 @@ def report():
             # already printed in the header above
             for tline in format_phase_table(record, indent="    ")[1:]:
                 print(tline)
+            health = record.get("health")
+            if isinstance(health, dict):
+                status = "ok" if health.get("ok", True) else \
+                    f"FAILED: {health.get('reason', '?')}"
+                print(f"    health: {status}, "
+                      f"{health.get('checks', 0)} checks, "
+                      f"{health.get('warnings', 0)} warnings")
+        elif kind == "health_postmortem":
+            n_post += 1
+            print(f"(postmortem) iter={record.get('iteration', '?')} "
+                  f"sim_time={record.get('sim_time', '?')}: "
+                  f"{record.get('reason', '(no reason)')}"
+                  + (f" [{record.get('directory')}]"
+                     if record.get("directory") else ""))
         else:
             n_other += 1
             ident = record.get("metric") or record.get("config") or "record"
             val = record.get("value")
             unit = record.get("unit", "")
             extra = f" = {val} {unit}".rstrip() if val is not None else ""
-            print(f"(other) {ident}{extra}")
+            stale = " [stale]" if record.get("stale") else ""
+            print(f"(other) {ident}{extra}{stale}")
     print(f"{n_metrics} metrics record(s), {n_other} other, "
-          f"{n_bad} unparsable")
-    if n_metrics == 0 and n_other == 0:
+          f"{n_post} postmortem, {n_bad} unparsable")
+    if n_metrics == 0 and n_other == 0 and n_post == 0:
         sys.exit(1)
+
+
+def postmortem():
+    """Summarize a health flight-recorder dump (tools/health.py): accepts
+    the post-mortem directory or a record file inside it."""
+    from .tools.health import read_postmortem, format_postmortem
+    if len(sys.argv) < 3:
+        print("usage: python -m dedalus_tpu postmortem <dir-or-record>",
+              file=sys.stderr)
+        sys.exit(2)
+    path = pathlib.Path(sys.argv[2])
+    try:
+        record, ring = read_postmortem(path)
+    except (OSError, ValueError) as exc:
+        print(f"postmortem: cannot read {path}: {exc}", file=sys.stderr)
+        sys.exit(1)
+    for line in format_postmortem(record, ring):
+        print(line)
 
 
 def main():
     commands = {"test": test, "bench": bench, "cov": cov,
                 "get_config": get_config, "get_examples": get_examples,
-                "report": report}
+                "report": report, "postmortem": postmortem}
     if len(sys.argv) < 2 or sys.argv[1] not in commands:
         print(f"usage: python -m dedalus_tpu [{'|'.join(commands)}]",
               file=sys.stderr)

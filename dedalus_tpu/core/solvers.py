@@ -29,6 +29,7 @@ from .subsystems import (PencilLayout, build_subproblems, build_matrices,
 from .future import EvalContext, ev
 from . import timesteppers as timesteppers_mod
 from ..libraries import pencilops
+from ..tools import health as health_mod
 from ..tools import metrics as metrics_mod
 from ..tools.config import config
 from ..tools.general import is_complex_dtype
@@ -486,7 +487,8 @@ class InitialValueSolver(SolverBase):
     def __init__(self, problem, timestepper, matsolver=None,
                  enforce_real_cadence=100, warmup_iterations=10,
                  profile=None, profile_directory=None, metrics=None,
-                 metrics_file=None, sample_cadence=None, **kw):
+                 metrics_file=None, sample_cadence=None, health=None,
+                 health_cadence=None, postmortem_dir=None, **kw):
         init_t0 = time_mod.time()
         super().__init__(problem, matsolver=matsolver, **kw)
         self.M_mat = self.ops.to_device(self._matrices["M"], self.pencil_dtype)
@@ -549,12 +551,29 @@ class InitialValueSolver(SolverBase):
                   "dtype": str(np.dtype(self.pencil_dtype)),
                   "pencil_shape": list(self.pencil_shape)})
         self._metrics_warm_pending = False
+        # Numerical-health monitor (tools/health.py): cadence-gated fused
+        # NaN/growth/tail-energy probe + divergence flight recorder.
+        # Default-on per [health] config; a disabled monitor compiles
+        # nothing (zero-overhead path) but keeps the structured
+        # invalid-dt error path available.
+        self.health = health_mod.resolve(
+            health, solver=self, cadence=health_cadence,
+            postmortem_dir=postmortem_dir)
+        self._health_error = None
         self._setup_time = time_mod.time() - init_t0
         self._trace_active = False
 
     @property
+    def health_error(self):
+        """The SolverHealthError that halted the run (None while healthy)."""
+        return self._health_error
+
+    @property
     def proceed(self):
         """Whether to keep iterating (reference: core/solvers.py:618)."""
+        if self._health_error is not None:
+            # logged once at detection (health monitor); graceful halt
+            return False
         if self.sim_time >= self.stop_sim_time:
             logger.info("Simulation stop time reached.")
             return False
@@ -650,10 +669,12 @@ class InitialValueSolver(SolverBase):
         self.iteration += n
         self.dt = dt
         self.metrics.observe_steps(n)   # dd path: counters only, no probes
-        self.evaluator.evaluate_scheduled(
-            iteration=self.iteration,
-            wall_time=time_mod.time() - self.start_time,
-            sim_time=self.sim_time, timestep=dt)
+        self.health.tick(n)             # probes the f32 view (dd.X.hi)
+        if self._health_error is None:
+            self.evaluator.evaluate_scheduled(
+                iteration=self.iteration,
+                wall_time=time_mod.time() - self.start_time,
+                sim_time=self.sim_time, timestep=dt)
 
     def _stop_trace(self):
         if self._trace_active:
@@ -673,6 +694,9 @@ class InitialValueSolver(SolverBase):
         if self.metrics.sampling and self._dd is None:
             if not self._try_sample_phases():
                 self._metrics_warm_pending = self.metrics.sampling
+        # health probe compiles here too (one baseline record), keeping
+        # its compile out of measured windows like the phase probes
+        self.health.warm(self.X)
         self.metrics.reset_loop()
         self.warmup_time = time_mod.time()
         if self.profile and not self._trace_active:
@@ -689,7 +713,10 @@ class InitialValueSolver(SolverBase):
         """Advance the system by one timestep (reference: core/solvers.py:683)."""
         dt = float(dt)
         if not np.isfinite(dt):
-            raise ValueError("Invalid timestep.")
+            # structured health-error path: names iteration/sim_time and
+            # dumps the flight recorder, so a CFL-produced NaN timestep
+            # leaves the same post-mortem evidence as a NaN state
+            raise self.health.invalid_dt(dt)
         if self.iteration == self.warmup_iterations:
             self._end_warmup()
         if self._dd is not None:
@@ -712,9 +739,14 @@ class InitialValueSolver(SolverBase):
         self.iteration += 1
         self.dt = dt
         self._metrics_tick(1)
-        self.evaluator.evaluate_scheduled(
-            iteration=self.iteration, wall_time=time_mod.time() - self.start_time,
-            sim_time=self.sim_time, timestep=dt)
+        self.health.tick(1)
+        if self._health_error is None:
+            # a poisoned step must not flow into scheduled outputs (no
+            # NaN-filled checkpoint written as a "good" write)
+            self.evaluator.evaluate_scheduled(
+                iteration=self.iteration,
+                wall_time=time_mod.time() - self.start_time,
+                sim_time=self.sim_time, timestep=dt)
 
     def step_many(self, n, dt):
         """
@@ -729,7 +761,7 @@ class InitialValueSolver(SolverBase):
         n = int(n)
         dt = float(dt)
         if not np.isfinite(dt):
-            raise ValueError("Invalid timestep.")
+            raise self.health.invalid_dt(dt)
         if n <= 0:
             return
         if self.iteration <= self.warmup_iterations < self.iteration + n:
@@ -754,10 +786,12 @@ class InitialValueSolver(SolverBase):
         self.dt = dt
         self.metrics.inc("step_many_blocks")
         self._metrics_tick(n)
-        self.evaluator.evaluate_scheduled(
-            iteration=self.iteration,
-            wall_time=time_mod.time() - self.start_time,
-            sim_time=self.sim_time, timestep=dt)
+        self.health.tick(n)
+        if self._health_error is None:
+            self.evaluator.evaluate_scheduled(
+                iteration=self.iteration,
+                wall_time=time_mod.time() - self.start_time,
+                sim_time=self.sim_time, timestep=dt)
 
     # -------------------------------------------------------------- metrics
 
@@ -832,11 +866,17 @@ class InitialValueSolver(SolverBase):
     def flush_metrics(self, extra=None):
         """Block on the state (so the loop window covers the device tail of
         the final dispatch) and flush one telemetry record — appended to
-        the JSONL sink when one is configured. Returns the record dict."""
+        the JSONL sink when one is configured. Health summary (checks,
+        warnings, ok/failed) rides along under the `health` key. Returns
+        the record dict."""
         try:
             jax.block_until_ready(self.X)
         except Exception:
             pass
+        health_summary = self.health.summary()
+        if health_summary is not None:
+            extra = dict(extra or {})
+            extra.setdefault("health", health_summary)
         return self.metrics.flush(extra=extra)
 
     def evolve(self, timestep_function=None, log_cadence=100):
@@ -851,6 +891,11 @@ class InitialValueSolver(SolverBase):
                 self.step(dt)
                 if self.iteration % log_cadence == 0:
                     logger.info(f"Iteration={self.iteration}, Time={self.sim_time:.6e}, dt={dt:.6e}")
+            if self._health_error is not None:
+                logger.error(
+                    f"Main loop halted by health monitor: "
+                    f"{self._health_error.reason} (error available as "
+                    f"solver.health_error)")
         except Exception:
             logger.error("Exception raised, triggering end of main loop.")
             raise
@@ -927,6 +972,13 @@ class InitialValueSolver(SolverBase):
             if record and record.get("phase_samples"):
                 for line in metrics_mod.format_phase_table(record):
                     logger.info(line)
+        health_summary = self.health.summary()
+        if health_summary is not None:
+            status = "ok" if health_summary.get("ok") else \
+                f"FAILED ({health_summary.get('reason')})"
+            logger.info(f"Health: {status}, "
+                        f"{health_summary.get('checks', 0)} checks, "
+                        f"{health_summary.get('warnings', 0)} warnings")
         if self.profile:
             import json
             os.makedirs(self.profile_directory, exist_ok=True)

@@ -171,6 +171,24 @@ class GlobalFlowProperty:
         # tasks are integrals already when requested via integ(...)
         return np.sum(self.properties[name])
 
+    def report(self, names):
+        """
+        {name: {"max", "min", "avg"}} for the given property names —
+        one dict consumable by the health sink (tools/health.py attaches
+        it to flight-recorder dumps via `monitor.attach_flow(flow,
+        names)`). Properties that have not evaluated yet are skipped.
+        """
+        out = {}
+        for name in names:
+            try:
+                data = self.properties[name]
+            except KeyError:
+                continue
+            out[name] = {"max": float(self.reducer.global_max(data)),
+                         "min": float(self.reducer.global_min(data)),
+                         "avg": float(self.reducer.global_mean(data))}
+        return out
+
 
 class CFL:
     """
@@ -183,7 +201,8 @@ class CFL:
 
     def __init__(self, solver, initial_dt, cadence=1, safety=1.0,
                  max_dt=np.inf, min_dt=0.0, max_change=np.inf, min_change=0.0,
-                 threshold=0.0):
+                 threshold=0.0, history_size=256):
+        from collections import deque
         self.solver = solver
         self.initial_dt = initial_dt
         self.cadence = cadence
@@ -196,6 +215,13 @@ class CFL:
         self.velocities = []
         self.frequencies = []
         self.current_dt = initial_dt
+        # bounded (iteration, dt, freq_max) trail: the flight recorder's
+        # dt/CFL-frequency evidence (tools/health.py dt_history)
+        self.history = deque(maxlen=max(int(history_size), 1))
+        self._last_freq_max = None
+        monitor = getattr(solver, "health", None)
+        if monitor is not None and hasattr(monitor, "attach_dt_source"):
+            monitor.attach_dt_source(self)
 
     def add_velocity(self, velocity):
         """Register a velocity vector field for CFL frequencies
@@ -224,6 +250,7 @@ class CFL:
         iteration = self.solver.iteration
         if iteration % self.cadence == 0:
             freq_max = self.compute_max_frequency()
+            self._last_freq_max = float(freq_max)
             if freq_max == 0.0:
                 dt = self.max_dt
             else:
@@ -239,4 +266,7 @@ class CFL:
                     self.current_dt = self.current_dt * change
             else:
                 self.current_dt = dt
+        self.history.append({"iteration": int(iteration),
+                             "dt": float(self.current_dt),
+                             "freq_max": self._last_freq_max})
         return self.current_dt

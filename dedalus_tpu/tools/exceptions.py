@@ -23,6 +23,29 @@ class UnsupportedEquationError(DedalusError):
     """Raised when an equation is structurally unsupported."""
 
 
+class SolverHealthError(DedalusError, ValueError):
+    """
+    Structured numerical-health failure of a timestepping run (non-finite
+    state, growth-bound violation, or a non-finite timestep): carries the
+    failure context so post-mortems need no rerun. Subclasses ValueError so
+    callers that guarded the historical bare `raise ValueError("Invalid
+    timestep.")` keep working.
+
+    Attributes: reason (str), iteration (int), sim_time (float), record
+    (the triggering health-probe record, when one exists), postmortem_dir
+    (path of the flight-recorder dump, when one was written).
+    """
+
+    def __init__(self, reason, iteration=None, sim_time=None, record=None,
+                 postmortem_dir=None):
+        self.reason = reason
+        self.iteration = iteration
+        self.sim_time = sim_time
+        self.record = record
+        self.postmortem_dir = postmortem_dir
+        super().__init__(reason)
+
+
 class SkipDispatchException(Exception):
     """Control-flow exception to bypass multiclass dispatch with an output."""
 

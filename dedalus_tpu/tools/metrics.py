@@ -15,7 +15,8 @@ never perturbs the solution.
 Naming scheme: phase timer names are the `jax.named_scope` labels on the
 corresponding traced code, prefixed `dedalus/` — `dedalus/transform/...`,
 `dedalus/matsolve/...`, `dedalus/transpose/...`, `dedalus/evaluator/...`,
-`dedalus/step...` — so per-phase wall aggregates in the JSONL record and
+`dedalus/step...`, `dedalus/health/...` (the numerical-health probe,
+tools/health.py) — so per-phase wall aggregates in the JSONL record and
 op rows in a `jax.profiler` trace share one vocabulary.
 
 Flush emits ONE record per call, shaped like `benchmarks/results.jsonl`
@@ -33,9 +34,9 @@ import jax
 
 from .config import config
 
-__all__ = ["PHASES", "Counter", "PhaseTimer", "MemoryWatermark", "Metrics",
-           "trace_scope", "annotate", "scoped", "resolve",
-           "format_phase_table"]
+__all__ = ["PHASES", "CadenceGate", "Counter", "PhaseTimer",
+           "MemoryWatermark", "Metrics", "trace_scope", "annotate", "scoped",
+           "resolve", "format_phase_table"]
 
 # The hot-path phase vocabulary (shared with trace annotations).
 PHASES = ("transform", "matsolve", "transpose", "evaluator")
@@ -63,6 +64,34 @@ def scoped(fn, label):
             return fn(*args, **kw)
     wrapper.__name__ = getattr(fn, "__name__", "scoped")
     return wrapper
+
+
+class CadenceGate:
+    """
+    Consuming iteration-cadence gate: `due(iterations)` fires once per
+    cadence crossing and advances the next due point past the observed
+    count (a block of steps crossing several multiples fires once). The
+    single gating primitive behind both the [profiling] phase sampler and
+    the [health] probe, so the two subsystems cannot drift in semantics.
+    """
+
+    __slots__ = ("cadence", "_next_due")
+
+    def __init__(self, cadence):
+        self.cadence = int(cadence)
+        self._next_due = max(self.cadence, 1)
+
+    def reset(self, iterations=0):
+        """Re-anchor: the next fire is one full cadence past `iterations`."""
+        self._next_due = iterations + max(self.cadence, 1)
+
+    def due(self, iterations):
+        if self.cadence <= 0:
+            return False
+        if iterations >= self._next_due:
+            self._next_due = iterations + self.cadence
+            return True
+        return False
 
 
 class Counter:
@@ -154,7 +183,7 @@ class Metrics:
         self.memory = MemoryWatermark()
         self.iterations = 0
         self._loop_t0 = None
-        self._next_due = max(self.sample_cadence, 1)
+        self._gate = CadenceGate(self.sample_cadence)
         self._warmed = set()
 
     # ------------------------------------------------------------- counters
@@ -185,7 +214,7 @@ class Metrics:
         ramp time stay out of the per-step accounting)."""
         self.iterations = 0
         self._loop_t0 = time.perf_counter()
-        self._next_due = max(self.sample_cadence, 1)
+        self._gate.reset(0)
 
     def loop_wall(self):
         if self._loop_t0 is None:
@@ -197,12 +226,9 @@ class Metrics:
     def due(self):
         """Whether a phase sample is due at the current iteration count;
         consuming (the next due point advances by one cadence)."""
-        if not self.sampling or self.sample_cadence <= 0:
+        if not self.sampling:
             return False
-        if self.iterations >= self._next_due:
-            self._next_due = self.iterations + self.sample_cadence
-            return True
-        return False
+        return self._gate.due(self.iterations)
 
     def time_thunk(self, name, thunk):
         """Wall-time one thunk, bracketing `block_until_ready`. The first
@@ -222,6 +248,26 @@ class Metrics:
         self.memory.sample()
 
     # ---------------------------------------------------------------- flush
+
+    def emit(self, record):
+        """Append one arbitrary record to the configured JSONL sink — the
+        shared telemetry channel used by flush() step records and the
+        health monitor's post-mortem records. Returns the record (with a
+        `ts` stamped when missing), or None when disabled or sinkless."""
+        if not (self.enabled and self.sink):
+            return None
+        record = dict(record)
+        record.setdefault("ts", round(time.time(), 1))
+        try:
+            parent = os.path.dirname(os.path.abspath(self.sink))
+            os.makedirs(parent, exist_ok=True)
+            with open(self.sink, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError as exc:
+            import logging
+            logging.getLogger(__name__).warning(
+                f"metrics sink {self.sink}: {exc}")
+        return record
 
     def flush(self, extra=None):
         """Build one telemetry record (and append it to the JSONL sink when
@@ -254,16 +300,7 @@ class Metrics:
         record.update(self.meta)
         if extra:
             record.update(extra)
-        if self.sink:
-            try:
-                parent = os.path.dirname(os.path.abspath(self.sink))
-                os.makedirs(parent, exist_ok=True)
-                with open(self.sink, "a") as f:
-                    f.write(json.dumps(record) + "\n")
-            except OSError as exc:
-                import logging
-                logging.getLogger(__name__).warning(
-                    f"metrics sink {self.sink}: {exc}")
+        self.emit(record)
         return record
 
 

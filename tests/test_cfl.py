@@ -104,6 +104,46 @@ def test_global_flow_property():
     assert abs(flow.grid_average("u2") - 9.0) < 1e-8
 
 
+def test_global_flow_property_report():
+    """report(names) returns the health-sink-consumable dict: {name:
+    {max, min, avg}} as plain floats; unevaluated names are skipped."""
+    solver, u, coords = build_advection(2.0, 1.0)
+    flow = GlobalFlowProperty(solver, cadence=1)
+    flow.add_property(u @ u, name="u2")
+    assert flow.report(["u2"]) == {}        # nothing evaluated yet
+    solver.step(1e-3)
+    out = flow.report(["u2", "missing"])
+    assert set(out) == {"u2"}
+    expected = 2.0 ** 2 + 1.0 ** 2
+    for key in ("max", "min", "avg"):
+        assert isinstance(out["u2"][key], float)
+        assert abs(out["u2"][key] - expected) < 1e-8
+    import json
+    json.dumps(out)                         # sink-serializable as-is
+
+
+def test_cfl_history_feeds_flight_recorder():
+    """compute_timestep appends bounded (iteration, dt, freq_max) entries,
+    and the CFL self-registers as a health dt source."""
+    solver, u, coords = build_advection(2.0, 0.5)
+    cfl = CFL(solver, initial_dt=1.0, safety=0.4, cadence=1, history_size=3)
+    cfl.add_velocity(u)
+    for i in range(5):
+        cfl.compute_timestep()
+        solver.iteration += 1
+    assert len(cfl.history) == 3            # bounded ring
+    last = cfl.history[-1]
+    assert set(last) == {"iteration", "dt", "freq_max"}
+    assert last["dt"] == cfl.current_dt
+    assert last["freq_max"] > 0
+    # the solver's health monitor sees the same entries
+    assert cfl in solver.health._dt_sources
+    hist = solver.health.dt_history()
+    assert [e["iteration"] for e in hist] == sorted(
+        e["iteration"] for e in hist)
+    assert hist[-1]["dt"] == cfl.current_dt
+
+
 def test_advective_cfl_operator_matches_flow_tool():
     """The AdvectiveCFL operator's grid frequencies agree with the CFL
     flow tool's host computation (reference: core/operators.py:4306)."""

@@ -58,6 +58,87 @@ def test_report_subprocess(tmp_path):
     assert "RB2D_steps_per_sec" in out
 
 
+def test_report_heterogeneous_rows(tmp_path):
+    """Pre-PR-2 records missing keys, postmortem rows, and non-object JSON
+    lines must not crash the report; each lands in the right bucket."""
+    fixture = tmp_path / "mixed.jsonl"
+    rows = [
+        '{"kind": "step_metrics"}',                        # bare, no keys
+        '{"kind": "step_metrics", "iterations": 5, '
+        '"health": {"ok": false, "reason": "boom", "checks": 2}}',
+        '{"kind": "health_postmortem", "iteration": 7, '
+        '"sim_time": 0.7, "reason": "non-finite state"}',
+        '{"metric": "RB2D_steps_per_sec", "value": 1.0, "stale": true}',
+        '[1, 2, 3]',                                       # not an object
+        'not json at all',
+    ]
+    fixture.write_text("\n".join(rows) + "\n")
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    assert "2 metrics record(s), 1 other, 1 postmortem, 2 unparsable" \
+        in proc.stdout
+    assert "health: FAILED: boom" in proc.stdout
+    assert "non-finite state" in proc.stdout
+    assert "[stale]" in proc.stdout
+
+
+def test_report_last_filter(tmp_path):
+    fixture = tmp_path / "many.jsonl"
+    rows = [{"kind": "step_metrics", "iterations": i} for i in range(5)]
+    fixture.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run_cli(["report", str(fixture), "--last", "2"])
+    assert proc.returncode == 0, proc.stderr
+    assert "2 metrics record(s)" in proc.stdout
+    assert "3 iters" in proc.stdout and "4 iters" in proc.stdout
+    assert "0 iters" not in proc.stdout
+    proc = _run_cli(["report", str(fixture), "--last", "notanint"])
+    assert proc.returncode == 2
+    assert "--last" in proc.stderr
+
+
+def test_postmortem_subprocess(tmp_path):
+    """`postmortem <dir>` summarizes a flight-recorder dump; the record
+    fields round-trip into the printed summary."""
+    pm = tmp_path / "postmortem_i00000042"
+    pm.mkdir()
+    record = {
+        "kind": "health_postmortem", "ts": 1.0,
+        "reason": "non-finite state: field 'u' has 3 NaN / 0 Inf entries",
+        "iteration": 42, "sim_time": 4.2, "dt": 0.1,
+        "checks": 9, "warnings": 1,
+        "fields": {"u": {"nan": 3, "inf": 0, "max_abs": 1.5, "l2": 2.5,
+                         "tail_frac": {"z": 0.4}}},
+        "dt_history": [{"iteration": 41, "dt": 0.1, "freq_max": 12.0}],
+        "checkpoint": "state_at_failure.h5",
+        "backend": "cpu", "dtype": "float32",
+    }
+    (pm / "postmortem.json").write_text(json.dumps(record))
+    (pm / "health_ring.jsonl").write_text(
+        json.dumps({"kind": "health_sample", "iteration": 41}) + "\n"
+        + json.dumps({"kind": "health_sample", "iteration": 42}) + "\n")
+    proc = _run_cli(["postmortem", str(pm)])
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "non-finite state: field 'u'" in out
+    assert "iteration=42" in out
+    assert "backend=cpu" in out
+    assert "ring buffer: 2 records" in out
+    assert "freq_max=12.0" in out
+    assert "state_at_failure.h5" in out
+
+
+def test_postmortem_missing_dir():
+    proc = _run_cli(["postmortem", "/nonexistent/pm_dir"])
+    assert proc.returncode == 1
+    assert "cannot read" in proc.stderr
+
+
+def test_postmortem_usage():
+    proc = _run_cli(["postmortem"])
+    assert proc.returncode == 2
+    assert "usage" in proc.stderr
+
+
 def test_report_missing_file():
     proc = _run_cli(["report", "/nonexistent/metrics.jsonl"])
     assert proc.returncode != 0

@@ -1,0 +1,357 @@
+"""
+Numerical-health monitor + flight recorder (tools/health.py): divergence
+halt semantics, post-mortem directory contents and CLI round-trip,
+tail-energy under-resolution warnings, the structured invalid-dt path,
+and the zero-overhead disabled path.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.tools.exceptions import SolverHealthError
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def build_blowup_solver(tmp_path, N=16, **solver_kw):
+    """dt(s) = s*s with s0 = 2 and dt = 1: superexponential doubling that
+    overflows float64 within ~10 steps — a deterministic, cheap divergent
+    IVP (explicit quadratic term, unstable at any dt)."""
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=N, bounds=(0, 2 * np.pi))
+    s = dist.Field(name="s", bases=xb)
+    problem = d3.IVP([s], namespace={})
+    problem.add_equation((d3.dt(s), s * s))
+    kw = dict(health_cadence=1, postmortem_dir=str(tmp_path / "pm"),
+              warmup_iterations=2)
+    kw.update(solver_kw)
+    solver = problem.build_solver(d3.SBDF1, **kw)
+    s["g"] = 2.0
+    return solver, s
+
+
+def build_2d_solver(Nx=16, Nz=24, **solver_kw):
+    """Static 2D field (dt(s) = 0) on Fourier x Chebyshev: a probe target
+    whose spectrum the test controls exactly."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=Nx, bounds=(0, 2 * np.pi))
+    zb = d3.ChebyshevT(coords["z"], size=Nz, bounds=(0, 1))
+    s = dist.Field(name="s", bases=(xb, zb))
+    problem = d3.IVP([s], namespace={})
+    problem.add_equation((d3.dt(s), 0))
+    solver = problem.build_solver(d3.SBDF1, **solver_kw)
+    return solver, s, dist, xb, zb
+
+
+def test_divergent_ivp_halts_with_flight_recorder(tmp_path):
+    """A divergent run halts gracefully within one health cadence of the
+    first non-finite value: proceed flips False, a structured error is
+    available, and the post-mortem directory holds the ring buffer, the
+    summary record, and the forensic checkpoint."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.max_abs_limit = float("inf")   # ride through to NaN/Inf
+    steps = 0
+    while solver.proceed and steps < 60:
+        solver.step(1.0)
+        steps += 1
+    assert steps < 60, "divergent run never halted"
+    err = solver.health_error
+    assert isinstance(err, SolverHealthError)
+    assert isinstance(err, ValueError)           # legacy catch compatibility
+    # cadence 1: the halt lands exactly on the iteration whose probe first
+    # saw a non-finite value
+    assert err.iteration == solver.iteration
+    assert "non-finite state" in err.reason
+    assert err.record["fields"]["s"]["nan"] + err.record["fields"]["s"]["inf"] > 0
+    # flight-recorder directory contents
+    pm = pathlib.Path(err.postmortem_dir)
+    assert pm.is_dir()
+    record = json.loads((pm / "postmortem.json").read_text())
+    assert record["kind"] == "health_postmortem"
+    assert record["reason"] == err.reason
+    assert record["iteration"] == err.iteration
+    ring = [json.loads(ln) for ln
+            in (pm / "health_ring.jsonl").read_text().splitlines()]
+    assert ring and ring[-1]["iteration"] == err.iteration
+    assert all(r["kind"] == "health_sample" for r in ring)
+    # one-line results.jsonl-compatible record matches the summary and is
+    # STRICT JSON — a NaN-filled state must not leak NaN/Infinity literals
+    def reject_constant(name):
+        raise AssertionError(f"non-strict JSON literal {name} in record")
+    line = (pm / "record.jsonl").read_text().strip()
+    assert json.loads(line, parse_constant=reject_constant)["reason"] \
+        == err.reason
+    for ring_line in (pm / "health_ring.jsonl").read_text().splitlines():
+        json.loads(ring_line, parse_constant=reject_constant)
+    # forensic checkpoint present, clearly named (never a "good" write)
+    assert (pm / "state_at_failure.h5").exists()
+    # the summary rides on metric flushes
+    rec = solver.flush_metrics()
+    assert rec["health"]["ok"] is False
+    assert rec["health"]["reason"] == err.reason
+
+
+def test_postmortem_cli_roundtrip(tmp_path):
+    """The dumped directory round-trips through
+    `python -m dedalus_tpu postmortem <dir>`."""
+    solver, s = build_blowup_solver(tmp_path)
+    while solver.proceed and solver.iteration < 60:
+        solver.step(1.0)
+    err = solver.health_error
+    assert err is not None and err.postmortem_dir
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dedalus_tpu", "postmortem",
+         err.postmortem_dir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+    assert "Post-mortem:" in proc.stdout
+    assert f"iteration={err.iteration}" in proc.stdout
+    assert "ring buffer" in proc.stdout
+
+
+def test_growth_bound_halts_before_nan(tmp_path):
+    """The configurable growth bound trips while the state is still
+    finite, so the post-mortem evidence is inspectable numbers."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.max_abs_limit = 1e6
+    while solver.proceed and solver.iteration < 60:
+        solver.step(1.0)
+    err = solver.health_error
+    assert err is not None
+    assert "growth bound exceeded" in err.reason
+    stats = err.record["fields"]["s"]
+    assert stats["nan"] == 0 and stats["inf"] == 0
+    assert stats["max_abs"] > 1e6
+
+
+def test_no_output_written_after_failure(tmp_path):
+    """Scheduled file handlers are skipped on the poisoned step: the last
+    checkpoint written predates the failure (no NaN write as 'good')."""
+    import h5py
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.max_abs_limit = float("inf")
+    snaps = solver.evaluator.add_file_handler(tmp_path / "snaps", iter=1)
+    snaps.add_task(s, name="s")
+    while solver.proceed and solver.iteration < 60:
+        solver.step(1.0)
+    err = solver.health_error
+    assert err is not None
+    files = sorted((tmp_path / "snaps").glob("*.h5"))
+    assert files
+    with h5py.File(files[-1], "r") as f:
+        iters = np.asarray(f["scales/iteration"])
+        data = np.asarray(f["tasks/s"])
+    # every scheduled write happened strictly before the failing iteration
+    assert iters.max() < err.iteration
+    assert np.all(np.isfinite(data))
+
+
+def test_rb_divergent_halts(tmp_path):
+    """The flagship configuration diverged on purpose (explicitly unstable
+    dt): the RB IVP halts within one cadence of the first non-finite
+    state, with a post-mortem on disk."""
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, b = build_rb_solver(32, 16, np.float32)
+    solver.warmup_iterations = 2
+    solver.health.cadence = 1        # property: re-arms the gate
+    solver.health.max_abs_limit = float("inf")
+    solver.health.postmortem_dir = str(tmp_path / "pm")
+    steps = 0
+    while solver.proceed and steps < 300:
+        solver.step(100.0)   # far above any stable explicit dt
+        steps += 1
+    err = solver.health_error
+    assert err is not None, "unstable RB run never halted"
+    assert err.iteration == solver.iteration   # within one cadence (=1)
+    assert pathlib.Path(err.postmortem_dir).is_dir()
+    bad = [name for name, st in err.record["fields"].items()
+           if st["nan"] or st["inf"]]
+    assert bad, "halt record carries no non-finite field"
+
+
+def test_tail_energy_warning_and_quiet(caplog):
+    """A flat (under-resolved) spectrum warns once per field/axis; a
+    smooth resolved field stays quiet."""
+    import logging
+    solver, s, dist, xb, zb = build_2d_solver()
+    s["c"] = np.ones_like(np.asarray(s["c"]))
+    solver.X = solver.gather_fields()
+    with caplog.at_level(logging.WARNING, logger="dedalus_tpu"):
+        rec = solver.health.check()
+    assert rec["fields"]["s"]["tail_frac"]["z"] > 0.25
+    assert solver.health.warnings >= 2          # both x and z axes flat
+    assert "under-resolution" in caplog.text
+    assert "axis 'z'" in caplog.text
+    warned = solver.health.warnings
+    solver.health.check()                       # same state: no re-warn
+    assert solver.health.warnings == warned
+    # resolved field: compact spectrum -> no warning
+    solver2, s2, dist2, xb2, zb2 = build_2d_solver()
+    z = dist2.local_grids(xb2, zb2)[1]
+    s2["g"] = np.exp(-((z - 0.5) ** 2) * 8.0) * np.ones((16, 1))
+    solver2.X = solver2.gather_fields()
+    rec2 = solver2.health.check()
+    assert rec2["fields"]["s"]["tail_frac"]["z"] < 0.01
+    assert solver2.health.warnings == 0
+
+
+def test_tau_fields_exempt_from_tail_warning(tmp_path):
+    """tau_* fields are spectrally broad by construction: no tail warning,
+    but their stats still land in the record and NaN checks still apply."""
+    import jax.numpy as jnp
+    coords = d3.CartesianCoordinates("x")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 2 * np.pi))
+    tau_s = dist.Field(name="tau_s", bases=xb)
+    problem = d3.IVP([tau_s], namespace={})
+    problem.add_equation((d3.dt(tau_s), 0))
+    solver = problem.build_solver(d3.SBDF1,
+                                  postmortem_dir=str(tmp_path / "pm"))
+    tau_s["c"] = np.ones_like(np.asarray(tau_s["c"]))
+    solver.X = solver.gather_fields()
+    rec = solver.health.check()
+    assert rec["fields"]["tau_s"]["tail_frac"]["x"] > 0.25
+    assert solver.health.warnings == 0          # exempt from the warning
+    X = np.asarray(solver.X).copy()
+    X[0, 0] = np.nan
+    solver.X = jnp.asarray(X)
+    solver.health.check()
+    assert solver.health_error is not None      # NaN check still applies
+
+
+def test_zero_energy_field_never_warns():
+    """Fields below the energy floor (e.g. a zero-initialized velocity)
+    must not warn on round-off content."""
+    solver, s, *_ = build_2d_solver()
+    rec = solver.health.check()                 # s is all zeros
+    assert rec["fields"]["s"]["l2"] == 0.0
+    assert solver.health.warnings == 0
+
+
+def test_probe_counts_nan_inf(tmp_path):
+    """The fused probe reports exact NaN/Inf entry counts per field."""
+    import jax.numpy as jnp
+    solver, s, *_ = build_2d_solver(postmortem_dir=str(tmp_path / "pm"))
+    X = np.asarray(solver.X).copy()
+    X[0, 0] = np.nan
+    X[0, 1] = np.inf
+    X[1, 2] = -np.inf
+    solver.X = jnp.asarray(X)
+    rec = solver.health.check()
+    assert rec["fields"]["s"]["nan"] == 1
+    assert rec["fields"]["s"]["inf"] == 2
+    assert solver.health_error is not None
+    assert "non-finite state" in solver.health_error.reason
+
+
+def test_ring_buffer_bounded(tmp_path):
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.ring = type(solver.health.ring)(maxlen=4)
+    for _ in range(10):
+        solver.health.check()
+        if solver.health_error:
+            break
+    assert len(solver.health.ring) <= 4
+
+
+def test_invalid_dt_routes_through_health(tmp_path):
+    """A non-finite timestep (the CFL blow-up product) raises the same
+    structured error and leaves a flight-recorder dump — but does NOT
+    poison the solver: the state is still fine, so a legacy catch-and-
+    retry guard keeps the run alive, and repeat offenses don't spray
+    one dump per retry."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.step(0.01)
+    with pytest.raises(SolverHealthError) as excinfo:
+        solver.step(np.nan)
+    err = excinfo.value
+    assert "Invalid timestep" in str(err)
+    assert f"iteration {solver.iteration}" in str(err)
+    assert "sim_time" in str(err)
+    assert err.postmortem_dir and pathlib.Path(err.postmortem_dir).is_dir()
+    # catch-and-retry: the run continues (state untouched by the bad dt)
+    assert solver.proceed
+    assert solver.health_error is None
+    solver.step(0.01)
+    assert np.all(np.isfinite(np.asarray(solver.X)))
+    # a second bad dt raises again but reuses the single forensic dump
+    pm_parent = pathlib.Path(err.postmortem_dir).parent
+    n_dumps = len(list(pm_parent.iterdir()))
+    with pytest.raises(SolverHealthError):
+        solver.step(np.nan)
+    assert len(list(pm_parent.iterdir())) == n_dumps
+    # legacy catch sites still work, even with health disabled
+    with pytest.raises(ValueError):
+        solver2, _ = build_blowup_solver(tmp_path, health=False)
+        solver2.step_many(3, np.inf)
+
+
+def test_cadence_setter_rearms_gate(tmp_path):
+    """Assigning solver.health.cadence mid-run takes effect (the gate is
+    rebuilt and re-anchored), instead of silently keeping the old one."""
+    solver, s = build_blowup_solver(tmp_path, health_cadence=1000)
+    for _ in range(3):
+        solver.step(1e-3)
+    checks0 = solver.health.checks
+    solver.health.cadence = 2
+    for _ in range(6):
+        solver.step(1e-3)
+    assert solver.health.checks >= checks0 + 2   # re-armed gate fired
+
+
+def test_health_off_zero_overhead(tmp_path):
+    """health=False: no probe is ever built or compiled, no records
+    accumulate, and telemetry flushes carry no health key."""
+    solver, s = build_blowup_solver(tmp_path, health=False)
+    for _ in range(5):
+        solver.step(0.01)
+    monitor = solver.health
+    assert monitor.enabled is False
+    assert monitor._probe is None               # nothing compiled
+    assert monitor.checks == 0
+    assert len(monitor.ring) == 0
+    assert monitor.summary() is None
+    rec = solver.flush_metrics()
+    assert rec is None or "health" not in rec
+
+
+def test_checkpoint_restorable_after_growth_halt(tmp_path):
+    """The forensic checkpoint of a growth-bound halt (finite state)
+    loads back through solver.load_state."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.max_abs_limit = 1e6
+    while solver.proceed and solver.iteration < 60:
+        solver.step(1.0)
+    err = solver.health_error
+    ckpt = pathlib.Path(err.postmortem_dir) / "state_at_failure.h5"
+    assert ckpt.exists()
+    solver2, s2 = build_blowup_solver(tmp_path, health=False)
+    write, dt = solver2.load_state(str(ckpt))
+    assert solver2.iteration == err.iteration
+    assert solver2.sim_time == pytest.approx(err.sim_time)
+    assert np.all(np.isfinite(np.asarray(solver2.X)))
+
+
+def test_health_summary_in_bench_style_flush(tmp_path):
+    """Healthy runs flush ok=True summaries with check counts (the shape
+    bench.py attaches to its official record)."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.stop_iteration = 4
+    while solver.proceed:
+        solver.step(1e-3)
+    rec = solver.flush_metrics()
+    health = rec["health"]
+    assert health["ok"] is True
+    assert health["checks"] >= 1
+    assert "max_abs" in health
