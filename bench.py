@@ -125,6 +125,24 @@ def run_benchmark():
         health_sum = None
     if health_sum is not None:
         record["health"] = health_sum
+    # Jit-hygiene sentinels, so the perf trajectory shows hygiene
+    # regressions alongside steps/sec: post-warmup retrace count
+    # (tools/retrace.py; anything nonzero means the measured loop paid
+    # compile time) and static-analysis cleanliness vs the checked-in
+    # baseline (tools/lint).
+    try:
+        from dedalus_tpu.tools.retrace import sentinel
+        record["retraces_post_warmup"] = sentinel.post_arm_retraces
+    except Exception as exc:
+        mark(f"retrace sentinel read failed (non-fatal): {exc}")
+    try:
+        from dedalus_tpu.tools.lint import lint_package
+        lint_summary = lint_package()
+        record["lint_clean"] = (lint_summary["new"] == 0
+                                and not lint_summary["stale"])
+        record["lint_new_findings"] = lint_summary["new"]
+    except Exception as exc:
+        mark(f"lint status failed (non-fatal): {exc}")
     return record
 
 

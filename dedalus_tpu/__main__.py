@@ -7,6 +7,7 @@ Command-line interface (reference: dedalus/__main__.py:1-45):
     python -m dedalus_tpu get_examples    # print the examples directory
     python -m dedalus_tpu report F.jsonl [--last N]  # summarize metrics JSONL
     python -m dedalus_tpu postmortem DIR  # summarize a health post-mortem
+    python -m dedalus_tpu lint [paths]    # jit-hygiene static analysis
 """
 
 import json
@@ -16,6 +17,16 @@ import sys
 
 def test():
     import pytest
+    # fail fast on a missing/stale lint baseline: tests/test_lint.py would
+    # fail anyway, but only after the whole suite ran — and a stale
+    # baseline usually means a fixed hazard whose grandfathering should be
+    # dropped in the SAME commit
+    from .tools.lint import check_baseline_fresh
+    problems = check_baseline_fresh()
+    if problems:
+        for problem in problems:
+            print(f"test: {problem}", file=sys.stderr)
+        sys.exit(1)
     root = pathlib.Path(__file__).parent.parent
     # tier-1 semantics: slow-marked tests (long timing runs) are opt-in
     # via pytest directly
@@ -170,10 +181,17 @@ def postmortem():
         print(line)
 
 
+def lint():
+    """Jit-hygiene static analysis (tools/lint): DTL rule set, baseline,
+    suppressions. Nonzero exit on findings not covered by the baseline."""
+    from .tools.lint.cli import main as lint_main
+    sys.exit(lint_main(sys.argv[2:]))
+
+
 def main():
     commands = {"test": test, "bench": bench, "cov": cov,
                 "get_config": get_config, "get_examples": get_examples,
-                "report": report, "postmortem": postmortem}
+                "report": report, "postmortem": postmortem, "lint": lint}
     if len(sys.argv) < 2 or sys.argv[1] not in commands:
         print(f"usage: python -m dedalus_tpu [{'|'.join(commands)}]",
               file=sys.stderr)

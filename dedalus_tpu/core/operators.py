@@ -1533,13 +1533,17 @@ class UnaryGridFunction(Future):
 
 
 def _tracing_active():
-    """True when called under a jax trace (jit/vmap/grad). Conservative:
-    unknown JAX internals report True, keeping the callback path."""
-    try:
-        from jax._src.core import trace_ctx, EvalTrace
-        return not isinstance(trace_ctx.trace, EvalTrace)
-    except Exception:
+    """True when called under a jax trace (jit/vmap/grad); the shared
+    hardened probe in tools/jitlift (public API first, guarded private
+    fallback). When the probe DEGRADED (every trace-state API failed),
+    report True: an argless impure callback evaluated at trace time has
+    no tracer arguments for the call-site scan to catch, so unknown must
+    keep the io_callback path — the same conservative default the local
+    jax._src probe had before it moved to jitlift."""
+    from ..tools.jitlift import tracing_active, tracing_state_known
+    if not tracing_state_known():
         return True
+    return tracing_active()
 
 
 class GeneralFunction(Future):

@@ -31,6 +31,7 @@ from . import timesteppers as timesteppers_mod
 from ..libraries import pencilops
 from ..tools import health as health_mod
 from ..tools import metrics as metrics_mod
+from ..tools import retrace as retrace_mod
 from ..tools.config import config
 from ..tools.general import is_complex_dtype
 
@@ -551,6 +552,10 @@ class InitialValueSolver(SolverBase):
                   "dtype": str(np.dtype(self.pencil_dtype)),
                   "pencil_shape": list(self.pencil_shape)})
         self._metrics_warm_pending = False
+        # Retrace sentinel (tools/retrace.py): armed at warmup end; a
+        # post-warmup recompile of any step program warns and bumps the
+        # dedalus/retrace counter on this metrics instance.
+        retrace_mod.sentinel.subscribe(self.metrics)
         # Numerical-health monitor (tools/health.py): cadence-gated fused
         # NaN/growth/tail-energy probe + divergence flight recorder.
         # Default-on per [health] config; a disabled monitor compiles
@@ -699,6 +704,9 @@ class InitialValueSolver(SolverBase):
         self.health.warm(self.X)
         self.metrics.reset_loop()
         self.warmup_time = time_mod.time()
+        # warmup compiled (or deferred-compiles) every step program; any
+        # later retrace is a hygiene regression worth a structured warning
+        retrace_mod.sentinel.arm()
         if self.profile and not self._trace_active:
             import atexit
             os.makedirs(self.profile_directory, exist_ok=True)
@@ -874,9 +882,13 @@ class InitialValueSolver(SolverBase):
         except Exception:
             pass
         health_summary = self.health.summary()
+        extra = dict(extra or {})
         if health_summary is not None:
-            extra = dict(extra or {})
             extra.setdefault("health", health_summary)
+        # retrace-sentinel verdict rides in every telemetry record so the
+        # perf trajectory shows compile-hygiene regressions in place
+        extra.setdefault("retraces_post_warmup",
+                         retrace_mod.sentinel.post_arm_retraces)
         return self.metrics.flush(extra=extra)
 
     def evolve(self, timestep_function=None, log_cadence=100):
@@ -1052,8 +1064,11 @@ class NonlinearBoundaryValueSolver(SolverBase):
                 get_expr=lambda member: exprs.get(id(member)))
             from ..tools.jitlift import lifted_jit, device_constant
             mask_np, rd = self.valid_row_mask, self.real_dtype
-            fn = lifted_jit(lambda extra: eval_R(None, extra_arrays=extra)
-                            * device_constant(mask_np, dtype=rd))
+            # memoized via _residual_cache just below (hand-rolled guard
+            # the static pass cannot see)
+            fn = lifted_jit(  # dedalus-lint: disable=DTL003
+                lambda extra: eval_R(None, extra_arrays=extra)
+                * device_constant(mask_np, dtype=rd))
             cache = self._residual_cache = (eval_R.extra_fields, fn)
         fields, fn = cache
         return fn([f.coeff_data() for f in fields])

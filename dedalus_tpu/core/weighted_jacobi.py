@@ -19,7 +19,6 @@ Host classes provide: Nr, alpha (tuple), k, rho, dR, radial_COV, clone_with.
 """
 
 import numpy as np
-import jax.numpy as jnp
 
 from ..tools.cache import CachedMethod
 from ..tools import jacobi as jacobi_tools
@@ -73,9 +72,13 @@ class WeightedJacobiRadial:
         return B
 
     def _radial_matmul(self, data, r_axis, scale, forward):
+        # pass the HOST matrix: apply_matrix_jax's match_precision funnel
+        # routes it through tools.jitlift.device_constant (CachedMethod
+        # keeps the object identity stable for interning), so compiled
+        # programs receive it as a runtime argument, not program text
         M = self._radial_forward_matrix(scale) if forward \
             else self._radial_backward_matrix(scale)
-        return apply_matrix_jax(jnp.asarray(M), data, r_axis)
+        return apply_matrix_jax(M, data, r_axis)
 
     # ------------------------------------------------------- operator parts
 

@@ -257,7 +257,10 @@ class HealthMonitor:
                     }
                 return out
 
-        self._probe = jax.jit(probe)
+        # noted(): the probe participates in the retrace sentinel like the
+        # lifted_jit step programs (tools/retrace.py)
+        from . import retrace as retrace_mod
+        self._probe = jax.jit(retrace_mod.noted(probe, "health/probe"))
         return self._probe
 
     # ------------------------------------------------------------- ticks

@@ -25,21 +25,87 @@ only device-use funnels (`tools.array.match_precision` and the transform
 matmul helpers) route through `device_constant`.
 """
 
+import logging
 import threading
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["device_constant", "lifted_jit"]
+from . import retrace as retrace_mod
+
+__all__ = ["device_constant", "lifted_jit", "tracing_active",
+           "tracing_state_known"]
 
 
-def _tracing_active():
-    try:
-        from jax._src.core import trace_ctx, EvalTrace
-        return not isinstance(trace_ctx.trace, EvalTrace)
-    except Exception:
-        return True
+def _probe_public():
+    """Public trace-state probe (jax.core has exported trace_state_clean
+    across recent majors)."""
+    from jax.core import trace_state_clean
+    trace_state_clean()  # verify callable before committing to it
+    return lambda: not trace_state_clean()
+
+
+def _probe_private():
+    """Legacy fallback on jax internals; kept only for JAX builds whose
+    public surface predates/renames trace_state_clean."""
+    # the one sanctioned private-API fallback, guarded by _resolve below
+    from jax._src.core import trace_ctx, EvalTrace  # dedalus-lint: disable=DTL005
+    isinstance(trace_ctx.trace, EvalTrace)  # verify the attributes exist
+    return lambda: not isinstance(trace_ctx.trace, EvalTrace)
+
+
+def _resolve_tracing_probe(candidates=(_probe_public, _probe_private)):
+    """Resolve a () -> bool tracing probe, trying public JAX surfaces
+    before private ones. When every candidate fails (API drift across a
+    JAX upgrade), degrade to a constant-False probe with ONE warning
+    instead of raising: callers lose the inline-instead-of-cache guard
+    (device_value) and the eager GeneralFunction fast path, both safe
+    fallbacks, rather than the whole import."""
+    for candidate in candidates:
+        try:
+            return candidate()
+        except Exception:
+            continue
+    logging.getLogger(__name__).warning(
+        "jitlift: no usable JAX trace-state API (public and private probes "
+        "both failed); assuming never-tracing. device_constant caching and "
+        "GeneralFunction dispatch fall back to conservative behavior.")
+    return _degraded_probe
+
+
+def _degraded_probe():
+    """Distinguished never-tracing probe: callers that need to know
+    whether the answer is trustworthy check tracing_state_known()."""
+    return False
+
+
+_tracing_probe = None
+
+
+def tracing_active():
+    """True when called under a jax trace (jit/vmap/grad/eval_shape).
+    Resolved lazily against the running JAX version; see
+    _resolve_tracing_probe for the degradation contract."""
+    global _tracing_probe
+    if _tracing_probe is None:
+        _tracing_probe = _resolve_tracing_probe()
+    return _tracing_probe()
+
+
+def tracing_state_known():
+    """False when the trace-state probe degraded to constant-False (every
+    candidate API failed): tracing_active() is then a guess, and callers
+    with a safe conservative branch (e.g. GeneralFunction's io_callback
+    path) should take it."""
+    global _tracing_probe
+    if _tracing_probe is None:
+        _tracing_probe = _resolve_tracing_probe()
+    return _tracing_probe is not _degraded_probe
+
+
+# historical internal spelling (device_value below predates the public name)
+_tracing_active = tracing_active
 
 
 class _Registry:
@@ -84,9 +150,13 @@ class _Registry:
         called outside a trace."""
         val = self.arrays[idx]
         if isinstance(val, np.ndarray):
-            if _tracing_active():
-                return jnp.asarray(val)   # foreign trace: inline, no cache
-            val = self.arrays[idx] = jnp.asarray(val)
+            converted = jnp.asarray(val)
+            # never cache a tracer: belt (probe) AND suspenders (type
+            # check), so a degraded never-tracing probe cannot poison the
+            # process-global registry from inside a foreign trace
+            if _tracing_active() or isinstance(converted, jax.core.Tracer):
+                return converted   # foreign trace: inline, no cache
+            val = self.arrays[idx] = converted
         return val
 
 
@@ -160,6 +230,11 @@ class lifted_jit:
         self.fn = fn
         self.static_argnums = tuple(static_argnums)
         self._cache = {}
+        # retrace sentinel: the jit bodies below note every trace of THIS
+        # wrapper, so post-warmup recompiles surface as structured
+        # warnings + the dedalus/retrace metric (tools/retrace.py)
+        self._retrace_state = retrace_mod.TraceCount(
+            getattr(fn, "__qualname__", None) or repr(fn))
 
     def __call__(self, *args):
         static = tuple(args[i] for i in self.static_argnums)
@@ -173,6 +248,8 @@ class lifted_jit:
             idxs = tuple(sorted(touched))
 
             def wrapped(consts, *d):
+                # trace-time side effect: runs per (re)trace, not per call
+                retrace_mod.sentinel.note(self._retrace_state)
                 with _Mode("substitute", dict(zip(idxs, consts))):
                     return self._call_fn(static, d)
 
@@ -200,5 +277,6 @@ class lifted_jit:
             with _Mode("substitute", dict(zip(idxs, consts))):
                 return self._call_fn(static, d)
 
-        return jax.jit(wrapped).lower([_registry.device_value(i) for i in idxs],
-                                      *dynamic)
+        # cold inspection path: a fresh jit per lower() is the point here
+        return jax.jit(wrapped).lower(  # dedalus-lint: disable=DTL003
+            [_registry.device_value(i) for i in idxs], *dynamic)

@@ -1,0 +1,78 @@
+"""
+Jit-hygiene static analysis (`python -m dedalus_tpu lint`).
+
+The hot loop of this framework is only fast while three invariants hold:
+no host round-trips inside the step path, no large host arrays inlined
+into compiled program text (tools/jitlift.py exists precisely to lift
+them to runtime arguments), and no post-warmup retraces. Benchmarks catch
+violations hours later; this AST pass catches them at review time.
+
+Components:
+  framework.py — rule registry, findings, per-line `# dedalus-lint:
+                 disable=RULE` suppressions, JSON baseline for
+                 grandfathered findings, module context (import-alias
+                 canonicalization + traced-function detection).
+  rules.py     — the DTL rule set (see each rule's docstring).
+  cli.py       — `python -m dedalus_tpu lint [paths]`; exits nonzero on
+                 findings not covered by the baseline.
+
+The pass is self-enforcing: tests/test_lint.py runs it over the package
+against the checked-in baseline (tools/lint/baseline.json), so tier-1
+fails on any new un-baselined violation. The runtime complements are the
+retrace sentinel (tools/retrace.py) and the opt-in `leak_check` pytest
+marker (tests/conftest.py).
+"""
+
+from .framework import (DEFAULT_BASELINE, PACKAGE_DIR, Finding, LintResult,
+                        Rule, all_rules, apply_baseline, baseline_rel,
+                        load_baseline, make_baseline, register, run_lint)
+from . import rules  # noqa: F401  (imports register the rule set)
+
+__all__ = ["PACKAGE_DIR", "DEFAULT_BASELINE", "Finding", "LintResult",
+           "Rule", "all_rules", "apply_baseline", "baseline_rel",
+           "check_baseline_fresh", "lint_package", "load_baseline",
+           "make_baseline", "register", "run_lint"]
+
+
+def lint_package(baseline_path=None):
+    """Lint the installed package tree against a baseline (default: the
+    checked-in one). Returns a plain-dict summary — the programmatic
+    surface used by bench.py, `python -m dedalus_tpu test`, and tests:
+    {"total", "new", "baselined", "suppressed", "stale", "findings"}
+    where `findings` holds the NEW (un-baselined) findings as dicts and
+    `stale` the baseline entries no longer matched by any finding."""
+    baseline_path = DEFAULT_BASELINE if baseline_path is None else baseline_path
+    result = run_lint([PACKAGE_DIR])
+    baseline = load_baseline(baseline_path)
+    new, stale = apply_baseline(result.findings, baseline)
+    return {
+        "total": len(result.findings),
+        "new": len(new),
+        "baselined": len(result.findings) - len(new),
+        "suppressed": len(result.suppressed),
+        "stale": stale,
+        "findings": [f.to_dict() for f in new],
+    }
+
+
+def check_baseline_fresh(baseline_path=None):
+    """Fail-fast guard for `python -m dedalus_tpu test`: returns a list of
+    problem strings when the lint baseline is missing or stale (a stale
+    entry means a grandfathered finding was fixed but the baseline was not
+    regenerated — run `python -m dedalus_tpu lint --update-baseline`).
+    An empty list means the baseline exists and every entry still
+    matches."""
+    import pathlib
+    baseline_path = pathlib.Path(
+        DEFAULT_BASELINE if baseline_path is None else baseline_path)
+    if not baseline_path.exists():
+        return [f"lint baseline missing: {baseline_path} (run "
+                "`python -m dedalus_tpu lint --update-baseline`)"]
+    try:
+        summary = lint_package(baseline_path)
+    except ValueError as exc:
+        return [f"lint baseline unreadable: {baseline_path}: {exc}"]
+    return [f"lint baseline stale: {e['rule']} {e['path']} "
+            f"({e['snippet']!r}) no longer found — run "
+            "`python -m dedalus_tpu lint --update-baseline`"
+            for e in summary["stale"]]

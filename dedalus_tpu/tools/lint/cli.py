@@ -1,0 +1,128 @@
+"""
+`python -m dedalus_tpu lint [paths]` — run the jit-hygiene analyzer.
+
+Exit codes: 0 clean (every finding suppressed or baselined, baseline not
+stale), 1 new findings or stale baseline entries, 2 usage error.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .framework import (all_rules, apply_baseline, load_baseline,
+                        make_baseline, run_lint, DEFAULT_BASELINE,
+                        PACKAGE_DIR)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m dedalus_tpu lint",
+        description="Jit-hygiene static analysis (DTL rule set). "
+                    "Suppress single findings with a same-line "
+                    "'# dedalus-lint: disable=RULE' comment; grandfather "
+                    "existing ones into the baseline.")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint "
+                             "(default: the dedalus_tpu package)")
+    parser.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                        help="baseline JSON of grandfathered findings "
+                             "(default: %(default)s)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report every finding)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="regenerate the baseline from the current "
+                             "findings and exit 0")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="report format (default: text)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    return parser
+
+
+def main(argv=None):
+    """Entry point; returns the exit code (the __main__ shim sys.exits)."""
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as exc:
+        # argparse exits 2 on usage error, 0 on --help; keep its code
+        return int(exc.code or 0)
+
+    if args.list_rules:
+        for rule in all_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id} [{rule.severity}] {rule.title}: {doc}")
+        return 0
+
+    for p in args.paths:
+        path = pathlib.Path(p)
+        if not (path.is_dir() or (path.is_file() and path.suffix == ".py")):
+            # a typo'd path must not report a clean lint
+            print(f"lint: no such file or directory (or not .py): {p}",
+                  file=sys.stderr)
+            return 2
+    paths = args.paths or [str(PACKAGE_DIR)]
+    # staleness of the PACKAGE baseline is only meaningful when the scan
+    # covers the package: a subset scan leaves out-of-scope entries
+    # unmatched by construction, not because their findings were fixed.
+    # A custom --baseline is assumed scoped to the given paths.
+    check_stale = (pathlib.Path(args.baseline).resolve()
+                   != DEFAULT_BASELINE.resolve()
+                   or not args.paths
+                   or any(pathlib.Path(p).resolve() == PACKAGE_DIR
+                          for p in args.paths))
+    result = run_lint(paths)
+
+    if args.update_baseline:
+        baseline_path = pathlib.Path(args.baseline)
+        if args.paths \
+                and baseline_path.resolve() == DEFAULT_BASELINE.resolve():
+            # a subset scan would silently WIPE every grandfathered entry
+            # outside the given paths; the package baseline regenerates
+            # only from the full default scan
+            print("lint: refusing to regenerate the package baseline from "
+                  "a subset of paths (it would drop entries outside them); "
+                  "drop the paths, or pass --baseline FILE for a scoped "
+                  "baseline", file=sys.stderr)
+            return 2
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        baseline_path.write_text(
+            json.dumps(make_baseline(result.findings), indent=1) + "\n")
+        print(f"baseline: {len(result.findings)} finding(s) grandfathered "
+              f"-> {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        baseline = {}
+    else:
+        try:
+            baseline = load_baseline(args.baseline)
+        except ValueError as exc:
+            print(f"lint: {exc}", file=sys.stderr)
+            return 2
+    new, stale = apply_baseline(result.findings, baseline)
+    if not check_stale:
+        stale = []
+
+    summary = {
+        "total": len(result.findings),
+        "new": len(new),
+        "baselined": len(result.findings) - len(new),
+        "suppressed": len(result.suppressed),
+        "stale": stale,
+    }
+    if args.format == "json":
+        print(json.dumps({"findings": [f.to_dict() for f in new],
+                          "summary": summary}, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        for e in stale:
+            print(f"stale baseline entry: {e['rule']} {e['path']} "
+                  f"({e['snippet']!r}) — fixed? run --update-baseline")
+        print(f"{summary['total']} finding(s): {summary['new']} new, "
+              f"{summary['baselined']} baselined, "
+              f"{summary['suppressed']} suppressed, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if (new or stale) else 0

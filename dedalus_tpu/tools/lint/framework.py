@@ -1,0 +1,405 @@
+"""
+Lint framework: findings, rule registry, module context (import-alias
+canonicalization + traced-function detection), suppressions, baseline.
+
+Scope and honesty: this is a static pass over untyped Python, so rules
+work from structural heuristics (documented per rule) rather than proofs.
+Two shared analyses keep them precise enough to be useful:
+
+  * Canonical names — import aliases are resolved per module, so
+    `jnp.asarray`, `jax.numpy.asarray` and `from jax import numpy` all
+    canonicalize to "jax.numpy.asarray" before rules match.
+  * Traced-function detection — a function is considered TRACED when its
+    name (or a lambda) is passed to lifted_jit / jax.jit / jax.eval_shape /
+    jax.vmap / jax.lax.scan / shard_map, or it carries a jit-ish decorator
+    (including functools.partial(jax.jit, ...)). Code inside a traced
+    function becomes XLA program text, which changes what counts as a
+    hazard. Transitive tracing through ordinary calls is NOT resolved;
+    rules that depend on tracedness also take module-path scopes for the
+    known device libraries.
+
+Suppressions: `# dedalus-lint: disable=RULE[,RULE...]` on the finding's
+line silences it (counted separately, never silently dropped);
+`disable-file=RULE` anywhere in the file silences the whole module.
+
+Baseline: grandfathered findings keyed on (rule, package-relative path,
+stripped source line) with an occurrence count — stable across unrelated
+line-number drift. A baseline entry matched by fewer findings than its
+count is STALE (the hazard was fixed; regenerate with --update-baseline)
+so the baseline can only shrink, never quietly pad.
+"""
+
+import ast
+import json
+import pathlib
+import re
+
+# dedalus_tpu package root (this file lives at tools/lint/framework.py)
+PACKAGE_DIR = pathlib.Path(__file__).resolve().parents[2]
+
+# the checked-in grandfather baseline (single source of truth; cli and the
+# package API both import it from here)
+DEFAULT_BASELINE = PACKAGE_DIR / "tools" / "lint" / "baseline.json"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dedalus-lint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+# Wrappers whose function-valued arguments are traced into XLA programs.
+_TRACE_WRAPPERS = ("jax.jit", "jax.eval_shape", "jax.vmap", "jax.lax.scan",
+                   "jax.lax.while_loop", "jax.lax.fori_loop", "jax.grad",
+                   "jax.experimental.shard_map.shard_map", "shard_map",
+                   "lifted_jit")
+
+
+def baseline_rel(path):
+    """Baseline key path: package-relative posix when inside the package
+    (stable across checkouts), absolute posix otherwise (test fixtures)."""
+    p = pathlib.Path(path).resolve()
+    try:
+        return p.relative_to(PACKAGE_DIR).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+class Finding:
+    """One rule violation at file:line."""
+
+    __slots__ = ("rule", "severity", "path", "line", "col", "message",
+                 "snippet")
+
+    def __init__(self, rule, severity, path, line, col, message, snippet):
+        self.rule = rule
+        self.severity = severity
+        self.path = pathlib.Path(path)
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.snippet = snippet
+
+    def key(self):
+        return (self.rule, baseline_rel(self.path), self.snippet)
+
+    def to_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": baseline_rel(self.path), "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def format(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+
+class LintResult:
+    """Active findings plus the suppressed ones (reported, never hidden)."""
+
+    __slots__ = ("findings", "suppressed")
+
+    def __init__(self, findings, suppressed):
+        self.findings = findings
+        self.suppressed = suppressed
+
+
+RULES = {}
+
+
+def register(cls):
+    """Class decorator: add a Rule to the global registry by its id."""
+    RULES[cls.id] = cls()
+    return cls
+
+
+def all_rules():
+    return [RULES[rid] for rid in sorted(RULES)]
+
+
+class Rule:
+    """Base rule: subclasses set id/severity/title and implement
+    check(ctx) yielding Findings."""
+
+    id = None
+    severity = "error"
+    title = ""
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+    def finding(self, ctx, node, message):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = ctx.lines[line - 1].strip() if line <= len(ctx.lines) else ""
+        return Finding(self.id, self.severity, ctx.path, line, col,
+                       message, snippet)
+
+
+class ModuleContext:
+    """Parsed module + the shared analyses rules draw on."""
+
+    def __init__(self, path, source):
+        self.path = pathlib.Path(path)
+        self.rel = baseline_rel(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.aliases = self._collect_aliases()
+        self.line_suppressions, self.file_suppressions = \
+            self._collect_suppressions()
+        self._traced = None
+
+    # ------------------------------------------------------ canonical names
+
+    def _collect_aliases(self):
+        aliases = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        aliases[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    full = f"{mod}.{alias.name}" if mod else alias.name
+                    aliases[alias.asname or alias.name] = full
+        return aliases
+
+    def canon(self, node):
+        """Dotted canonical name of a Name/Attribute chain, with the base
+        resolved through this module's import aliases; None when the base
+        is not a plain name (e.g. a call result)."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            base = self.aliases.get(node.id, node.id)
+            return ".".join([base] + parts[::-1])
+        return None
+
+    # ----------------------------------------------------------- structure
+
+    def parent(self, node):
+        return self._parents.get(node)
+
+    def enclosing_function(self, node):
+        """Nearest enclosing FunctionDef/AsyncFunctionDef (not lambdas)."""
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    # ------------------------------------------------------------- tracing
+
+    def _jitish(self, call):
+        """Whether a Call node invokes a trace wrapper (directly or as
+        functools.partial(jax.jit, ...))."""
+        name = self.canon(call.func)
+        if name is None:
+            return False
+        if name_matches(name, *_TRACE_WRAPPERS):
+            return True
+        if name_matches(name, "functools.partial") and call.args:
+            inner = self.canon(call.args[0])
+            return inner is not None and name_matches(inner, *_TRACE_WRAPPERS)
+        return False
+
+    def _decorator_jitish(self, dec):
+        name = self.canon(dec)
+        if name is not None and name_matches(name, *_TRACE_WRAPPERS):
+            return True
+        return isinstance(dec, ast.Call) and self._jitish(dec)
+
+    def traced_nodes(self):
+        """Set of FunctionDef/Lambda nodes treated as traced (see module
+        docstring for the detection contract)."""
+        if self._traced is not None:
+            return self._traced
+        traced_names = set()
+        traced = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and self._jitish(node):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        traced_names.add(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        traced.add(arg)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._decorator_jitish(d) for d in node.decorator_list):
+                    traced.add(node)
+        for node in ast.walk(self.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in traced_names):
+                traced.add(node)
+        self._traced = traced
+        return traced
+
+    def in_traced(self, node):
+        """Whether node sits lexically inside a traced function/lambda."""
+        traced = self.traced_nodes()
+        cur = node
+        while cur is not None:
+            if cur in traced:
+                return True
+            cur = self.parent(cur)
+        return False
+
+    # -------------------------------------------------------- suppressions
+
+    def _collect_suppressions(self):
+        """Scan COMMENT tokens only (via tokenize), so suppression syntax
+        QUOTED in a docstring or string literal — e.g. documentation of
+        the mechanism itself — never registers as a real suppression.
+        Falls back to a raw line scan only if tokenization fails (the
+        module already parsed, so that is not an expected path)."""
+        per_line = {}
+        per_file = set()
+        try:
+            import io
+            import tokenize
+            comments = [(tok.start[0], tok.string) for tok in
+                        tokenize.generate_tokens(
+                            io.StringIO(self.source).readline)
+                        if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = list(enumerate(self.lines, start=1))
+        for lineno, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            if m.group("file"):
+                per_file |= rules
+            else:
+                per_line.setdefault(lineno, set()).update(rules)
+        return per_line, per_file
+
+    def suppressed(self, finding):
+        if finding.rule in self.file_suppressions:
+            return True
+        return finding.rule in self.line_suppressions.get(finding.line, set())
+
+
+def name_matches(canon, *patterns):
+    """Suffix-tolerant canonical-name match: 'a.b.c' matches patterns
+    'a.b.c', 'b.c' and 'c' only at dotted boundaries — so from-imports
+    whose defining module the linter cannot resolve (relative imports)
+    still match their known tails."""
+    for pat in patterns:
+        if canon == pat or canon.endswith("." + pat):
+            return True
+    return False
+
+
+def module_matches(rel, module_paths):
+    """Whether a file's baseline-relative path is one of the given
+    package-relative module paths (suffix match, so test fixtures living
+    under tmp dirs can opt into a scope by mirroring the path)."""
+    rel = pathlib.PurePosixPath(rel).as_posix()
+    for mod in module_paths:
+        if rel == mod or rel.endswith("/" + mod):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ runner
+
+def collect_py_files(paths):
+    files = []
+    for path in paths:
+        p = pathlib.Path(path)
+        if p.is_dir():
+            files.extend(sorted(f for f in p.rglob("*.py")
+                                if "__pycache__" not in f.parts))
+        elif p.suffix == ".py":
+            files.append(p)
+    # dedupe, preserving order
+    seen = set()
+    unique = []
+    for f in files:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            unique.append(f)
+    return unique
+
+
+def run_lint(paths, rules=None):
+    """Run the rule set over .py files under `paths`. Unparsable files
+    surface as DTL000 findings (a lint pass that skips broken files hides
+    exactly the commit that needs review). Returns a LintResult."""
+    rules = all_rules() if rules is None else rules
+    findings = []
+    suppressed = []
+    for path in collect_py_files(paths):
+        try:
+            source = path.read_text()
+            ctx = ModuleContext(path, source)
+        except (OSError, SyntaxError, ValueError) as exc:
+            findings.append(Finding("DTL000", "error", path,
+                                    getattr(exc, "lineno", 1) or 1, 0,
+                                    f"unparsable module: {exc}", ""))
+            continue
+        for rule in rules:
+            for finding in rule.check(ctx):
+                if ctx.suppressed(finding):
+                    suppressed.append(finding)
+                else:
+                    findings.append(finding)
+    return LintResult(findings, suppressed)
+
+
+# ---------------------------------------------------------------- baseline
+
+def load_baseline(path):
+    """Baseline file -> {key: count}. A missing file is an empty baseline
+    (callers that require its presence check exists() themselves)."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        return {}
+    try:
+        data = json.loads(p.read_text())
+        entries = data["entries"]
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        raise ValueError(f"unreadable baseline {p}: {exc}")
+    baseline = {}
+    for e in entries:
+        key = (e["rule"], e["path"], e["snippet"])
+        baseline[key] = baseline.get(key, 0) + int(e.get("count", 1))
+    return baseline
+
+
+def make_baseline(findings):
+    """Grandfather the given findings: the JSON-able baseline structure."""
+    counts = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [{"rule": rule, "path": rel, "snippet": snippet, "count": n}
+               for (rule, rel, snippet), n in sorted(counts.items())]
+    return {"version": 1, "entries": entries}
+
+
+def apply_baseline(findings, baseline):
+    """Split findings against a {key: count} baseline. Returns
+    (new_findings, stale_entries): each baseline count absorbs that many
+    matching findings; the excess is new, and under-matched entries are
+    stale dicts {"rule", "path", "snippet", "missing"}."""
+    remaining = dict(baseline)
+    new = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    stale = [{"rule": rule, "path": rel, "snippet": snippet, "missing": n}
+             for (rule, rel, snippet), n in sorted(remaining.items()) if n > 0]
+    return new, stale

@@ -1,0 +1,339 @@
+"""
+The DTL rule set. Every rule is grounded in a hazard this codebase has
+actually hit (see docstrings); each documents its heuristic boundaries so
+a quiet pass is never mistaken for a proof.
+
+Scopes:
+  HOT_PATH_MODULES     — the step-loop modules where a stray host sync
+                         serializes the dispatch pipeline every iteration.
+  TRACED_CONTEXT_MODULES — device math libraries whose functions run under
+                         jit via the transform/solve call graph even though
+                         no jit wrapper appears in-module (static tracing
+                         detection cannot see through the call graph, so
+                         these are declared).
+  FUNNEL_MODULES       — the sanctioned precision/constant funnels; exempt
+                         from DTL002 (they ARE the device_constant route).
+"""
+
+import ast
+
+from .framework import Rule, register, name_matches, module_matches
+
+HOT_PATH_MODULES = (
+    "core/timesteppers.py",
+    "core/ddstep.py",
+    "libraries/pencilops.py",
+    "parallel/transposes.py",
+)
+
+TRACED_CONTEXT_MODULES = (
+    "core/transforms.py",
+    "core/weighted_jacobi.py",
+    "libraries/pencilops.py",
+    "libraries/matsolvers.py",
+    "libraries/sphere.py",
+    "libraries/zernike.py",
+    "libraries/spin_intertwiners.py",
+)
+
+FUNNEL_MODULES = (
+    "tools/array.py",
+    "tools/jitlift.py",
+)
+
+
+def _contains_jax_call(ctx, node):
+    """Whether the expression contains a call into jax/jax.numpy."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = ctx.canon(sub.func)
+            if name is not None and (name.startswith("jax.")
+                                     or name == "jax"):
+                return True
+    return False
+
+
+@register
+class HostSyncInHotPath(Rule):
+    """DTL001: host synchronization in the step loop.
+
+    JAX dispatch is asynchronous; `.item()`, `float()/int()` of a device
+    value, `np.asarray()` of a tracer, and `block_until_ready` each force
+    the host to wait on the device (or worse, bake a sync into every
+    iteration), which serializes the dispatch pipeline the whole metrics
+    subsystem was built to keep clean (tools/metrics.py module docstring).
+    The only sanctioned blocking is the cadence-gated sampler in
+    tools/metrics.py — which is outside this rule's scope by construction.
+
+    Heuristics: fires in HOT_PATH_MODULES (whole file) and inside traced
+    functions anywhere. `float()/int()` only flag when the argument
+    contains a jax/jnp call (`float(dt)` on host scalars is fine);
+    `np.asarray/np.array` only flag bare-Name arguments inside traced code
+    (attribute chains like `scheme.A` are host tableau constants).
+    """
+
+    id = "DTL001"
+    severity = "error"
+    title = "host-sync-in-hot-path"
+
+    def check(self, ctx):
+        hot = module_matches(ctx.rel, HOT_PATH_MODULES)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            in_scope = hot or ctx.in_traced(node)
+            if not in_scope:
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "item" \
+                    and not node.args:
+                yield self.finding(
+                    ctx, node, ".item() forces a device->host sync in the "
+                    "hot path; keep reductions on device or move the read "
+                    "behind a metrics/health cadence gate")
+                continue
+            if (isinstance(func, ast.Attribute)
+                    and func.attr == "block_until_ready") or (
+                    (name := ctx.canon(func)) is not None
+                    and name_matches(name, "jax.block_until_ready")):
+                yield self.finding(
+                    ctx, node, "block_until_ready in the hot path "
+                    "serializes the dispatch pipeline; only the "
+                    "cadence-gated sampler in tools/metrics.py may block")
+                continue
+            name = ctx.canon(func)
+            if name in ("float", "int") and node.args \
+                    and _contains_jax_call(ctx, node.args[0]):
+                yield self.finding(
+                    ctx, node, f"{name}() of a jax expression synchronously "
+                    "pulls the value to host; keep the computation on "
+                    "device or sample it behind a cadence gate")
+                continue
+            # exact match: suffix-tolerant matching would also catch
+            # jax.numpy.asarray, which is the trace-safe spelling
+            if name in ("numpy.asarray", "numpy.array") \
+                    and node.args and isinstance(node.args[0], ast.Name) \
+                    and ctx.in_traced(node):
+                yield self.finding(
+                    ctx, node, f"{name.split('.')[-1]}() on a local inside "
+                    "traced code concretizes a tracer (host sync or trace "
+                    "error); use jnp, or hoist host work out of the trace")
+
+
+@register
+class InlinedDeviceConstant(Rule):
+    """DTL002: host array inlined into compiled program text.
+
+    This JAX version inlines every non-splat array constant into the
+    lowered MLIR — a 100 MB transform stack adds ~400 MB of program text,
+    and spectral kernels are built from exactly such constants
+    (tools/jitlift.py module docstring; the multi-GB programs that
+    motivated lifted_jit). Host matrices entering traced code must route
+    through tools.jitlift.device_constant (directly or via the
+    tools.array.match_precision funnel) so they become runtime ARGUMENTS.
+
+    Heuristic: flags `jnp.asarray(x)` / `jnp.array(x)` where x is a bare
+    Name or attribute chain and no dtype= is given, inside traced
+    functions anywhere plus anywhere in TRACED_CONTEXT_MODULES (device
+    libraries reached under jit through the call graph). Calls that pass
+    dtype= are the deliberate small-scalar/coefficient conversions the
+    step path makes (e.g. `jnp.asarray(a, dtype=rd)`); the bare no-dtype
+    form is the "just ship the matrix" pattern that inlines (the shipped
+    case: core/weighted_jacobi.py's radial matmul before it was routed
+    through the funnel).
+    """
+
+    id = "DTL002"
+    severity = "error"
+    title = "inlined-device-constant"
+
+    def check(self, ctx):
+        if module_matches(ctx.rel, FUNNEL_MODULES):
+            return
+        lib = module_matches(ctx.rel, TRACED_CONTEXT_MODULES)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.canon(node.func)
+            if name is None or not name_matches(
+                    name, "jax.numpy.asarray", "jax.numpy.array"):
+                continue
+            # a dtype argument (kwarg or positional) marks the deliberate
+            # scalar/coefficient conversions of the step path
+            if len(node.args) >= 2 \
+                    or any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if not node.args or not isinstance(node.args[0],
+                                               (ast.Name, ast.Attribute)):
+                continue
+            if lib or ctx.in_traced(node):
+                yield self.finding(
+                    ctx, node, "host array converted in traced context is "
+                    "inlined into program text; route it through "
+                    "tools.jitlift.device_constant (or the "
+                    "tools.array.match_precision funnel) so it becomes a "
+                    "runtime argument")
+
+
+@register
+class JitInCallPath(Rule):
+    """DTL003: jit wrapper constructed inside a call path.
+
+    `jax.jit` / `lifted_jit` build a fresh trace cache per wrapper object:
+    constructing one inside a function that runs per step (or per solve)
+    retraces and recompiles on every call — the program-cache equivalent
+    of a host sync, and it also defeats lifted_jit's constant interning.
+    Wrappers belong at module scope, in `__init__`, or memoized.
+
+    Heuristic: flags jit/lifted_jit calls (including
+    functools.partial(jax.jit, ...) used as a decorator) lexically inside
+    a function body, EXCEPT inside `__init__` and except when the result
+    is stored to `self.<attr>` or into a subscripted cache (both memoized-
+    once patterns used across this codebase). Hand-rolled `if cache is
+    None` guards around a plain local are invisible to this pass — carry
+    a suppression comment naming the cache.
+    """
+
+    id = "DTL003"
+    severity = "error"
+    title = "jit-in-call-path"
+
+    def _exempt_assignment(self, ctx, node):
+        """Whether the jit call's value lands in a memoized slot."""
+        cur = node
+        parent = ctx.parent(cur)
+        while parent is not None and not isinstance(parent, ast.stmt):
+            cur, parent = parent, ctx.parent(parent)
+        if isinstance(parent, ast.Assign):
+            return any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in parent.targets)
+        if isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+            return isinstance(parent.target, (ast.Attribute, ast.Subscript))
+        return False
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and ctx._jitish(node):
+                name = ctx.canon(node.func)
+                # only the jit constructors; tracing combinators like
+                # lax.scan/vmap run inside traces by design
+                if name is None or not (
+                        name_matches(name, "jax.jit", "lifted_jit")
+                        or (name_matches(name, "functools.partial")
+                            and node.args
+                            and (inner := ctx.canon(node.args[0])) is not None
+                            and name_matches(inner, "jax.jit"))):
+                    continue
+                enclosing = ctx.enclosing_function(node)
+                if enclosing is None or enclosing.name == "__init__":
+                    continue
+                if self._exempt_assignment(ctx, node):
+                    continue
+                yield self.finding(
+                    ctx, node, "jit wrapper constructed inside a function "
+                    "retraces per call; hoist to module scope/__init__, "
+                    "memoize on self or in a cache, or suppress with the "
+                    "cache named")
+
+
+@register
+class DtypeLiteralHygiene(Rule):
+    """DTL004: hard-coded wide dtype on the device path.
+
+    TPU has no complex128 and emulates float64; working precision is
+    chosen once per problem and funneled through tools/array.py
+    (match_precision) and the solver's pencil/real dtypes. A literal
+    `jnp.float64` / `jnp.complex128` — or numpy's spelled as a jnp dtype=
+    argument — silently promotes device arrays past the configured
+    precision, costing memory and MXU throughput exactly where it is
+    least visible.
+
+    Heuristic: flags `jnp.float64` / `jnp.complex128` attributes anywhere,
+    `np.float64` / `np.complex128` when passed as dtype= to a jnp call,
+    and `.astype(np.float64/complex128)` inside traced code. Host-side
+    numpy float64 (quadrature, matrix assembly) is the house precision
+    and intentionally not flagged.
+    """
+
+    id = "DTL004"
+    severity = "warning"
+    title = "dtype-literal-hygiene"
+
+    _WIDE_JNP = ("jax.numpy.float64", "jax.numpy.complex128")
+    _WIDE_NP = ("numpy.float64", "numpy.complex128")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                name = ctx.canon(node)
+                if name is not None and name_matches(name, *self._WIDE_JNP):
+                    yield self.finding(
+                        ctx, node, f"hard-coded {name.split('.')[-1]} "
+                        "bypasses the precision funnel (tools/array.py); "
+                        "derive the dtype from the data or the solver's "
+                        "configured precision")
+            elif isinstance(node, ast.Call):
+                fname = ctx.canon(node.func)
+                if fname is not None and fname.startswith("jax.numpy."):
+                    for kw in node.keywords:
+                        if kw.arg != "dtype":
+                            continue
+                        dname = ctx.canon(kw.value)
+                        if dname is not None and name_matches(
+                                dname, *self._WIDE_NP):
+                            yield self.finding(
+                                ctx, node, f"dtype={dname.split('.')[-1]} "
+                                "on a jnp call bypasses the precision "
+                                "funnel (tools/array.py)")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args
+                        and ctx.in_traced(node)):
+                    dname = ctx.canon(node.args[0])
+                    if dname is not None and name_matches(
+                            dname, *self._WIDE_NP, *self._WIDE_JNP):
+                        yield self.finding(
+                            ctx, node, f".astype({dname.split('.')[-1]}) "
+                            "inside traced code bypasses the precision "
+                            "funnel (tools/array.py)")
+
+
+@register
+class PrivateJaxApi(Rule):
+    """DTL005: dependency on jax._src internals.
+
+    `jax._src` has no stability contract; imports from it are the part of
+    this codebase that breaks on every JAX upgrade (the historical
+    `_tracing_active` probe in tools/jitlift.py). Public equivalents or a
+    guarded fallback (try public, degrade with one warning) are required;
+    the single sanctioned fallback carries a suppression naming why.
+    """
+
+    id = "DTL005"
+    severity = "warning"
+    title = "private-jax-api"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level == 0 and (mod == "jax._src"
+                                        or mod.startswith("jax._src.")):
+                    yield self.finding(
+                        ctx, node, f"import from {mod} (no stability "
+                        "contract); prefer the public jax.* surface with "
+                        "a guarded fallback")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax._src" \
+                            or alias.name.startswith("jax._src."):
+                        yield self.finding(
+                            ctx, node, f"import of {alias.name} (no "
+                            "stability contract); prefer the public jax.* "
+                            "surface with a guarded fallback")
+            elif isinstance(node, ast.Attribute) and node.attr == "_src":
+                name = ctx.canon(node)
+                if name == "jax._src":
+                    yield self.finding(
+                        ctx, node, "jax._src attribute access (no "
+                        "stability contract); prefer the public jax.* "
+                        "surface with a guarded fallback")

@@ -1,0 +1,150 @@
+"""
+Retrace sentinel: runtime counterpart of the DTL003 lint rule.
+
+A compiled step loop should trace each program once during warmup and
+never again; a post-warmup retrace means something in the hot path is
+producing fresh signatures (shape/dtype drift, unstable static args,
+rebuilt wrappers) and the loop is silently paying compile time per step.
+The static analyzer cannot see that — it is a runtime property — so the
+traced functions carry a trace-time side effect: their Python bodies only
+execute while JAX is tracing, so a counter bump there counts compiles,
+not calls.
+
+Wiring: `tools.jitlift.lifted_jit` notes every trace of every instance
+(covering the solver step/factor/eval programs), and `noted()` wraps raw
+`jax.jit` users (the health probe). The solver arms the sentinel at
+warmup end; an armed retrace logs a structured warning, records an
+event, and bumps a `dedalus/retrace` counter on every subscribed Metrics
+instance — so it lands in the JSONL telemetry next to steps/sec and is
+assertable in tests (`sentinel.post_arm_retraces == 0`).
+
+Counting granularity is the WRAPPER INSTANCE, deliberately: the first
+trace of a fresh wrapper (e.g. the step_many scan block compiled after
+warmup) is a compile, not a retrace — but within one wrapper, every
+post-warmup trace counts, including "new signature" traces. Under jax a
+recompile is ALWAYS a new signature (identical signatures hit the cache),
+so counting per cache key instead would make per-step shape/static-arg
+drift — the exact hazard — invisible as an endless stream of "first
+compiles". Corollary: a driver that varies step_many block sizes
+post-warmup is flagged, correctly — each new block length pays a full
+trace+compile; fix the driver to use fixed block sizes.
+"""
+
+import collections
+import logging
+import threading
+import weakref
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TraceCount", "RetraceSentinel", "sentinel", "noted"]
+
+# bounded accounting: a per-step retrace storm (the exact pathology the
+# sentinel exists to catch) must not itself leak memory or flood the log
+EVENT_RING_SIZE = 256
+WARNINGS_PER_LABEL = 5
+
+
+class TraceCount:
+    """Per-wrapper trace counter (one per lifted_jit / noted() wrapper)."""
+
+    __slots__ = ("label", "count")
+
+    def __init__(self, label):
+        self.label = str(label)
+        self.count = 0
+
+
+class RetraceSentinel:
+    """Process-wide trace accounting. Counts are per wrapper instance (a
+    fresh solver's first traces never look like retraces), the armed flag
+    is global (once any solver is past warmup, a retrace anywhere in the
+    process is a hygiene event)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = weakref.WeakSet()
+        self._warned = {}   # label -> warnings emitted (rate limit)
+        self.armed = False
+        self.total_traces = 0
+        self.retraces = 0
+        self.post_arm_retraces = 0
+        self.events = collections.deque(maxlen=EVENT_RING_SIZE)
+
+    def subscribe(self, metrics):
+        """Register a Metrics instance to receive `dedalus/retrace`
+        counter bumps on armed retraces (held weakly)."""
+        # under the lock: note() snapshots the set while holding it, and a
+        # solver can be constructed while another thread is mid-trace
+        with self._lock:
+            self._metrics.add(metrics)
+
+    def arm(self):
+        """Mark warmup complete: from now on retraces warn and count."""
+        self.armed = True
+
+    def reset(self):
+        """Test hook: disarm and zero the global accounting. Per-wrapper
+        counts live on the wrappers and are NOT cleared — an old wrapper
+        retracing after a reset is still a retrace."""
+        with self._lock:
+            self.armed = False
+            self.total_traces = 0
+            self.retraces = 0
+            self.post_arm_retraces = 0
+            self.events = collections.deque(maxlen=EVENT_RING_SIZE)
+            self._warned = {}
+
+    def note(self, state):
+        """Record one trace of the wrapper owning `state`. Called from
+        inside traced bodies: runs at trace time only."""
+        with self._lock:
+            state.count += 1
+            self.total_traces += 1
+            if state.count <= 1:
+                return
+            self.retraces += 1
+            if not self.armed:
+                return
+            self.post_arm_retraces += 1
+            event = {"kind": "retrace", "label": state.label,
+                     "trace_number": state.count,
+                     "post_arm_index": self.post_arm_retraces}
+            self.events.append(event)
+            warned = self._warned.get(state.label, 0)
+            self._warned[state.label] = warned + 1
+            metrics_instances = list(self._metrics)
+        # outside the lock: logging/metrics must not deadlock a nested note
+        if warned < WARNINGS_PER_LABEL:
+            tail = ("; further retraces of this program will be counted "
+                    "but not logged" if warned == WARNINGS_PER_LABEL - 1
+                    else "")
+            logger.warning(
+                f"post-warmup retrace of '{state.label}' (trace "
+                f"#{state.count}): a hot-path program recompiled after "
+                "warmup — check for changing shapes/dtypes or unstable "
+                f"static arguments (DTL003 territory){tail}")
+        for m in metrics_instances:
+            try:
+                m.inc("dedalus/retrace")
+            except Exception:
+                pass
+
+
+sentinel = RetraceSentinel()
+
+
+def noted(fn, label=None):
+    """Wrap a function destined for `jax.jit` (or another tracer) with the
+    trace-time sentinel side effect. The wrapper must only be called under
+    tracing (e.g. `jax.jit(noted(probe, "health/probe"))`); calling it
+    eagerly would count executions as traces."""
+    state = TraceCount(label or getattr(fn, "__qualname__", "traced_fn"))
+
+    def wrapper(*args, **kwargs):
+        sentinel.note(state)
+        return fn(*args, **kwargs)
+
+    wrapper.__name__ = getattr(fn, "__name__", "noted")
+    wrapper._retrace_state = state
+    return wrapper

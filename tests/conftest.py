@@ -21,3 +21,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel(request):
+    """Opt-in tracer-leak sentinel: tests marked `leak_check` run under
+    jax.checking_leaks(), so a jitted path that captures tracers in
+    module/global state (the classic lifted_jit-registry hazard class)
+    fails the marked test instead of surfacing as a cryptic error in some
+    later trace. Opt-in because the check globally disables trace caching
+    (every call retraces) — too slow for the whole suite."""
+    if request.node.get_closest_marker("leak_check") is None:
+        yield
+        return
+    with jax.checking_leaks():
+        yield
