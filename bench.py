@@ -125,6 +125,16 @@ def run_benchmark():
         health_sum = None
     if health_sum is not None:
         record["health"] = health_sum
+    # Resilience summary (tools/resilience.py): rewind/retry/resume
+    # counts when the run was driven by a ResilientLoop (absent — not
+    # zero — for a plain loop, so readers can tell "no resilience" from
+    # "resilience, no events").
+    resilience = getattr(solver, "resilience", None)
+    if resilience is not None:
+        try:
+            record["resilience"] = resilience.summary()
+        except Exception as exc:
+            mark(f"resilience summary failed (non-fatal): {exc}")
     # Jit-hygiene sentinels, so the perf trajectory shows hygiene
     # regressions alongside steps/sec: post-warmup retrace count
     # (tools/retrace.py; anything nonzero means the measured loop paid

@@ -29,7 +29,9 @@ def test():
         sys.exit(1)
     root = pathlib.Path(__file__).parent.parent
     # tier-1 semantics: slow-marked tests (long timing runs) are opt-in
-    # via pytest directly
+    # via pytest directly; chaos-marked fault-injection tests
+    # (tests/test_resilience.py) are fast and run by default — recovery
+    # paths that are not exercised do not exist
     sys.exit(pytest.main([str(root / "tests"), "-q", "-m", "not slow"]))
 
 
@@ -142,11 +144,29 @@ def report():
                 print(f"    health: {status}, "
                       f"{health.get('checks', 0)} checks, "
                       f"{health.get('warnings', 0)} warnings")
+            resilience = record.get("resilience")
+            if isinstance(resilience, dict):
+                parts = [f"{resilience.get('rewinds', 0)} rewinds",
+                         f"{resilience.get('retries', 0)} retries"]
+                if resilience.get("dt_limit") is not None:
+                    parts.append(f"dt capped {resilience['dt_limit']}")
+                if resilience.get("stopped_by"):
+                    parts.append(f"stopped by {resilience['stopped_by']}")
+                if resilience.get("resumed_from"):
+                    parts.append(
+                        f"resumed from {resilience['resumed_from']} "
+                        f"(write {resilience.get('resume_write', '?')})")
+                print(f"    resilience: {', '.join(parts)}")
         elif kind == "health_postmortem":
             n_post += 1
+            resilience = record.get("resilience")
+            lineage = ""
+            if isinstance(resilience, dict) and resilience.get("retries"):
+                lineage = (f" (retry {resilience['retries']}, "
+                           f"{resilience.get('rewinds', 0)} rewinds)")
             print(f"(postmortem) iter={record.get('iteration', '?')} "
                   f"sim_time={record.get('sim_time', '?')}: "
-                  f"{record.get('reason', '(no reason)')}"
+                  f"{record.get('reason', '(no reason)')}{lineage}"
                   + (f" [{record.get('directory')}]"
                      if record.get("directory") else ""))
         else:

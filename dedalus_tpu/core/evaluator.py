@@ -73,6 +73,24 @@ class Handler:
         self.last_wall_div = -1
         self.last_sim_div = -1
         self.last_iter_div = -1
+        # optional transient-IO retry policy (tools/resilience.RetryPolicy
+        # or any callable-with-.call) applied around file writes; None
+        # writes directly (zero overhead beyond one attribute check)
+        self.io_retry = None
+
+    def schedule_state(self):
+        """Scheduling counters as a restorable dict — captured into
+        resilience snapshots (tools/resilience.py) so a rewound run
+        re-arms its output cadences consistently with the rewound clock
+        instead of skipping the replayed interval's writes."""
+        return {"last_wall_div": self.last_wall_div,
+                "last_sim_div": self.last_sim_div,
+                "last_iter_div": self.last_iter_div}
+
+    def restore_schedule_state(self, state):
+        self.last_wall_div = state["last_wall_div"]
+        self.last_sim_div = state["last_sim_div"]
+        self.last_iter_div = state["last_iter_div"]
 
     def add_task(self, task, layout="g", name=None, scales=None):
         """Add a task (operand expression, field, or namespace string)."""
@@ -288,7 +306,6 @@ class FileHandler(Handler):
         return path
 
     def process(self, iteration=0, wall_time=0.0, sim_time=0.0, timestep=None, **kw):
-        import h5py
         if self.current_file is None or self.writes_in_set >= self.max_writes:
             self._new_file()
         self.write_num += 1
@@ -298,6 +315,20 @@ class FileHandler(Handler):
         results = self.evaluate_tasks()
         if not self._primary:
             return
+        write = lambda: self._write_results(results, iteration=iteration,
+                                            wall_time=wall_time,
+                                            sim_time=sim_time,
+                                            timestep=timestep)
+        if self.io_retry is not None:
+            # transient host/IO faults (flaky disk/NFS) retried with
+            # backoff before they can kill the run (tools/resilience.py)
+            self.io_retry.call(write, label=f"write {self.current_file}")
+        else:
+            write()
+
+    def _write_results(self, results, iteration, wall_time, sim_time,
+                       timestep):
+        import h5py
         with h5py.File(self.current_file, "a") as f:
             scales = f["scales"]
             for key, val in [("sim_time", sim_time), ("wall_time", wall_time),
@@ -315,6 +346,13 @@ class FileHandler(Handler):
                     tasks.create_dataset(name, shape=(0,) + data.shape,
                                          maxshape=(None,) + data.shape,
                                          dtype=data.dtype)
+                    task = next((t for t in self.tasks
+                                 if t["name"] == name), None)
+                    # recorded so load_state can restore through the
+                    # layout the data was written in ('c' checkpoints
+                    # round-trip bitwise — no transform in the path)
+                    tasks[name].attrs["layout"] = \
+                        task["layout"] if task else "g"
                     self._attach_grid_scales(f, tasks[name], name)
                 ds = tasks[name]
                 ds.resize((ds.shape[0] + 1,) + data.shape)

@@ -552,6 +552,10 @@ class InitialValueSolver(SolverBase):
                   "dtype": str(np.dtype(self.pencil_dtype)),
                   "pencil_shape": list(self.pencil_shape)})
         self._metrics_warm_pending = False
+        # Abnormal-exit telemetry: an interrupted run (exception, SIGTERM)
+        # still flushes one complete results.jsonl record (atexit + the
+        # chaining signal hook; tools/metrics.py)
+        metrics_mod.register_exit_flush(self)
         # Retrace sentinel (tools/retrace.py): armed at warmup end; a
         # post-warmup recompile of any step program warns and bumps the
         # dedalus/retrace counter on this metrics instance.
@@ -885,11 +889,35 @@ class InitialValueSolver(SolverBase):
         extra = dict(extra or {})
         if health_summary is not None:
             extra.setdefault("health", health_summary)
+        resilience = getattr(self, "resilience", None)
+        if resilience is not None:
+            extra.setdefault("resilience", resilience.summary())
         # retrace-sentinel verdict rides in every telemetry record so the
         # perf trajectory shows compile-hygiene regressions in place
         extra.setdefault("retraces_post_warmup",
                          retrace_mod.sentinel.post_arm_retraces)
         return self.metrics.flush(extra=extra)
+
+    def evolve_resilient(self, timestep_function=None, dt=None,
+                         log_cadence=100, **kw):
+        """
+        Run the main loop under the resilient driver
+        (tools/resilience.ResilientLoop): rolling state-snapshot ring,
+        automatic rewind + dt backoff on SolverHealthError, SIGTERM/
+        SIGINT-safe durable checkpointing with validated resume, and
+        transient-IO retry around checkpoint/telemetry writes. Keyword
+        arguments (snapshot_cadence, max_retries, dt_backoff,
+        checkpoint_dir, resume, chaos, ...) configure the loop; defaults
+        come from the [resilience] config section. Returns the loop's
+        summary dict (also attached to flushed telemetry records).
+        """
+        from ..tools.resilience import ResilientLoop
+        loop = ResilientLoop(self, timestep_function=timestep_function,
+                             dt=dt, **kw)
+        try:
+            return loop.run(log_cadence=log_cadence)
+        finally:
+            self.log_stats()
 
     def evolve(self, timestep_function=None, log_cadence=100):
         """Run the main loop to completion (reference: core/solvers.py:713)."""
@@ -931,24 +959,110 @@ class InitialValueSolver(SolverBase):
             print(f"group {sp.group}: rank={np.linalg.matrix_rank(A)}/{A.shape[0]}, "
                   f"cond={np.linalg.cond(A):.2e}")
 
-    def load_state(self, path, index=-1, allow_missing=False):
+    def load_state(self, path, index=-1, allow_missing=False,
+                   fallback=False):
         """Restore state from an HDF5 checkpoint
-        (reference: core/solvers.py:632 load_state)."""
+        (reference: core/solvers.py:632 load_state).
+
+        Hardened against truncated/corrupt files: failures raise a
+        structured `CheckpointError` naming the file and write index
+        instead of a raw h5py traceback. With `fallback=True`, a corrupt
+        write falls back to the previous writes in the same file (newest
+        surviving write wins); `tools.resilience.resume_latest` extends
+        the fallback across set files.
+        """
         import h5py
-        with h5py.File(path, "r") as f:
-            write = np.asarray(f["scales/write_number"])[index]
-            self.sim_time = self.initial_sim_time = float(np.asarray(f["scales/sim_time"])[index])
-            self.iteration = self.initial_iteration = int(np.asarray(f["scales/iteration"])[index])
-            self.dt = float(np.asarray(f["scales/timestep"])[index]) \
-                if "scales/timestep" in f else None
-            logger.info(f"Loading iteration: {self.iteration} (write {write})")
-            for var in self.state:
-                if var.name in f["tasks"]:
-                    var["g"] = np.asarray(f["tasks"][var.name][index])
-                elif not allow_missing:
-                    raise KeyError(f"State variable {var.name} not found in {path}")
+        from ..tools.exceptions import CheckpointError
+        try:
+            f = h5py.File(path, "r")
+        except OSError as exc:
+            raise CheckpointError(
+                f"checkpoint {path} unreadable (truncated or corrupt): "
+                f"{exc}", path=path) from exc
+        with f:
+            try:
+                n_writes = len(f["scales/write_number"])
+            except KeyError as exc:
+                raise CheckpointError(
+                    f"checkpoint {path} has no scales/write_number "
+                    f"(not a handler file?)", path=path) from exc
+            if n_writes == 0:
+                raise CheckpointError(
+                    f"checkpoint {path} has an empty write index",
+                    path=path)
+            start = index if index >= 0 else n_writes + index
+            if not 0 <= start < n_writes:
+                raise CheckpointError(
+                    f"checkpoint {path}: write index {index} out of range "
+                    f"({n_writes} writes)", path=path, index=index)
+            candidates = range(start, -1, -1) if fallback else (start,)
+            failures = []
+            for idx in candidates:
+                try:
+                    self._load_write(f, path, idx, allow_missing)
+                except CheckpointError as exc:
+                    if not fallback:
+                        raise
+                    failures.append(str(exc))
+                    logger.warning(f"checkpoint write unusable, "
+                                   f"falling back: {exc}")
+                    continue
+                if failures:
+                    logger.info(f"loaded write {idx} of {path} after "
+                                f"{len(failures)} fallback(s)")
+                write = int(np.asarray(f["scales/write_number"])[idx])
+                break
+            else:
+                raise CheckpointError(
+                    f"checkpoint {path}: no loadable write at or before "
+                    f"index {index} ({'; '.join(failures)})",
+                    path=path, index=index)
         self.X = self.gather_fields()
         return write, self.dt
+
+    def _load_write(self, f, path, idx, allow_missing):
+        """Load ONE write of an open checkpoint file into the solver,
+        wrapping data-level corruption (h5py OSError/ValueError on torn
+        datasets) as CheckpointError. Scalar clocks are restored last-
+        writer-wins only after every field read back cleanly."""
+        from ..tools.exceptions import CheckpointError
+        try:
+            sim_time = float(np.asarray(f["scales/sim_time"])[idx])
+            iteration = int(np.asarray(f["scales/iteration"])[idx])
+            dt = float(np.asarray(f["scales/timestep"])[idx]) \
+                if "scales/timestep" in f else None
+            tasks = f["tasks"]
+            data = {}
+            for var in self.state:
+                if var.name not in tasks:
+                    if allow_missing:
+                        continue
+                    raise KeyError(
+                        f"State variable {var.name} not found in {path}")
+                ds = tasks[var.name]
+                if len(ds) <= idx:
+                    raise CheckpointError(
+                        f"checkpoint {path} write {idx}: task "
+                        f"'{var.name}' has only {len(ds)} write(s) "
+                        f"(torn write)", path=path, index=idx)
+                layout = ds.attrs.get("layout", "g")
+                if isinstance(layout, bytes):
+                    layout = layout.decode()
+                data[var.name] = (layout, np.asarray(ds[idx]))
+        except CheckpointError:
+            raise
+        except (OSError, ValueError, IndexError) as exc:
+            raise CheckpointError(
+                f"checkpoint {path} write {idx} unreadable: {exc}",
+                path=path, index=idx) from exc
+        for var in self.state:
+            if var.name in data:
+                layout, arr = data[var.name]
+                var[layout if layout in ("c", "g") else "g"] = arr
+        self.sim_time = self.initial_sim_time = sim_time
+        self.iteration = self.initial_iteration = iteration
+        self.dt = dt
+        logger.info(f"Loading iteration: {iteration} (write index {idx})")
 
     def log_stats(self, format=".4g"):
         """Log run statistics including the reference's throughput metric

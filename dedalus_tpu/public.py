@@ -43,7 +43,7 @@ from .core.solvers import (InitialValueSolver, LinearBoundaryValueSolver,
                            NonlinearBoundaryValueSolver, EigenvalueSolver)
 from .core.evaluator import Evaluator
 from .extras.flow_tools import CFL, GlobalFlowProperty, GlobalArrayReducer
-from .tools.exceptions import SolverHealthError
+from .tools.exceptions import CheckpointError, SolverHealthError
 from .tools.health import HealthMonitor
 
 # lowercase operator aliases (reference: core/operators.py aliases)

@@ -1,0 +1,162 @@
+"""
+Deterministic fault injection (chaos harness) for the resilient loop.
+
+Production fault tolerance that has never seen a fault is a hypothesis,
+not a feature. This module injects the faults tools/resilience.py claims
+to absorb — deterministically, from a seed/config, so every recovery
+branch is an ordinary reproducible test (tests/test_resilience.py, the
+`chaos` pytest marker):
+
+  * NaN poisoning of a named field at iteration N (divergence without
+    waiting for physics to diverge),
+  * a transient `OSError` on the Nth checkpoint write (flaky disk/NFS),
+  * simulated SIGTERM delivery at iteration N (pool preemption),
+  * checkpoint-file truncation/corruption (a crash mid-write).
+
+Each armed fault fires ONCE (rewind replays the triggering iteration; a
+re-firing fault would deadlock the recovery it is testing) and is logged
+loudly when it fires. `ChaosInjector` is test machinery: it is never
+constructed by the production path, only handed to `ResilientLoop(...,
+chaos=...)` or used standalone on files.
+"""
+
+import errno
+import logging
+import os
+import signal
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ChaosInjector", "corrupt_checkpoint"]
+
+
+def _field_slice(solver, name):
+    """(offset, size) of one named state variable inside the gathered
+    (G, S) pencil state."""
+    from ..core.subsystems import state_key
+    offset = 0
+    for v in solver.variables:
+        size = solver.layout.slot_size(v.domain, v.tensorsig)
+        if state_key(v) == name or v.name == name:
+            return offset, size
+        offset += size
+    raise KeyError(f"no state variable named {name!r}")
+
+
+def corrupt_checkpoint(path, mode="truncate", seed=0):
+    """
+    Damage a checkpoint file in place the way a crash or bad disk would:
+      truncate — cut the file to half length (kill mid-write: the HDF5
+                 superblock/objects become unreadable),
+      zero     — overwrite the middle third with zeros (silent media
+                 corruption; the file may still open but datasets break),
+      garbage  — overwrite the middle third with seeded random bytes.
+    """
+    path = os.fspath(path)
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    elif mode in ("zero", "garbage"):
+        start, stop = size // 3, 2 * size // 3
+        blob = (bytes(stop - start) if mode == "zero"
+                else np.random.default_rng(seed).bytes(stop - start))
+        with open(path, "r+b") as f:
+            f.seek(start)
+            f.write(blob)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    logger.warning(f"chaos: corrupted checkpoint {path} (mode={mode})")
+
+
+class ChaosInjector:
+    """
+    Seed/config-driven fault injector driven by ResilientLoop hooks
+    (`before_step`/`after_step`) or attached manually. Faults:
+
+      nan_field + nan_iteration   — poison the named field's pencil
+          slice with NaN after completing iteration N (the next health
+          probe sees a non-finite state).
+      fail_checkpoint_write       — raise a transient OSError (EIO) on
+          the Nth durable checkpoint write (1-based), succeeding on
+          retry.
+      sigterm_iteration           — deliver a real SIGTERM to this
+          process after completing iteration N.
+
+    `fired` records what fired and when, for test assertions.
+    """
+
+    def __init__(self, seed=0, nan_field=None, nan_iteration=None,
+                 fail_checkpoint_write=None, sigterm_iteration=None):
+        self.seed = int(seed)
+        self.nan_field = nan_field
+        self.nan_iteration = nan_iteration
+        self.fail_checkpoint_write = fail_checkpoint_write
+        self.sigterm_iteration = sigterm_iteration
+        self.fired = []
+        self._checkpoint_writes = 0
+        self._armed = set()
+        if nan_field is not None and nan_iteration is not None:
+            self._armed.add("nan")
+        if sigterm_iteration is not None:
+            self._armed.add("sigterm")
+        if fail_checkpoint_write is not None:
+            self._armed.add("io")
+
+    def attach(self, loop):
+        """Wire the IO fault into the loop's checkpoint path: the Nth
+        write attempt raises a transient OSError BEFORE touching the
+        file (retry then finds clean ground)."""
+        if "io" not in self._armed:
+            return
+        handler_write = loop.write_checkpoint
+
+        def chaotic_write():
+            self._checkpoint_writes += 1
+            if ("io" in self._armed
+                    and self._checkpoint_writes == self.fail_checkpoint_write):
+                self._armed.discard("io")
+                self._fire("io", attempt=self._checkpoint_writes)
+                raise OSError(errno.EIO, "chaos: injected transient IO fault")
+            return handler_write()
+
+        loop.write_checkpoint = chaotic_write
+
+    def _fire(self, kind, **info):
+        info["kind"] = kind
+        self.fired.append(info)
+        logger.warning(f"chaos: fired {info}")
+
+    # ------------------------------------------------------- loop hooks
+
+    def before_step(self, solver):
+        """No pre-step faults currently; hook kept so injectors can be
+        subclassed without touching the loop."""
+
+    def after_step(self, solver):
+        it = int(solver.iteration)
+        if "nan" in self._armed and it >= self.nan_iteration:
+            self._armed.discard("nan")
+            self.poison_field(solver, self.nan_field)
+            self._fire("nan", iteration=it, field=self.nan_field)
+        if "sigterm" in self._armed and it >= self.sigterm_iteration:
+            self._armed.discard("sigterm")
+            self._fire("sigterm", iteration=it)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # ----------------------------------------------------- fault bodies
+
+    def poison_field(self, solver, name):
+        """Overwrite the named field's slice of the gathered state with
+        NaN — a pure device-side update (no host sync), exactly what a
+        diverging nonlinearity produces."""
+        import jax.numpy as jnp
+        offset, size = _field_slice(solver, name)
+        solver.X = solver.X.at[:, offset:offset + size].set(jnp.nan)
+        # the fields' lazy pulls still reference the clean X; re-install
+        # against the poisoned state so harness code sees what the
+        # solver sees
+        solver.defer_scatter(solver.X)
+        solver.snapshot_versions()

@@ -46,6 +46,23 @@ class SolverHealthError(DedalusError, ValueError):
         super().__init__(reason)
 
 
+class CheckpointError(DedalusError, OSError):
+    """
+    Structured checkpoint load/validation failure: names the file and the
+    write index that failed (and the underlying cause) instead of leaking
+    a raw h5py traceback. Subclasses OSError so callers that guarded the
+    historical h5py `OSError` keep working.
+
+    Attributes: path (str), index (write index attempted, or None for a
+    file-level failure).
+    """
+
+    def __init__(self, message, path=None, index=None):
+        self.path = str(path) if path is not None else None
+        self.index = index
+        super().__init__(message)
+
+
 class SkipDispatchException(Exception):
     """Control-flow exception to bypass multiclass dispatch with an output."""
 

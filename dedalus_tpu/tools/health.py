@@ -158,6 +158,19 @@ class HealthMonitor:
         self.solver = solver
         return self
 
+    def reset_failure(self):
+        """Clear the failure latch after a resilient rewind
+        (tools/resilience.py): the solver's health_error is dropped,
+        `proceed` can flip True again, and the probe gate re-anchors at
+        the rewound iteration. Forensic state (ring, postmortem_path,
+        check/warning counts) is preserved — the flight recordings of
+        every attempt remain on disk and in the ring."""
+        self.failed_reason = None
+        self._dt_dumped = False
+        if self.solver is not None:
+            self.solver._health_error = None
+            self.gate.reset(int(self.solver.iteration))
+
     def attach_dt_source(self, cfl):
         """Register a CFL controller whose dt/frequency history feeds the
         flight recorder (extras.flow_tools.CFL self-registers)."""
@@ -453,7 +466,12 @@ class HealthMonitor:
         """
         solver = self.solver
         base = pathlib.Path(self.postmortem_dir)
-        stem = f"postmortem_i{int(solver.iteration):08d}"
+        # collision-proof naming: iteration + wall-clock timestamp stem,
+        # plus a counter for same-second repeats — a rewind-retry-fail
+        # cycle rediverging at the SAME iteration must never overwrite an
+        # earlier flight recording
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        stem = f"postmortem_i{int(solver.iteration):08d}_{stamp}"
         path = base / stem
         n = 0
         while path.exists():
@@ -493,6 +511,12 @@ class HealthMonitor:
             "checkpoint": checkpoint,
             "directory": str(path),
         }
+        resilience = getattr(solver, "resilience", None)
+        if resilience is not None:
+            # retry lineage: which rewind/backoff attempts preceded this
+            # dump (tools/resilience.py), so a chain of flight recordings
+            # reads as one story
+            record["resilience"] = resilience.summary()
         record.update({k: v for k, v in solver.metrics.meta.items()
                        if k not in record})
         record = _jsonable(record)
@@ -673,6 +697,22 @@ def format_postmortem(record, ring=()):
     if record.get("checkpoint"):
         lines.append(f"  checkpoint: {record['checkpoint']} "
                      f"(state at failure — forensic, may be non-finite)")
+    resilience = record.get("resilience")
+    if isinstance(resilience, dict):
+        lines.append(
+            f"  resilience: {resilience.get('rewinds', 0)} rewind(s), "
+            f"{resilience.get('retries', 0)} retry(ies)"
+            + (f", resumed from {resilience['resumed_from']}"
+               if resilience.get("resumed_from") else ""))
+        for attempt in resilience.get("lineage") or []:
+            lines.append(
+                f"    attempt {attempt.get('attempt', '?')}: failed at "
+                f"iteration {attempt.get('failure_iteration', '?')} "
+                f"({attempt.get('reason', '?')}) -> "
+                f"{attempt.get('outcome', '?')}"
+                + (f" @ iteration {attempt['rewind_iteration']}, "
+                   f"dt capped {_fmt(attempt.get('dt_limit'))}"
+                   if attempt.get("rewind_iteration") is not None else ""))
     lines.append(f"  checks={record.get('checks', 0)} "
                  f"warnings={record.get('warnings', 0)}")
     return lines

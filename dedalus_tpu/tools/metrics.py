@@ -25,9 +25,13 @@ phase breakdown attached; `python -m dedalus_tpu report <file.jsonl>`
 summarizes the records.
 """
 
+import atexit
 import json
 import os
+import signal
+import threading
 import time
+import weakref
 
 import numpy as np
 import jax
@@ -36,7 +40,8 @@ from .config import config
 
 __all__ = ["PHASES", "CadenceGate", "Counter", "PhaseTimer",
            "MemoryWatermark", "Metrics", "trace_scope", "annotate", "scoped",
-           "resolve", "format_phase_table"]
+           "resolve", "format_phase_table", "register_exit_flush",
+           "flush_pending"]
 
 # The hot-path phase vocabulary (shared with trace annotations).
 PHASES = ("transform", "matsolve", "transpose", "evaluator")
@@ -182,6 +187,10 @@ class Metrics:
         self.timer = PhaseTimer()
         self.memory = MemoryWatermark()
         self.iterations = 0
+        # unflushed-activity latch: set by step/counter observations,
+        # cleared by flush() — the exit-flush hooks use it to decide
+        # whether an interrupted run still owes a telemetry record
+        self.dirty = False
         self._loop_t0 = None
         self._gate = CadenceGate(self.sample_cadence)
         self._warmed = set()
@@ -197,6 +206,7 @@ class Metrics:
     def inc(self, name, n=1):
         if not self.enabled:
             return 0
+        self.dirty = True
         return self.counter(name).inc(n)
 
     # ----------------------------------------------------------------- loop
@@ -208,6 +218,7 @@ class Metrics:
         if self._loop_t0 is None:
             self._loop_t0 = time.perf_counter()
         self.iterations += int(n)
+        self.dirty = True
 
     def reset_loop(self):
         """Re-anchor the loop window (called at warmup end so compile and
@@ -258,11 +269,22 @@ class Metrics:
             return None
         record = dict(record)
         record.setdefault("ts", round(time.time(), 1))
-        try:
+
+        def write():
             parent = os.path.dirname(os.path.abspath(self.sink))
             os.makedirs(parent, exist_ok=True)
             with open(self.sink, "a") as f:
                 f.write(json.dumps(record) + "\n")
+
+        # transient host/IO faults (flaky disk/NFS) are retried with
+        # backoff under the [resilience] IO_RETRIES/IO_BASE_DELAY budget
+        # (tools/resilience.io_retry_policy classification); a
+        # persistently failing sink degrades to a warning — telemetry
+        # must never kill the simulation
+        try:
+            from .resilience import io_retry_policy
+            io_retry_policy().call(
+                write, label=f"metrics sink {self.sink}")
         except OSError as exc:
             import logging
             logging.getLogger(__name__).warning(
@@ -301,7 +323,73 @@ class Metrics:
         if extra:
             record.update(extra)
         self.emit(record)
+        self.dirty = False
         return record
+
+
+# --------------------------------------------------- abnormal-exit flush
+#
+# A run killed by an exception or a termination signal should still leave
+# a complete results.jsonl record. Solvers register themselves here; the
+# atexit hook (and, for SIGTERM — whose default action skips atexit — a
+# chaining signal hook) flushes any registered solver whose metrics have
+# unflushed activity and a configured sink.
+
+_exit_solvers = []          # weakrefs to registered solvers
+_signal_previous = {}       # {signum: previous handler} once installed
+_exit_lock = threading.Lock()
+
+
+def flush_pending(source="atexit"):
+    """Flush every registered solver with unflushed activity and a JSONL
+    sink. Best-effort: one failing flush never blocks the others."""
+    for ref in list(_exit_solvers):
+        solver = ref()
+        if solver is None:
+            continue
+        m = getattr(solver, "metrics", None)
+        if m is None or not (m.enabled and m.sink and m.dirty):
+            continue
+        try:
+            solver.flush_metrics(extra={"flush_source": source})
+        except Exception:
+            pass
+
+
+def _signal_flush(signum, frame):
+    """Chaining SIGTERM hook: flush, restore the previous disposition,
+    and re-deliver so the process still terminates with the original
+    signal semantics (exit code, parent observation)."""
+    flush_pending(source=f"signal:{signum}")
+    previous = _signal_previous.get(signum, signal.SIG_DFL)
+    try:
+        signal.signal(signum, previous)
+    except (ValueError, OSError):
+        return
+    os.kill(os.getpid(), signum)
+
+
+def register_exit_flush(solver):
+    """Register a solver for the abnormal-exit telemetry flush (atexit +
+    SIGTERM). Idempotent per solver; the signal hook is installed once,
+    and only where the default disposition is still in place (a user- or
+    ResilientLoop-installed handler is never stomped)."""
+    with _exit_lock:
+        if not any(ref() is solver for ref in _exit_solvers):
+            _exit_solvers.append(weakref.ref(solver))
+        _exit_solvers[:] = [ref for ref in _exit_solvers
+                            if ref() is not None]
+        if signal.SIGTERM not in _signal_previous:
+            try:
+                current = signal.getsignal(signal.SIGTERM)
+                if current == signal.SIG_DFL:
+                    _signal_previous[signal.SIGTERM] = current
+                    signal.signal(signal.SIGTERM, _signal_flush)
+            except (ValueError, OSError):
+                pass   # non-main thread / unsupported platform
+
+
+atexit.register(flush_pending)
 
 
 def resolve(spec=None, sink=None, cadence=None, meta=None):

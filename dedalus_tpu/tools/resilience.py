@@ -1,0 +1,731 @@
+"""
+Resilient solve loop: snapshot rewind + dt backoff, preemption-safe
+checkpointing, and transient-IO retry classification.
+
+PR 2's health monitor turned a divergence into a graceful halt with a
+flight recorder; this module turns it into a *recoverable* event. A
+`ResilientLoop` (surfaced as `solver.evolve_resilient(...)`) wraps the
+stepping loop with four layers of protection:
+
+  1. **Snapshot ring** — a rolling in-memory ring of last-known-good
+     state snapshots, captured every `SNAPSHOT_CADENCE` iterations. JAX
+     device arrays are immutable, so a snapshot is a tuple of
+     *references* (the gathered pencil state `solver.X`, the multistep
+     history arrays, `sim_time`/`iteration`/`dt`, and the evaluator
+     scheduling counters): capture costs a few Python attribute reads and
+     **never syncs the device** — the hot path stays async.
+
+  2. **Rewind + dt backoff** — on a `SolverHealthError` (NaN/Inf state,
+     growth-bound violation, or a non-finite timestep) the loop rewinds
+     to the newest snapshot whose state is finite, shrinks the effective
+     timestep by `DT_BACKOFF`, waits an exponential wall-clock backoff,
+     and retries — up to `MAX_RETRIES` consecutive failures before
+     escalating to the existing post-mortem path (the flight recorder of
+     every attempt is preserved; dump directories are collision-proof).
+     The dt cap relaxes by `DT_RECOVERY` per clean snapshot cadence, so a
+     transient stiff patch does not permanently slow the run.
+
+  3. **Preemption safety** — SIGTERM/SIGINT request a *graceful* stop:
+     the current step completes, a final durable checkpoint is written
+     through the evaluator file-handler path, telemetry is flushed, and
+     `run()` returns with `stopped_by` set. `resume_latest(...)` locates
+     the newest checkpoint set, validates its integrity (crash-truncated
+     or torn newest writes are detected) and falls back write-by-write
+     and set-by-set to the previous good data.
+
+  4. **Transient-IO retry** — checkpoint writes and telemetry flushes go
+     through a `RetryPolicy` that classifies host/IO faults: transient
+     `OSError`s (EIO, EAGAIN, NFS hiccups) are retried with exponential
+     backoff; structural ones (ENOENT, EACCES, EISDIR) escalate
+     immediately.
+
+Everything is observable: rewinds, retries, dt backoffs, checkpoints
+written/validated and resume events are counted under the
+`resilience/...` metrics scope (tools/metrics.py), ride in every flushed
+telemetry record and bench row, and surface in
+`python -m dedalus_tpu report`.
+
+The chaos harness (tools/chaos.py) drives every branch of this machinery
+deterministically in tests/test_resilience.py.
+"""
+
+import errno
+import json
+import logging
+import os
+import pathlib
+import signal
+import time
+
+import numpy as np
+
+from .config import config
+from .exceptions import CheckpointError, SolverHealthError
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ResilientLoop", "RetryPolicy", "Snapshot", "SnapshotRing",
+           "resume_latest", "validate_checkpoint"]
+
+
+# --------------------------------------------------------------- IO retry
+
+# errnos that indicate a *structural* problem retrying cannot fix
+_PERSISTENT_ERRNOS = frozenset({
+    errno.ENOENT, errno.EACCES, errno.EPERM, errno.EISDIR, errno.ENOTDIR,
+    errno.EROFS, errno.ENAMETOOLONG,
+})
+
+
+class RetryPolicy:
+    """
+    Retry-with-backoff classification for transient host/IO faults.
+
+    `call(fn)` runs `fn`, retrying on *transient* failures (OSError whose
+    errno is not structurally persistent) with exponential wall-clock
+    backoff, up to `max_attempts` total attempts. Non-transient
+    exceptions — and transient ones past the attempt budget — propagate.
+    `on_retry(attempt, exc)` observes each retry (the metrics hook).
+    """
+
+    def __init__(self, max_attempts=3, base_delay=0.05, max_delay=2.0,
+                 on_retry=None):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.on_retry = on_retry
+
+    @staticmethod
+    def is_transient(exc):
+        """Classify one exception: worth retrying?"""
+        if isinstance(exc, OSError):
+            return exc.errno not in _PERSISTENT_ERRNOS
+        return False
+
+    def delay(self, attempt):
+        """Backoff before retry `attempt` (1-based): base * 2^(attempt-1)."""
+        return min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay)
+
+    def call(self, fn, label="io"):
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except Exception as exc:
+                if attempt >= self.max_attempts or not self.is_transient(exc):
+                    raise
+                delay = self.delay(attempt)
+                logger.warning(
+                    f"transient {label} fault (attempt {attempt}/"
+                    f"{self.max_attempts}): {exc}; retrying in {delay:.3g}s")
+                if self.on_retry is not None:
+                    self.on_retry(attempt, exc)
+                time.sleep(delay)
+
+
+# -------------------------------------------------------------- snapshots
+
+class Snapshot:
+    """
+    One last-known-good state capture. Device arrays are held by
+    *reference* (JAX arrays are immutable), so capture is sync-free and
+    O(1); the arrays stay alive on device for the lifetime of the ring
+    slot. Host metadata: sim_time/iteration/dt, the timestepper's
+    multistep bookkeeping, and the evaluator scheduling counters.
+    """
+
+    __slots__ = ("X", "sim_time", "iteration", "dt", "timestepper_state",
+                 "evaluator_state", "dd_X", "wall_ts", "_finite")
+
+    def __init__(self, X, sim_time, iteration, dt, timestepper_state,
+                 evaluator_state, dd_X=None):
+        self.X = X
+        self.sim_time = sim_time
+        self.iteration = iteration
+        self.dt = dt
+        self.timestepper_state = timestepper_state
+        self.evaluator_state = evaluator_state
+        self.dd_X = dd_X
+        self.wall_ts = time.time()
+        self._finite = None
+
+    def is_finite(self):
+        """Whether the captured state is fully finite. Host-syncs the
+        snapshot array on first call — only ever invoked on the recovery
+        path, never in the stepping loop."""
+        if self._finite is None:
+            self._finite = bool(np.all(np.isfinite(np.asarray(self.X))))
+        return self._finite
+
+
+def capture_snapshot(solver):
+    """Capture the solver's current state as a Snapshot (sync-free)."""
+    ts = solver.timestepper
+    ts_state = {"iteration": int(ts.iteration)}
+    if hasattr(ts, "F_hist"):
+        ts_state.update(
+            F_hist=ts.F_hist, MX_hist=ts.MX_hist, LX_hist=ts.LX_hist,
+            dt_hist=list(ts.dt_hist))
+    ev_state = [h.schedule_state() for h in solver.evaluator.handlers]
+    dd = getattr(solver, "_dd", None)
+    return Snapshot(
+        X=solver.X,
+        sim_time=float(solver.sim_time),
+        iteration=int(solver.iteration),
+        dt=float(solver.dt) if solver.dt is not None else None,
+        timestepper_state=ts_state,
+        evaluator_state=ev_state,
+        dd_X=dd.X if dd is not None else None)
+
+
+def restore_snapshot(solver, snap):
+    """Rewind the solver to a snapshot: state, clocks, timestepper
+    history, and evaluator scheduling counters. The LHS factorization is
+    invalidated (the retry dt differs anyway) and the health monitor's
+    failure latch is cleared so the run can proceed."""
+    solver.X = snap.X
+    solver.sim_time = snap.sim_time
+    solver.iteration = snap.iteration
+    solver.dt = snap.dt
+    solver.problem.sim_time = snap.sim_time
+    ts = solver.timestepper
+    st = snap.timestepper_state
+    ts.iteration = st["iteration"]
+    if "F_hist" in st:
+        ts.F_hist = st["F_hist"]
+        ts.MX_hist = st["MX_hist"]
+        ts.LX_hist = st["LX_hist"]
+        ts.dt_hist = list(st["dt_hist"])
+    # drop the (possibly poisoned-era) factorization; the next step
+    # refactors for its own dt
+    ts._lhs_key = None
+    ts._lhs_aux = None
+    dd = getattr(solver, "_dd", None)
+    if dd is not None and snap.dd_X is not None:
+        dd.X = snap.dd_X
+        dd.reset_history(snap.sim_time)
+    for handler, state in zip(solver.evaluator.handlers,
+                              snap.evaluator_state):
+        handler.restore_schedule_state(state)
+    # make the fields see the rewound state (lazy pulls, version-synced)
+    solver.defer_scatter(snap.X)
+    solver.snapshot_versions()
+    solver.health.reset_failure()
+
+
+class SnapshotRing:
+    """Bounded ring of Snapshots, newest last."""
+
+    def __init__(self, size=4):
+        self.size = max(int(size), 1)
+        self._ring = []
+
+    def __len__(self):
+        return len(self._ring)
+
+    @property
+    def newest(self):
+        return self._ring[-1] if self._ring else None
+
+    def push(self, snap):
+        self._ring.append(snap)
+        del self._ring[:-self.size]
+
+    def pop_newest_finite(self):
+        """Pop and return the newest snapshot whose state is finite,
+        discarding poisoned ones (a snapshot taken between the true onset
+        and the probe's detection can already carry NaNs). None when the
+        whole ring is poisoned or empty."""
+        while self._ring:
+            snap = self._ring.pop()
+            if snap.is_finite():
+                return snap
+            logger.warning(
+                f"snapshot at iteration {snap.iteration} is non-finite; "
+                "discarding and rewinding further")
+        return None
+
+
+# -------------------------------------------------- checkpoint validation
+
+def validate_checkpoint(path):
+    """
+    Integrity-check one checkpoint set file. Returns (n_valid_writes,
+    reason): n_valid_writes is the number of trailing-consistent writes
+    (0 = unusable), reason explains a rejection. Detects crash-truncated
+    files (h5py cannot open them) and torn writes (task datasets shorter
+    than the scales cursor — the write died between resizes).
+    """
+    import h5py
+    try:
+        with h5py.File(path, "r") as f:
+            if "scales/write_number" not in f:
+                return 0, "no scales/write_number"
+            n = len(f["scales/write_number"])
+            if n == 0:
+                return 0, "empty write index"
+            if "tasks" not in f or not len(f["tasks"]):
+                return 0, "no task datasets"
+            n_tasks = min(len(f["tasks"][name]) for name in f["tasks"])
+            if n_tasks < n:
+                return n_tasks, (f"torn write: scales cursor at {n}, "
+                                 f"shortest task at {n_tasks}")
+            return n, None
+    except OSError as exc:
+        return 0, f"unreadable (truncated/corrupt?): {exc}"
+
+
+def resume_latest(solver, base_path, metrics=None):
+    """
+    Restore the solver from the newest valid checkpoint under
+    `base_path` (a FileHandler output directory). Walks the numbered set
+    files newest-first, validating each (`validate_checkpoint`) and
+    falling back write-by-write within a set (`load_state(...,
+    fallback=True)`), so a crash-truncated or torn newest write resumes
+    from the previous good one. Returns a resume-event dict, or None
+    when no checkpoint directory/sets exist (fresh start). Raises
+    CheckpointError when sets exist but none are loadable.
+    """
+    from .post import get_assigned_sets
+    base_path = pathlib.Path(base_path)
+    if not base_path.is_dir():
+        return None
+    sets = get_assigned_sets(base_path)
+    if not sets:
+        return None
+    rejected = []
+    for path in reversed(sets):
+        n_valid, reason = validate_checkpoint(path)
+        if metrics is not None:
+            metrics.inc("resilience/checkpoints_validated")
+        if n_valid == 0:
+            logger.warning(f"checkpoint {path} rejected: {reason}")
+            rejected.append({"path": str(path), "reason": reason})
+            continue
+        try:
+            # index clamped to the validated prefix: a torn final write
+            # is skipped even though its scales row exists
+            write, dt = solver.load_state(path, index=n_valid - 1,
+                                          fallback=True)
+        except CheckpointError as exc:
+            logger.warning(f"checkpoint {path} unloadable: {exc}")
+            rejected.append({"path": str(path), "reason": str(exc)})
+            continue
+        event = {
+            "path": str(path),
+            "write": int(write),
+            "iteration": int(solver.iteration),
+            "sim_time": float(solver.sim_time),
+            "dt": dt,
+            "fallbacks": rejected,
+        }
+        if reason is not None:
+            event["validation"] = reason
+        logger.info(
+            f"resumed from {path} (write {write}, iteration "
+            f"{solver.iteration}, sim_time {solver.sim_time:.6e})"
+            + (f" after skipping {len(rejected)} bad set(s)"
+               if rejected else ""))
+        return event
+    raise CheckpointError(
+        f"no loadable checkpoint under {base_path} "
+        f"({len(rejected)} set(s) rejected: "
+        f"{'; '.join(r['reason'] for r in rejected)})",
+        path=str(base_path))
+
+
+# ---------------------------------------------------------- the main loop
+
+def _cfg(key, fallback):
+    section = config["resilience"] if config.has_section("resilience") else {}
+    try:
+        return section.get(key, fallback) or fallback
+    except AttributeError:
+        return fallback
+
+
+def io_retry_policy(on_retry=None):
+    """The [resilience]-configured transient-IO RetryPolicy — the single
+    construction point for checkpoint writes AND telemetry-sink emits
+    (tools/metrics.py), so IO_RETRIES/IO_BASE_DELAY govern both."""
+    return RetryPolicy(max_attempts=int(_cfg("IO_RETRIES", "3")),
+                       base_delay=float(_cfg("IO_BASE_DELAY", "0.05")),
+                       on_retry=on_retry)
+
+
+class ResilientLoop:
+    """
+    Driver wrapping `solver.step` with snapshot rewind, dt backoff,
+    preemption-safe checkpointing, and transient-IO retry. Build one via
+    `solver.evolve_resilient(...)` (which constructs and runs it) or
+    directly for finer control; `run()` returns a summary dict.
+
+    Parameters (None pulls the [resilience] config default):
+      timestep_function — adaptive dt callable (e.g. CFL.compute_timestep);
+          its output is capped by the post-rewind backoff limit.
+      dt — constant timestep when no timestep_function is given.
+      snapshot_cadence — iterations between ring captures.
+      ring_size — snapshots retained.
+      max_retries — consecutive recoveries before escalating.
+      dt_backoff — dt shrink factor per recovery (< 1).
+      dt_recovery — dt cap growth factor per clean snapshot cadence (> 1).
+      retry_base_delay — wall backoff base between recoveries (doubles
+          per consecutive retry).
+      checkpoint_dir — durable checkpoint directory (None disables
+          durable checkpoints AND resume; preemption then stops without
+          a final write).
+      checkpoint_iter — iterations between durable checkpoints (0: only
+          the final preemption/completion write).
+      resume — locate/validate/load the newest checkpoint before
+          starting (ignored without checkpoint_dir).
+      chaos — a tools/chaos.ChaosInjector exercised by tests.
+      install_signal_handlers — trap SIGTERM/SIGINT for the run (the
+          previous handlers are restored on exit).
+    """
+
+    def __init__(self, solver, timestep_function=None, dt=None,
+                 snapshot_cadence=None, ring_size=None, max_retries=None,
+                 dt_backoff=None, dt_recovery=None, retry_base_delay=None,
+                 checkpoint_dir=None, checkpoint_iter=None, resume=False,
+                 chaos=None, install_signal_handlers=True):
+        self.solver = solver
+        self.timestep_function = timestep_function
+        self.dt = float(dt) if dt is not None else None
+        self.snapshot_cadence = int(snapshot_cadence
+                                    if snapshot_cadence is not None
+                                    else _cfg("SNAPSHOT_CADENCE", "50"))
+        self.max_retries = int(max_retries if max_retries is not None
+                               else _cfg("MAX_RETRIES", "3"))
+        self.dt_backoff = float(dt_backoff if dt_backoff is not None
+                                else _cfg("DT_BACKOFF", "0.5"))
+        self.dt_recovery = float(dt_recovery if dt_recovery is not None
+                                 else _cfg("DT_RECOVERY", "2.0"))
+        self.retry_base_delay = float(
+            retry_base_delay if retry_base_delay is not None
+            else _cfg("RETRY_BASE_DELAY", "0.05"))
+        self.ring = SnapshotRing(int(ring_size if ring_size is not None
+                                     else _cfg("RING_SNAPSHOTS", "4")))
+        self.io_retry = io_retry_policy(
+            on_retry=lambda attempt, exc:
+                solver.metrics.inc("resilience/io_retries"))
+        self.checkpoint_dir = (pathlib.Path(checkpoint_dir)
+                               if checkpoint_dir else None)
+        self.checkpoint_iter = int(checkpoint_iter
+                                   if checkpoint_iter is not None
+                                   else _cfg("CHECKPOINT_ITER", "0"))
+        self.resume = bool(resume)
+        self.chaos = chaos
+        self.install_signal_handlers = bool(install_signal_handlers)
+        # recovery bookkeeping
+        self.rewinds = 0
+        self.retries = 0
+        self.snapshots_captured = 0
+        self.dt_limit = None          # post-rewind dt cap (None: unlimited)
+        self._consecutive = 0
+        self._last_failure_iter = None
+        self.lineage = []             # one entry per recovery attempt
+        self.resume_event = None
+        self.stopped_by = None
+        self._stop_signal = None
+        self._checkpoint_handler = None
+        solver.resilience = self
+        if chaos is not None:
+            chaos.attach(self)
+
+    # ------------------------------------------------------- checkpoints
+
+    def _ensure_checkpoint_handler(self):
+        """The durable-checkpoint FileHandler: one write per set file
+        (a crash can at worst truncate the newest set — exactly what
+        resume_latest validates), append-mode numbering across restarts,
+        coefficient-layout tasks so restore is bitwise."""
+        if self._checkpoint_handler is None:
+            handler = self.solver.evaluator.add_file_handler(
+                self.checkpoint_dir, max_writes=1, mode="append",
+                iter=self.checkpoint_iter or None)
+            handler.io_retry = self.io_retry
+            for var in self.solver.state:
+                handler.add_task(var, layout="c", name=var.name)
+            self._checkpoint_handler = handler
+        return self._checkpoint_handler
+
+    def write_checkpoint(self):
+        """Force one durable checkpoint write now (the preemption and
+        end-of-run path; periodic writes ride the evaluator schedule).
+        Refuses a known-poisoned state: a checkpoint is a promise of
+        restartability. Retry is the CALLER's job here (_final_checkpoint
+        wraps this whole call), so the handler's own per-write retry is
+        suspended to keep the attempt budget single-layered."""
+        if self.checkpoint_dir is None:
+            return None
+        solver = self.solver
+        if solver.health_error is not None:
+            raise SolverHealthError(
+                f"refusing durable checkpoint of a poisoned state: "
+                f"{solver.health_error.reason}",
+                iteration=int(solver.iteration),
+                sim_time=float(solver.sim_time))
+        handler = self._ensure_checkpoint_handler()
+        saved, handler.io_retry = handler.io_retry, None
+        try:
+            handler.process(
+                iteration=int(solver.iteration),
+                wall_time=time.time() - solver.start_time,
+                sim_time=float(solver.sim_time),
+                timestep=float(solver.dt) if solver.dt is not None else None)
+        finally:
+            handler.io_retry = saved
+        solver.metrics.inc("resilience/checkpoints_written")
+        return handler.current_file
+
+    # ----------------------------------------------------------- signals
+
+    def _handle_stop_signal(self, signum, frame):
+        """SIGTERM/SIGINT: request a graceful stop. The loop notices at
+        the next step boundary; nothing solver-side happens here (the
+        handler can interrupt a step mid-dispatch)."""
+        self._stop_signal = signum
+        logger.warning(
+            f"received {signal.Signals(signum).name}: finishing the "
+            "current step, writing a final checkpoint, and stopping")
+
+    def _install_signals(self):
+        if not self.install_signal_handlers:
+            return {}
+        previous = {}
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                previous[signum] = signal.signal(
+                    signum, self._handle_stop_signal)
+            except (ValueError, OSError):
+                # non-main thread or unsupported platform: degrade to
+                # cooperative stops (request_stop) only
+                pass
+        return previous
+
+    # ---------------------------------------------------------- recovery
+
+    def _recover(self, err):
+        """Rewind to the newest finite snapshot, tighten the dt cap, and
+        wait the exponential backoff. Raises the original error when the
+        retry budget or the snapshot ring is exhausted (the flight
+        recorder of every attempt is already on disk)."""
+        solver = self.solver
+        self.retries += 1
+        self._consecutive += 1
+        solver.metrics.inc("resilience/retries")
+        entry = {
+            "failure_iteration": int(solver.iteration),
+            "reason": getattr(err, "reason", str(err)),
+            "postmortem": getattr(err, "postmortem_dir", None),
+            "attempt": self._consecutive,
+        }
+        if self._consecutive > self.max_retries:
+            entry["outcome"] = "escalated: retry budget exhausted"
+            self.lineage.append(entry)
+            logger.error(
+                f"resilience: {self.max_retries} consecutive recoveries "
+                "exhausted; escalating")
+            raise err
+        snap = self.ring.pop_newest_finite()
+        if snap is None:
+            entry["outcome"] = "escalated: no finite snapshot"
+            self.lineage.append(entry)
+            logger.error("resilience: snapshot ring exhausted (no finite "
+                         "state to rewind to); escalating")
+            raise err
+        # dt backoff: cap future timesteps below the dt that failed
+        failed_dt = solver.dt or snap.dt or self.dt
+        if failed_dt:
+            base = self.dt_limit if self.dt_limit is not None else failed_dt
+            self.dt_limit = min(base, failed_dt) * self.dt_backoff
+            solver.metrics.inc("resilience/dt_backoffs")
+        restore_snapshot(solver, snap)
+        self.rewinds += 1
+        self._last_failure_iter = entry["failure_iteration"]
+        solver.metrics.inc("resilience/rewinds")
+        entry.update({
+            "outcome": "rewound",
+            "rewind_iteration": snap.iteration,
+            "dt_limit": self.dt_limit,
+        })
+        self.lineage.append(entry)
+        delay = self.retry_base_delay * (2.0 ** (self._consecutive - 1))
+        logger.warning(
+            f"resilience: rewound iteration "
+            f"{entry['failure_iteration']} -> {snap.iteration}, dt capped "
+            f"at {self.dt_limit}, retry {self._consecutive}/"
+            f"{self.max_retries} in {delay:.3g}s")
+        if delay > 0:
+            time.sleep(delay)
+
+    def _effective_dt(self):
+        dt = (self.timestep_function() if self.timestep_function
+              else (self.solver.dt or self.dt))
+        if dt is None:
+            raise ValueError(
+                "evolve_resilient() requires dt=..., a timestep_function, "
+                "or a prior solver.step(dt)")
+        if self.dt_limit is not None:
+            dt = min(float(dt), self.dt_limit)
+        return dt
+
+    def _capture(self):
+        solver = self.solver
+        if solver.fields_dirty():
+            # user edits (initial conditions, checkpoint restore) not yet
+            # gathered: the anchor snapshot must hold the state the next
+            # step will actually use, not the stale X
+            solver.X = solver.gather_fields()
+        self.ring.push(capture_snapshot(solver))
+        self.snapshots_captured += 1
+        solver.metrics.inc("resilience/snapshots")
+        # a clean cadence past the last failure: relax the dt cap and
+        # reset the consecutive-failure budget
+        if (self._last_failure_iter is None
+                or solver.iteration > self._last_failure_iter):
+            self._consecutive = 0
+            if self.dt_limit is not None:
+                self.dt_limit *= self.dt_recovery
+                # with a constant dt the cap clears once it stops binding;
+                # under a timestep_function there is no base to compare
+                # against, so the cap keeps doubling until min() makes it
+                # moot — an effective un-cap
+                if self.dt is not None and self.dt_limit >= self.dt:
+                    self.dt_limit = None
+
+    def request_stop(self, why="requested"):
+        """Cooperative stop request (the signal handler's path, also
+        callable directly): honored at the next step boundary."""
+        if self._stop_signal is None:
+            self._stop_signal = why
+
+    # ---------------------------------------------------------- the loop
+
+    def run(self, log_cadence=100):
+        """Drive the solver to completion (or preemption). Returns a
+        summary dict (also available as `self.summary()`)."""
+        solver = self.solver
+        previous_handlers = self._install_signals()
+        try:
+            if self.resume and self.checkpoint_dir is not None:
+                self.resume_event = resume_latest(
+                    solver, self.checkpoint_dir, metrics=solver.metrics)
+                if self.resume_event is not None:
+                    solver.metrics.inc("resilience/resumes")
+                    if self.dt is None and self.resume_event["dt"]:
+                        self.dt = self.resume_event["dt"]
+            if self.checkpoint_dir is not None:
+                self._ensure_checkpoint_handler()
+            self._capture()   # iteration-0 (or resume-point) anchor
+            next_snapshot = solver.iteration + self.snapshot_cadence
+            while True:
+                # recovery BEFORE the stop check: a preemption landing on
+                # the same step as a divergence must rewind first, so the
+                # final checkpoint is written from a good state, never
+                # the poisoned one
+                if solver.health_error is not None:
+                    self._recover(solver.health_error)
+                    next_snapshot = solver.iteration + self.snapshot_cadence
+                    continue
+                if self._stop_signal is not None:
+                    self._graceful_stop()
+                    break
+                if not solver.proceed:
+                    self.stopped_by = "completed"
+                    break
+                dt = self._effective_dt()
+                try:
+                    if self.chaos is not None:
+                        self.chaos.before_step(solver)
+                    solver.step(dt)
+                except SolverHealthError as err:
+                    # the raising path (invalid dt): state is unpoisoned
+                    # but dt production is broken — same rewind + backoff
+                    self._recover(err)
+                    next_snapshot = solver.iteration + self.snapshot_cadence
+                    continue
+                if self.chaos is not None:
+                    self.chaos.after_step(solver)
+                if solver.health_error is None \
+                        and solver.iteration >= next_snapshot:
+                    self._capture()
+                    next_snapshot = solver.iteration + self.snapshot_cadence
+                if log_cadence and solver.iteration % log_cadence == 0:
+                    logger.info(
+                        f"Iteration={solver.iteration}, "
+                        f"Time={solver.sim_time:.6e}, dt={dt:.6e}")
+            if self.stopped_by == "completed" and self.checkpoint_dir:
+                self._final_checkpoint()
+        finally:
+            for signum, handler in previous_handlers.items():
+                try:
+                    signal.signal(signum, handler)
+                except (ValueError, OSError):
+                    pass
+            try:
+                solver.flush_metrics()
+            except Exception as exc:
+                logger.warning(f"final telemetry flush failed: {exc}")
+        return self.summary()
+
+    def _graceful_stop(self):
+        solver = self.solver
+        sig = self._stop_signal
+        self.stopped_by = (signal.Signals(sig).name
+                           if isinstance(sig, int) else str(sig))
+        logger.info(f"resilience: graceful stop ({self.stopped_by}) at "
+                    f"iteration {solver.iteration}")
+        # last-chance integrity check: preemption can land between a
+        # divergence and its cadenced detection — the final checkpoint is
+        # a promise of restartability, so probe now and rewind first if
+        # the state is poisoned
+        if solver.health.enabled and solver.health_error is None:
+            try:
+                solver.health.check()
+            except Exception as exc:
+                logger.warning(f"pre-checkpoint health check failed: {exc}")
+        if solver.health_error is not None:
+            try:
+                self._recover(solver.health_error)
+            except SolverHealthError:
+                logger.error(
+                    "resilience: state unrecoverable at preemption; "
+                    "skipping the final checkpoint (the flight recorder "
+                    "holds the forensic state)")
+                return
+        self._final_checkpoint()
+
+    def _final_checkpoint(self):
+        if self.checkpoint_dir is None:
+            return
+        try:
+            path = self.io_retry.call(self.write_checkpoint,
+                                      label="final checkpoint")
+            logger.info(f"final checkpoint written: {path}")
+        except Exception as exc:
+            logger.error(f"final checkpoint failed: {exc}")
+
+    # ----------------------------------------------------------- summary
+
+    def summary(self):
+        """Compact record of this loop's resilience activity — attached
+        to telemetry flushes (solver.flush_metrics), bench rows, and
+        post-mortem dumps (retry lineage)."""
+        out = {
+            "rewinds": self.rewinds,
+            "retries": self.retries,
+            "snapshots": self.snapshots_captured,
+            "dt_limit": self.dt_limit,
+            "stopped_by": self.stopped_by,
+        }
+        if self.lineage:
+            out["lineage"] = list(self.lineage)
+        if self.resume_event is not None:
+            out["resumed_from"] = self.resume_event["path"]
+            out["resume_write"] = self.resume_event["write"]
+        return out
+
+
+def jsonable_summary(summary):
+    """Strict-JSON view of a summary (non-finite floats stringified)."""
+    return json.loads(json.dumps(summary, default=str))

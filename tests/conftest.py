@@ -18,6 +18,18 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # chaos: fault-injection tests (tools/chaos.py driving the resilient
+    # loop's recovery branches). Registered here as well as in
+    # pyproject.toml so the marker exists even under a bare pytest
+    # invocation with a stripped ini; chaos tests are tier-1 (fast, CPU)
+    # and run by default — they are the proof the recovery paths work.
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests of the resilient "
+        "solve loop (tools/resilience.py + tools/chaos.py)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,458 @@
+"""
+Resilient solve loop (tools/resilience.py) driven by the chaos harness
+(tools/chaos.py): divergence -> rewind -> dt-backoff -> completion,
+SIGTERM -> checkpoint -> resume round-trips (bitwise), transient-IO retry,
+corrupted-checkpoint fallback, escalation semantics, and the
+zero-overhead disabled path. Every recovery branch is exercised by a
+deterministic injected fault — tier-1, CPU, no timing dependence.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.tools import chaos as chaos_mod
+from dedalus_tpu.tools import resilience as res_mod
+from dedalus_tpu.tools.exceptions import CheckpointError, SolverHealthError
+
+REPO = pathlib.Path(__file__).parent.parent
+
+pytestmark = pytest.mark.chaos
+
+
+def build_diffusion_solver(tmp_path, scheme="RK222", **solver_kw):
+    """Small stable 1D heat IVP: recovery trivially succeeds once the
+    injected fault is rewound past."""
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = 0")
+    kw = dict(health_cadence=1, warmup_iterations=2,
+              enforce_real_cadence=0,
+              postmortem_dir=str(tmp_path / "pm"))
+    kw.update(solver_kw)
+    solver = problem.build_solver(getattr(d3, scheme), **kw)
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(3 * x)
+    return solver, u
+
+
+def build_blowup_solver(tmp_path, **solver_kw):
+    """dt(s) = s*s, s0 = 2: diverges at ANY dt — rewinds cannot save it,
+    so escalation paths are reachable deterministically."""
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=16, bounds=(0, 2 * np.pi))
+    s = dist.Field(name="s", bases=xb)
+    problem = d3.IVP([s], namespace={})
+    problem.add_equation((d3.dt(s), s * s))
+    kw = dict(health_cadence=1, warmup_iterations=2,
+              postmortem_dir=str(tmp_path / "pm"))
+    kw.update(solver_kw)
+    solver = problem.build_solver(d3.SBDF1, **kw)
+    s["g"] = 2.0
+    return solver, s
+
+
+# ------------------------------------------------------ rewind + backoff
+
+def test_nan_divergence_rewind_recovers(tmp_path):
+    """Injected NaN at iteration N: the loop rewinds to the last good
+    snapshot, caps dt by the backoff factor, and runs to completion —
+    with the recovery visible in the telemetry record."""
+    solver, u = build_diffusion_solver(tmp_path)
+    solver.stop_iteration = 30
+    injector = chaos_mod.ChaosInjector(nan_field="u", nan_iteration=12)
+    summary = solver.evolve_resilient(
+        dt=1e-3, snapshot_cadence=5, max_retries=3, dt_backoff=0.5,
+        retry_base_delay=0.0, chaos=injector)
+    assert solver.iteration == 30
+    assert np.all(np.isfinite(np.asarray(solver.X)))
+    assert summary["stopped_by"] == "completed"
+    assert summary["rewinds"] >= 1
+    assert summary["retries"] >= 1
+    assert [f["kind"] for f in injector.fired] == ["nan"]
+    # the rewind went to a snapshot at or before the poisoned iteration
+    lineage = summary["lineage"]
+    assert lineage[0]["outcome"] == "rewound"
+    assert lineage[0]["rewind_iteration"] <= 12
+    assert lineage[0]["dt_limit"] == pytest.approx(5e-4)
+    # counters + summary ride in the flushed telemetry record
+    rec = solver.flush_metrics()
+    assert rec["resilience"]["rewinds"] == summary["rewinds"]
+    assert rec["counters"]["resilience/rewinds"] >= 1
+    assert rec["counters"]["resilience/dt_backoffs"] >= 1
+    # the postmortem of the poisoned attempt records the retry lineage
+    pm_dirs = sorted((tmp_path / "pm").iterdir())
+    assert pm_dirs
+    from dedalus_tpu.tools.health import read_postmortem, format_postmortem
+    record, _ = read_postmortem(pm_dirs[-1])
+    text = "\n".join(format_postmortem(record))
+    assert "resilience" in record or "retry" in text
+
+
+def test_rb_nan_divergence_recovers_and_reports(tmp_path):
+    """Acceptance: injected NaN divergence on the RB benchmark problem
+    recovers automatically and the rewind/retry counts surface in the
+    flushed record and in `python -m dedalus_tpu report`."""
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, b = build_rb_solver(32, 16, np.float32)
+    solver.warmup_iterations = 2
+    solver.health.cadence = 1
+    solver.health.postmortem_dir = str(tmp_path / "pm")
+    solver.stop_iteration = 20
+    injector = chaos_mod.ChaosInjector(nan_field="b", nan_iteration=8)
+    summary = solver.evolve_resilient(
+        dt=0.01, snapshot_cadence=4, max_retries=3,
+        retry_base_delay=0.0, chaos=injector)
+    assert solver.iteration == 20
+    assert np.all(np.isfinite(np.asarray(solver.X)))
+    assert summary["rewinds"] >= 1
+    rec = solver.flush_metrics()
+    assert rec["resilience"]["rewinds"] >= 1
+    # report CLI shows the resilience columns
+    sink = tmp_path / "results.jsonl"
+    with open(sink, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dedalus_tpu", "report", str(sink)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+    assert "resilience:" in proc.stdout
+    assert "rewinds" in proc.stdout
+
+
+def test_poisoned_snapshot_skipped(tmp_path):
+    """A snapshot captured after the true onset but before detection is
+    itself poisoned: the ring discards it and rewinds further."""
+    solver, u = build_diffusion_solver(tmp_path)
+    loop = res_mod.ResilientLoop(solver, dt=1e-3, snapshot_cadence=5,
+                                 retry_base_delay=0.0,
+                                 install_signal_handlers=False)
+    loop._capture()
+    good_iter = solver.iteration
+    for _ in range(3):
+        solver.step(1e-3)
+    # capture a poisoned snapshot on top of the good anchor
+    chaos_mod.ChaosInjector().poison_field(solver, "u")
+    loop._capture()
+    assert len(loop.ring) == 2
+    solver.health.check()
+    assert solver.health_error is not None
+    loop._recover(solver.health_error)
+    assert solver.iteration == good_iter
+    assert np.all(np.isfinite(np.asarray(solver.X)))
+    assert loop.rewinds == 1
+
+
+def test_retry_budget_escalates(tmp_path):
+    """max_retries consecutive failures escalate: the original structured
+    error propagates and the lineage records the decision."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.max_abs_limit = 1e6
+    solver.stop_iteration = 500
+    with pytest.raises(SolverHealthError):
+        solver.evolve_resilient(dt=1.0, snapshot_cadence=1000,
+                                max_retries=0, retry_base_delay=0.0)
+    loop = solver.resilience
+    assert loop.lineage[-1]["outcome"] == "escalated: retry budget exhausted"
+    rec = solver.flush_metrics()
+    assert rec["resilience"]["retries"] == 1
+
+
+def test_ring_exhaustion_escalates(tmp_path):
+    """When every snapshot has been consumed (or poisoned), recovery
+    escalates instead of rewinding to nothing."""
+    solver, s = build_blowup_solver(tmp_path)
+    solver.health.max_abs_limit = 1e6
+    solver.stop_iteration = 500
+    with pytest.raises(SolverHealthError):
+        # one anchor snapshot, cadence too long to capture another:
+        # failure 1 consumes the anchor, failure 2 finds an empty ring
+        solver.evolve_resilient(dt=1.0, snapshot_cadence=1000,
+                                max_retries=5, retry_base_delay=0.0)
+    loop = solver.resilience
+    assert loop.rewinds == 1
+    assert loop.lineage[-1]["outcome"] == "escalated: no finite snapshot"
+
+
+def test_postmortem_dirs_collision_proof(tmp_path):
+    """Repeated dumps at the SAME iteration (a rewind-retry-fail cycle)
+    never overwrite an earlier flight recording."""
+    solver, s = build_blowup_solver(tmp_path)
+    paths = {solver.health.dump_postmortem(f"attempt {i}")
+             for i in range(3)}
+    assert len(paths) == 3
+    for p in paths:
+        assert p.is_dir()
+
+
+# ------------------------------------------- preemption + checkpointing
+
+def test_sigterm_checkpoint_resume_roundtrip(tmp_path):
+    """Acceptance: a SIGTERM mid-run produces a valid checkpoint; the
+    resumed run restores sim_time/iteration/state exactly and finishes
+    bitwise-identical to an uninterrupted reference run."""
+    ckpt = tmp_path / "ckpt"
+    # reference: 20 uninterrupted steps
+    ref, _ = build_diffusion_solver(tmp_path, metrics=False)
+    ref.stop_iteration = 20
+    for _ in range(20):
+        ref.step(1e-3)
+
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    solver.stop_iteration = 20
+    injector = chaos_mod.ChaosInjector(sigterm_iteration=10)
+    summary = solver.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, chaos=injector)
+    assert summary["stopped_by"] == "SIGTERM"
+    assert solver.iteration == 10
+    assert [f["kind"] for f in injector.fired] == ["sigterm"]
+    sets = sorted(ckpt.glob("*.h5"))
+    assert sets, "no checkpoint written on SIGTERM"
+    # the previous SIGTERM disposition was restored on loop exit
+    assert signal.getsignal(signal.SIGTERM) is not None
+
+    resumed, u2 = build_diffusion_solver(tmp_path, metrics=False)
+    resumed.stop_iteration = 20
+    summary2 = resumed.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, resume=True)
+    assert summary2["resumed_from"]
+    event = resumed.resilience.resume_event
+    assert event["iteration"] == 10
+    assert event["sim_time"] == solver.sim_time      # exact
+    assert summary2["stopped_by"] == "completed"
+    assert resumed.iteration == 20
+    # bitwise: coefficient-layout checkpoints put no transform in the
+    # restore path, so the resumed trajectory is the reference trajectory
+    assert np.array_equal(np.asarray(resumed.X), np.asarray(ref.X))
+    assert resumed.sim_time == ref.sim_time
+
+
+def test_sigterm_during_divergence_writes_good_checkpoint(tmp_path):
+    """Preemption landing on the same step as (undetected) divergence:
+    the graceful stop probes the state, rewinds first, and writes the
+    final checkpoint from the last GOOD state — never the poisoned one."""
+    ckpt = tmp_path / "ckpt"
+    solver, u = build_diffusion_solver(tmp_path)
+    solver.stop_iteration = 30
+    injector = chaos_mod.ChaosInjector(nan_field="u", nan_iteration=8,
+                                       sigterm_iteration=8)
+    summary = solver.evolve_resilient(
+        dt=1e-3, snapshot_cadence=3, retry_base_delay=0.0,
+        checkpoint_dir=ckpt, chaos=injector)
+    assert summary["stopped_by"] == "SIGTERM"
+    assert summary["rewinds"] == 1
+    sets = sorted(ckpt.glob("*.h5"))
+    assert sets, "no final checkpoint written"
+    resumed, _ = build_diffusion_solver(tmp_path)
+    event = res_mod.resume_latest(resumed, ckpt)
+    assert event["iteration"] <= 8
+    assert np.all(np.isfinite(np.asarray(resumed.X))), \
+        "poisoned state leaked into the durable checkpoint"
+
+
+def test_resume_restores_state_bitwise(tmp_path):
+    """The restore itself is exact: X after resume equals X at the write,
+    bit for bit, and the clocks match."""
+    ckpt = tmp_path / "ckpt"
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    loop = res_mod.ResilientLoop(solver, dt=1e-3, checkpoint_dir=ckpt,
+                                 install_signal_handlers=False)
+    for _ in range(7):
+        solver.step(1e-3)
+    X_at_write = np.asarray(solver.X).copy()
+    loop.write_checkpoint()
+    solver2, u2 = build_diffusion_solver(tmp_path, metrics=False)
+    event = res_mod.resume_latest(solver2, ckpt)
+    assert event is not None and not event["fallbacks"]
+    assert solver2.iteration == 7
+    assert solver2.sim_time == solver.sim_time
+    assert solver2.dt == solver.dt
+    assert np.array_equal(np.asarray(solver2.X), X_at_write)
+
+
+def test_corrupted_newest_checkpoint_falls_back(tmp_path):
+    """Acceptance: a corrupted newest checkpoint is detected at resume
+    and the previous write is used; with every set corrupted the failure
+    is structured."""
+    ckpt = tmp_path / "ckpt"
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    loop = res_mod.ResilientLoop(solver, dt=1e-3, checkpoint_dir=ckpt,
+                                 install_signal_handlers=False)
+    marks = {}
+    for k in range(3):
+        for _ in range(4):
+            solver.step(1e-3)
+        loop.write_checkpoint()
+        marks[solver.iteration] = np.asarray(solver.X).copy()
+    sets = sorted(ckpt.glob("*.h5"),
+                  key=lambda p: int(p.stem.rsplit("_s", 1)[1]))
+    assert len(sets) == 3
+    chaos_mod.corrupt_checkpoint(sets[-1], mode="truncate")
+    solver2, _ = build_diffusion_solver(tmp_path, metrics=False)
+    event = res_mod.resume_latest(solver2, ckpt)
+    assert event["path"] == str(sets[-2])
+    assert len(event["fallbacks"]) == 1
+    assert "unreadable" in event["fallbacks"][0]["reason"]
+    assert solver2.iteration == 8
+    assert np.array_equal(np.asarray(solver2.X), marks[8])
+    # all sets corrupted: structured escalation naming the directory
+    for p in sets[:-1]:
+        chaos_mod.corrupt_checkpoint(p, mode="truncate")
+    solver3, _ = build_diffusion_solver(tmp_path, metrics=False)
+    with pytest.raises(CheckpointError) as excinfo:
+        res_mod.resume_latest(solver3, ckpt)
+    assert "no loadable checkpoint" in str(excinfo.value)
+    # no checkpoints at all: a fresh start, not an error
+    assert res_mod.resume_latest(solver3, tmp_path / "nowhere") is None
+
+
+def test_transient_io_fault_retried(tmp_path):
+    """The Nth checkpoint write raises a transient OSError: the retry
+    policy absorbs it and the write lands."""
+    ckpt = tmp_path / "ckpt"
+    solver, u = build_diffusion_solver(tmp_path)
+    solver.stop_iteration = 6
+    injector = chaos_mod.ChaosInjector(fail_checkpoint_write=1)
+    summary = solver.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, chaos=injector)
+    assert summary["stopped_by"] == "completed"
+    assert [f["kind"] for f in injector.fired] == ["io"]
+    sets = sorted(ckpt.glob("*.h5"))
+    assert sets, "checkpoint lost despite retry"
+    n_valid, reason = res_mod.validate_checkpoint(sets[-1])
+    assert n_valid == 1 and reason is None
+    rec = solver.flush_metrics()
+    assert rec["counters"]["resilience/io_retries"] >= 1
+    assert rec["counters"]["resilience/checkpoints_written"] >= 1
+
+
+# ------------------------------------------------- load_state hardening
+
+def test_load_state_structured_errors_and_fallback(tmp_path):
+    """Truncated files raise CheckpointError naming the file; a torn
+    newest write falls back to the previous valid write."""
+    ckpt = tmp_path / "ckpt"
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    handler = solver.evaluator.add_file_handler(ckpt, max_writes=10)
+    handler.add_task(u, layout="c", name="u")
+    clocks = []
+    for _ in range(3):
+        solver.step(1e-3)
+        handler.process(iteration=solver.iteration,
+                        sim_time=solver.sim_time, timestep=solver.dt)
+        clocks.append((solver.iteration, solver.sim_time))
+    path = handler.current_file
+    # tear the newest write: task data shorter than the scales cursor
+    import h5py
+    with h5py.File(path, "r+") as f:
+        ds = f["tasks/u"]
+        ds.resize((2,) + ds.shape[1:])
+    solver2, _ = build_diffusion_solver(tmp_path, metrics=False)
+    with pytest.raises(CheckpointError) as excinfo:
+        solver2.load_state(path, index=-1)
+    err = excinfo.value
+    assert isinstance(err, OSError)            # legacy catch compatibility
+    assert str(path) in str(err)
+    assert "torn write" in str(err)
+    assert err.index == 2
+    # fallback walks to the previous valid write
+    write, dt = solver2.load_state(path, index=-1, fallback=True)
+    assert write == 2
+    assert (solver2.iteration, solver2.sim_time) == clocks[1]
+    # validate_checkpoint reports the same torn-write diagnosis
+    n_valid, reason = res_mod.validate_checkpoint(path)
+    assert n_valid == 2 and "torn write" in reason
+    # file-level corruption: structured error, file named, no h5py leak
+    chaos_mod.corrupt_checkpoint(path, mode="truncate")
+    with pytest.raises(CheckpointError) as excinfo:
+        solver2.load_state(path)
+    assert "unreadable" in str(excinfo.value)
+    # missing file is also structured
+    with pytest.raises(CheckpointError):
+        solver2.load_state(tmp_path / "missing.h5")
+
+
+# ----------------------------------------------------- retry classifier
+
+def test_retry_policy_classification():
+    """Transient OSErrors are retried with exponential backoff;
+    structural ones and foreign exceptions escalate immediately."""
+    import errno
+    policy = res_mod.RetryPolicy(max_attempts=3, base_delay=0.0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(errno.EIO, "flaky disk")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+
+    def denied():
+        calls["n"] += 1
+        raise OSError(errno.EACCES, "permission denied")
+
+    calls["n"] = 0
+    with pytest.raises(PermissionError):
+        policy.call(denied)
+    assert calls["n"] == 1                       # no retry on EACCES
+
+    def wrong():
+        raise ValueError("not IO at all")
+
+    with pytest.raises(ValueError):
+        policy.call(wrong)
+    # transient fault past the attempt budget propagates
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        res_mod.RetryPolicy(max_attempts=2, base_delay=0.0).call(
+            lambda: (_ for _ in ()).throw(OSError(errno.EIO, "always")))
+    # backoff doubles per attempt, capped
+    p = res_mod.RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.25)
+    assert [p.delay(k) for k in (1, 2, 3)] == [0.1, 0.2, 0.25]
+
+
+# -------------------------------------------------------- zero overhead
+
+def test_disabled_resilience_zero_overhead(tmp_path):
+    """A plain run never touches the resilience machinery: no snapshots,
+    no counters, no handlers, no `resilience` key in telemetry."""
+    solver, u = build_diffusion_solver(tmp_path)
+    for _ in range(5):
+        solver.step(1e-3)
+    assert getattr(solver, "resilience", None) is None
+    assert solver.evaluator.handlers == []
+    rec = solver.flush_metrics()
+    assert "resilience" not in rec
+    assert not any(k.startswith("resilience/") for k in rec["counters"])
+
+
+def test_schedule_state_roundtrip(tmp_path):
+    """Evaluator scheduling counters rewind with the solver: an output
+    cadence crossed between snapshot and failure re-fires on replay."""
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    handler = solver.evaluator.add_dictionary_handler(iter=5)
+    handler.add_task(u, name="u")
+    state0 = handler.schedule_state()
+    for _ in range(6):
+        solver.step(1e-3)
+    assert handler.last_iter_div == 1            # fired at iteration 5
+    handler.restore_schedule_state(state0)
+    assert handler.last_iter_div == state0["last_iter_div"]
+    # replaying past the cadence schedules the handler again
+    assert handler.check_schedule(iteration=5) is True
