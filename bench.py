@@ -75,7 +75,9 @@ def run_benchmark():
     from __graft_entry__ import _build_rb_solver
 
     mark(f"building RB {NX}x{NZ} solver dtype={np.dtype(dtype).name}")
+    t_build = time.time()
     solver, b = _build_rb_solver(NX, NZ, dtype)
+    build_sec = time.time() - t_build
     dt = 0.01
     mark("warmup (first step compiles)")
     for i in range(WARMUP):
@@ -101,6 +103,12 @@ def run_benchmark():
         "value": round(steps_per_sec, 3),
         "unit": "steps/sec",
         "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+        # cold-start accounting: solver-construction wall time plus the
+        # host_assembly/structure/factor/compile split and assembly-cache
+        # verdict (tools/metrics.BuildPhases; benchmarks/coldstart.py is
+        # the dedicated cold-vs-warm study)
+        "build_sec": round(build_sec, 3),
+        "build_phases": solver.build_phases.record(),
     }
     # Attach the sampled per-phase breakdown (tools/metrics.py; default-on,
     # cadence-gated so it never blocked inside the measured region)
@@ -188,7 +196,8 @@ def _recent_tpu_row(config=None, max_age_hours=48):
     results.jsonl recorded within the recent measurement window (48h:
     wide enough to span a round whose chip window opened early — or the
     previous round's sweep when the chip stayed unclaimable throughout,
-    as rows carry their own measured_ts provenance)."""
+    as rows carry their own measured_ts provenance). `max_age_hours=None`
+    disables the window (the stale-headline guard's unfiltered probe)."""
     import time
     config = config or f"rb{NX}x{NZ}"
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -206,11 +215,68 @@ def _recent_tpu_row(config=None, max_age_hours=48):
                         and row.get("finite")
                         and row.get("steps_per_sec")
                         and row.get("ts")
-                        and time.time() - row["ts"] < max_age_hours * 3600):
+                        and (max_age_hours is None
+                             or time.time() - row["ts"]
+                             < max_age_hours * 3600)):
                     best = row
     except OSError:
         return None
     return best
+
+
+def _prior_headline_reuses(measured_ts, same_round_grace_hours=6.0):
+    """(rounds, rerun): how many PREVIOUS official bench headline ROUNDS
+    already re-reported the watcher row with this measured_ts, and whether
+    the newest such report is recent enough that the current run is a
+    retry of that same round (a flaky-probe re-run inside the window that
+    owns the measurement, not a new reuse). Reports clustered within
+    `same_round_grace_hours` count as ONE round, and refusal records
+    (`stale_headline`) never count — otherwise a refusal would increment
+    the tally it guards on and wedge every subsequent run."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results.jsonl")
+    report_times = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                # rows from before the stale-stamp convention carry
+                # measured_ts but no `stale` flag; any headline that
+                # re-reported this measurement counts as a reuse
+                if (row.get("config") == f"rb{NX}x{NZ}_bench"
+                        and row.get("measured_ts") == measured_ts
+                        and measured_ts is not None
+                        and not row.get("stale_headline")
+                        and row.get("ts")):
+                    report_times.append(float(row["ts"]))
+    except OSError:
+        pass
+    if not report_times:
+        return 0, False
+    report_times.sort()
+    grace = same_round_grace_hours * 3600.0
+    rounds, anchor = 1, report_times[0]
+    for t in report_times[1:]:
+        if t - anchor > grace:
+            rounds += 1
+            anchor = t
+    rerun = (time.time() - report_times[-1]) <= grace
+    return rounds, rerun
+
+
+def _refuse_stale(record, errors, reason):
+    """Record a stale-headline refusal (loudly, rc=1): one shape for both
+    refusal sites so `report` consumers see consistent fields."""
+    record["stale_headline"] = reason
+    record["error"] = "; ".join(errors + [f"stale_headline: {reason}"])
+    mark(f"REFUSING stale headline: {reason}")
+    _attach_progression(record)
+    _log_result(record)
+    print(json.dumps(record), flush=True)
+    sys.exit(1)
 
 
 def _attach_progression(record):
@@ -279,11 +345,36 @@ def main():
     # report that real measurement as the official number, with explicit
     # provenance, rather than a CPU number for a TPU framework.
     watcher = _recent_tpu_row()
+    if watcher is None:
+        # No in-window TPU measurement. If an OLDER one exists, refuse to
+        # fall through silently: record the refusal loudly so the ancient
+        # TPU number can never be mistaken for this round's result — and
+        # the CPU fallback below never masks the staleness.
+        old = _recent_tpu_row(max_age_hours=None)
+        if old is not None and old.get("ts"):
+            age_hours = round((time.time() - old["ts"]) / 3600.0, 2)
+            record = {
+                "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec",
+                "value": 0.0, "unit": "steps/sec", "vs_baseline": 0.0,
+                "measured_ts": old.get("ts"),
+                "age_hours": age_hours,
+            }
+            _refuse_stale(record, errors,
+                          f"newest TPU watcher row is {age_hours}h old "
+                          f"(> 48h window); measured_ts={old['ts']}")
     if watcher is not None:
         sps = float(watcher["steps_per_sec"])
-        # Re-reported cached measurement: stamped stale with its age so it
-        # can never pass as a fresh number — the original measured_ts stays
-        # separate from the report-time `ts` that _append_result stamps.
+        age_s = round(time.time() - watcher["ts"], 1) \
+            if watcher.get("ts") else None
+        age_hours = round(age_s / 3600.0, 2) if age_s is not None else None
+        reuses, same_round_rerun = _prior_headline_reuses(watcher.get("ts"))
+        headline_reuse = reuses if same_round_rerun else reuses + 1
+        # Re-reported cached measurement: stamped stale with its age AND
+        # its measurement round so it can never pass as a fresh number —
+        # the original measured_ts stays separate from the report-time
+        # `ts` that _append_result stamps, and `round_measured` +
+        # `headline_reuse` record how often this row has already
+        # headlined an official bench line.
         record = {
             "metric": f"RB2D_{NX}x{NZ}_IVP_steps_per_sec_"
                       f"{watcher.get('dtype', 'float32')}_tpu",
@@ -295,12 +386,29 @@ def main():
                       "sweep; chip unclaimable at round end)",
             "stale": True,
             "measured_ts": watcher.get("ts"),
-            "age_s": round(time.time() - watcher["ts"], 1)
+            "round_measured": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(watcher["ts"]))
             if watcher.get("ts") else None,
+            "age_s": age_s,
+            "age_hours": age_hours,
+            "headline_reuse": headline_reuse,
             "error": "; ".join(errors),
         }
+        # Stale-headline guard: a watcher row may headline ONE round when
+        # the chip is unclaimable; re-reporting it in a later round would
+        # let the same TPU number silently headline a third round — fail
+        # loudly instead. (The >48h window is enforced upstream:
+        # _recent_tpu_row only returns in-window rows, and the
+        # watcher-is-None branch above refuses older ones.) A retry
+        # within the grace window of the newest report is the SAME round
+        # re-running (flaky probe), not a new-round reuse.
+        if reuses >= 1 and not same_round_rerun:
+            _refuse_stale(record, errors,
+                          f"watcher row measured_ts={watcher.get('ts')} "
+                          f"already headlined {reuses} prior round(s)")
         mark("chip unclaimable now; reporting the in-round watcher TPU "
-             f"measurement ({sps:.1f} steps/s)")
+             f"measurement ({sps:.1f} steps/s, {age_hours}h old, "
+             f"headline reuse #{headline_reuse})")
         _attach_progression(record)
         _log_result(record)
         print(json.dumps(record), flush=True)

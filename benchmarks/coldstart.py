@@ -1,0 +1,178 @@
+"""
+Cold-start benchmark: RB 256x64 solver build time, cold vs warm caches.
+
+Measures what the assembly cache (tools/assembly_cache.py) + persistent
+XLA compile cache actually buy on CPU, in the three regimes that matter:
+
+  cold                fresh process, EMPTY assembly + XLA cache dirs
+  warm_same_process   second build inside the cold process
+  warm_fresh_process  new process against the now-populated caches
+                      (median of N runs; this box is noisy)
+
+Each build is timed from entering the builder to the solver being ready
+(the same window progression.py records as `build_sec`), with the
+backend pre-warmed by a trivial dispatch first so jax runtime init is
+not billed to the solver. The per-phase split
+(host_assembly/structure/factor/compile, tools/metrics.BuildPhases)
+rides along, `compile_sec` from a first `step()` timed separately.
+
+Appends rows {"config": "rb256x64_coldstart", ...} to
+benchmarks/results.jsonl and exits nonzero when the warm same-process
+build fails the >= 3x target (the machine-checked acceptance bar).
+
+Run: python benchmarks/coldstart.py [--keep-caches]
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NX, NZ = 256, 64
+FRESH_RUNS = 3
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[cold {time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _child():
+    """One measured process: build (+ optional same-process rebuild and
+    first-step compile) and print a JSON record on stdout."""
+    import numpy as np
+    import jax
+    import dedalus_tpu.public  # noqa: F401  (configures caches from cfg)
+    xla_dir = os.environ.get("COLDSTART_XLA_DIR")
+    if xla_dir:
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    from dedalus_tpu.tools.config import config
+    config["linear algebra"]["MATRIX_SOLVER"] = os.environ.get(
+        "COLDSTART_MATSOLVER", "banded")
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    import jax.numpy as jnp
+    # backend/runtime warmup: jax init is not solver cold-start
+    jax.block_until_ready(jnp.zeros((8, 8)) @ jnp.zeros((8, 8)))
+
+    def one_build():
+        t0 = time.perf_counter()
+        solver, b = build_rb_solver(NX, NZ, np.float64)
+        return solver, time.perf_counter() - t0
+
+    solver, build_sec = one_build()
+    out = {
+        "build_sec": round(build_sec, 4),
+        "build_phases": solver.build_phases.record(),
+        "ops": type(solver.ops).__name__,
+        "pencil_shape": list(solver.pencil_shape),
+    }
+    if os.environ.get("COLDSTART_REBUILD"):
+        solver2, warm_sec = one_build()
+        out["build_sec_warm_same_process"] = round(warm_sec, 4)
+        out["build_phases_warm_same_process"] = \
+            solver2.build_phases.record()
+        # solver2 never steps, so its compile phase is unmeasured — null,
+        # not a measured zero
+        out["build_phases_warm_same_process"]["compile_sec"] = None
+    if os.environ.get("COLDSTART_STEP"):
+        solver.step(0.01)
+        jax.block_until_ready(solver.X)
+        out["build_phases"] = solver.build_phases.record()  # + compile_sec
+        out["finite"] = bool(np.isfinite(np.asarray(solver.X)).all())
+    else:
+        out["build_phases"]["compile_sec"] = None
+    print(json.dumps(out), flush=True)
+
+
+def _run_child(env, tag, timeout=1200):
+    mark(f"running {tag} child")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, stdout=subprocess.PIPE, text=True, timeout=timeout)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(f"{tag} child failed (rc={proc.returncode})")
+    rec = json.loads(line)
+    mark(f"{tag}: build {rec['build_sec']}s "
+         f"(cache={rec['build_phases'].get('assembly_cache')})")
+    return rec
+
+
+def main():
+    if "--child" in sys.argv:
+        _child()
+        return
+    from __graft_entry__ import _append_result
+
+    keep = "--keep-caches" in sys.argv
+    tmp = tempfile.mkdtemp(prefix="dedalus_coldstart_")
+    asm_dir = os.path.join(tmp, "assembly")
+    xla_dir = os.path.join(tmp, "xla")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DEDALUS_TPU_ASSEMBLY_CACHE"] = asm_dir
+    env["COLDSTART_XLA_DIR"] = xla_dir
+    env["COLDSTART_REBUILD"] = "1"
+    env["COLDSTART_STEP"] = "1"
+
+    mark(f"cold run (empty caches under {tmp})")
+    cold = _run_child(env, "cold")
+
+    env.pop("COLDSTART_REBUILD")
+    env.pop("COLDSTART_STEP")
+    warm_fresh = []
+    for i in range(FRESH_RUNS):
+        warm_fresh.append(_run_child(env, f"warm-fresh-{i + 1}"))
+    warm_fresh_sec = statistics.median(
+        r["build_sec"] for r in warm_fresh)
+    warm_rec = min(warm_fresh, key=lambda r: abs(
+        r["build_sec"] - warm_fresh_sec))
+
+    cold_sec = cold["build_sec"]
+    warm_same_sec = cold["build_sec_warm_same_process"]
+    record = {
+        "config": f"rb{NX}x{NZ}_coldstart",
+        "backend": env.get("JAX_PLATFORMS", "cpu"),
+        "matsolver": env.get("COLDSTART_MATSOLVER", "banded"),
+        "build_sec_cold": cold_sec,
+        "build_phases_cold": cold["build_phases"],
+        "build_sec_warm_same_process": warm_same_sec,
+        "build_phases_warm_same_process":
+            cold["build_phases_warm_same_process"],
+        "build_sec_warm_fresh_process": warm_fresh_sec,
+        "build_phases_warm_fresh_process": warm_rec["build_phases"],
+        "warm_fresh_runs": [r["build_sec"] for r in warm_fresh],
+        "speedup_same_process": round(cold_sec / warm_same_sec, 2)
+        if warm_same_sec else None,
+        "speedup_fresh_process": round(cold_sec / warm_fresh_sec, 2)
+        if warm_fresh_sec else None,
+        "finite": cold.get("finite"),
+        "ops": cold.get("ops"),
+        "pencil_shape": cold.get("pencil_shape"),
+    }
+    ok = (record["speedup_same_process"] or 0) >= 3.0
+    record["meets_3x_same_process"] = ok
+    record["meets_3x_fresh_process"] = \
+        (record["speedup_fresh_process"] or 0) >= 3.0
+    _append_result(record)
+    print(json.dumps(record), flush=True)
+    mark(f"speedups: same-process {record['speedup_same_process']}x, "
+         f"fresh-process {record['speedup_fresh_process']}x")
+    if not keep:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    if not ok:
+        mark("FAIL: warm same-process build is not >= 3x faster than cold")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -311,6 +311,9 @@ def run_config(name, warmup=5, measure=50):
         "steps_per_sec": round(sps, 3),
         "mode_stages_per_sec": round(G * S * stages * sps, 1),
         "build_sec": round(build_s, 2),
+        # cold-start split (host_assembly/structure/factor/compile seconds
+        # + assembly-cache verdict; tools/metrics.BuildPhases)
+        "build_phases": solver.build_phases.record(),
         "finite": finite,
         "finite_after_warmup": finite_warmup,
     }

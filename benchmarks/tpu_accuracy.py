@@ -81,18 +81,34 @@ def dd_sweep(record):
     old = config["linear algebra"].get("MATRIX_SOLVER", "auto")
     config["linear algebra"]["MATRIX_SOLVER"] = "dense"
     try:
-        # heat: dd trajectory vs exact decay (f64-grade floor)
+        # heat, MATCHED SCHEME: the dd trajectory against the native-f64
+        # trajectory of the SAME scheme at the SAME dt. This isolates the
+        # emulated-f64 arithmetic (target ~1e-10, like
+        # tests/test_ddstep.py:77); the old `dd_heat_err_N64` number was
+        # dd-vs-EXACT, i.e. dominated by the SBDF2 time-discretization
+        # error (~4e-6), and is kept under its honest name
+        # `dd_heat_timedisc_err_N64` as a sanity floor.
         N, dt, steps = 64, 1e-3, 200
+        ref_solver, ref_u, ref_x = build_heat(N, np.float64, scheme=d3.SBDF2)
+        for _ in range(steps):
+            ref_solver.step(dt)
+        X64 = np.asarray(ref_solver.X, dtype=np.float64)
         solver, u, x = build_heat(N, np.float64, scheme=d3.SBDF2)
         runner = maybe_dd_runner(solver) or DDIVPRunner(solver)
         runner.sync_state()   # ICs were set after build_solver
         for _ in range(steps):
             runner.step(dt)
+        Xdd = runner.state_f64()
+        scale = max(float(np.abs(X64).max()), 1e-300)
+        record["dd_vs_f64_heat_N64"] = \
+            float(np.abs(Xdd - X64).max()) / scale
         runner.push_state()
         err = float(np.abs(np.asarray(u["g"], np.float64)
                            - heat_exact(x, steps * dt)).max())
-        record["dd_heat_err_N64"] = err
-        mark(f"dd heat N=64: max err {err:.3e} (SBDF2 dt={dt})")
+        record["dd_heat_timedisc_err_N64"] = err
+        mark(f"dd heat N=64: dd-vs-f64 {record['dd_vs_f64_heat_N64']:.3e} "
+             f"(matched SBDF2 dt={dt}); vs exact {err:.3e} "
+             f"(time-discretization floor)")
 
         # KdV: mass conservation at f64 grade + dd-vs-f32 step cost
         N = 256
@@ -197,9 +213,13 @@ def main():
     # resolution-independent floor: spectral convergence bottoms out at
     # the dtype roundoff, not a power law
     assert errs[128] < (2e-5 if dtype == np.float32 else 1e-8), errs
-    # dd path must deliver f64-grade results wherever it ran
-    if "dd_heat_err_N64" in record:
-        assert record["dd_heat_err_N64"] < 1e-5, record
+    # dd path must deliver f64-grade results wherever it ran: the
+    # matched-scheme comparison isolates the arithmetic (f64-grade
+    # agreement, far below the f32 floor of ~1e-7)
+    if "dd_vs_f64_heat_N64" in record:
+        assert record["dd_vs_f64_heat_N64"] < 1e-9, record
+    if "dd_heat_timedisc_err_N64" in record:
+        assert record["dd_heat_timedisc_err_N64"] < 1e-5, record
     if "dd_kdv_mass_drift" in record:
         assert record["dd_kdv_mass_drift"] < 1e-10, record
     # dd_error on an accelerator is recorded as a diagnostic (the sweep

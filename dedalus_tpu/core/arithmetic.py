@@ -402,18 +402,37 @@ class ProductBase(Future):
             raise NonlinearOperatorError(
                 "Azimuthally-varying polar NCCs require the operand on a "
                 "polar basis too.")
-        # real-dtype tensor operands store spin-recombined pairs whose
-        # recombination does NOT commute with the azimuth convolution
-        # (reflection-type fold blocks anti-commute with the pair-J), so
-        # the convolution in stored coordinates couples components with
-        # pair slots — outside this kron-term structure
-        if operand.tensorsig and not is_complex_dtype(operand.dtype):
-            raise NonlinearOperatorError(
-                "Azimuthally-varying polar NCCs multiplying TENSOR "
-                "operands require a complex dtype (the real spin-pair "
-                "recombination does not commute with the azimuth "
-                "convolution); use a complex dtype or move the term to "
-                "the RHS.")
+        # Real-dtype TENSOR operands store spin-recombined (cos, -sin)
+        # pairs; the recombination does NOT commute with the azimuth
+        # convolution (reflection-type fold blocks anti-commute with the
+        # pair-J), so a spin-diagonal convolution would be wrong. The
+        # dtype-generic route conjugates the coordinate-component
+        # convolution by the stored recombination W = Re(U) (x) I2 +
+        # Im(U) (x) J (curvilinear.real_pair_matrix structure), which
+        # expands each azimuth mode's term into at most four kron terms
+        # with component-MIXING tensor factors:
+        #   W_out (I_c (x) A (x) R) W_in^dagger
+        #     =   Re(Uo)Re(Ui)^T (x) A        (x) R
+        #       - Re(Uo)Im(Ui)^T (x) A Jz_in  (x) R
+        #       + Im(Uo)Re(Ui)^T (x) Jz_out A (x) R
+        #       - Im(Uo)Im(Ui)^T (x) Jz_out A Jz_in (x) R
+        # with Jz = I_groups (x) PAIR_J acting on the whole interleaved
+        # azimuth axis. Scalar operands (U = 1) reduce to the single
+        # real term; complex dtypes keep the spin-diagonal fast path.
+        real_tensor = bool(operand.tensorsig) \
+            and not is_complex_dtype(operand.dtype)
+        mixers = [(None, 0, 0)]
+        if real_tensor:
+            from .curvilinear import recombination_matrix, PAIR_J
+            cs = nb.cs
+            Uo = recombination_matrix(tuple(self.tensorsig), cs)
+            Ui = recombination_matrix(tuple(operand.tensorsig), cs)
+            mixers = [
+                (Uo.real @ Ui.real.T, 0, 0),
+                (-(Uo.real @ Ui.imag.T), 0, 1),
+                (Uo.imag @ Ui.real.T, 1, 0),
+                (-(Uo.imag @ Ui.imag.T), 1, 1),
+            ]
         moved = np.moveaxis(ccomp, (ax0, r_axis), (0, 1))
         if moved.size != moved.shape[0] * moved.shape[1]:
             raise NonlinearOperatorError(
@@ -431,12 +450,26 @@ class ProductBase(Future):
             e_j[j] = 1.0
             A = ob_pol.azimuth_basis.multiplication_matrix(
                 e_j, nb.azimuth_basis)
+            A = sp.csr_matrix(A)
             R = ob_pol.radial_multiplication_matrix(prof, nb.k, k_out=0)
             cut = self._ncc_sparsify_cutoff(prof)
-            descrs = [None] * dim
-            descrs[ax0] = ("full", sparsify(A, 1e-14))
-            descrs[r_axis] = ("full", sparsify(R, cut))
-            terms.append((None, descrs))
+            R = sparsify(R, cut)
+            for mix, left_j, right_j in mixers:
+                if mix is not None and np.abs(mix).max() < 1e-14:
+                    continue
+                Ax = A
+                if right_j:
+                    Jz = sp.kron(sp.identity(A.shape[1] // 2), PAIR_J,
+                                 format="csr")
+                    Ax = Ax @ Jz
+                if left_j:
+                    Jz = sp.kron(sp.identity(A.shape[0] // 2), PAIR_J,
+                                 format="csr")
+                    Ax = Jz @ Ax
+                descrs = [None] * dim
+                descrs[ax0] = ("full", sparsify(Ax, 1e-14))
+                descrs[r_axis] = ("full", R)
+                terms.append((mix, descrs))
         if not terms:
             descrs = [None] * dim
             descrs[ax0] = ("full", sp.csr_matrix(
@@ -645,9 +678,14 @@ class ProductBase(Future):
         with A_j the whole-axis azimuth convolution of basis mode j and
         F/B the per-m Zernike quadrature stacks (the radial spaces are
         m-dependent, so every coupled (m_out, m_in) pair gets its own
-        radial block). Scalar NCCs only; tensor OPERANDS require a
-        complex dtype (the real spin-pair recombination does not commute
-        with the azimuth convolution — same limit as the annulus path).
+        radial block). Scalar NCCs only. Real-dtype TENSOR operands route
+        through the stored-pair conjugation (the real spin-pair
+        recombination does not commute with the azimuth convolution):
+        each 2x2 azimuth pair block az2 carries the component-mixing
+        combination C1 az2 + C2 az2 J + C3 J az2 + C4 J az2 J with
+        Ck the Re/Im products of the spin recombinations — the disk
+        analogue of the annulus kron-term expansion
+        (_polar_coupled_azimuth_terms), with per-(m, spin) radial blocks.
         """
         from .curvilinear import component_spins
         nb = self._polar_spin_basis(ncc)
@@ -657,13 +695,6 @@ class ProductBase(Future):
                 "Azimuthally-varying disk NCCs must be scalar fields; "
                 "move tensor-valued azimuthal backgrounds to the RHS.")
         real = not is_complex_dtype(self.dtype)
-        if real and operand.tensorsig:
-            raise NonlinearOperatorError(
-                "Azimuthally-varying disk NCCs multiplying TENSOR "
-                "operands require a complex dtype (the real spin-pair "
-                "recombination does not commute with the azimuth "
-                "convolution); use a complex dtype or move the term to "
-                "the RHS.")
         az_axis = nb.first_axis
         out_basis = self.domain.bases[az_axis]
         prof = moved[0].reshape(moved.shape[1], -1)       # (Ng_az, Ngr)
@@ -692,6 +723,54 @@ class ProductBase(Future):
                 e_j, nb.azimuth_basis)
             conv.append((j, np.asarray(
                 A_j.todense() if sp.issparse(A_j) else A_j)))
+        if real:
+            # stored-pair conjugation (docstring): component-mixing 2x2
+            # azimuth blocks with per-(m, spin-pair) radial blocks. The
+            # scalar-operand case reduces to C1 = 1 (K = az2), i.e. the
+            # plain pair convolution.
+            from .curvilinear import recombination_matrix, PAIR_J
+            Uo = recombination_matrix(tuple(self.tensorsig), cs)
+            Ui = recombination_matrix(tuple(operand.tensorsig), cs)
+            s_out = component_spins(tuple(self.tensorsig), cs) \
+                if self.tensorsig else np.zeros(1, dtype=int)
+            Cs = [Uo.real @ Ui.real.T, -(Uo.real @ Ui.imag.T),
+                  Uo.imag @ Ui.real.T, -(Uo.imag @ Ui.imag.T)]
+            ncomp_out = len(s_out)
+            J = PAIR_J
+            F = {int(s): np.asarray(out_basis.radial_forward_stack(int(s),
+                                                                   2.0))
+                 for s in set(int(v) for v in s_out)}
+            B = {int(s): np.asarray(ob.radial_backward_stack(int(s), 2.0))
+                 for s in set(int(v) for v in s_in)}
+            M = np.zeros((ncomp_out * naz * Nr, ncomp * naz * Nr))
+            for j, A_j in conv:
+                prof_j = modes[j]
+                for ci in range(ncomp_out):
+                    Fi = F[int(s_out[ci])]
+                    for cj in range(ncomp):
+                        cvals = [Ck[ci, cj] for Ck in Cs]
+                        if max(abs(v) for v in cvals) < 1e-14:
+                            continue
+                        Bj = B[int(s_in[cj])]
+                        r0 = ci * naz * Nr
+                        c0 = cj * naz * Nr
+                        for go in range(G):
+                            Rrow = None
+                            for gi in range(G):
+                                az2 = A_j[go * gs:(go + 1) * gs,
+                                          gi * gs:(gi + 1) * gs]
+                                K = (cvals[0] * az2 + cvals[1] * (az2 @ J)
+                                     + cvals[2] * (J @ az2)
+                                     + cvals[3] * (J @ az2 @ J))
+                                if np.abs(K).max() < 1e-14:
+                                    continue
+                                if Rrow is None:
+                                    Rrow = Fi[go] * prof_j[None, :]
+                                R = Rrow @ Bj[gi]          # (Nr, Nr)
+                                M[r0 + go * gs * Nr:r0 + (go + 1) * gs * Nr,
+                                  c0 + gi * gs * Nr:
+                                  c0 + (gi + 1) * gs * Nr] += np.kron(K, R)
+            return sp.csr_matrix(sparsify(M, 1e-14))
         spin_mats = {}
         for s in sorted(set(int(v) for v in s_in)):
             F = np.asarray(out_basis.radial_forward_stack(s, 2.0))
@@ -1499,6 +1578,13 @@ class ProductBase(Future):
         for comp in comp_indices:
             for scalar, descrs in self._ncc_axis_terms(ncc, comp, operand):
                 factors = [tensor_factor_fn(comp)]
+                if scalar is not None and not np.isscalar(scalar):
+                    # component-MIXING tensor factor (real-pair expansion
+                    # of azimuthally-varying polar NCCs): composes with
+                    # the ncc-component placement on the left
+                    factors[0] = sp.csr_matrix(np.asarray(scalar)) \
+                        @ sp.csr_matrix(factors[0])
+                    scalar = None
                 for axis, descr in enumerate(descrs):
                     ob = operand_domain.bases[axis]
                     if descr is None:

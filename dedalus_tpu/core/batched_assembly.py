@@ -21,6 +21,16 @@ reference: core/subsystems.py:493-598 build_matrices).
 
 Falls back (BatchUnsupported) for node types without batchable descriptors
 (currently: spherical regularity NCC products).
+
+PARTIAL mode (`partial=True`, with `subproblems`): instead of abandoning
+the whole system when one expression lacks batched terms, only THAT
+expression drops to the per-group `operand_expression_matrices` walk
+(fanned over the [caching] ASSEMBLY_WORKERS pool); its per-group entries
+are unioned onto the shared pattern alongside the batched chunks. Layouts
+with NCC-coupled separable axes are admitted here — descriptors on a
+coupled axis convert to whole-axis block-diagonal matrices — so an
+ell-coupled shell problem batches everything except the coupling NCC
+itself instead of walking scipy O(G) times for every term.
 """
 
 import numpy as np
@@ -100,8 +110,32 @@ class BTerm:
         return BTerm(self.scalar * scalar, self.tensor, self.factors)
 
 
-def _convert_descrs(layout, domain, terms):
-    """operators.terms() output -> [BTerm] (descr lists per axis)."""
+def _coupled_blocks_matrix(stack, out_basis, in_basis):
+    """
+    Whole-axis matrix of a per-group "blocks" stack on a FORCE-COUPLED
+    separable axis (the slot spans the whole axis, group-major):
+    endomorphic blocks (both sides carry the axis) block-diagonalize;
+    reductions (no output basis: integrate/interpolate rows) concatenate
+    horizontally; embeddings (no operand basis) stack vertically.
+    """
+    blocks = [sp.csr_matrix(b) for b in stack]
+    if out_basis is not None and in_basis is not None:
+        return sp.block_diag(blocks, format="csr")
+    if out_basis is None and in_basis is not None:
+        if any(b.shape[0] != 1 for b in blocks):
+            raise BatchUnsupported("coupled-axis reduction with >1 rows")
+        return sp.hstack(blocks, format="csr")
+    if out_basis is not None and in_basis is None:
+        if any(b.shape[1] != 1 for b in blocks):
+            raise BatchUnsupported("coupled-axis embedding with >1 cols")
+        return sp.vstack(blocks, format="csr")
+    raise BatchUnsupported("coupled-axis blocks without bases")
+
+
+def _convert_descrs(layout, domain, terms, out_domain=None):
+    """operators.terms() output -> [BTerm] (descr lists per axis).
+    `domain` is the OPERAND's domain; `out_domain` (the expression's own
+    domain) disambiguates reductions vs embeddings on coupled axes."""
     out = []
     for tensor_factor, axis_descrs in terms:
         tensor = None if tensor_factor is None else _dense(tensor_factor)
@@ -114,19 +148,29 @@ def _convert_descrs(layout, domain, terms):
                 elif basis is None:
                     factors.append(("I", 1))
                 else:
-                    sub = axis - basis.first_axis
-                    if basis.sub_separable(sub):
-                        factors.append(("I", basis.sub_group_shape(sub)))
-                    else:
-                        factors.append(("I", basis.coeff_size(sub)))
+                    # slot width of a coupled axis is the full coefficient
+                    # size (subsystems.PencilLayout.slot_shape), including
+                    # separable bases the layout force-coupled
+                    factors.append(("I", basis.coeff_size(
+                        axis - basis.first_axis)))
             else:
                 kind = descr[0]
                 if kind == "full":
                     factors.append(("D", descr[1]))
                 elif kind == "blocks":
-                    factors.append(("B", axis, np.asarray(descr[1])))
+                    stack = np.asarray(descr[1])
+                    if axis in layout.sep_widths:
+                        factors.append(("B", axis, stack))
+                    else:
+                        out_basis = out_domain.bases[axis] \
+                            if out_domain is not None else basis
+                        factors.append(("D", _coupled_blocks_matrix(
+                            stack, out_basis, basis)))
                 elif kind == "gblocks":
                     _, group_axis, stack = descr
+                    if group_axis not in layout.sep_widths:
+                        raise BatchUnsupported(
+                            f"gblocks indexed by coupled axis {group_axis}.")
                     factors.append(("B", group_axis, np.asarray(stack)))
                 else:
                     raise BatchUnsupported(f"Descriptor kind {kind!r}.")
@@ -184,7 +228,8 @@ def batched_expression_matrices(expr, layout, vars):
             raise BatchUnsupported(
                 f"{type(expr).__name__} overrides expression_matrices.")
         op_terms = batched_expression_matrices(expr.operand, layout, vars)
-        my_terms = _convert_descrs(layout, expr.operand.domain, expr.terms())
+        my_terms = _convert_descrs(layout, expr.operand.domain, expr.terms(),
+                                   out_domain=expr.domain)
         out = {}
         for var, terms in op_terms.items():
             out[var] = [mt.matmul(ot) for mt in my_terms for ot in terms]
@@ -200,11 +245,18 @@ def _batched_spherical_ncc(expr, layout, vars, ncc_index, ncc, operand):
     regularity pair with a one-hot tensor factor and a colatitude-indexed
     "gblocks" radial factor.
     """
-    setup = expr._sph_ncc_setup(ncc, operand, ncc_index)
-    basis = setup["basis"]
+    basis = expr._spherical_regularity_basis(ncc)
     az_axis = basis.first_axis
     colat_axis = az_axis + 1
     r_axis = az_axis + 2
+    # guard BEFORE the angularly-constant setup: on an ell-coupled layout
+    # (theta-dependent NCC elsewhere in the system) this product assembles
+    # through the per-group whole-axis path, and _sph_ncc_setup's
+    # radial-only validation may legitimately reject it
+    if colat_axis not in layout.sep_n_groups or \
+            az_axis not in layout.sep_widths:
+        raise BatchUnsupported("spherical NCC on a coupled angular axis")
+    setup = expr._sph_ncc_setup(ncc, operand, ncc_index)
     Nell = layout.sep_n_groups[colat_axis]
     ncomp_in = 3 ** setup["rank_in"]
     ncomp_out = 3 ** (setup["rank_n"] + setup["rank_in"])
@@ -260,8 +312,13 @@ def _batched_ncc_matrices(expr, layout, vars):
         if len(ncc_terms) != 1:
             raise BatchUnsupported("jointly-varying (multi-axis) NCC")
         scalar, descrs = ncc_terms[0]
+        if scalar is not None and not np.isscalar(scalar):
+            # component-mixing tensor factor (real-pair polar expansion):
+            # handled by the per-group path
+            raise BatchUnsupported("component-mixing NCC term")
         bterms = _convert_descrs(layout, operand.domain,
-                                 [(tensor_factor_fn(comp), descrs)])
+                                 [(tensor_factor_fn(comp), descrs)],
+                                 out_domain=expr.domain)
         if scalar is not None:
             bterms = [t.scaled(scalar) for t in bterms]
         my_terms.extend(bterms)
@@ -379,7 +436,55 @@ def _materialize_term(term, group_idx, ncomp_in, ncomp_out):
     return shape, rows, cols, vals
 
 
-def batched_system_coos(layout, equations, variables, names):
+def _pergroup_var_chunks(expr, subproblems, variables, act_groups, G, vdtype):
+    """
+    Per-group fallback of one expression (partial mode): walk
+    `operand_expression_matrices` for each (active) group — fanned over
+    the assembly worker pool — and union the per-group entries into
+    shared-pattern chunks. Returns {var: (rows, cols, vals (G, nnz))}
+    with rows/cols relative to the expression's own block.
+    """
+    from .operators import operand_expression_matrices
+    from .subsystems import map_groups
+    vset = set(variables)
+    sps = [subproblems[g] for g in act_groups]
+    mats_list = map_groups(
+        lambda spx: operand_expression_matrices(expr, spx, vset), sps)
+    out = {}
+    for var in {v for mats in mats_list for v in mats}:
+        csrs = {}
+        for g, mats in zip(act_groups, mats_list):
+            if var in mats:
+                m = sp.csr_matrix(mats[var])
+                m.sum_duplicates()
+                m.eliminate_zeros()
+                csrs[g] = m
+        if not csrs:
+            continue
+        ncols = next(iter(csrs.values())).shape[1]
+        pat = None
+        for m in csrs.values():
+            p = m.copy()
+            p.data = np.ones_like(p.data)
+            pat = p if pat is None else pat + p
+        pat = pat.tocoo()
+        lin = pat.row.astype(np.int64) * ncols + pat.col
+        order = np.argsort(lin)
+        lin = lin[order]
+        rows = pat.row[order].astype(int)
+        cols = pat.col[order].astype(int)
+        vals = np.zeros((G, lin.size), dtype=vdtype)
+        for g, m in csrs.items():
+            coo = m.tocoo()
+            idx = np.searchsorted(
+                lin, coo.row.astype(np.int64) * ncols + coo.col)
+            vals[g, idx] = coo.data
+        out[var] = (rows, cols, vals)
+    return out
+
+
+def batched_system_coos(layout, equations, variables, names,
+                        subproblems=None, partial=False):
     """
     Assemble the full pencil system for all groups at once.
 
@@ -387,10 +492,15 @@ def batched_system_coos(layout, equations, variables, names):
     row_valid (G, S), col_valid (G, S)) — one shared COO pattern
     (duplicates summed) with per-group values; validity is applied by
     ZEROING values (pattern stays shared). No closure entries are added.
-    Raises BatchUnsupported when any LHS expression lacks batched terms.
+    Raises BatchUnsupported when any LHS expression lacks batched terms —
+    unless `partial=True` (requires `subproblems`), where unbatchable
+    expressions drop to the per-group walk individually and everything
+    else stays vectorized (module docstring, PARTIAL mode).
     """
     from .subsystems import _system_sizes
-    if getattr(layout, "forced_coupled", None):
+    if partial and subproblems is None:
+        raise ValueError("partial mode requires subproblems")
+    if getattr(layout, "forced_coupled", None) and not partial:
         # NCC-coupled separable axes build whole-axis multiplication
         # matrices; their group structure is not batchable (and is tiny —
         # typically G=1), so use the per-group walk
@@ -451,19 +561,35 @@ def batched_system_coos(layout, equations, variables, names):
                 expr = member.get(name)
                 if expr is None or (np.isscalar(expr) and expr == 0):
                     continue
-                bmats = batched_expression_matrices(expr, layout,
-                                                    set(variables))
-                for var, terms in bmats.items():
-                    c0 = var_offsets[var_index[var]]
-                    n_in = ncomp(var.tensorsig)
-                    n_out = ncomp(eq["tensorsig"])
-                    for term in terms:
-                        shape, r, c, v = _materialize_term(
-                            term, group_idx, n_in, n_out)
-                        if v.ndim == 1:
-                            v = np.broadcast_to(v, (G, v.size))
-                        if activity is not None:
-                            v = v * activity[:, None]
+                staged = []
+                try:
+                    bmats = batched_expression_matrices(expr, layout,
+                                                        set(variables))
+                    for var, terms in bmats.items():
+                        c0 = var_offsets[var_index[var]]
+                        n_in = ncomp(var.tensorsig)
+                        n_out = ncomp(eq["tensorsig"])
+                        for term in terms:
+                            shape, r, c, v = _materialize_term(
+                                term, group_idx, n_in, n_out)
+                            if v.ndim == 1:
+                                v = np.broadcast_to(v, (G, v.size))
+                            if activity is not None:
+                                v = v * activity[:, None]
+                            staged.append((name, r + row0, c + c0, v))
+                    chunks.extend(staged)
+                except BatchUnsupported:
+                    if not partial:
+                        raise
+                    # per-group walk of just this expression; only groups
+                    # where the member is active are assembled (others
+                    # contribute structural zeros, like activity masking)
+                    act = np.arange(G) if activity is None \
+                        else np.flatnonzero(activity)
+                    pg = _pergroup_var_chunks(expr, subproblems, variables,
+                                              act, G, vdtype)
+                    for var, (r, c, v) in pg.items():
+                        c0 = var_offsets[var_index[var]]
                         chunks.append((name, r + row0, c + c0, v))
 
     if not chunks:

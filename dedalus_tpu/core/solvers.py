@@ -29,6 +29,7 @@ from .subsystems import (PencilLayout, build_subproblems, build_matrices,
 from .future import EvalContext, ev
 from . import timesteppers as timesteppers_mod
 from ..libraries import pencilops
+from ..tools import assembly_cache
 from ..tools import health as health_mod
 from ..tools import metrics as metrics_mod
 from ..tools import retrace as retrace_mod
@@ -44,6 +45,7 @@ class SolverBase:
 
     matrices = ("L",)
     lazy_ok = False   # EVP: per-group on-demand assembly at large sizes
+    cache_ok = True   # NLBVP: Jacobian rebuilds churn the persistent cache
 
     def __init__(self, problem, matsolver=None, ncc_cutoff=None,
                  matrix_coupling=None, **kw):
@@ -66,6 +68,9 @@ class SolverBase:
                                                      self.dist, self.layout)
         self.subproblems = build_subproblems(self.layout)
         self._lazy = False
+        # cold-start accounting: host_assembly/structure/factor/compile
+        # wall seconds + assembly-cache verdict (tools/metrics.BuildPhases)
+        self.build_phases = metrics_mod.BuildPhases()
         self._build_pencil_system()
         self.valid_row_mask = row_valid_masks(self.layout, self.equations)
 
@@ -103,6 +108,36 @@ class SolverBase:
             self.structure = None
             self.ops = None
             return
+        # persistent assembly cache (tools/assembly_cache.py): on a hit the
+        # symbolic walk, scipy kron folds and banded structural analysis are
+        # all skipped — the COO/banded stores load from disk
+        cache = assembly_cache.resolve() if self.cache_ok else None
+        ckey = None
+        if cache is not None:
+            ckey = assembly_cache.solver_key(self, names)
+        if ckey is not None:
+            payload = cache.load(ckey)
+            if payload is not None:
+                try:
+                    installed = assembly_cache.install_payload(
+                        self, names, payload)
+                except Exception as exc:
+                    # parseable but internally inconsistent (missing
+                    # array, drifted structure state): quarantine and
+                    # assemble fresh — same contract as load-time
+                    # corruption, which must never abort solver builds
+                    installed = False
+                    logger.warning(
+                        f"assembly cache payload {ckey[:12]} failed to "
+                        f"install ({exc!r}); quarantined, assembling fresh")
+                    cache.discard(ckey)
+                if installed:
+                    self.build_phases.cache = "hit"
+                    logger.info(
+                        f"Pencil system: assembly cache hit "
+                        f"({payload['meta']['kind']}, key {ckey[:12]})")
+                    return
+            self.build_phases.cache = "miss"
         self._assemble_batched(names)
         spec = self.matsolver if isinstance(self.matsolver, str) else ""
         forced = spec.lower() if spec.lower() in ("banded", "dense") else None
@@ -117,6 +152,7 @@ class SolverBase:
         if try_banded:
             result = self._try_banded(names, S)
             if result is True:
+                self._cache_store(cache, ckey, names)
                 return
             if forced == "banded":
                 raise ValueError("Banded solve forced but not applicable: "
@@ -136,28 +172,52 @@ class SolverBase:
             else:
                 logger.info(msg)
             # reuse the already-assembled COO matrices for the dense fallback
-            self._matrices = self._densify_coo_store(result, names, S)
+            with self.build_phases.scope("host_assembly"):
+                self._matrices = self._densify_coo_store(result, names, S)
         elif self._batched is not None:
-            self._matrices = self._dense_from_batched(names)
+            with self.build_phases.scope("host_assembly"):
+                self._matrices = self._dense_from_batched(names)
         else:
-            self._matrices = build_matrices(
-                self.subproblems, self.equations, self.variables,
-                names=names)
+            with self.build_phases.scope("host_assembly"):
+                self._matrices = build_matrices(
+                    self.subproblems, self.equations, self.variables,
+                    names=names)
         self.ops = pencilops.DenseOps(self._dense_matsolver())
+        self._cache_store(cache, ckey, names)
+
+    def _cache_store(self, cache, ckey, names):
+        """Persist the freshly built pencil system (miss path only)."""
+        if cache is None or ckey is None:
+            return
+        try:
+            exported = assembly_cache.export_payload(self, names)
+            if exported is not None:
+                cache.store(ckey, *exported)
+        except Exception as exc:
+            logger.warning(f"assembly cache store failed: {exc!r}")
 
     def _assemble_batched(self, names):
         """Attempt group-batched assembly; sets self._batched to the shared
         COO pattern result (rows, cols, {name: (G, nnz) vals}, row_valid,
         col_valid) or None when the expression tree requires the per-group
-        walk."""
+        walk. Runs in PARTIAL mode (per-expression fallback onto the
+        shared pattern) so a single unbatchable expression never forces
+        the whole system onto the per-group walk."""
         from .batched_assembly import batched_system_coos, BatchUnsupported
-        try:
-            self._batched = batched_system_coos(
-                self.layout, self.equations, self.variables, names)
-        except BatchUnsupported as exc:
-            logger.debug(f"Batched assembly unavailable ({exc}); "
-                         "using per-group assembly.")
-            self._batched = None
+        with self.build_phases.scope("host_assembly"):
+            # PARTIAL mode directly: with zero per-expression fallbacks it
+            # produces the full-mode output, and a system with one
+            # unbatchable term late in the tree would otherwise pay full
+            # assembly of every preceding expression twice (once in a
+            # doomed non-partial pass, again in the retry)
+            try:
+                self._batched = batched_system_coos(
+                    self.layout, self.equations, self.variables, names,
+                    subproblems=self.subproblems, partial=True)
+            except BatchUnsupported as exc:
+                logger.debug(f"Batched assembly unavailable ({exc}); "
+                             "using per-group assembly.")
+                self._batched = None
 
     def _dense_from_batched(self, names):
         """Scatter the shared-pattern COO store into dense (G, S, S) arrays
@@ -223,9 +283,13 @@ class SolverBase:
             scale = max((np.abs(bvals[name]).max() if bvals[name].size else 0.0)
                         for name in names)
         else:
-            for sp in self.subproblems:
-                coos, row_valid, col_valid = assemble_group_coos(
-                    sp, equations, self.variables, names, closure=False)
+            from .subsystems import map_groups
+            with self.build_phases.scope("host_assembly"):
+                results = map_groups(
+                    lambda sp: assemble_group_coos(
+                        sp, equations, self.variables, names, closure=False),
+                    self.subproblems)
+            for coos, row_valid, col_valid in results:
                 coo_store.append(coos)
                 masks.append((row_valid, col_valid))
                 scale = max(scale, max((np.abs(v).max() if len(v) else 0.0
@@ -239,46 +303,51 @@ class SolverBase:
         # separates the two cleanly in both precisions.
         eps_p = np.finfo(self.real_dtype).eps
         row_frac = max(tol, 10.0 * eps_p)
-        for coos, (row_valid, col_valid) in zip(coo_store, masks):
-            rowmax = np.zeros(S)
-            for r, c, v in coos.values():
-                if len(r):
-                    np.maximum.at(rowmax, r, np.abs(v))
-            pat = {}
-            for k, (r, c, v) in coos.items():
-                # row-significant AND above the global assembly-dirt floor
-                # (dirt-only rows would otherwise self-certify)
-                keep = (np.abs(v) >= row_frac * rowmax[r]) \
-                    & (np.abs(v) > tol_abs)
-                pat[k] = (r[keep], c[keep], v[keep])
-            acc.add_group(pat, row_valid, col_valid)
-        structure = MatrixStructure(self.layout, self.variables, equations)
-        row_valid_all = np.array([m[0] for m in masks])
-        col_valid_all = np.array([m[1] for m in masks])
-        spec = self.matsolver if isinstance(self.matsolver, str) else ""
-        structure.finalize(acc.union, acc.qualified(), row_valid_all,
-                           col_valid_all, vmax=acc.vmax,
-                           allow_uneconomic=(spec.lower() == "banded"))
-        if not structure.ok:
-            self._banded_reason = structure.reason
-            return (coo_store, masks)
-        # validity closure aligned with the matching (passed separately to
-        # build_banded_arrays so the shared COO pattern stays shared and
-        # the scatter can vectorize over the whole group batch)
-        closures = []
-        for coos, (row_valid, col_valid) in zip(coo_store, masks):
-            closure = compute_group_closure(structure, row_valid, col_valid)
-            if closure is None:
-                self._banded_reason = "validity closure misaligned with matching"
+        with self.build_phases.scope("structure"):
+            for coos, (row_valid, col_valid) in zip(coo_store, masks):
+                rowmax = np.zeros(S)
+                for r, c, v in coos.values():
+                    if len(r):
+                        np.maximum.at(rowmax, r, np.abs(v))
+                pat = {}
+                for k, (r, c, v) in coos.items():
+                    # row-significant AND above the global assembly-dirt
+                    # floor (dirt-only rows would otherwise self-certify)
+                    keep = (np.abs(v) >= row_frac * rowmax[r]) \
+                        & (np.abs(v) > tol_abs)
+                    pat[k] = (r[keep], c[keep], v[keep])
+                acc.add_group(pat, row_valid, col_valid)
+            structure = MatrixStructure(self.layout, self.variables,
+                                        equations)
+            row_valid_all = np.array([m[0] for m in masks])
+            col_valid_all = np.array([m[1] for m in masks])
+            spec = self.matsolver if isinstance(self.matsolver, str) else ""
+            structure.finalize(acc.union, acc.qualified(), row_valid_all,
+                               col_valid_all, vmax=acc.vmax,
+                               allow_uneconomic=(spec.lower() == "banded"))
+            if not structure.ok:
+                self._banded_reason = structure.reason
                 return (coo_store, masks)
-            closures.append(closure)
+            # validity closure aligned with the matching (passed separately
+            # to build_banded_arrays so the shared COO pattern stays shared
+            # and the scatter can vectorize over the whole group batch)
+            closures = []
+            for coos, (row_valid, col_valid) in zip(coo_store, masks):
+                closure = compute_group_closure(structure, row_valid,
+                                                col_valid)
+                if closure is None:
+                    self._banded_reason = \
+                        "validity closure misaligned with matching"
+                    return (coo_store, masks)
+                closures.append(closure)
         host_dtype = (np.complex128 if is_complex_dtype(self.pencil_dtype)
                       else np.float64)
         try:
-            self._matrices = build_banded_arrays(
-                coo_store, structure, names, host_dtype,
-                drop_tol=max(tol_abs, row_frac * (scale or 1.0)),
-                closures=closures)
+            with self.build_phases.scope("host_assembly"):
+                self._matrices = build_banded_arrays(
+                    coo_store, structure, names, host_dtype,
+                    drop_tol=max(tol_abs, row_frac * (scale or 1.0)),
+                    closures=closures)
         except ValueError as exc:
             self._banded_reason = str(exc)
             return (coo_store, masks)
@@ -342,9 +411,24 @@ class SolverBase:
     # ---------------------------------------------------------------- fields
 
     def gather_fields(self, fields=None):
+        """One jitted program per field set (memoized): eager per-op
+        dispatch of the reshape/transpose chain costs ~0.5 s of every cold
+        start, while a single traced program is one dispatch AND lands in
+        the persistent XLA cache for the next process."""
         fields = fields or self.variables
         arrays = {state_key(v): v.coeff_data() for v in fields}
-        return gather_state(self.layout, fields, arrays)
+        key = tuple(state_key(v) for v in fields)
+        programs = self.__dict__.setdefault("_gather_programs", {})
+        fn = programs.get(key)
+        if fn is None:
+            from ..tools.jitlift import lifted_jit
+            layout = self.layout
+            fields = list(fields)
+            # memoized in _gather_programs just above (cache-subscript
+            # guard the static pass cannot see)
+            fn = programs[key] = lifted_jit(  # dedalus-lint: disable=DTL003
+                lambda arrs: gather_state(layout, fields, arrs))
+        return fn(arrays)
 
     def scatter_fields(self, X, fields=None):
         """Eager scatter: counts as a mutation so a co-resident IVP solver's
@@ -492,8 +576,11 @@ class InitialValueSolver(SolverBase):
                  health_cadence=None, postmortem_dir=None, **kw):
         init_t0 = time_mod.time()
         super().__init__(problem, matsolver=matsolver, **kw)
-        self.M_mat = self.ops.to_device(self._matrices["M"], self.pencil_dtype)
-        self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
+        with self.build_phases.scope("factor"):
+            self.M_mat = self.ops.to_device(self._matrices["M"],
+                                            self.pencil_dtype)
+            self.L_mat = self.ops.to_device(self._matrices["L"],
+                                            self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F", time_field=problem.time)
         # timestepping state
         self.sim_time = 0.0
@@ -743,8 +830,16 @@ class InitialValueSolver(SolverBase):
         if self.enforce_real_cadence:
             if self.iteration % self.enforce_real_cadence < self.timestepper.steps:
                 self.enforce_hermitian_symmetry()
+        first = "compile" not in self.build_phases.seconds
+        t_first = time_mod.perf_counter() if first else None
         with metrics_mod.annotate("dedalus/step"):
             self.timestepper.step(dt)
+        if first:
+            # trace + lower + XLA compile of the step program dominates the
+            # first dispatch; recorded as the cold-start `compile` phase
+            jax.block_until_ready(self.X)
+            self.build_phases.add(
+                "compile", time_mod.perf_counter() - t_first)
         self.defer_scatter(self.X)
         self.snapshot_versions()
         self.problem.sim_time = self.sim_time
@@ -789,8 +884,14 @@ class InitialValueSolver(SolverBase):
             if (n >= cadence or r < self.timestepper.steps
                     or (cadence - r) < n):
                 self.enforce_hermitian_symmetry()
+        first = "compile" not in self.build_phases.seconds
+        t_first = time_mod.perf_counter() if first else None
         with metrics_mod.annotate("dedalus/step_many"):
             self.timestepper.step_many(n, dt)
+        if first:
+            jax.block_until_ready(self.X)
+            self.build_phases.add(
+                "compile", time_mod.perf_counter() - t_first)
         self.defer_scatter(self.X)
         self.snapshot_versions()
         self.problem.sim_time = self.sim_time
@@ -896,6 +997,9 @@ class InitialValueSolver(SolverBase):
         # perf trajectory shows compile-hygiene regressions in place
         extra.setdefault("retraces_post_warmup",
                          retrace_mod.sentinel.post_arm_retraces)
+        # cold-start phase split (host_assembly/structure/factor/compile
+        # seconds + assembly-cache verdict)
+        extra.setdefault("build_phases", self.build_phases.record())
         return self.metrics.flush(extra=extra)
 
     def evolve_resilient(self, timestep_function=None, dt=None,
@@ -1075,6 +1179,13 @@ class InitialValueSolver(SolverBase):
         logger.info(f"Final iteration: {self.iteration}")
         logger.info(f"Final sim time: {self.sim_time}")
         logger.info(f"Setup time (init - iter 0): {self.start_time - self.init_time:{format}} sec")
+        bp = self.build_phases.record()
+        logger.info(
+            f"Build phases: host_assembly {bp['host_assembly_sec']:{format}}"
+            f" s, structure {bp['structure_sec']:{format}} s, factor "
+            f"{bp['factor_sec']:{format}} s, compile "
+            f"{bp['compile_sec']:{format}} s "
+            f"(assembly cache: {bp['assembly_cache']})")
         phases = {"setup": self._setup_time,
                   "total": total}
         if self.iteration > self.warmup_iterations and self.warmup_time:
@@ -1121,9 +1232,14 @@ class LinearBoundaryValueSolver(SolverBase):
 
     def __init__(self, problem, matsolver=None, **kw):
         super().__init__(problem, matsolver=matsolver, **kw)
-        self.L_mat = self.ops.to_device(self._matrices["L"], self.pencil_dtype)
+        with self.build_phases.scope("factor"):
+            self.L_mat = self.ops.to_device(self._matrices["L"],
+                                            self.pencil_dtype)
+            self._aux = self.ops.factor(self.L_mat)
+        # RHS-evaluator construction is expression compilation, not
+        # factorization: outside the factor scope so factor_sec stays
+        # comparable across solver types (IVP builds eval_F unscoped too)
         self.eval_F = self.build_rhs_evaluator("F")
-        self._aux = self.ops.factor(self.L_mat)
         from ..tools.jitlift import lifted_jit, device_constant
         mask_np, rd = self.valid_row_mask, self.real_dtype
         eval_F, ops = self.eval_F, self.ops
@@ -1149,6 +1265,9 @@ class NonlinearBoundaryValueSolver(SolverBase):
     """Newton-Kantorovich NLBVP solver (reference: core/solvers.py:418)."""
 
     matrices = ("L",)
+    # Jacobians rebuild around the moving state every Newton iteration;
+    # persisting each one would churn the on-disk cache for zero reuse.
+    cache_ok = False
 
     def __init__(self, problem, matsolver=None, **kw):
         # Matrices are in terms of the perturbation variables.
@@ -1278,6 +1397,11 @@ class EigenvalueSolver(SolverBase):
         reassembles M/L around the current NCC field data (parameter
         continuation, e.g. the Mathieu example's q sweep)."""
         if rebuild_matrices:
+            # parameter-continuation rebuilds change the NCC data every
+            # call: each would hash to a never-reloaded fresh cache key,
+            # churning the persistent store and LRU-evicting useful
+            # entries — so rebuilds opt out (same rationale as NLBVP)
+            self.cache_ok = False
             if self._lazy:
                 self._lazy_cache = None
             else:
@@ -1310,6 +1434,9 @@ class EigenvalueSolver(SolverBase):
         (reference: core/solvers.py:225 solve_sparse)."""
         from ..tools.array import scipy_sparse_eigs
         if rebuild_matrices:
+            # see solve_dense: continuation rebuilds must not churn the
+            # persistent assembly cache
+            self.cache_ok = False
             if self._lazy:
                 self._lazy_cache = None
             else:

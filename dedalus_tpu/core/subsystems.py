@@ -570,6 +570,43 @@ def assemble_group_coos(subproblem, equations, variables, names, closure=True):
     return out, row_valid, col_valid
 
 
+def assembly_workers(n_groups):
+    """Worker-thread count for per-group assembly ([caching]
+    ASSEMBLY_WORKERS: 0/off = serial, 'auto' = up to one thread per core,
+    integer = explicit). Returns 0 when pooling is off or pointless."""
+    from ..tools.config import config
+    if not config.has_section("caching"):
+        return 0
+    spec = config["caching"].get("ASSEMBLY_WORKERS", "0").strip().lower()
+    if spec in ("", "0", "off", "none", "false"):
+        return 0
+    import os
+    workers = (os.cpu_count() or 1) if spec == "auto" else int(spec)
+    workers = min(workers, n_groups)
+    return workers if workers > 1 else 0
+
+
+def map_groups(fn, subproblems):
+    """
+    `[fn(sp) for sp in subproblems]`, fanned over a thread pool when
+    [caching] ASSEMBLY_WORKERS asks for one. The FIRST group always runs
+    serially: it warms the per-basis/operator memoization caches
+    (CachedMethod) and performs any NCC scale-change roundtrips, so the
+    pooled remainder runs on read-mostly state. scipy/numpy kernels drop
+    the GIL, which is where the per-group time goes.
+    """
+    if not subproblems:
+        return []
+    workers = assembly_workers(len(subproblems) - 1)
+    if not workers:
+        return [fn(sp) for sp in subproblems]
+    import concurrent.futures
+    first = fn(subproblems[0])
+    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+        rest = list(pool.map(fn, subproblems[1:]))
+    return [first] + rest
+
+
 def build_matrices(subproblems, equations, variables, names=("M", "L")):
     """
     Assemble the batched dense pencil matrices for all subproblems.
@@ -584,8 +621,10 @@ def build_matrices(subproblems, equations, variables, names=("M", "L")):
     dtype = np.complex128 if complex_problem else np.float64
     G = len(subproblems)
     out = {name: np.zeros((G, S, S), dtype=dtype) for name in names}
-    for sp_i, subproblem in enumerate(subproblems):
-        coos, _, _ = assemble_group_coos(subproblem, equations, variables, names)
+    all_coos = map_groups(
+        lambda sp: assemble_group_coos(sp, equations, variables, names)[0],
+        subproblems)
+    for sp_i, coos in enumerate(all_coos):
         for name in names:
             rows, cols, vals = coos[name]
             out[name][sp_i][rows, cols] = vals
@@ -613,6 +652,21 @@ class MatrixStructure:
     Schur complement would create (the pinned matrix's condition number
     matches the full tau system's).
     """
+
+    @classmethod
+    def from_state(cls, state, layout=None):
+        """Rehydrate a finalized structure from its persisted scalar/array
+        state (tools/assembly_cache.py): everything BandedOps and the
+        solve path consume (permutations, pin data, band geometry) without
+        re-running the symbolic analysis."""
+        st = cls.__new__(cls)
+        st.layout = layout
+        st.ok = True
+        st.reason = None
+        for key, val in state.items():
+            setattr(st, key, np.asarray(val) if isinstance(
+                val, np.ndarray) else val)
+        return st
 
     def __init__(self, layout, variables, equations):
         self.layout = layout

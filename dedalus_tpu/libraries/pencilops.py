@@ -122,6 +122,19 @@ class BandedOps:
 
     def __init__(self, structure, refine=1):
         st = structure
+        # Structures arrive either freshly finalized or rehydrated from
+        # the persistent assembly cache (MatrixStructure.from_state);
+        # validate the contract HERE so a drifted/hand-edited cache
+        # payload fails with a clear message instead of an AttributeError
+        # deep inside a factorization scan.
+        missing = [attr for attr in
+                   ("S", "NB", "q", "t_pins", "kl", "ku", "row_perm",
+                    "col_perm", "pinned_positions")
+                   if getattr(st, attr, None) is None]
+        if missing:
+            raise ValueError(
+                f"BandedOps: structure is missing {missing} (corrupt or "
+                f"stale assembly-cache payload?)")
         self.st = st
         self.refine = int(refine)
         # pencil-batch chunking (lax.map over G-chunks): bounds the

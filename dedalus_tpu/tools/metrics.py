@@ -38,13 +38,19 @@ import jax
 
 from .config import config
 
-__all__ = ["PHASES", "CadenceGate", "Counter", "PhaseTimer",
-           "MemoryWatermark", "Metrics", "trace_scope", "annotate", "scoped",
-           "resolve", "format_phase_table", "register_exit_flush",
-           "flush_pending"]
+__all__ = ["PHASES", "BUILD_PHASES", "CadenceGate", "Counter", "PhaseTimer",
+           "MemoryWatermark", "Metrics", "BuildPhases", "trace_scope",
+           "annotate", "scoped", "resolve", "format_phase_table",
+           "register_exit_flush", "flush_pending"]
 
 # The hot-path phase vocabulary (shared with trace annotations).
 PHASES = ("transform", "matsolve", "transpose", "evaluator")
+
+# The cold-start (build) phase vocabulary: host-side symbolic assembly,
+# banded structural analysis, device transfer + factorization, and the
+# first-dispatch trace/compile. Labels double as `dedalus/build/...`
+# trace annotations so profiler rows and telemetry share one vocabulary.
+BUILD_PHASES = ("host_assembly", "structure", "factor", "compile")
 
 
 def trace_scope(phase, detail=None):
@@ -97,6 +103,51 @@ class CadenceGate:
             self._next_due = iterations + self.cadence
             return True
         return False
+
+
+class BuildPhases:
+    """
+    Wall-clock accounting of the solver BUILD (cold-start) phases, the
+    setup-side sibling of the step-loop PhaseTimer: `scope(name)` brackets
+    one phase (accumulating across re-entries, e.g. Newton rebuilds) and
+    annotates the region `dedalus/build/<name>` for profiler traces.
+    `record()` flattens to the `<name>_sec` keys telemetry records and
+    bench rows carry (`host_assembly_sec`, `structure_sec`, `factor_sec`,
+    `compile_sec`), plus the assembly-cache verdict.
+    """
+
+    def __init__(self):
+        self.seconds = {}
+        self.cache = "off"   # off | miss | hit
+
+    class _Scope:
+        def __init__(self, phases, name):
+            self.phases = phases
+            self.name = name
+
+        def __enter__(self):
+            self.ann = annotate(f"dedalus/build/{self.name}")
+            self.ann.__enter__()
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            dt = time.perf_counter() - self.t0
+            sec = self.phases.seconds
+            sec[self.name] = sec.get(self.name, 0.0) + dt
+            return self.ann.__exit__(*exc)
+
+    def scope(self, name):
+        return self._Scope(self, name)
+
+    def add(self, name, seconds):
+        self.seconds[name] = self.seconds.get(name, 0.0) + float(seconds)
+
+    def record(self):
+        out = {f"{name}_sec": round(self.seconds.get(name, 0.0), 4)
+               for name in BUILD_PHASES}
+        out["assembly_cache"] = self.cache
+        return out
 
 
 class Counter:

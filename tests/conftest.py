@@ -14,6 +14,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Hermetic assembly cache: the persistent matrix cache stays EXERCISED
+# (its own tests depend on it; ambient solver builds hit/store too) but
+# against a per-session temporary directory, so a stale ~/.cache entry
+# written by a different checkout can never leak into test results.
+# An explicit DEDALUS_TPU_ASSEMBLY_CACHE (e.g. the cross-process reuse
+# test's subprocess env) still wins.
+if "DEDALUS_TPU_ASSEMBLY_CACHE" not in os.environ:
+    import atexit
+    import shutil
+    import tempfile
+
+    _assembly_cache_tmp = tempfile.mkdtemp(
+        prefix="dedalus_test_assembly_cache_")
+    os.environ["DEDALUS_TPU_ASSEMBLY_CACHE"] = _assembly_cache_tmp
+    atexit.register(shutil.rmtree, _assembly_cache_tmp, ignore_errors=True)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
