@@ -1,0 +1,234 @@
+"""
+Ensemble benchmark: fleet (vmapped + mesh-sharded) stepping vs N x serial.
+
+Measures what core/ensemble.EnsembleSolver actually buys on the virtual
+CPU mesh: member-steps per second for a fleet of N independent IVPs
+advanced as ONE compiled, scanned program, against the strongest honest
+serial baseline — a single already-built, already-compiled solver driven
+through the same `step_many` scanned blocks (so the baseline amortizes
+its own Python loop; the fleet win is batching, not a strawman).
+
+Two problems:
+
+  diffusion64_ensemble   1-D forced heat equation (64 modes) — the
+                         dispatch-bound regime where per-member overhead
+                         dominates; the acceptance bar (>= 4x at N=64)
+                         is checked here.
+  rb256x64_ensemble      the 2-D Rayleigh-Benard flagship (RK222) — the
+                         compute-bound regime; the sweep records where
+                         batching stops paying on 2 host cores.
+
+For each N in the sweep the row records a per-phase breakdown:
+  build_sec    template solver build (paid ONCE per fleet)
+  init_sec     per-member IC/parameter installation + device_put
+  compile_sec  first fleet dispatch (trace + XLA compile)
+  loop_sec     measured stepping window (post-warmup)
+plus ensemble_steps_per_sec, the serial baseline, and the speedup.
+
+Appends one row per problem to benchmarks/results.jsonl and exits
+nonzero when the diffusion N=64 speedup misses the 4x acceptance bar.
+
+Run: python benchmarks/ensemble.py [--quick]
+  --quick   trims the sweep to {1, 8} and shortens windows (CI smoke).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The virtual member mesh must exist before jax initializes (conftest.py
+# does the same for the test suite); only forced when the backend is CPU
+# and the caller has not already configured a device count.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[ensemble {time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def build_diffusion(size=64):
+    """1-D forced heat IVP with a per-member parameter field `a` (an RHS
+    extra operand, so the sweep exercises batched NCC/parameter data,
+    not just batched ICs)."""
+    import dedalus_tpu.public as d3
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=size, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    a = dist.Field(name="a", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "a": a, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = a*u")
+    solver = problem.build_solver(d3.SBDF2, warmup_iterations=2,
+                                  enforce_real_cadence=0)
+    x = dist.local_grid(xb)
+
+    def member_init(i):
+        u["g"] = np.sin((1 + i % 4) * x)
+        a["g"] = 0.1 * (1 + i % 7) * np.cos(x)
+
+    return solver, member_init
+
+
+def build_rb():
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, b = build_rb_solver(256, 64, np.float64)
+    solver.warmup_iterations = 2
+
+    def member_init(i):
+        b.fill_random("g", seed=100 + i, distribution="normal", scale=1e-3)
+        b["g"] += (1.0 - b.dist.local_grids(*b.domain.bases)[1])
+
+    return solver, member_init
+
+
+def measure_serial(builder, dt, block, blocks):
+    """Post-warmup steps/s of ONE solver through scanned `step_many`
+    blocks — the per-member rate a user pays running the fleet serially
+    (x N for the fleet-equivalent wall time)."""
+    import jax
+    t0 = time.perf_counter()
+    solver, member_init = builder()
+    build_sec = time.perf_counter() - t0
+    member_init(0)
+    t0 = time.perf_counter()
+    solver.step_many(block, dt)           # trace + compile
+    jax.block_until_ready(solver.X)
+    compile_sec = time.perf_counter() - t0
+    solver.step_many(block, dt)           # warm
+    jax.block_until_ready(solver.X)
+    t0 = time.perf_counter()
+    for _ in range(blocks):
+        solver.step_many(block, dt)
+    jax.block_until_ready(solver.X)
+    loop_sec = time.perf_counter() - t0
+    steps = block * blocks
+    return {
+        "build_sec": round(build_sec, 4),
+        "compile_sec": round(compile_sec, 4),
+        "loop_sec": round(loop_sec, 4),
+        "steps": steps,
+        "steps_per_sec": round(steps / loop_sec, 2),
+        "finite": bool(np.isfinite(np.asarray(solver.X)).all()),
+    }
+
+
+def measure_fleet(builder, N, dt, block, blocks, warm=True):
+    """Post-warmup ensemble-steps/s (member-steps per wall second) of an
+    N-member fleet on the auto mesh, with the per-phase breakdown.
+    `warm=False` skips the extra post-compile warm block (the
+    compute-bound RB fleet, where one block is minutes of wall time and
+    the compile dispatch already warmed the program)."""
+    import jax
+    t0 = time.perf_counter()
+    solver, member_init = builder()
+    build_sec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ens = solver.ensemble(N, mesh="auto")
+    ens.init_members(member_init)
+    init_sec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ens.step_many(block, dt)              # trace + compile
+    jax.block_until_ready(ens.X)
+    compile_sec = time.perf_counter() - t0
+    if warm:
+        ens.step_many(block, dt)
+        jax.block_until_ready(ens.X)
+    t0 = time.perf_counter()
+    for _ in range(blocks):
+        ens.step_many(block)
+    jax.block_until_ready(ens.X)
+    loop_sec = time.perf_counter() - t0
+    member_steps = N * block * blocks
+    return {
+        "members": N,
+        "devices": ens.mesh.shape["batch"] if ens.mesh is not None else 1,
+        "build_sec": round(build_sec, 4),
+        "init_sec": round(init_sec, 4),
+        "compile_sec": round(compile_sec, 4),
+        "loop_sec": round(loop_sec, 4),
+        "member_steps": member_steps,
+        "ensemble_steps_per_sec": round(member_steps / loop_sec, 2),
+        "finite": bool(np.isfinite(np.asarray(ens.X)).all()),
+    }
+
+
+def run_problem(config, builder, dt, block, blocks, sweep, append,
+                warm=True):
+    mark(f"{config}: serial baseline ({block}-step blocks x {blocks})")
+    serial = measure_serial(builder, dt, block, blocks)
+    mark(f"{config}: serial {serial['steps_per_sec']} steps/s")
+    row = {
+        "config": config,
+        "backend": os.environ.get("JAX_PLATFORMS", "cpu").split(",")[0],
+        "dt": dt,
+        "block": block,
+        "blocks": blocks,
+        "serial": serial,
+        "sweep": [],
+    }
+    for N in sweep:
+        fleet = measure_fleet(builder, N, dt, block, blocks, warm=warm)
+        fleet["speedup_vs_serial"] = round(
+            fleet["ensemble_steps_per_sec"] / serial["steps_per_sec"], 2)
+        # setup amortization: one build+compile for the fleet vs N of them
+        serial_setup = N * (serial["build_sec"] + serial["compile_sec"])
+        fleet_setup = (fleet["build_sec"] + fleet["init_sec"]
+                       + fleet["compile_sec"])
+        fleet["setup_amortization"] = round(serial_setup / fleet_setup, 2) \
+            if fleet_setup else None
+        row["sweep"].append(fleet)
+        mark(f"{config}: N={N} -> {fleet['ensemble_steps_per_sec']} "
+             f"member-steps/s ({fleet['speedup_vs_serial']}x serial, "
+             f"compile {fleet['compile_sec']}s)")
+    n64 = next((f for f in row["sweep"] if f["members"] == 64), None)
+    if n64 is not None:
+        row["speedup_n64"] = n64["speedup_vs_serial"]
+        row["meets_4x_n64"] = n64["speedup_vs_serial"] >= 4.0
+    append(row)
+    return row
+
+
+def main():
+    quick = "--quick" in sys.argv
+    from __graft_entry__ import _append_result
+    if quick:
+        # smoke mode: no N=64 point, so nothing is appended to the
+        # machine record (a quick row would shadow the full sweep in
+        # bench.py's _attach_ensemble)
+        _append_result = lambda record: None  # noqa: E731
+    sweep = [1, 8] if quick else [1, 8, 64, 256]
+    rows = [run_problem(
+        "diffusion64_ensemble", build_diffusion, 1e-3,
+        block=8 if quick else 32, blocks=2 if quick else 16,
+        sweep=sweep, append=_append_result)]
+    # RB: compute-bound on the host cores (a member-step is seconds of
+    # wall time), so single-step blocks, a one-block measured window, and
+    # no extra warm block; the sweep is still the full N list — nothing
+    # silently dropped, the row just records a short window
+    rows.append(run_problem(
+        "rb256x64_ensemble", build_rb, 0.01,
+        block=1, blocks=1, sweep=[1, 8] if quick else sweep,
+        append=_append_result, warm=False))
+    diffusion = rows[0]
+    ok = quick or (diffusion.get("speedup_n64") or 0) >= 4.0
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    if not ok:
+        mark("FAIL: diffusion N=64 ensemble-steps/s is not >= 4x serial")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -144,6 +144,22 @@ def report():
                 print(f"    health: {status}, "
                       f"{health.get('checks', 0)} checks, "
                       f"{health.get('warnings', 0)} warnings")
+            ensemble = record.get("ensemble")
+            if isinstance(ensemble, dict):
+                parts = [f"{ensemble.get('members', '?')} members",
+                         f"{ensemble.get('active', '?')} active",
+                         f"{ensemble.get('dropped', 0)} dropped"]
+                if ensemble.get("rewinds"):
+                    parts.append(f"{ensemble['rewinds']} rewinds")
+                parts.append(
+                    f"{ensemble.get('ensemble_steps_per_sec', 0.0)} "
+                    f"member-steps/s")
+                if ensemble.get("devices"):
+                    parts.append(f"{ensemble['devices']} device(s)")
+                print(f"    ensemble: {', '.join(parts)}")
+                if ensemble.get("dropped_members"):
+                    print(f"    dropped members: "
+                          f"{ensemble['dropped_members']}")
             resilience = record.get("resilience")
             if isinstance(resilience, dict):
                 parts = [f"{resilience.get('rewinds', 0)} rewinds",
@@ -177,6 +193,22 @@ def report():
             extra = f" = {val} {unit}".rstrip() if val is not None else ""
             stale = " [stale]" if record.get("stale") else ""
             print(f"(other) {ident}{extra}{stale}")
+            # ensemble benchmark rows (benchmarks/ensemble.py): one line
+            # per sweep point so speedups read without opening the JSONL
+            sweep = record.get("sweep")
+            if isinstance(sweep, list) and sweep \
+                    and isinstance(sweep[0], dict) \
+                    and "ensemble_steps_per_sec" in sweep[0]:
+                serial = record.get("serial") or {}
+                if serial.get("steps_per_sec") is not None:
+                    print(f"    serial baseline: "
+                          f"{serial['steps_per_sec']} steps/s")
+                for point in sweep:
+                    print(f"    N={point.get('members', '?')}: "
+                          f"{point.get('ensemble_steps_per_sec', '?')} "
+                          f"member-steps/s "
+                          f"({point.get('speedup_vs_serial', '?')}x serial,"
+                          f" {point.get('devices', '?')} device(s))")
     print(f"{n_metrics} metrics record(s), {n_other} other, "
           f"{n_post} postmortem, {n_bad} unparsable")
     if n_metrics == 0 and n_other == 0 and n_post == 0:

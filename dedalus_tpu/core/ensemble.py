@@ -1,0 +1,813 @@
+"""
+EnsembleSolver: one compiled step, thousands of simulations.
+
+The production workload for a spectral-PDE service is rarely one big run —
+it is parameter sweeps, uncertainty ensembles, and per-request scenarios:
+thousands of *independent* IVPs that, stepped serially, each pay their own
+dispatch and Python loop overhead. This module turns the repo's unit of
+work from "a run" into "a fleet": it takes ONE built
+`InitialValueSolver` (whose pencil matrices are already batched over
+groups) and vmaps the timestepper's raw step body over a second, leading
+**member** axis, then shards that axis over a 1-D
+`jax.sharding.Mesh(("batch",))` so N members on D devices advance as one
+XLA program — no per-member dispatch, no per-member compile, and (with a
+common dt) ONE shared LHS factorization serving the whole fleet.
+
+Batched operands per member:
+  * initial conditions         — the gathered pencil state X, (N, G, S)
+  * RHS parameters / NCC data  — every non-variable field feeding F
+                                 (forcings, parameter fields) becomes a
+                                 batched operand of the compiled step
+  * simulation time            — (N,) device clock (members drift apart
+                                 after drops/rewinds)
+  * dt                         — (N,) operand; heterogeneous values need
+                                 `per_member_dt=True` (RK schemes), which
+                                 vmaps the LHS factorization too
+
+Shared operands: the pencil matrices M/L, the (common-dt) factorization,
+and the multistep coefficient vectors — replicated over the mesh.
+
+Sharding layout (the SNIPPETS `get_naive_sharding` pattern): every
+member-batched array leads with the member axis and is placed by ONE
+`device_put` with `NamedSharding(mesh, P("batch"))`; the fleet step runs
+inside `shard_map` over that axis (each device steps only its local
+member block — XLA cannot partition fft/LU ops, so plain GSPMD would
+all-gather; see core/meshctx.py and libraries/pencilops.shard_groups for
+the same discipline on the group axis).
+
+Per-member health: a jitted per-member probe (NaN/Inf count + max|coeff|)
+runs on the PR-2 cadence machinery; a diverged member is restored from
+its slot in the rolling fleet-snapshot ring (PR-4's capture-by-reference
+trick — device arrays are immutable, so snapshots are O(1) and sync-free)
+and either **dropped** (frozen + masked out, the default) or **rewound**
+with a per-member dt backoff (`policy="rewind"`, RK + per_member_dt) —
+without stopping the batch, and without retracing the compiled step (the
+active mask is a value operand, not a shape).
+
+Telemetry: `ensemble/...` counters (fleet_steps, member_steps, dropped,
+rewinds, health_checks) plus an `ensemble` summary block (members /
+active / dropped / ensemble-steps-per-s) in every flushed record —
+`python -m dedalus_tpu report` renders it as its own column set.
+"""
+
+import functools
+import logging
+import time as time_mod
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .subsystems import scatter_state, state_key
+from . import timesteppers as timesteppers_mod
+from ..tools import metrics as metrics_mod
+from ..tools import retrace as retrace_mod
+from ..tools.compat import shard_map
+from ..tools.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EnsembleSolver", "FleetSnapshot"]
+
+MEMBER_AXIS = "batch"
+
+
+class FleetSnapshot:
+    """One last-known-good capture of the whole fleet. Device arrays are
+    held by REFERENCE (immutable), so capture is O(1) and never syncs;
+    each member's slice doubles as that member's snapshot slot on the
+    recovery path (restores are per-member `where` masks)."""
+
+    __slots__ = ("X", "T", "hists", "iteration", "sim_times",
+                 "wall_ts", "_finite")
+
+    def __init__(self, X, T, hists, iteration, sim_times):
+        self.X = X
+        self.T = T
+        self.hists = hists          # (F, MX, LX) or None for RK
+        self.iteration = int(iteration)
+        self.sim_times = np.array(sim_times)
+        self.wall_ts = time_mod.time()
+        self._finite = None
+
+    def member_finite(self, m):
+        """Whether member m's captured state is fully finite. Host-syncs
+        the fleet state ONCE per snapshot on first call — recovery path
+        only, never the stepping loop."""
+        if self._finite is None:
+            flat = np.asarray(self.X).reshape(self.X.shape[0], -1)
+            self._finite = np.all(np.isfinite(flat), axis=1)
+        return bool(self._finite[m])
+
+
+class EnsembleSolver:
+    """
+    Fleet driver over one built `InitialValueSolver` template.
+
+    Parameters
+    ----------
+    solver : InitialValueSolver
+        The built template (undistributed, native-precision step path).
+        Its state at construction seeds every member's default IC.
+    members : int
+        Number of ensemble members N.
+    mesh : "auto" | None | jax.sharding.Mesh
+        "auto" builds a 1-D Mesh(("batch",)) over all local devices when
+        more than one is visible (the member count is padded up to a
+        multiple of the device count with inactive clones); None disables
+        sharding; an explicit 1-D mesh is used as given.
+    per_member_dt : bool
+        Carry dt as a genuinely heterogeneous (N,) operand, vmapping the
+        LHS factorization per member (RK schemes only — multistep
+        coefficient ramps are fleet-global). Required for
+        policy="rewind"'s per-member dt backoff. Chosen at construction
+        so the compiled program never switches variants mid-run (which
+        would retrace).
+    policy : "drop" | "rewind"
+        What to do with a diverged member: freeze it at its newest
+        finite snapshot slot and mask it out ("drop"), or restore it and
+        retry with its dt scaled by `dt_backoff`, dropping after
+        `max_member_retries` failed retries ("rewind").
+    health_cadence, snapshot_cadence, ring_size, dt_backoff,
+    max_member_retries :
+        Recovery knobs; defaults from the [health]/[resilience] config
+        sections.
+    metrics, metrics_file :
+        Fleet telemetry (tools/metrics.py); `metrics.iterations` counts
+        MEMBER-steps, so the flushed `steps_per_sec` IS
+        ensemble-steps-per-second.
+    """
+
+    def __init__(self, solver, members, mesh="auto", per_member_dt=False,
+                 policy="drop", health_cadence=None, snapshot_cadence=None,
+                 ring_size=None, dt_backoff=None, max_member_retries=None,
+                 warmup_iterations=None, metrics=None, metrics_file=None):
+        if getattr(solver, "_dd", None) is not None:
+            raise ValueError(
+                "EnsembleSolver requires the native step path; the template "
+                "uses the emulated-f64 (double-double) runner. Build it "
+                "with [execution] EMULATED_F64 = never.")
+        if getattr(solver.dist, "mesh", None) is not None:
+            raise ValueError(
+                "EnsembleSolver shards the MEMBER axis; the template must "
+                "be undistributed (no spatial mesh on the Distributor).")
+        ts = solver.timestepper
+        self._multistep = isinstance(ts, timesteppers_mod.MultistepIMEX)
+        if not self._multistep and not isinstance(
+                ts, timesteppers_mod.RungeKuttaIMEX):
+            raise ValueError(f"Unsupported timestepper {type(ts).__name__}")
+        if per_member_dt and self._multistep:
+            raise ValueError(
+                "per_member_dt requires a Runge-Kutta scheme (multistep "
+                "coefficient ramps are fleet-global); use e.g. RK222.")
+        if policy not in ("drop", "rewind"):
+            raise ValueError(f"policy must be 'drop' or 'rewind', "
+                             f"got {policy!r}")
+        if policy == "rewind" and not per_member_dt:
+            raise ValueError(
+                "policy='rewind' retries with a per-member dt backoff; "
+                "pass per_member_dt=True (RK schemes).")
+        self.solver = solver
+        self.timestepper = ts
+        self.members = int(members)
+        self.per_member_dt = bool(per_member_dt)
+        self.policy = policy
+        self.rd = solver.real_dtype
+        self.mesh = self._resolve_mesh(mesh)
+        D = self.mesh.shape[MEMBER_AXIS] if self.mesh is not None else 1
+        self.n_pad = -(-self.members // D) * D
+        # ---------------------------------------------------- fleet state
+        G, S = solver.pencil_shape
+        X0 = solver.gather_fields()
+        self.X = self._put(jnp.broadcast_to(X0, (self.n_pad, G, S)))
+        self.sim_times = np.full(self.n_pad, float(solver.sim_time))
+        self.T = self._put(jnp.asarray(self.sim_times, dtype=self.rd))
+        self.dts = np.zeros(self.n_pad)
+        self.DT = self._put(jnp.zeros(self.n_pad, dtype=self.rd))
+        self.active_host = np.zeros(self.n_pad, dtype=bool)
+        self.active_host[:self.members] = True
+        self._active_dev = self._put(jnp.asarray(self.active_host))
+        if self._multistep:
+            s = ts.steps
+            zeros = jnp.zeros((self.n_pad, s, G, S),
+                              dtype=solver.pencil_dtype)
+            self.F_hist = self._put(zeros)
+            self.MX_hist = self._put(zeros)
+            self.LX_hist = self._put(zeros)
+            self._ms_iter = 0
+            self._dt_hist = []
+        # per-member RHS operands: every extra field batched (N, ...)
+        self._extras = [self._put(jnp.broadcast_to(
+            arr, (self.n_pad,) + arr.shape))
+            for arr in solver.rhs_extra()]
+        # ------------------------------------------------------- programs
+        self._programs = {}
+        self._project_prog = None
+        self._probe_prog = None
+        self._vfactor_prog = None
+        self._lhs_key = None
+        self._lhs_aux = None
+        # ------------------------------------------------------- recovery
+        self.iteration = 0
+        self.ring = []
+        self.ring_size = int(ring_size if ring_size is not None
+                             else cfg_get("resilience", "RING_SNAPSHOTS", "4"))
+        self.snapshot_cadence = int(
+            snapshot_cadence if snapshot_cadence is not None
+            else cfg_get("resilience", "SNAPSHOT_CADENCE", "50"))
+        self.health_cadence = int(
+            health_cadence if health_cadence is not None
+            else cfg_get("health", "CHECK_CADENCE", "200"))
+        self.max_abs_limit = float(cfg_get("health", "MAX_ABS_LIMIT", "1e12"))
+        self.dt_backoff = float(dt_backoff if dt_backoff is not None
+                                else cfg_get("resilience", "DT_BACKOFF", "0.5"))
+        self.max_member_retries = int(
+            max_member_retries if max_member_retries is not None
+            else cfg_get("resilience", "MAX_RETRIES", "3"))
+        self._health_gate = metrics_mod.CadenceGate(self.health_cadence)
+        self._snapshot_gate = metrics_mod.CadenceGate(self.snapshot_cadence)
+        self._retries = np.zeros(self.n_pad, dtype=int)
+        self.dropped = []
+        self.rewound = []
+        # ------------------------------------------------------ telemetry
+        self.warmup_iterations = int(
+            warmup_iterations if warmup_iterations is not None
+            else solver.warmup_iterations)
+        self._warmed = False
+        self.metrics = metrics_mod.resolve(
+            metrics, sink=metrics_file,
+            meta={"config": f"ensemble[{self.members}]",
+                  "backend": jax.default_backend(),
+                  "dtype": str(np.dtype(solver.pencil_dtype)),
+                  "pencil_shape": list(solver.pencil_shape),
+                  "members": self.members})
+        self.metrics.inc("ensemble/members", self.members)
+        logger.info(
+            f"EnsembleSolver: {self.members} members (padded {self.n_pad}) "
+            f"on {D} device(s), "
+            f"{'per-member' if self.per_member_dt else 'common'} dt, "
+            f"policy={self.policy}")
+
+    # ------------------------------------------------------------ plumbing
+
+    def _resolve_mesh(self, mesh):
+        if mesh is None:
+            return None
+        if mesh == "auto":
+            devices = jax.devices()
+            if len(devices) < 2:
+                return None
+            return Mesh(np.array(devices), (MEMBER_AXIS,))
+        if len(mesh.axis_names) != 1:
+            raise ValueError("EnsembleSolver requires a 1-D member mesh.")
+        if mesh.axis_names[0] != MEMBER_AXIS:
+            raise ValueError(
+                f"member mesh axis must be named {MEMBER_AXIS!r}")
+        return mesh
+
+    def _put(self, arr):
+        """One device_put onto the member sharding (SNIPPETS §[2]
+        get_naive_sharding: lead axis on the batch mesh axis)."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, NamedSharding(self.mesh, P(MEMBER_AXIS)))
+
+    @property
+    def layout(self):
+        return self.solver.layout
+
+    @property
+    def variables(self):
+        return self.solver.variables
+
+    @property
+    def active(self):
+        """Per-member activity mask (true member count, no padding)."""
+        return self.active_host[:self.members].copy()
+
+    @property
+    def n_active(self):
+        return int(self.active_host[:self.members].sum())
+
+    # ----------------------------------------------------------- member IO
+
+    def init_members(self, fn):
+        """
+        Initialize the fleet: `fn(i)` is called for each member index and
+        should set the template problem's fields (state variables AND any
+        parameter/forcing fields) for member i; the gathered state and
+        every RHS extra field are recorded as that member's batched
+        operands. Fields `fn` leaves untouched simply repeat across
+        members.
+        """
+        solver = self.solver
+        X_rows, extra_rows = [], []
+        for i in range(self.members):
+            fn(i)
+            X_rows.append(solver.gather_fields())
+            extra_rows.append([jnp.asarray(a) for a in solver.rhs_extra()])
+        pad = self.n_pad - self.members
+        X_rows += [X_rows[0]] * pad
+        extra_rows += [extra_rows[0]] * pad
+        self.X = self._put(jnp.stack(X_rows))
+        self._extras = [self._put(jnp.stack([row[k] for row in extra_rows]))
+                        for k in range(len(extra_rows[0]))]
+        return self
+
+    def set_states(self, X):
+        """Install per-member initial pencil states directly:
+        X is (members, G, S)."""
+        X = jnp.asarray(X, dtype=self.solver.pencil_dtype)
+        if X.shape[0] != self.members:
+            raise ValueError(f"expected leading dim {self.members}, "
+                             f"got {X.shape[0]}")
+        pad = self.n_pad - self.members
+        if pad:
+            X = jnp.concatenate([X, jnp.broadcast_to(
+                X[:1], (pad,) + X.shape[1:])])
+        self.X = self._put(X)
+        return self
+
+    def member_arrays(self, m):
+        """{state_key: coefficient array} of member m's current state."""
+        if not 0 <= m < self.members:
+            raise IndexError(f"member {m} out of range [0, {self.members})")
+        arrays = scatter_state(self.layout, self.variables, self.X[m])
+        return {k: np.asarray(v) for k, v in arrays.items()}
+
+    def load_member(self, m):
+        """Scatter member m's state into the template problem fields (for
+        plotting/analysis with the normal Field API)."""
+        solver = self.solver
+        arrays = scatter_state(self.layout, self.variables, self.X[m])
+        for v in self.variables:
+            v.preset_coeff(arrays[state_key(v)])
+            v.mark_modified()
+        return solver.state
+
+    # ------------------------------------------------------------ programs
+
+    def _specs(self, tree, batched):
+        spec = P(MEMBER_AXIS) if batched else P()
+        return jax.tree.map(lambda _: spec, tree)
+
+    def _wrap(self, raw, label, args, batched_flags):
+        """jit (and shard_map, when a mesh is active) one fleet program.
+        `batched_flags` marks which top-level args carry the member axis;
+        specs are built per-leaf from the actual argument tree."""
+        fn = retrace_mod.noted(raw, label)
+        if self.mesh is not None:
+            in_specs = tuple(self._specs(a, b)
+                             for a, b in zip(args, batched_flags))
+            fn = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=P(MEMBER_AXIS))
+        # every call site memoizes the wrapper (self._programs[n] /
+        # self._project_prog / self._vfactor_prog), so each fleet program
+        # is built and traced exactly once
+        return jax.jit(fn)  # dedalus-lint: disable=DTL003
+
+    @staticmethod
+    def _freeze(new, old, act):
+        """Hold inactive members at their previous values (a dropped
+        member's slice never advances; NaN arithmetic from a poisoned
+        member is computed then discarded — vmap guarantees no
+        cross-member reduction, so poison cannot leak)."""
+        def one(a, b):
+            keep = act.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(keep, a, b)
+        return jax.tree.map(one, new, old)
+
+    def _fleet_multistep(self, n, M, L, X, T, DT, act, extras,
+                         Fh, MXh, LXh, a, b, c, aux):
+        body_fn = self.timestepper.advance_body
+        af = act.astype(self.rd)
+
+        def body(carry, _):
+            X, T, Fh, MXh, LXh = carry
+            with jax.named_scope("dedalus/ensemble/step"):
+                Xn, Fhn, MXhn, LXhn = jax.vmap(
+                    body_fn,
+                    in_axes=(None, None, 0, 0, 0, 0, 0, 0,
+                             None, None, None, None))(
+                    M, L, X, T, extras, Fh, MXh, LXh, a, b, c, aux)
+            Xn, Fhn, MXhn, LXhn = self._freeze(
+                (Xn, Fhn, MXhn, LXhn), (X, Fh, MXh, LXh), act)
+            return (Xn, T + DT * af, Fhn, MXhn, LXhn), None
+
+        carry, _ = jax.lax.scan(body, (X, T, Fh, MXh, LXh), None, length=n)
+        return carry
+
+    def _fleet_rk(self, n, M, L, X, T, DT, act, extras, auxs):
+        body_fn = self.timestepper.step_body
+        aux_ax = 0 if self.per_member_dt else None
+        af = act.astype(self.rd)
+
+        def body(carry, _):
+            X, T = carry
+            with jax.named_scope("dedalus/ensemble/step"):
+                Xn = jax.vmap(
+                    body_fn,
+                    in_axes=(None, None, 0, 0, 0, 0, aux_ax))(
+                    M, L, X, T, DT, extras, auxs)
+            Xn = self._freeze(Xn, X, act)
+            return (Xn, T + DT * af), None
+
+        carry, _ = jax.lax.scan(body, (X, T), None, length=n)
+        return carry
+
+    def _program(self, n, args, batched_flags):
+        # memoized per block size in self._programs (cache-subscript
+        # guard): one wrapper per static n, so fixed-size drivers trace
+        # each program exactly once and the retrace sentinel stays quiet
+        prog = self._programs.get(n)
+        if prog is None:
+            raw = functools.partial(
+                self._fleet_multistep if self._multistep else self._fleet_rk,
+                n)
+            prog = self._programs[n] = self._wrap(
+                raw, f"ensemble/fleet_step[{n}]", args, batched_flags)
+        return prog
+
+    def _project_fleet(self):
+        """Vmapped Hermitian/valid-mode re-projection of active members
+        (mirrors solver.enforce_hermitian_symmetry; inactive members are
+        frozen through it)."""
+        if self._project_prog is None:
+            self.solver._ensure_project()
+            proj = self.solver._project_body
+
+            def raw(X, act):
+                Xp = jax.vmap(proj)(X)
+                return self._freeze(Xp, X, act)
+
+            self._project_prog = self._wrap(
+                raw, "ensemble/project", (self.X, self._active_dev),
+                (True, True))
+        self.X = self._project_prog(self.X, self._active_dev)
+
+    def _probe(self):
+        """Per-member health reduction: (nonfinite count, max |coeff|) —
+        one jitted program, host-read only on the health cadence."""
+        if self._probe_prog is None:
+            def raw(X):
+                def one(x):
+                    ax = jnp.abs(x)
+                    return (jnp.sum(~jnp.isfinite(x)), jnp.max(ax))
+                with metrics_mod.trace_scope("ensemble", "probe"):
+                    return jax.vmap(one)(X)
+            self._probe_prog = jax.jit(
+                retrace_mod.noted(raw, "ensemble/probe"))
+        return self._probe_prog(self.X)
+
+    # ------------------------------------------------------ factorization
+
+    def _ensure_factor_rk(self, dt):
+        ts = self.timestepper
+        solver = self.solver
+        if not self.per_member_dt:
+            key = round(float(dt), 14)
+            if key != self._lhs_key:
+                self._lhs_key = key
+                self._lhs_aux = ts._factor(
+                    solver.M_mat, solver.L_mat,
+                    jnp.asarray(float(dt), dtype=self.rd))
+            return
+        key = tuple(np.round(self.dts, 14))
+        if key == self._lhs_key:
+            return
+        self._lhs_key = key
+        if self._vfactor_prog is None:
+            ops = solver.ops
+            uniq = ts.uniq_H_diag
+            slot = ts.stage_slot
+            one = jnp.asarray(1.0, dtype=self.rd)
+
+            def raw(M, L, dts):
+                def member(dt):
+                    return [ops.factor_lincomb(one, M, dt * h, L)
+                            for h in uniq]
+                auxs = jax.vmap(member)(dts)
+                return [auxs[j] for j in slot]
+
+            self._vfactor_prog = self._wrap(
+                raw, "ensemble/vfactor",
+                (solver.M_mat, solver.L_mat, self.DT),
+                (False, False, True))
+        self._lhs_aux = self._vfactor_prog(
+            solver.M_mat, solver.L_mat, self.DT)
+
+    def _ensure_factor_ms(self, a0, b0):
+        key = (round(float(a0), 14), round(float(b0), 14))
+        if key != self._lhs_key:
+            self._lhs_key = key
+            self._lhs_aux = self.timestepper._factor(
+                self.solver.M_mat, self.solver.L_mat,
+                jnp.asarray(a0, dtype=self.rd),
+                jnp.asarray(b0, dtype=self.rd))
+
+    # ------------------------------------------------------------ stepping
+
+    def _set_common_dt(self, dt):
+        dt = float(dt)
+        target = np.full(self.n_pad, dt)
+        if self.per_member_dt:
+            # members mid-rewind keep their backed-off dt (capped by the
+            # request): a per-step driving loop re-passes the same scalar
+            # dt every call, and overwriting the backoff would make the
+            # member re-diverge identically until its retries burn out
+            backed = self._retries > 0
+            target[backed] = np.minimum(self.dts[backed], dt)
+        live = self.active_host | (self.dts == 0.0)
+        if not np.all(self.dts[live] == target[live]):
+            self.dts = target
+            self.DT = self._put(jnp.asarray(target, dtype=self.rd))
+
+    def _dispatch(self, n, a=None, b=None, c=None):
+        solver = self.solver
+        if self._multistep:
+            args = (solver.M_mat, solver.L_mat, self.X, self.T, self.DT,
+                    self._active_dev, self._extras, self.F_hist,
+                    self.MX_hist, self.LX_hist, a, b, c, self._lhs_aux)
+            flags = (False, False, True, True, True, True, True, True,
+                     True, True, False, False, False, False)
+            prog = self._program(n, args, flags)
+            self.X, self.T, self.F_hist, self.MX_hist, self.LX_hist = \
+                prog(*args)
+        else:
+            args = (solver.M_mat, solver.L_mat, self.X, self.T, self.DT,
+                    self._active_dev, self._extras, self._lhs_aux)
+            flags = (False, False, True, True, True, True, True,
+                     self.per_member_dt)
+            prog = self._program(n, args, flags)
+            self.X, self.T = prog(*args)
+        self.iteration += n
+        self.sim_times += n * self.dts * self.active_host
+        self.metrics.inc("ensemble/fleet_steps", n)
+        member_steps = n * int(self.active_host[:self.members].sum())
+        self.metrics.inc("ensemble/member_steps", member_steps)
+        self.metrics.observe_steps(member_steps)
+
+    def _ms_single(self, dt):
+        """One fleet multistep step with the ramp's order build-up
+        (mirrors MultistepIMEX.step coefficient handling)."""
+        ts = self.timestepper
+        s = ts.steps
+        self._dt_hist = [float(dt)] + self._dt_hist[:s - 1]
+        self._ms_iter += 1
+        order = min(s, self._ms_iter)
+        a, b, c = ts.compute_coefficients(self._dt_hist, order)
+        a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+        b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+        c = np.concatenate([c, np.zeros(s - len(c))])
+        self._ensure_factor_ms(a[0], b[0])
+        self._dispatch(1, jnp.asarray(a, dtype=self.rd),
+                       jnp.asarray(b, dtype=self.rd),
+                       jnp.asarray(c, dtype=self.rd))
+
+    def step(self, dt=None):
+        self.step_many(1, dt)
+
+    def step_many(self, n, dt=None):
+        """
+        Advance the whole fleet n constant-dt steps: the multistep ramp
+        (order build-up) runs as single fleet steps, the remainder as ONE
+        scanned device dispatch. With per_member_dt, `dt` may be a
+        (members,) array; scalars apply fleet-wide.
+        """
+        n = int(n)
+        if n <= 0:
+            return
+        solver = self.solver
+        ts = self.timestepper
+        if dt is not None:
+            if np.ndim(dt) == 0:
+                self._set_common_dt(dt)
+            else:
+                self.set_member_dts(dt)
+        if not np.all(np.isfinite(self.dts[self.active_host])) \
+                or not np.any(self.dts):
+            raise ValueError(f"invalid ensemble dt state: {self.dts}")
+        # Hermitian/valid-mode re-projection cadence (mirrors
+        # solver.step_many's block condition)
+        cadence = solver.enforce_real_cadence
+        if cadence:
+            r = self.iteration % cadence
+            if (n >= cadence or r < ts.steps or (cadence - r) < n):
+                self._project_fleet()
+        if self._multistep:
+            dt0 = float(self.dts[0])
+            s = ts.steps
+            while n > 0 and not (self._ms_iter >= s
+                                 and len(self._dt_hist) == s
+                                 and all(abs(k - dt0) < 1e-15 * abs(dt0)
+                                         for k in self._dt_hist)):
+                self._ms_single(dt0)
+                n -= 1
+            if n > 0:
+                a, b, c = ts.compute_coefficients(self._dt_hist, s)
+                self._ensure_factor_ms(a[0], b[0])
+                self._dispatch(n, jnp.asarray(a, dtype=self.rd),
+                               jnp.asarray(b, dtype=self.rd),
+                               jnp.asarray(c, dtype=self.rd))
+        else:
+            self._ensure_factor_rk(self.dts[0])
+            self._dispatch(n)
+        if not self._warmed and self.iteration >= self.warmup_iterations:
+            self._end_warmup()
+        if self._health_gate.due(self.iteration):
+            self.check_health()
+
+    def set_member_dts(self, dts):
+        """Install per-member timesteps (requires per_member_dt=True)."""
+        if not self.per_member_dt:
+            raise ValueError("per-member dt values require "
+                             "per_member_dt=True")
+        dts = np.asarray(dts, dtype=float)
+        if dts.shape != (self.members,):
+            raise ValueError(f"expected shape ({self.members},), "
+                             f"got {dts.shape}")
+        full = np.concatenate([dts, np.full(self.n_pad - self.members,
+                                            dts[0] if len(dts) else 0.0)])
+        if not np.array_equal(full, self.dts):
+            self.dts = full
+            self.DT = self._put(jnp.asarray(full, dtype=self.rd))
+
+    def _end_warmup(self):
+        """Warmup boundary: compile-bearing first dispatches stay out of
+        the measured loop window; the retrace sentinel arms (each fleet
+        program wrapper must trace exactly once from here on)."""
+        self._warmed = True
+        jax.block_until_ready(self.X)
+        self.metrics.reset_loop()
+        retrace_mod.sentinel.arm()
+
+    # ------------------------------------------------- health and recovery
+
+    def check_health(self):
+        """Run the per-member probe now; diverged members are dropped or
+        rewound per `policy`. Returns the list of member events handled."""
+        nonfinite, max_abs = jax.device_get(self._probe())
+        self.metrics.inc("ensemble/health_checks")
+        bad = []
+        for m in range(self.members):
+            if not self.active_host[m]:
+                continue
+            if nonfinite[m]:
+                bad.append((m, f"non-finite state ({int(nonfinite[m])} "
+                               f"entries) at iteration {self.iteration}"))
+            elif np.isfinite(self.max_abs_limit) \
+                    and max_abs[m] > self.max_abs_limit:
+                bad.append((m, f"growth bound exceeded: max|coeff| = "
+                               f"{max_abs[m]:.3e} > {self.max_abs_limit:.3e}"
+                               f" at iteration {self.iteration}"))
+        if bad:
+            self._handle_bad(bad)
+        return bad
+
+    def _newest_finite_slot(self, m):
+        for snap in reversed(self.ring):
+            if snap.member_finite(m):
+                return snap
+        return None
+
+    def _restore_members(self, mask_np, snap):
+        """Per-member rewind: `where` the snapshot slots of the masked
+        members back into the fleet arrays (other members untouched)."""
+        mask = self._put(jnp.asarray(mask_np))
+
+        def back(cur, old):
+            keep = mask.reshape((-1,) + (1,) * (cur.ndim - 1))
+            return jnp.where(keep, old, cur)
+
+        self.X = back(self.X, snap.X)
+        self.T = back(self.T, snap.T)
+        if self._multistep and snap.hists is not None:
+            self.F_hist, self.MX_hist, self.LX_hist = jax.tree.map(
+                back, (self.F_hist, self.MX_hist, self.LX_hist), snap.hists)
+        self.sim_times[mask_np] = snap.sim_times[mask_np]
+
+    def _handle_bad(self, bad):
+        by_snap = {}
+        for m, reason in bad:
+            event = {"member": m, "iteration": self.iteration,
+                     "reason": reason}
+            snap = self._newest_finite_slot(m)
+            rewind = (self.policy == "rewind"
+                      and self._retries[m] < self.max_member_retries
+                      and snap is not None)
+            if rewind:
+                self._retries[m] += 1
+                new_dt = self.dts[m] * self.dt_backoff
+                event.update(outcome="rewound",
+                             rewind_iteration=snap.iteration,
+                             retry=int(self._retries[m]), dt=new_dt)
+                self.dts[m] = new_dt
+                self.rewound.append(event)
+                self.metrics.inc("ensemble/rewinds")
+                logger.warning(
+                    f"ensemble: member {m} diverged ({reason}); rewound to "
+                    f"iteration {snap.iteration}, dt backed off to "
+                    f"{new_dt:.3e} (retry {self._retries[m]}/"
+                    f"{self.max_member_retries})")
+            else:
+                self.active_host[m] = False
+                event.update(
+                    outcome="dropped",
+                    frozen_iteration=snap.iteration if snap else None)
+                self.dropped.append(event)
+                self.metrics.inc("ensemble/dropped")
+                logger.warning(
+                    f"ensemble: member {m} diverged ({reason}); dropped"
+                    + (f", frozen at snapshot iteration {snap.iteration}"
+                       if snap else " (no finite snapshot: state left "
+                       "as-is, masked out)"))
+            if snap is not None:
+                by_snap.setdefault(id(snap), (snap, []))[1].append(m)
+        for snap, ms in by_snap.values():
+            mask = np.zeros(self.n_pad, dtype=bool)
+            mask[ms] = True
+            self._restore_members(mask, snap)
+        self._active_dev = self._put(jnp.asarray(self.active_host))
+        if self.per_member_dt:
+            self.DT = self._put(jnp.asarray(self.dts, dtype=self.rd))
+            self._lhs_key = None   # refactor with the backed-off dts
+
+    def snapshot(self):
+        """Capture the fleet (sync-free device references)."""
+        hists = ((self.F_hist, self.MX_hist, self.LX_hist)
+                 if self._multistep else None)
+        self.ring.append(FleetSnapshot(
+            self.X, self.T, hists, self.iteration, self.sim_times))
+        del self.ring[:-self.ring_size]
+        self.metrics.inc("ensemble/snapshots")
+
+    # ------------------------------------------------------------ the loop
+
+    def evolve(self, dt=None, stop_iteration=None, block=None, chaos=None,
+               log_cadence=100):
+        """
+        Drive the fleet to `stop_iteration` in fixed-size scanned blocks
+        (sizes {block, 1} only, so each program traces once): snapshot
+        ring + per-member health on their cadences, chaos hooks for fault
+        injection, telemetry flush at the end. Returns the summary dict.
+        """
+        if stop_iteration is None:
+            raise ValueError("evolve requires stop_iteration")
+        block = int(block or min(16, max(self.snapshot_cadence, 1)))
+        if dt is not None and np.ndim(dt) == 0:
+            self._set_common_dt(dt)
+        elif dt is not None:
+            self.set_member_dts(dt)
+        self.snapshot()   # iteration-0 anchor
+        while self.iteration < stop_iteration and self.n_active:
+            n = block if stop_iteration - self.iteration >= block else 1
+            self.step_many(n)
+            if chaos is not None:
+                chaos.after_step(self)
+            if self._snapshot_gate.due(self.iteration):
+                self.snapshot()
+            if log_cadence and self.iteration % log_cadence < n:
+                logger.info(
+                    f"Ensemble iteration={self.iteration}, "
+                    f"active={self.n_active}/{self.members}, "
+                    f"dropped={len(self.dropped)}")
+        self.flush_metrics()
+        return self.summary()
+
+    # ----------------------------------------------------------- telemetry
+
+    def summary(self):
+        """Compact ensemble record (the `ensemble` block of flushed
+        telemetry; `report` renders it as member columns)."""
+        m = self.metrics
+        wall = m.loop_wall()
+        member_steps = m.iterations
+        return {
+            "members": self.members,
+            "active": self.n_active,
+            "dropped": len(self.dropped),
+            "rewinds": len(self.rewound),
+            "fleet_steps": self.iteration,
+            "member_steps": member_steps,
+            "ensemble_steps_per_sec": round(member_steps / wall, 4)
+            if wall > 0 else 0.0,
+            "devices": (self.mesh.shape[MEMBER_AXIS]
+                        if self.mesh is not None else 1),
+            "per_member_dt": self.per_member_dt,
+            "policy": self.policy,
+            "dropped_members": [e["member"] for e in self.dropped],
+        }
+
+    def flush_metrics(self, extra=None):
+        """Block on the fleet state and flush one telemetry record with
+        the `ensemble` summary block attached."""
+        try:
+            jax.block_until_ready(self.X)
+        except Exception:
+            pass
+        extra = dict(extra or {})
+        extra.setdefault("ensemble", self.summary())
+        extra.setdefault("retraces_post_warmup",
+                         retrace_mod.sentinel.post_arm_retraces)
+        return self.metrics.flush(extra=extra)

@@ -16,8 +16,9 @@ dedalus/core/transposes.pyx moves data so that stays true).
 import threading
 from functools import partial
 
-import jax
 from jax.sharding import PartitionSpec
+
+from ..tools.compat import shard_map
 
 _CTX = threading.local()
 
@@ -78,5 +79,5 @@ def local_fft(fn, data, orig_axis):
         out = fn(flat)
         return out.reshape(shp[:-1] + out.shape[-1:])
 
-    return partial(jax.shard_map, mesh=mesh, in_specs=spec,
+    return partial(shard_map, mesh=mesh, in_specs=spec,
                    out_specs=spec)(local)(data)

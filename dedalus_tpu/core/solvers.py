@@ -717,6 +717,10 @@ class InitialValueSolver(SolverBase):
                                                          tensorsig=v.tensorsig)
                     return gather_state(layout, variables, out)
 
+            # ensemble hook: the raw projection body (core/ensemble.py
+            # vmaps it over the member axis for the fleet's Hermitian/
+            # valid-mode re-projection cadence)
+            self._project_body = project
             self._project_state = lifted_jit(project)
         return self._project_state
 
@@ -1022,6 +1026,14 @@ class InitialValueSolver(SolverBase):
             return loop.run(log_cadence=log_cadence)
         finally:
             self.log_stats()
+
+    def ensemble(self, members, **kw):
+        """Build an EnsembleSolver over this (built, undistributed) IVP:
+        one compiled, vmapped + mesh-sharded step advancing `members`
+        independent copies with per-member initial conditions, RHS
+        parameters, and (RK schemes) per-member dt (core/ensemble.py)."""
+        from .ensemble import EnsembleSolver
+        return EnsembleSolver(self, members, **kw)
 
     def evolve(self, timestep_function=None, log_cadence=100):
         """Run the main loop to completion (reference: core/solvers.py:713)."""

@@ -23,10 +23,48 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..libraries import pencilops
 from ..tools.jitlift import lifted_jit
 from ..tools.config import config
 
 schemes = {}
+
+
+def _mesh_pin(solver):
+    """
+    Pencil-sharding pin for step-program intermediates: when the solver is
+    distributed (parallel/sharding.distribute_solver recorded a mesh on the
+    distributor), XLA's sharding propagation alone does NOT keep the
+    factor/solve boundary sharded — the factored LHS comes back replicated
+    and every solve then all-gathers its RHS (observed on the virtual CPU
+    mesh). Returns pin(tree, lead=0): constrains every array leaf whose
+    `lead` axis is the pencil-group axis (length G) onto the mesh's first
+    axis; identity when no mesh is active, so unsharded runs trace zero
+    extra ops. Resolved at trace time (closure over the solver) so the
+    same step bodies serve both the unsharded and post-distribute traces.
+    """
+    mesh = getattr(solver.dist, "mesh", None)
+    if mesh is None:
+        return lambda tree, lead=0: tree
+    from jax.sharding import NamedSharding, PartitionSpec
+    name = mesh.axis_names[0]
+    n = mesh.shape[name]
+    G = solver.pencil_shape[0]
+
+    def pin(tree, lead=0):
+        def one(a):
+            ndim = getattr(a, "ndim", None)
+            # only pencil-batched leaves: chunked banded factors (leading
+            # chunk axis) and scalars pass through unconstrained
+            if ndim is None or ndim <= lead or a.shape[lead] != G or G % n:
+                return a
+            spec = [None] * ndim
+            spec[lead] = name
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, PartitionSpec(*spec)))
+        return jax.tree.map(one, tree)
+
+    return pin
 
 
 def _use_split_step(solver):
@@ -109,7 +147,12 @@ class MultistepIMEX:
         # M and L are explicit arguments (not closure constants) so the
         # compiled HLO stays small and the arrays live as device buffers.
         def _factor_body(M, L, a0, b0):
-            return ops.factor_lincomb(a0, M, b0, L)
+            # pinned + shard_map-routed: an unconstrained factor replicates
+            # under GSPMD (forcing an all-gather into every solve), and the
+            # pivoted-LU custom calls are unpartitionable without the
+            # pencil_mesh shard_map routing (libraries/pencilops.py)
+            with pencilops.pencil_mesh(getattr(solver.dist, "mesh", None)):
+                return _mesh_pin(solver)(ops.factor_lincomb(a0, M, b0, L))
         _factor_jit = lifted_jit(_factor_body)
         G = solver.pencil_shape[0]
         itemsize = np.dtype(solver.pencil_dtype).itemsize
@@ -125,19 +168,23 @@ class MultistepIMEX:
         # the fused step body composes the same two pieces the split mode
         # dispatches separately, so the numerics cannot drift between modes
         def eval_parts(M, L, X, t, extra):
-            return (eval_F(X, t, extra) * mask(), ops.matvec(M, X),
-                    ops.matvec(L, X))
+            pin = _mesh_pin(solver)
+            return pin((eval_F(X, t, extra) * mask(), ops.matvec(M, X),
+                        ops.matvec(L, X)))
 
         def update_solve(Fn, MXn, LXn, F_hist, MX_hist, LX_hist, a, b, c,
                          lhs_aux, M, L):
+            pin = _mesh_pin(solver)
             F_hist = jnp.concatenate([Fn[None], F_hist[:-1]])
             MX_hist = jnp.concatenate([MXn[None], MX_hist[:-1]])
             LX_hist = jnp.concatenate([LXn[None], LX_hist[:-1]])
             RHS = (jnp.tensordot(c, F_hist, axes=1)
                    - jnp.tensordot(a[1:], MX_hist, axes=1)
                    - jnp.tensordot(b[1:], LX_hist, axes=1))
-            Xn = ops.solve(lhs_aux, RHS, mats=(M, L))
-            return Xn, F_hist, MX_hist, LX_hist
+            with pencilops.pencil_mesh(getattr(solver.dist, "mesh", None)):
+                Xn = pin(ops.solve(lhs_aux, RHS, mats=(M, L)))
+            return Xn, pin(F_hist, lead=1), pin(MX_hist, lead=1), \
+                pin(LX_hist, lead=1)
 
         def advance_body(M, L, X, t, extra, F_hist, MX_hist, LX_hist, a, b, c,
                          lhs_aux):
@@ -162,6 +209,11 @@ class MultistepIMEX:
         self._factor = _factor
         self._advance = lifted_jit(advance_body)
         self._advance_n = lifted_jit(_advance_n, static_argnums=(11,))
+        # ensemble hook (core/ensemble.py): the raw, un-jitted step body,
+        # vmapped over a leading member axis by EnsembleSolver — the same
+        # composition the fused program compiles, so fleet numerics cannot
+        # drift from the serial step
+        self.advance_body = advance_body
 
         # split-step pieces: the SAME bodies the fused program composes,
         # compiled as separate (smaller) device programs for very large
@@ -442,7 +494,11 @@ class RungeKuttaIMEX:
         # is assembled OUTSIDE the jit so stages sharing a factor alias the
         # same device buffers instead of duplicating the jit's outputs
         def _factor_uniq(M, L, dt):
-            return [ops.factor_lincomb(one, M, dt * h, L) for h in uniq]
+            # pinned + shard_map-routed: see MultistepIMEX._factor_body
+            pin = _mesh_pin(solver)
+            with pencilops.pencil_mesh(getattr(solver.dist, "mesh", None)):
+                return [pin(ops.factor_lincomb(one, M, dt * h, L))
+                        for h in uniq]
         _factor_uniq = lifted_jit(_factor_uniq)
         G = solver.pencil_shape[0]
         itemsize = np.dtype(solver.pencil_dtype).itemsize
@@ -463,13 +519,16 @@ class RungeKuttaIMEX:
         # the fused step body composes the same per-stage pieces the split
         # mode dispatches separately, so the numerics cannot drift
         def stage_eval(M, L, Xi, ti, extra):
-            return (ops.matvec(L, Xi), eval_F(Xi, ti, extra) * mask())
+            pin = _mesh_pin(solver)
+            return pin((ops.matvec(L, Xi), eval_F(Xi, ti, extra) * mask()))
 
         def stage_solve(i, MX0, Fs, LXs, dt, lhs_aux, M, L):
             RHS = MX0
             for j in range(i):
                 RHS = RHS + dt * (A[i, j] * Fs[j] - H[i, j] * LXs[j])
-            return ops.solve(lhs_aux, RHS, mats=(M, L))
+            with pencilops.pencil_mesh(getattr(solver.dist, "mesh", None)):
+                return _mesh_pin(solver)(ops.solve(lhs_aux, RHS,
+                                                   mats=(M, L)))
 
         def step_body(M, L, X0, t0, dt, extra, lhs_auxs):
             MX0 = ops.matvec(M, X0)
@@ -498,6 +557,12 @@ class RungeKuttaIMEX:
         self._factor = _factor
         self._step = lifted_jit(step_body)
         self._step_n = lifted_jit(_step_n, static_argnums=(7,))
+        # ensemble hooks (core/ensemble.py): the raw step body for member
+        # vmapping, plus the unique-implicit-diagonal bookkeeping so the
+        # per-member-dt mode can vmap its own factorization
+        self.step_body = step_body
+        self.uniq_H_diag = uniq
+        self.stage_slot = stage_slot
 
         # split-step pieces: the SAME per-stage bodies the fused program
         # composes, compiled as separate device programs (see _use_split_step)

@@ -28,13 +28,86 @@ metadata (permutations, band offsets, block size, pin positions) is
 host-static.
 """
 
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
+from jax.sharding import PartitionSpec
 
 from .matsolvers import get_solver
+from ..tools.compat import shard_map
 from ..tools.config import config
+
+
+# ------------------------------------------------------- pencil-mesh routing
+#
+# XLA's SPMD partitioner cannot partition the pivoted-LU custom calls
+# (lu_solve's pivot gather/scatter loop, triangular_solve): with the pencil
+# batch sharded over a mesh, a plain jitted factor/solve lowers as
+# all-gather + replicated full-batch solve — the exact failure local_fft
+# (core/meshctx.py) guards against for ffts. The step bodies publish the
+# active pencil mesh here at trace time; the batched dense factor/solve
+# funnels below then run inside shard_map so each device factors/solves
+# only its own group block. EnsembleSolver (core/ensemble.py) reuses the
+# same routing with its member axis as the leading batch dimension.
+
+_PENCIL_MESH = threading.local()
+
+
+class pencil_mesh:
+    """Trace-time context: batched factor/solve calls under this context
+    run inside shard_map over the leading batch axis of `mesh`'s first
+    axis (or `axis_name`). `mesh=None` is a no-op, so unsharded traces
+    compile identically to before."""
+
+    def __init__(self, mesh, axis_name=None):
+        self.state = None if mesh is None else \
+            (mesh, axis_name or mesh.axis_names[0])
+
+    def __enter__(self):
+        self.prev = getattr(_PENCIL_MESH, "state", None)
+        _PENCIL_MESH.state = self.state
+        return self.state
+
+    def __exit__(self, *exc):
+        _PENCIL_MESH.state = self.prev
+
+
+def active_pencil_mesh():
+    return getattr(_PENCIL_MESH, "state", None)
+
+
+def shard_groups(fn, G, *args):
+    """
+    Run `fn(*args)` with the length-G leading batch axis sharded over the
+    active pencil mesh (each device computes its local block; zero
+    collectives inside). Falls back to a direct call when no mesh context
+    is active, G does not divide the mesh axis, or any array leaf does not
+    lead with the batch axis (e.g. the chunked banded factor slabs, whose
+    leading dim is the chunk count — those rely on GSPMD propagation).
+    Scalar leaves ride along replicated.
+    """
+    state = active_pencil_mesh()
+    if state is None:
+        return fn(*args)
+    mesh, name = state
+    if G % mesh.shape[name]:
+        return fn(*args)
+    spec = PartitionSpec(name)
+
+    def spec_of(leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0:
+            return PartitionSpec()
+        return spec if leaf.shape[0] == G else None
+
+    in_specs = jax.tree.map(spec_of, args)
+    if any(s is None for s in jax.tree.leaves(
+            in_specs, is_leaf=lambda x: x is None)):
+        return fn(*args)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec)(*args)
 
 
 class DenseOps:
@@ -60,14 +133,15 @@ class DenseOps:
 
     def factor(self, A):
         with jax.named_scope("dedalus/matsolve/dense.factor"):
-            return self.solver_cls.factor(A)
+            return shard_groups(self.solver_cls.factor, A.shape[0], A)
 
     def factor_lincomb(self, a, A, b, B):
         return self.factor(self.lincomb(a, A, b, B))
 
     def solve(self, aux, rhs, mats=None):
         with jax.named_scope("dedalus/matsolve/dense.solve"):
-            return self.solver_cls.solve(aux, rhs)
+            return shard_groups(self.solver_cls.solve, rhs.shape[0],
+                                aux, rhs)
 
     def densify_host(self, host_mat, g):
         return np.asarray(host_mat[g])

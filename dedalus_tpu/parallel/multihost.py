@@ -56,6 +56,17 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         # user code already called jax.distributed.initialize() directly
         _initialized = True
         return
+    # CPU rehearsal worlds (the 2-process tests, laptop dry runs): the
+    # default XLA:CPU client has no cross-process collectives ("Multiprocess
+    # computations aren't implemented on the CPU backend"); jaxlib's gloo
+    # implementation provides them. Must be set before the backend spins
+    # up — initialize() is that point; harmless for TPU/GPU worlds (the
+    # flag only affects CPU client construction) and best-effort across
+    # jax versions that lack the option.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

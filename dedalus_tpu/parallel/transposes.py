@@ -19,7 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from ..tools.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -98,6 +98,14 @@ class DistributedPencilPipeline:
         return fn(data, len(tensorsig) + axis, scales[axis],
                   tensorsig=tensorsig, sub_axis=axis - basis.first_axis)
 
+    def _constrain(self, data, layout):
+        """Pin the stage sharding: fft ops are unpartitionable, so without
+        explicit constraints GSPMD gathers at the first local transform
+        after a transpose and the walk degrades to replicated."""
+        spec = [layout.get(d) for d in range(data.ndim)]
+        return jax.lax.with_sharding_constraint(
+            data, NamedSharding(self.mesh, P(*spec)))
+
     def coeff_layout(self, tdim=0):
         """{array dim: mesh axis} for full-coefficient arrays."""
         return {tdim + r: self.axis_names[r] for r in range(self.R)}
@@ -107,35 +115,59 @@ class DistributedPencilPipeline:
         return {tdim + r + 1: self.axis_names[r] for r in range(self.R)}
 
     def to_grid(self, cdata, scales=None, tensorsig=()):
-        """Full coefficient -> full grid, sharded end-to-end."""
+        """Full coefficient -> full grid, sharded end-to-end. The current
+        {dim: mesh axis} layout is published to core/meshctx so every
+        local transform routes its fft through shard_map (XLA cannot
+        partition fft ops), and each stage's sharding is pinned."""
+        from ..core import meshctx
         scales = scales or (1.0,) * self.D
         D, R = self.D, self.R
         tdim = len(tensorsig)
-        out = cdata
-        for axis in range(D - 1, R - 1, -1):
-            out = self._transform(out, axis, scales, tensorsig, forward=False)
         layout = self.coeff_layout(tdim)
-        for r in range(R - 1, -1, -1):
-            del layout[tdim + r]
-            out = all_to_all_transpose(out, tdim + r, tdim + r + 1, self.mesh,
-                                       self.axis_names[r], layout=layout)
-            layout[tdim + r + 1] = self.axis_names[r]
-            out = self._transform(out, r, scales, tensorsig, forward=False)
-        return out
+        prev = meshctx.set_walk(self.mesh, layout)
+        try:
+            out = self._constrain(cdata, layout)
+            for axis in range(D - 1, R - 1, -1):
+                out = self._transform(out, axis, scales, tensorsig,
+                                      forward=False)
+            for r in range(R - 1, -1, -1):
+                del layout[tdim + r]
+                out = all_to_all_transpose(out, tdim + r, tdim + r + 1,
+                                           self.mesh, self.axis_names[r],
+                                           layout=layout)
+                layout[tdim + r + 1] = self.axis_names[r]
+                meshctx.set_walk(self.mesh, layout)
+                out = self._constrain(out, layout)
+                out = self._transform(out, r, scales, tensorsig,
+                                      forward=False)
+            return self._constrain(out, layout)
+        finally:
+            meshctx.restore_walk(prev)
 
     def to_coeff(self, gdata, scales=None, tensorsig=()):
-        """Full grid -> full coefficient, sharded end-to-end."""
+        """Full grid -> full coefficient, sharded end-to-end (see to_grid
+        for the meshctx walk publication + stage pinning)."""
+        from ..core import meshctx
         scales = scales or (1.0,) * self.D
         D, R = self.D, self.R
         tdim = len(tensorsig)
-        out = gdata
         layout = self.grid_layout(tdim)
-        for r in range(R):
-            out = self._transform(out, r, scales, tensorsig, forward=True)
-            del layout[tdim + r + 1]
-            out = all_to_all_transpose(out, tdim + r + 1, tdim + r, self.mesh,
-                                       self.axis_names[r], layout=layout)
-            layout[tdim + r] = self.axis_names[r]
-        for axis in range(R, D):
-            out = self._transform(out, axis, scales, tensorsig, forward=True)
-        return out
+        prev = meshctx.set_walk(self.mesh, layout)
+        try:
+            out = self._constrain(gdata, layout)
+            for r in range(R):
+                out = self._transform(out, r, scales, tensorsig,
+                                      forward=True)
+                del layout[tdim + r + 1]
+                out = all_to_all_transpose(out, tdim + r + 1, tdim + r,
+                                           self.mesh, self.axis_names[r],
+                                           layout=layout)
+                layout[tdim + r] = self.axis_names[r]
+                meshctx.set_walk(self.mesh, layout)
+                out = self._constrain(out, layout)
+            for axis in range(R, D):
+                out = self._transform(out, axis, scales, tensorsig,
+                                      forward=True)
+            return self._constrain(out, layout)
+        finally:
+            meshctx.restore_walk(prev)

@@ -41,6 +41,7 @@ from .core.timesteppers import (schemes, add_scheme, MultistepIMEX,
                                 RK443, RKSMR, RKGFY)
 from .core.solvers import (InitialValueSolver, LinearBoundaryValueSolver,
                            NonlinearBoundaryValueSolver, EigenvalueSolver)
+from .core.ensemble import EnsembleSolver
 from .core.evaluator import Evaluator
 from .extras.flow_tools import CFL, GlobalFlowProperty, GlobalArrayReducer
 from .tools.exceptions import CheckpointError, SolverHealthError

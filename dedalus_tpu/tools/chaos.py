@@ -78,7 +78,11 @@ class ChaosInjector:
 
       nan_field + nan_iteration   — poison the named field's pencil
           slice with NaN after completing iteration N (the next health
-          probe sees a non-finite state).
+          probe sees a non-finite state). With `nan_member` set and an
+          EnsembleSolver as the target, only that member's slice of the
+          (N, G, S) fleet state is poisoned — the per-member drop/rewind
+          machinery (core/ensemble.py) must absorb it without stopping
+          the batch.
       fail_checkpoint_write       — raise a transient OSError (EIO) on
           the Nth durable checkpoint write (1-based), succeeding on
           retry.
@@ -89,10 +93,12 @@ class ChaosInjector:
     """
 
     def __init__(self, seed=0, nan_field=None, nan_iteration=None,
-                 fail_checkpoint_write=None, sigterm_iteration=None):
+                 fail_checkpoint_write=None, sigterm_iteration=None,
+                 nan_member=None):
         self.seed = int(seed)
         self.nan_field = nan_field
         self.nan_iteration = nan_iteration
+        self.nan_member = nan_member
         self.fail_checkpoint_write = fail_checkpoint_write
         self.sigterm_iteration = sigterm_iteration
         self.fired = []
@@ -140,7 +146,8 @@ class ChaosInjector:
         if "nan" in self._armed and it >= self.nan_iteration:
             self._armed.discard("nan")
             self.poison_field(solver, self.nan_field)
-            self._fire("nan", iteration=it, field=self.nan_field)
+            self._fire("nan", iteration=it, field=self.nan_field,
+                       member=self.nan_member)
         if "sigterm" in self._armed and it >= self.sigterm_iteration:
             self._armed.discard("sigterm")
             self._fire("sigterm", iteration=it)
@@ -151,10 +158,23 @@ class ChaosInjector:
     def poison_field(self, solver, name):
         """Overwrite the named field's slice of the gathered state with
         NaN — a pure device-side update (no host sync), exactly what a
-        diverging nonlinearity produces."""
+        diverging nonlinearity produces. A 3-D (members, G, S) fleet
+        state (core/ensemble.EnsembleSolver) poisons only `nan_member`'s
+        slice."""
         import jax.numpy as jnp
         offset, size = _field_slice(solver, name)
-        solver.X = solver.X.at[:, offset:offset + size].set(jnp.nan)
+        X = solver.X
+        if X.ndim == 3:
+            m = int(self.nan_member or 0)
+            # JAX scatter silently drops out-of-bounds indices — a typo'd
+            # member would record a fired fault that never happened
+            if not 0 <= m < X.shape[0]:
+                raise ValueError(
+                    f"nan_member={m} out of range for a {X.shape[0]}-member "
+                    f"fleet")
+            solver.X = X.at[m, :, offset:offset + size].set(jnp.nan)
+            return
+        solver.X = X.at[:, offset:offset + size].set(jnp.nan)
         # the fields' lazy pulls still reference the clean X; re-install
         # against the poisoned state so harness code sees what the
         # solver sees

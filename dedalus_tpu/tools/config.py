@@ -16,3 +16,14 @@ _here = pathlib.Path(__file__).parent.parent
 config.read(str(_here / "dedalus_tpu.cfg"))
 config.read(os.path.expanduser("~/.dedalus_tpu/dedalus_tpu.cfg"))
 config.read("dedalus_tpu.cfg")
+
+
+def cfg_get(section, key, fallback):
+    """Config value with fallback, tolerant of a missing section and of
+    empty-string values (both yield `fallback`). The one implementation
+    of the section/get/or-fallback dance shared by the tools modules."""
+    sec = config[section] if config.has_section(section) else {}
+    try:
+        return sec.get(key, fallback) or fallback
+    except AttributeError:
+        return fallback
