@@ -191,15 +191,13 @@ def _run_child(env, timeout, tag):
     return None, f"{tag} child rc={proc.returncode}"
 
 
-def _recent_tpu_row(config=None, max_age_hours=48):
-    """Latest finite backend=tpu row for `config` (default rb256x64) from
-    results.jsonl recorded within the recent measurement window (48h:
-    wide enough to span a round whose chip window opened early — or the
-    previous round's sweep when the chip stayed unclaimable throughout,
-    as rows carry their own measured_ts provenance). `max_age_hours=None`
-    disables the window (the stale-headline guard's unfiltered probe)."""
+def _recent_row(predicate, max_age_hours=48):
+    """Latest results.jsonl row satisfying `predicate` whose report ts
+    falls inside the measurement window (`max_age_hours=None` disables
+    the window). The ONE scan loop behind the TPU-headline, ensemble,
+    and serving probes, so the provenance-window rules can never drift
+    between them."""
     import time
-    config = config or f"rb{NX}x{NZ}"
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "results.jsonl")
     best = None
@@ -210,11 +208,7 @@ def _recent_tpu_row(config=None, max_age_hours=48):
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if (row.get("config") == config
-                        and row.get("backend") == "tpu"
-                        and row.get("finite")
-                        and row.get("steps_per_sec")
-                        and row.get("ts")
+                if (predicate(row) and row.get("ts")
                         and (max_age_hours is None
                              or time.time() - row["ts"]
                              < max_age_hours * 3600)):
@@ -222,6 +216,22 @@ def _recent_tpu_row(config=None, max_age_hours=48):
     except OSError:
         return None
     return best
+
+
+def _recent_tpu_row(config=None, max_age_hours=48):
+    """Latest finite backend=tpu row for `config` (default rb256x64) from
+    results.jsonl recorded within the recent measurement window (48h:
+    wide enough to span a round whose chip window opened early — or the
+    previous round's sweep when the chip stayed unclaimable throughout,
+    as rows carry their own measured_ts provenance). `max_age_hours=None`
+    disables the window (the stale-headline guard's unfiltered probe)."""
+    config = config or f"rb{NX}x{NZ}"
+    return _recent_row(
+        lambda row: (row.get("config") == config
+                     and row.get("backend") == "tpu"
+                     and row.get("finite")
+                     and row.get("steps_per_sec")),
+        max_age_hours)
 
 
 def _prior_headline_reuses(measured_ts, same_round_grace_hours=6.0):
@@ -301,6 +311,7 @@ def _attach_progression(record):
                 if row.get("ts") else None,
             }
     _attach_ensemble(record)
+    _attach_serving(record)
     return record
 
 
@@ -309,28 +320,12 @@ def _recent_ensemble_row(config, max_age_hours=48):
     measurement window. Ensemble rows are CPU-measured by design (the
     virtual member mesh; ROADMAP platform note), so unlike
     _recent_tpu_row this does not filter on backend."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "results.jsonl")
-    best = None
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if (row.get("config") == config
-                        and isinstance(row.get("sweep"), list)
-                        and row["sweep"]
-                        and row.get("speedup_n64") is not None
-                        and row.get("ts")
-                        and (max_age_hours is None
-                             or time.time() - row["ts"]
-                             < max_age_hours * 3600)):
-                    best = row
-    except OSError:
-        return None
-    return best
+    return _recent_row(
+        lambda row: (row.get("config") == config
+                     and isinstance(row.get("sweep"), list)
+                     and row["sweep"]
+                     and row.get("speedup_n64") is not None),
+        max_age_hours)
 
 
 def _attach_ensemble(record):
@@ -356,6 +351,45 @@ def _attach_ensemble(record):
                 best.get("ensemble_steps_per_sec"),
             "serial_steps_per_sec":
                 (row.get("serial") or {}).get("steps_per_sec"),
+            "backend": row.get("backend"),
+            "stale": True,
+            "measured_ts": row.get("ts"),
+            "age_s": round(time.time() - row["ts"], 1)
+            if row.get("ts") else None,
+        }
+    return record
+
+
+def _recent_serving_row(config, max_age_hours=48):
+    """Latest benchmarks/serving.py row for `config` within the
+    measurement window. Serving rows are CPU-measured by design (the
+    daemon subprocess; ROADMAP platform note), so no backend filter."""
+    return _recent_row(
+        lambda row: (row.get("config") == config
+                     and row.get("ttfs_speedup") is not None
+                     and row.get("bit_identical_cold_warm")),
+        max_age_hours)
+
+
+def _attach_serving(record):
+    """Attach the newest in-window serving benchmark headline (warm
+    pool-hit vs cold fresh-process time-to-first-step,
+    benchmarks/serving.py) to the official bench line. Same provenance
+    discipline as the ensemble rows: a CACHED prior measurement, stamped
+    stale with its original measured_ts and age, and dropped entirely
+    once outside the 48h window."""
+    for key, config in (("serving_rb256x64", "rb256x64_serving"),
+                        ("serving_diffusion64", "diffusion64_serving")):
+        row = _recent_serving_row(config)
+        if row is None:
+            continue
+        record[key] = {
+            "ttfs_cold_sec": row.get("ttfs_cold_sec"),
+            "ttfs_warm_sec": row.get("ttfs_warm_sec"),
+            "ttfs_speedup": row.get("ttfs_speedup"),
+            "meets_10x": row.get("meets_10x"),
+            "throughput_requests_per_sec":
+                row.get("throughput_requests_per_sec"),
             "backend": row.get("backend"),
             "stale": True,
             "measured_ts": row.get("ts"),

@@ -1,21 +1,26 @@
 """
-Command-line interface (reference: dedalus/__main__.py:1-45):
+Command-line interface (reference: dedalus/__main__.py:1-45), argparse
+subcommands — `python -m dedalus_tpu <command> --help` documents each:
 
-    python -m dedalus_tpu test            # run the test suite
-    python -m dedalus_tpu bench           # run the benchmark (bench.py)
-    python -m dedalus_tpu get_config      # print the resolved configuration
-    python -m dedalus_tpu get_examples    # print the examples directory
-    python -m dedalus_tpu report F.jsonl [--last N]  # summarize metrics JSONL
-    python -m dedalus_tpu postmortem DIR  # summarize a health post-mortem
-    python -m dedalus_tpu lint [paths]    # jit-hygiene static analysis
+    test          run the tier-1 test suite
+    cov           test suite under coverage
+    bench         run the benchmark (bench.py)
+    get_config    print the resolved configuration
+    get_examples  print the examples directory
+    report        summarize a metrics/results JSONL file
+    postmortem    summarize a health post-mortem directory
+    lint          jit-hygiene static analysis (own arg surface)
+    serve         warm-pool solver daemon (dedalus_tpu/service/)
+    submit        submit one run to a serve daemon
 """
 
+import argparse
 import json
 import pathlib
 import sys
 
 
-def test():
+def test(args=None):
     import pytest
     # fail fast on a missing/stale lint baseline: tests/test_lint.py would
     # fail anyway, but only after the whole suite ran — and a stale
@@ -30,12 +35,13 @@ def test():
     root = pathlib.Path(__file__).parent.parent
     # tier-1 semantics: slow-marked tests (long timing runs) are opt-in
     # via pytest directly; chaos-marked fault-injection tests
-    # (tests/test_resilience.py) are fast and run by default — recovery
-    # paths that are not exercised do not exist
+    # (tests/test_resilience.py) and service-marked daemon tests
+    # (tests/test_service.py) are fast and run by default — recovery and
+    # serving paths that are not exercised do not exist
     sys.exit(pytest.main([str(root / "tests"), "-q", "-m", "not slow"]))
 
 
-def bench():
+def bench(args=None):
     import runpy
     root = pathlib.Path(__file__).parent.parent
     bench_path = root / "bench.py"
@@ -45,7 +51,7 @@ def bench():
     runpy.run_path(str(bench_path), run_name="__main__")
 
 
-def cov():
+def cov(args=None):
     """Test suite under coverage (reference: dedalus/tests/__init__.py:30
     cov). Requires the `coverage` package. Runs in a fresh interpreter so
     coverage measures modules imported by the package itself (starting
@@ -66,38 +72,25 @@ def cov():
     sys.exit(rc)
 
 
-def get_config():
+def get_config(args=None):
     from .tools.config import config
     config.write(sys.stdout)
 
 
-def get_examples():
+def get_examples(args=None):
     root = pathlib.Path(__file__).parent.parent / "examples"
     print(root)
 
 
-def report():
+def report(args):
     """Summarize a metrics JSONL file (tools/metrics.py records; bench rows
-    from benchmarks/results.jsonl listed briefly; health post-mortem
-    records get their own line). Tolerates heterogeneous rows — records
-    from before any given key existed print with defaults rather than
-    crashing. `--last N` restricts to the N most recent parsable rows."""
+    from benchmarks/results.jsonl listed briefly; health post-mortem and
+    service records get their own lines). Tolerates heterogeneous rows —
+    records from before any given key existed print with defaults rather
+    than crashing. `--last N` restricts to the N most recent parsable
+    rows."""
     from .tools.metrics import format_phase_table
-    args = sys.argv[2:]
-    last = None
-    if "--last" in args:
-        i = args.index("--last")
-        try:
-            last = int(args[i + 1])
-        except (IndexError, ValueError):
-            print("report: --last requires an integer", file=sys.stderr)
-            sys.exit(2)
-        args = args[:i] + args[i + 2:]
-    if not args:
-        print("usage: python -m dedalus_tpu report <metrics.jsonl> "
-              "[--last N]", file=sys.stderr)
-        sys.exit(2)
-    path = pathlib.Path(args[0])
+    path = pathlib.Path(args.jsonl)
     try:
         lines = path.read_text().splitlines()
     except OSError as exc:
@@ -118,8 +111,8 @@ def report():
             n_bad += 1
             continue
         records.append(record)
-    if last is not None:
-        records = records[-last:] if last > 0 else []
+    if args.last is not None:
+        records = records[-args.last:] if args.last > 0 else []
     n_metrics = n_post = n_other = 0
     for record in records:
         kind = record.get("kind")
@@ -173,6 +166,18 @@ def report():
                         f"resumed from {resilience['resumed_from']} "
                         f"(write {resilience.get('resume_write', '?')})")
                 print(f"    resilience: {', '.join(parts)}")
+            serving = record.get("serving")
+            if isinstance(serving, dict):
+                # served-latency columns (dedalus_tpu/service/): the pool
+                # verdict and time-to-first-step ARE the serving story
+                parts = [f"pool={serving.get('pool_verdict', '?')}",
+                         f"queue={serving.get('queue_sec', '?')}s",
+                         f"ttfs={serving.get('time_to_first_step_sec')}s"]
+                if serving.get("build_sec"):
+                    parts.append(f"build={serving['build_sec']}s")
+                if serving.get("request_id"):
+                    parts.append(f"request={serving['request_id']}")
+                print(f"    serving: {', '.join(parts)}")
         elif kind == "health_postmortem":
             n_post += 1
             resilience = record.get("resilience")
@@ -185,6 +190,16 @@ def report():
                   f"{record.get('reason', '(no reason)')}{lineage}"
                   + (f" [{record.get('directory')}]"
                      if record.get("directory") else ""))
+        elif kind == "service_stats":
+            n_other += 1
+            pool = record.get("pool") or {}
+            print(f"(service) {record.get('requests_served', 0)} requests, "
+                  f"{record.get('errors', 0)} errors, "
+                  f"pool {pool.get('hits', 0)} hits / "
+                  f"{pool.get('misses', 0)} misses / "
+                  f"{pool.get('evictions', 0)} evictions, "
+                  f"{len(pool.get('entries', []))} warm entr(ies), "
+                  f"uptime {record.get('uptime_sec', '?')}s")
         else:
             n_other += 1
             ident = record.get("metric") or record.get("config") or "record"
@@ -209,21 +224,29 @@ def report():
                           f"member-steps/s "
                           f"({point.get('speedup_vs_serial', '?')}x serial,"
                           f" {point.get('devices', '?')} device(s))")
+            # serving benchmark rows (benchmarks/serving.py): the cold-
+            # miss vs warm-hit time-to-first-step comparison in one line
+            if record.get("ttfs_cold_sec") is not None \
+                    or record.get("ttfs_warm_sec") is not None:
+                line = (f"    serving: ttfs cold "
+                        f"{record.get('ttfs_cold_sec', '?')}s -> warm "
+                        f"{record.get('ttfs_warm_sec', '?')}s "
+                        f"({record.get('ttfs_speedup', '?')}x)")
+                if record.get("throughput_requests_per_sec") is not None:
+                    line += (f", {record['throughput_requests_per_sec']} "
+                             "requests/s")
+                print(line)
     print(f"{n_metrics} metrics record(s), {n_other} other, "
           f"{n_post} postmortem, {n_bad} unparsable")
     if n_metrics == 0 and n_other == 0 and n_post == 0:
         sys.exit(1)
 
 
-def postmortem():
+def postmortem(args):
     """Summarize a health flight-recorder dump (tools/health.py): accepts
     the post-mortem directory or a record file inside it."""
     from .tools.health import read_postmortem, format_postmortem
-    if len(sys.argv) < 3:
-        print("usage: python -m dedalus_tpu postmortem <dir-or-record>",
-              file=sys.stderr)
-        sys.exit(2)
-    path = pathlib.Path(sys.argv[2])
+    path = pathlib.Path(args.directory)
     try:
         record, ring = read_postmortem(path)
     except (OSError, ValueError) as exc:
@@ -233,22 +256,81 @@ def postmortem():
         print(line)
 
 
-def lint():
+def lint(argv):
     """Jit-hygiene static analysis (tools/lint): DTL rule set, baseline,
     suppressions. Nonzero exit on findings not covered by the baseline."""
     from .tools.lint.cli import main as lint_main
-    sys.exit(lint_main(sys.argv[2:]))
+    sys.exit(lint_main(argv))
+
+
+def serve(argv):
+    """Warm-pool solver daemon (dedalus_tpu/service/server.py)."""
+    from .service.server import main as serve_main
+    sys.exit(serve_main(argv))
+
+
+def submit(argv):
+    """Submit one run to a serve daemon (dedalus_tpu/service/client.py)."""
+    from .service.client import main as submit_main
+    sys.exit(submit_main(argv))
+
+
+# Subcommands that own their whole argument surface (each has its own
+# argparse parser, including --help): dispatched BEFORE the top-level
+# parser sees the argv tail — argparse's REMAINDER does not reliably
+# capture leading options like `--help`, so forwarding must bypass it.
+PASSTHROUGH = {"lint": lint, "serve": serve, "submit": submit}
+
+
+def build_parser():
+    doc_lines = (__doc__ or "").strip().splitlines()
+    parser = argparse.ArgumentParser(
+        prog="python -m dedalus_tpu",
+        # docstrings are stripped under -OO: fall back rather than index
+        description=doc_lines[0] if doc_lines
+        else "dedalus_tpu command-line interface")
+    sub = parser.add_subparsers(dest="command", metavar="command",
+                                required=True)
+    sub.add_parser("test", help="run the tier-1 test suite "
+                                "(slow-marked tests excluded)"
+                   ).set_defaults(func=test)
+    sub.add_parser("bench", help="run the benchmark (bench.py)"
+                   ).set_defaults(func=bench)
+    sub.add_parser("cov", help="test suite under coverage"
+                   ).set_defaults(func=cov)
+    sub.add_parser("get_config", help="print the resolved configuration"
+                   ).set_defaults(func=get_config)
+    sub.add_parser("get_examples", help="print the examples directory"
+                   ).set_defaults(func=get_examples)
+    p = sub.add_parser("report", help="summarize a metrics/results JSONL "
+                                      "file (tools/metrics.py records)")
+    p.add_argument("jsonl", help="path to the JSONL file")
+    p.add_argument("--last", type=int, default=None, metavar="N",
+                   help="only the N most recent parsable rows")
+    p.set_defaults(func=report)
+    p = sub.add_parser("postmortem", help="summarize a health post-mortem "
+                                          "dump (tools/health.py)")
+    p.add_argument("directory", help="post-mortem directory or record file")
+    p.set_defaults(func=postmortem)
+    # pass-through subcommands: listed here so the top-level --help names
+    # them, but main() dispatches them before this parser ever runs
+    for name, helptext in (
+            ("lint", "jit-hygiene static analysis (DTL rule set); "
+                     "see `lint --help`"),
+            ("serve", "warm-pool solver daemon (docs/serving.md); "
+                      "see `serve --help`"),
+            ("submit", "submit one run to a serve daemon; "
+                       "see `submit --help`")):
+        sub.add_parser(name, help=helptext, add_help=False)
+    return parser
 
 
 def main():
-    commands = {"test": test, "bench": bench, "cov": cov,
-                "get_config": get_config, "get_examples": get_examples,
-                "report": report, "postmortem": postmortem, "lint": lint}
-    if len(sys.argv) < 2 or sys.argv[1] not in commands:
-        print(f"usage: python -m dedalus_tpu [{'|'.join(commands)}]",
-              file=sys.stderr)
-        sys.exit(2)
-    commands[sys.argv[1]]()
+    if len(sys.argv) > 1 and sys.argv[1] in PASSTHROUGH:
+        PASSTHROUGH[sys.argv[1]](sys.argv[2:])
+        return
+    args = build_parser().parse_args()
+    args.func(args)
 
 
 if __name__ == "__main__":

@@ -115,6 +115,11 @@ class SolverBase:
         ckey = None
         if cache is not None:
             ckey = assembly_cache.solver_key(self, names)
+        # content identity of this pencil system, stashed for consumers
+        # that key on it after the build (the warm-pool service's
+        # assembly_cache.pool_key); None when the cache is disabled or
+        # the graph is unfingerprintable — pool_key then recomputes
+        self.assembly_key = ckey
         if ckey is not None:
             payload = cache.load(ckey)
             if payload is not None:

@@ -135,6 +135,9 @@ class MultistepIMEX:
         self._lhs_key = None
         self._lhs_aux = None
         self.iteration = 0
+        # per-run state lives in the block above; reset_run() must mirror
+        # any addition here or pooled served runs stop bit-matching fresh
+        # solves (tests/test_service.py::test_pool_reset_bit_identity)
 
         eval_F = solver.eval_F
         from ..tools.jitlift import device_constant
@@ -225,6 +228,25 @@ class MultistepIMEX:
     def compute_coefficients(self, dt_hist, order):
         """Return (a[0..order], b[0..order], c[1..order])."""
         raise NotImplementedError
+
+    def reset_run(self):
+        """Rewind per-run state to just-constructed values IN PLACE (the
+        warm-pool service's between-request reset, service/pool.py) —
+        the instance survives because it owns the compiled step
+        programs. The multistep ramp restarts; the LHS factorization
+        cache (_lhs_key/_lhs_aux) is deliberately KEPT: it is a pure
+        function of (M, L, scheme coefficients, dt history), all
+        request-invariant on one pooled solver, and step() re-keys it
+        whenever the dt pattern differs — exactly the check a fresh
+        solver performs."""
+        solver = self.solver
+        G, S = solver.pencil_shape
+        zeros = jnp.zeros((self.steps, G, S), dtype=solver.pencil_dtype)
+        self.F_hist = zeros
+        self.MX_hist = zeros
+        self.LX_hist = zeros
+        self.dt_hist = []
+        self.iteration = 0
 
     def step(self, dt, wall_time=None):
         solver = self.solver
@@ -469,7 +491,7 @@ class RungeKuttaIMEX:
         self._lhs_key = None
         self._lhs_aux = None
 
-        eval_F = solver.eval_F
+        eval_F = solver.eval_F  # (reset_run mirrors the per-run state)
         rd = solver.real_dtype
         from ..tools.jitlift import device_constant
         mask_np = solver.valid_row_mask
@@ -592,6 +614,12 @@ class RungeKuttaIMEX:
             Xi = self._stage_solve(i, MX0, Fs, LXs, dtj,
                                    self._lhs_aux[i - 1], M, L)
         return Xi
+
+    def reset_run(self):
+        """Per-run reset (see MultistepIMEX.reset_run): RK schemes carry
+        no ramp history, only the step count; the LHS factorization
+        cache is deliberately kept — _ensure_factor re-keys on dt."""
+        self.iteration = 0
 
     def _ensure_factor(self, dt):
         solver = self.solver

@@ -8,7 +8,7 @@ benchmarks, and the emulated-f64 regression tests.
 import numpy as np
 
 
-def build_rb_solver(Nx, Nz, dtype, mesh=None):
+def build_rb_solver(Nx, Nz, dtype, mesh=None, matsolver=None):
     import dedalus_tpu.public as d3
     Lx, Lz = 4.0, 1.0
     coords = d3.CartesianCoordinates("x", "z")
@@ -40,7 +40,10 @@ def build_rb_solver(Nx, Nz, dtype, mesh=None):
     problem.add_equation("b(z=Lz) = 0")
     problem.add_equation("u(z=Lz) = 0")
     problem.add_equation("integ(p) = 0")
-    solver = problem.build_solver(d3.RK222)
+    # matsolver=None defers to [linear algebra] MATRIX_SOLVER; callers on
+    # the headline banded configuration (bench/coldstart/serving) pass
+    # "banded" explicitly so their numbers do not depend on ambient config
+    solver = problem.build_solver(d3.RK222, matsolver=matsolver)
     b.fill_random("g", seed=42, distribution="normal", scale=1e-3)
     b["g"] += (Lz - z)
     return solver, b

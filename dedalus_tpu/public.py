@@ -106,3 +106,11 @@ def interpolate(arg, **positions):
         arg = Interpolate(arg, coord, position)
     return arg
 
+
+
+# Warm-pool solver service (dedalus_tpu/service/; docs/serving.md): the
+# lightweight blocking client for a `python -m dedalus_tpu serve` daemon.
+# Imported last; the client touches none of the solver stack — the
+# daemon owns all solver state and compilation.
+from .service.client import ServiceClient
+from .service.protocol import ServiceError, SpecError

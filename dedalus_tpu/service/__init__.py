@@ -1,0 +1,24 @@
+"""
+Warm-pool solver service: a long-running daemon holding an LRU pool of
+live, compiled solvers keyed by the persistent assembly-cache content
+key, serving problem specs + initial conditions over a local socket.
+
+    python -m dedalus_tpu serve --port 8751 --pool-size 4   # daemon
+    python -m dedalus_tpu submit --port 8751 --spec ... --dt ...
+
+Modules:
+  protocol.py — spec schema, frame codec, npz field payloads, registry
+  pool.py     — LRU of warm solvers (reset, eviction, hit/miss counters)
+  server.py   — accept loop, dispatch, graceful SIGTERM/SIGINT drain
+  client.py   — blocking client + `submit` CLI (no solver-stack import)
+
+See docs/serving.md for the protocol reference and operations guide.
+"""
+
+from .protocol import (PROBLEMS, ProtocolError, ServiceError, SpecError,
+                       register_problem, spec_digest, spec_name)
+from .client import RunResult, ServiceClient
+
+__all__ = ["PROBLEMS", "ProtocolError", "RunResult", "ServiceClient",
+           "ServiceError", "SpecError", "register_problem", "spec_digest",
+           "spec_name"]

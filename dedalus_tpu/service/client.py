@@ -1,0 +1,297 @@
+"""
+Blocking client for the warm-pool solver service, plus the
+`python -m dedalus_tpu submit` CLI.
+
+Deliberately lightweight: this module itself imports only the protocol
+codecs (json/socket/numpy) and never touches the solver stack — no
+fields, bases, or compiled programs load on the client side. (Reaching
+it through the `dedalus_tpu` package still executes the package root,
+which imports jax; the point is that the DAEMON owns all solver state
+and compilation, so a client process stays cheap after import.)
+
+    from dedalus_tpu.service.client import ServiceClient
+    client = ServiceClient(port=8751)
+    result = client.run({"problem": "diffusion", "params": {"size": 64}},
+                        ics={"u": ("g", u0)}, dt=1e-3, stop_iteration=100)
+    result.fields["u"]          # ('c', ndarray) final state, bit-exact
+    result.record["serving"]    # queue_sec / pool_verdict / ttfs
+
+Telemetry frames stream during the run; `run(on_record=...)` observes
+them live, and every streamed record is kept on the RunResult.
+"""
+
+import argparse
+import json
+import socket
+import sys
+
+import numpy as np
+
+from . import protocol
+from .protocol import ServiceError
+
+__all__ = ["RunResult", "ServiceClient", "main"]
+
+
+class RunResult:
+    """Everything one run request produced, in arrival order."""
+
+    def __init__(self):
+        self.ack = None         # pool verdict + queue_sec frame
+        self.progress = []      # streamed progress frames
+        self.records = []       # streamed telemetry records
+        self.result = None      # final result header
+        self.fields = {}        # {name: (layout, ndarray)} final state
+
+    @property
+    def record(self):
+        """The run's telemetry record (newest streamed one)."""
+        return self.records[-1] if self.records else None
+
+    @property
+    def serving(self):
+        return (self.result or {}).get("serving") or {}
+
+
+class ServiceClient:
+    """One-request-per-connection blocking client (the daemon serializes
+    execution on its worker thread; connections are cheap and keeping
+    them one-shot keeps drain semantics trivial)."""
+
+    def __init__(self, host="127.0.0.1", port=None, timeout=600.0):
+        if port is None:
+            raise ValueError("ServiceClient needs the daemon port (the "
+                             "'ready' banner printed by `serve` names it)")
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+
+    def _connect(self):
+        conn = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        return conn, conn.makefile("rb"), conn.makefile("wb")
+
+    def _simple(self, request, expect):
+        conn, rfile, wfile = self._connect()
+        try:
+            protocol.send_frame(wfile, request)
+            header, _payload = protocol.recv_frame(rfile)
+            if header is None:
+                raise ServiceError("closed", "daemon closed the connection")
+            if header.get("kind") == "error":
+                raise ServiceError(header.get("code", "error"),
+                                   header.get("message", ""))
+            if header.get("kind") != expect:
+                raise ServiceError(
+                    "protocol", f"expected {expect!r} reply, got "
+                    f"{header.get('kind')!r}")
+            return header
+        finally:
+            conn.close()
+
+    def ping(self):
+        return self._simple({"kind": "ping"}, "pong")
+
+    def stats(self):
+        return self._simple({"kind": "stats"}, "stats")
+
+    def shutdown(self):
+        """Ask the daemon to drain and exit (same path as SIGTERM)."""
+        return self._simple({"kind": "shutdown"}, "ok")
+
+    def run(self, spec, ics=None, dt=None, stop_iteration=None,
+            stop_sim_time=None, outputs=None, layout="c",
+            progress_every=0, checkpoint=None, resume=False,
+            request_id=None, on_record=None, on_progress=None):
+        """Submit one run and block until its result frame.
+
+        `ics` maps field name -> (layout, array) or a bare array (grid
+        layout). Raises ServiceError on a structured daemon error (e.g.
+        code 'bad-spec', 'draining', 'health')."""
+        header = {"kind": "run",
+                  "spec": protocol.normalize_spec(spec,
+                                                  check_registry=False),
+                  "dt": dt, "layout": layout}
+        if request_id is not None:
+            header["id"] = str(request_id)
+        if stop_iteration is not None:
+            header["stop_iteration"] = int(stop_iteration)
+        if stop_sim_time is not None:
+            header["stop_sim_time"] = float(stop_sim_time)
+        if outputs is not None:
+            header["outputs"] = list(outputs)
+        if progress_every:
+            header["progress_every"] = int(progress_every)
+        if checkpoint is not None:
+            header["checkpoint"] = (checkpoint if isinstance(checkpoint,
+                                                             dict)
+                                    else {"dir": str(checkpoint)})
+            header["resume"] = bool(resume)
+        payload = None
+        if ics:
+            norm = {}
+            for name, value in ics.items():
+                if isinstance(value, tuple):
+                    norm[name] = value
+                else:
+                    norm[name] = ("g", np.asarray(value))
+            payload = protocol.encode_fields(norm)
+        out = RunResult()
+        conn, rfile, wfile = self._connect()
+        try:
+            protocol.send_frame(wfile, header, payload=payload)
+            while True:
+                frame, frame_payload = protocol.recv_frame(rfile)
+                if frame is None:
+                    raise ServiceError(
+                        "closed", "daemon closed the stream before the "
+                        "result frame (see the daemon log)")
+                kind = frame.get("kind")
+                if kind == "error":
+                    raise ServiceError(frame.get("code", "error"),
+                                       frame.get("message", ""))
+                if kind == "ack":
+                    out.ack = frame
+                elif kind == "progress":
+                    out.progress.append(frame)
+                    if on_progress is not None:
+                        on_progress(frame)
+                elif kind == "result":
+                    out.result = frame
+                    if frame_payload:
+                        out.fields = protocol.decode_fields(frame_payload)
+                    return out
+                else:
+                    # telemetry: the metrics-sink record format IS the
+                    # wire format (kind step_metrics today; forward-
+                    # compatible with any future record kinds)
+                    out.records.append(frame)
+                    if on_record is not None:
+                        on_record(frame)
+        finally:
+            conn.close()
+
+
+# --------------------------------------------------------------- CLI
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m dedalus_tpu submit",
+        description="Submit one run to a `dedalus_tpu serve` daemon "
+                    "(docs/serving.md). Prints the ack, streamed "
+                    "telemetry summaries, and the result line; saves "
+                    "final fields with --out.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True,
+                        help="daemon port (from its ready banner)")
+    parser.add_argument("--spec", help="problem spec: inline JSON or a "
+                                       "path to a JSON file")
+    parser.add_argument("--ic", help="npz of initial conditions: members "
+                                     "named '<g|c>__<field>' (bare names "
+                                     "are taken as grid layout)")
+    parser.add_argument("--dt", type=float, help="timestep")
+    parser.add_argument("--stop-iteration", type=int, default=None)
+    parser.add_argument("--stop-sim-time", type=float, default=None)
+    parser.add_argument("--outputs", nargs="*", default=None,
+                        help="state fields to return (default: all)")
+    parser.add_argument("--layout", choices=("c", "g"), default="c",
+                        help="output layout (default: coefficient — "
+                             "bit-exact)")
+    parser.add_argument("--progress-every", type=int, default=0,
+                        help="stream a progress frame every N iterations")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="durable checkpoint directory for the served "
+                             "run (enables drain-time checkpointing)")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from the newest valid checkpoint in "
+                             "--checkpoint-dir before stepping")
+    parser.add_argument("--out", default=None,
+                        help="write the returned fields to this npz path")
+    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--ping", action="store_true",
+                        help="just ping the daemon and exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="print daemon/pool stats JSON and exit")
+    parser.add_argument("--shutdown", action="store_true",
+                        help="ask the daemon to drain and exit")
+    return parser
+
+
+def _load_spec(text):
+    if text is None:
+        raise SystemExit("submit: --spec is required for a run")
+    try:
+        if text.lstrip().startswith("{"):
+            return json.loads(text)
+        with open(text) as f:
+            return json.load(f)
+    except (OSError, ValueError) as exc:
+        raise SystemExit(f"submit: cannot load spec {text!r}: {exc}")
+
+
+def _load_ics(path):
+    if path is None:
+        return None
+    ics = {}
+    with np.load(path, allow_pickle=False) as npz:
+        for key in npz.files:
+            layout, sep, name = key.partition("__")
+            if sep == "__" and layout in ("g", "c") and name:
+                ics[name] = (layout, npz[key])
+            else:
+                ics[key] = ("g", npz[key])
+    return ics
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    client = ServiceClient(host=args.host, port=args.port,
+                           timeout=args.timeout)
+    try:
+        if args.ping:
+            client.ping()
+            print("pong")
+            return 0
+        if args.stats:
+            print(json.dumps(client.stats(), indent=2))
+            return 0
+        if args.shutdown:
+            client.shutdown()
+            print("draining")
+            return 0
+        if args.dt is None:
+            print("submit: --dt is required for a run", file=sys.stderr)
+            return 2
+        result = client.run(
+            _load_spec(args.spec), ics=_load_ics(args.ic), dt=args.dt,
+            stop_iteration=args.stop_iteration,
+            stop_sim_time=args.stop_sim_time, outputs=args.outputs,
+            layout=args.layout, progress_every=args.progress_every,
+            checkpoint=args.checkpoint_dir, resume=args.resume,
+            on_progress=lambda f: print(
+                f"progress: iteration={f['iteration']} "
+                f"sim_time={f['sim_time']:.6e}", file=sys.stderr))
+    except (ServiceError, OSError) as exc:
+        print(f"submit: {exc}", file=sys.stderr)
+        return 1
+    ack = result.ack or {}
+    serving = result.serving
+    print(f"ack: pool={ack.get('pool_verdict')} "
+          f"queue={ack.get('queue_sec')}s build={ack.get('build_sec')}s")
+    ttfs = serving.get("time_to_first_step_sec")
+    print(f"result: iteration={result.result['iteration']} "
+          f"sim_time={result.result['sim_time']:.6e} "
+          f"stopped_by={result.result['stopped_by']} "
+          f"time_to_first_step={ttfs}s")
+    rec = result.record
+    if rec:
+        print(f"telemetry: {rec.get('iterations')} iters at "
+              f"{rec.get('steps_per_sec')} steps/s "
+              f"({rec.get('phase_samples', 0)} phase samples)")
+    if args.out:
+        np.savez(args.out, **{f"{layout}__{name}": arr
+                              for name, (layout, arr)
+                              in result.fields.items()})
+        print(f"fields written: {args.out} "
+              f"({', '.join(sorted(result.fields))})")
+    return 0

@@ -1,0 +1,234 @@
+"""
+LRU pool of live, compiled solvers — the warm tier behind
+`python -m dedalus_tpu serve`.
+
+Entries are keyed by the PR-5 assembly-cache content key
+(tools/assembly_cache.pool_key: the equation-tree/NCC-data/basis/config
+fingerprint the persistent matrix cache already uses, composed with the
+timestepper scheme the step program compiled for), with the normalized
+spec digest as a fast-path alias — so two textually different specs that
+build the same problem converge on ONE warm entry. A pool miss pays the
+(assembly-cached) cold start once; every later request for the same spec
+shape reuses the built matrices, factorizations, AND the compiled step
+programs, so it starts in milliseconds.
+
+Reset discipline: a pooled solver is reset to its just-built state
+before EVERY request (state and RHS-parameter fields zeroed, clocks and
+timestepper history cleared, evaluator handlers restored to the build-
+time set, health/metrics accounting re-zeroed) and the request's initial
+conditions are applied on top. The compiled step programs are closures
+on the (unchanged) timestepper instance, so reset costs microseconds and
+never retraces — and because reset + IC install performs exactly the
+same field assignments a fresh in-process run would, served results are
+bit-identical to direct solves (tests/test_service.py asserts this).
+"""
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from . import protocol
+from ..tools import assembly_cache
+from ..tools.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PoolEntry", "SolverPool"]
+
+
+class PoolEntry:
+    """One warm solver plus the build-time snapshot reset restores."""
+
+    __slots__ = ("key", "spec", "solver", "build_sec", "base_handlers",
+                 "base_schedule", "created_ts", "last_used_ts", "uses")
+
+    def __init__(self, key, spec, solver, build_sec):
+        self.key = key
+        self.spec = spec
+        self.solver = solver
+        self.build_sec = build_sec
+        # the handler set present at registration (usually empty): per-
+        # request additions (the resilient loop's checkpoint FileHandler)
+        # are dropped by reset so one request's checkpoint cadence can
+        # never leak into the next
+        self.base_handlers = list(solver.evaluator.handlers)
+        self.base_schedule = [h.schedule_state() for h in self.base_handlers]
+        self.created_ts = time.time()
+        self.last_used_ts = self.created_ts
+        self.uses = 0
+
+    def describe(self):
+        return {
+            "key": self.key[:16],
+            "spec": protocol.spec_name(self.spec),
+            "pencil_shape": list(self.solver.pencil_shape),
+            "build_sec": round(self.build_sec, 4),
+            "uses": self.uses,
+            "age_sec": round(time.time() - self.created_ts, 1),
+        }
+
+
+class SolverPool:
+    """
+    Bounded LRU of PoolEntry. SOLVERS are single-owner (only the service
+    worker thread acquires/resets/steps them), but the bookkeeping dicts
+    are read by `stats()` from the server's per-connection reader
+    threads, so every entries/aliases mutation and the stats snapshot
+    take `_lock` (never held across a build or a solver reset).
+    `acquire(spec)` returns a reset-and-ready entry plus the pool
+    verdict — "hit" (warm solver reused), "warm-cache" (fresh build that
+    hit the persistent assembly cache), or "cold" (fresh build, fresh
+    assembly). Hit/miss/eviction/reset counters feed the `stats` reply
+    and the service telemetry records.
+    """
+
+    def __init__(self, size=None, allow_imports=False):
+        self.size = max(int(size if size is not None
+                            else cfg_get("service", "POOL_SIZE", "4")), 1)
+        self.allow_imports = bool(allow_imports)
+        self._entries = OrderedDict()   # pool key -> PoolEntry
+        self._aliases = {}              # spec digest -> pool key
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.resets = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    # ------------------------------------------------------------ lookup
+
+    def acquire(self, spec):
+        """Warm (or build) the solver for `spec`, reset it to a fresh-run
+        state, and return (entry, verdict, build_sec). Raises SpecError
+        for invalid specs; build failures propagate."""
+        spec = protocol.normalize_spec(spec)
+        digest = protocol.spec_digest(spec)
+        with self._lock:
+            key = self._aliases.get(digest)
+            entry = self._entries.get(key) if key else None
+            if entry is not None:
+                self.hits += 1
+                self._entries.move_to_end(entry.key)
+        if entry is not None:
+            verdict, build_sec = "hit", 0.0
+        else:
+            entry, verdict, build_sec = self._build(spec, digest)
+        entry.uses += 1
+        entry.last_used_ts = time.time()
+        self.reset_entry(entry)
+        return entry, verdict, build_sec
+
+    def peek(self, spec):
+        """Non-mutating lookup (no reset, no counters): the entry that
+        `acquire` would hit, or None."""
+        digest = protocol.spec_digest(spec)
+        with self._lock:
+            key = self._aliases.get(digest)
+            return self._entries.get(key) if key else None
+
+    def _build(self, spec, digest):
+        build = protocol.resolve_builder(spec,
+                                         allow_imports=self.allow_imports)
+        t0 = time.perf_counter()
+        solver = build()        # the long part: outside the lock
+        build_sec = time.perf_counter() - t0
+        verdict = ("warm-cache"
+                   if solver.build_phases.cache == "hit" else "cold")
+        key = assembly_cache.pool_key(solver) or f"spec:{digest}"
+        with self._lock:
+            self.misses += 1
+            existing = self._entries.get(key)
+            if existing is not None:
+                # a textually new spec converged on an already-warm
+                # problem: keep the warm entry (its step programs are
+                # compiled), let the duplicate build be garbage-
+                # collected, and alias the new digest so the NEXT
+                # occurrence is a plain hit
+                logger.info(f"pool: spec {digest[:8]} aliases warm entry "
+                            f"{key[:12]}")
+                self._aliases[digest] = key
+                self._entries.move_to_end(key)
+                return existing, verdict, build_sec
+            entry = PoolEntry(key, spec, solver, build_sec)
+            self._entries[key] = entry
+            self._aliases[digest] = key
+            self._evict()
+        logger.info(
+            f"pool: built {protocol.spec_name(spec)} ({verdict}, "
+            f"{build_sec:.2f}s, key {key[:12]}); {len(self)}/{self.size}")
+        return entry, verdict, build_sec
+
+    def _evict(self):
+        """Drop LRU entries above the budget (caller holds _lock)."""
+        while len(self._entries) > self.size:
+            key, entry = self._entries.popitem(last=False)
+            self._aliases = {d: k for d, k in self._aliases.items()
+                             if k != key}
+            self.evictions += 1
+            logger.info(f"pool: evicted {protocol.spec_name(entry.spec)} "
+                        f"(key {key[:12]}, {entry.uses} uses)")
+
+    # ------------------------------------------------------------- reset
+
+    def reset_entry(self, entry):
+        """Rewind one pooled solver to its just-built state. Everything a
+        run mutates is restored; the compiled step programs (closures on
+        the surviving timestepper/ops instances) are untouched, so the
+        next request never retraces."""
+        solver = entry.solver
+        # state + RHS-parameter fields: zero in coefficient layout (exact;
+        # the request's IC payload overwrites the fields it names)
+        for var in solver.state:
+            var["c"] = 0
+        for field in solver.eval_F.extra_fields:
+            field["c"] = 0
+        # clocks and stop criteria
+        solver.sim_time = solver.initial_sim_time = 0.0
+        solver.iteration = solver.initial_iteration = 0
+        solver.dt = None
+        solver.problem.sim_time = 0.0
+        solver.stop_sim_time = np.inf
+        solver.stop_iteration = np.inf
+        solver.stop_wall_time = np.inf
+        solver.start_time = time.time()
+        solver.warmup_time = None
+        solver._metrics_warm_pending = False
+        # timestepper: the scheme owns its per-run state and the reset
+        # that mirrors its __init__ (core/timesteppers.py reset_run —
+        # which also documents why the LHS factorization cache SURVIVES:
+        # keeping it takes one factor dispatch out of every warm-hit
+        # time-to-first-step)
+        solver.timestepper.reset_run()
+        # evaluator: drop per-request handlers (resilient checkpointing),
+        # restore build-time schedules
+        solver.evaluator.handlers[:] = list(entry.base_handlers)
+        for handler, state in zip(entry.base_handlers, entry.base_schedule):
+            handler.restore_schedule_state(state)
+        # per-run accounting: health latch + forensic ring, metrics
+        # counters/loop window, stale resilience summary
+        solver.resilience = None
+        solver.health.reset_run()
+        solver.metrics.reset_run()
+        self.resets += 1
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self):
+        """Snapshot for the `stats` reply — called from the server's
+        reader threads while the worker may be mutating the pool, hence
+        the lock around the entries iteration."""
+        with self._lock:
+            return {
+                "size": self.size,
+                "entries": [e.describe()
+                            for e in self._entries.values()],
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "resets": self.resets,
+            }

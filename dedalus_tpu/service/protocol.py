@@ -1,0 +1,307 @@
+"""
+Wire protocol of the warm-pool solver service (`python -m dedalus_tpu
+serve` / `submit`): problem-spec schema, message framing, and the
+npz field-payload codecs shared by server.py and client.py.
+
+Framing
+-------
+Every message is ONE frame on the stream:
+
+    <JSON header line, UTF-8, "\\n"-terminated>
+    <payload: exactly header["payload_bytes"] raw bytes, when present>
+
+Headers are flat JSON objects with a `kind` discriminator. Telemetry
+records stream back to the client as plain frames whose header IS the
+record — the `tools/metrics.py` JSONL sink format is the wire format, so
+a client can append streamed frames straight into a results-style file
+and `python -m dedalus_tpu report` reads them unchanged.
+
+Client -> server kinds:  run, ping, stats, shutdown
+Server -> client kinds:  ready (stdout banner, not a frame), ack,
+                         progress, step_metrics (telemetry), result,
+                         error, pong, stats
+
+Field payloads are `np.savez` archives: one member per field, named
+`<layout>__<fieldname>` with layout `g` (grid) or `c` (coefficient).
+Coefficient layout round-trips bit-exactly (no transform in the path),
+which is what makes served results bit-identical to in-process solves.
+
+Problem specs
+-------------
+A spec is a JSON object naming a registered problem builder plus its
+parameters:
+
+    {"problem": "diffusion",       "params": {"size": 64}}
+    {"problem": "rayleigh_benard", "params": {"Nx": 256, "Nz": 64}}
+    {"builder": "mypkg.mymod:make_solver", "params": {...}}
+
+`problem` resolves in the built-in registry below; `builder` imports a
+dotted `module:function` path ON THE SERVER and is therefore gated
+behind `serve --import-builders` (a local trust boundary: anyone who can
+reach the socket can already run code as the daemon's user, but the gate
+keeps accidental remote exposure from becoming an import primitive).
+Builders take the spec params as keyword arguments and return a built
+`InitialValueSolver`. Initial conditions arrive separately in the run
+request's field payload, so one pooled (compiled) solver serves many
+requests — the pool zeroes all state and RHS-parameter fields before
+each run and the request's payload overwrites the fields it names.
+"""
+
+import io
+import json
+
+import numpy as np
+
+__all__ = ["PROBLEMS", "ProtocolError", "SpecError", "ServiceError",
+           "decode_fields", "encode_fields", "normalize_spec",
+           "recv_frame", "register_problem", "resolve_builder",
+           "send_frame", "spec_digest", "spec_name"]
+
+# Defensive bounds: a stray client writing garbage at the socket must
+# produce a structured error, not an OOM in the daemon. The payload
+# bound is per frame and far above realistic field payloads (an RB
+# 256x64 f64 state is ~0.5 MB/field) while small enough that even a
+# handful of concurrent garbage connections cannot buffer their way to
+# gigabytes before spec validation runs.
+MAX_HEADER_BYTES = 1 << 20        # one JSON control line
+MAX_PAYLOAD_BYTES = 1 << 28       # npz field payload (256 MiB)
+
+
+class ProtocolError(Exception):
+    """Malformed frame or stream-level violation."""
+
+
+class SpecError(ValueError):
+    """Invalid problem spec or run parameters (maps to a structured
+    `error` reply with code 'bad-spec'; the daemon stays up)."""
+
+
+class ServiceError(RuntimeError):
+    """Client-side surface of a structured `error` reply."""
+
+    def __init__(self, code, message):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+# ---------------------------------------------------------------- framing
+
+def send_frame(wfile, header, payload=None):
+    """Write one frame (header dict + optional payload bytes) and flush."""
+    header = dict(header)
+    if payload is not None:
+        header["payload_bytes"] = len(payload)
+    wfile.write(json.dumps(header).encode() + b"\n")
+    if payload is not None:
+        wfile.write(payload)
+    wfile.flush()
+
+
+def recv_frame(rfile):
+    """Read one frame. Returns (header, payload_or_None); None header on
+    clean EOF. Raises ProtocolError on garbage or truncation."""
+    line = rfile.readline(MAX_HEADER_BYTES + 1)
+    if not line:
+        return None, None
+    if len(line) > MAX_HEADER_BYTES:
+        raise ProtocolError("header line exceeds the size bound")
+    try:
+        header = json.loads(line.decode())
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise ProtocolError(f"unparsable header: {exc}")
+    if not isinstance(header, dict):
+        raise ProtocolError("header is not a JSON object")
+    n = header.get("payload_bytes", 0)
+    if not isinstance(n, int) or n < 0 or n > MAX_PAYLOAD_BYTES:
+        raise ProtocolError(f"bad payload_bytes: {n!r}")
+    payload = None
+    if n:
+        payload = rfile.read(n)
+        if len(payload) != n:
+            raise ProtocolError(
+                f"truncated payload: expected {n} bytes, got {len(payload)}")
+    return header, payload
+
+
+# ------------------------------------------------------- field payloads
+
+def encode_fields(fields):
+    """npz-encode {name: (layout, array)} field data. Layout is 'g'
+    (grid) or 'c' (coefficient); coefficient arrays round-trip
+    bit-exactly."""
+    members = {}
+    for name, (layout, array) in fields.items():
+        if layout not in ("g", "c"):
+            raise SpecError(f"field {name!r}: unknown layout {layout!r}")
+        members[f"{layout}__{name}"] = np.asarray(array)
+    buf = io.BytesIO()
+    np.savez(buf, **members)
+    return buf.getvalue()
+
+
+def decode_fields(payload):
+    """Decode an npz field payload to {name: (layout, array)}."""
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as npz:
+            out = {}
+            for key in npz.files:
+                layout, sep, name = key.partition("__")
+                if sep != "__" or layout not in ("g", "c") or not name:
+                    raise SpecError(
+                        f"field payload member {key!r}: expected "
+                        "'<g|c>__<fieldname>'")
+                out[name] = (layout, npz[key])
+            return out
+    except SpecError:
+        raise
+    except Exception as exc:
+        raise SpecError(f"unreadable field payload: {exc}")
+
+
+# ------------------------------------------------------ problem registry
+
+def _build_diffusion(size=64, dtype="float64", scheme="SBDF2",
+                     warmup_iterations=2):
+    """1-D forced heat IVP `dt(u) - lap(u) = a*u` with a parameter field
+    `a` (an RHS extra operand), mirroring benchmarks/ensemble.py — the
+    dispatch-bound serving regime."""
+    from .. import public as d3
+    size = int(size)
+    if size < 4:
+        raise SpecError(f"diffusion: size {size} too small")
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.dtype(dtype))
+    xb = d3.RealFourier(xc, size=size, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    a = dist.Field(name="a", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "a": a, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = a*u")
+    scheme_cls = _scheme(scheme)
+    return problem.build_solver(scheme_cls, enforce_real_cadence=0,
+                                warmup_iterations=int(warmup_iterations))
+
+
+def _build_rayleigh_benard(Nx=256, Nz=64, dtype="float64",
+                           matsolver=None):
+    """The 2-D Rayleigh-Benard flagship (extras/bench_problems.py) — the
+    compute-bound serving regime. ICs come from the request payload (the
+    builder's random fill is zeroed by the pool reset). `matsolver`
+    ("banded" on the headline configuration) rides into the assembly and
+    pool keys, so requests differing in it never share an entry."""
+    from ..extras.bench_problems import build_rb_solver
+    if matsolver is not None and str(matsolver).lower() not in (
+            "auto", "banded", "dense"):
+        raise SpecError(f"rayleigh_benard: matsolver {matsolver!r} not in "
+                        "auto|banded|dense")
+    solver, b = build_rb_solver(int(Nx), int(Nz), np.dtype(dtype),
+                                matsolver=matsolver)
+    return solver
+
+
+def _scheme(name):
+    from ..core import timesteppers
+    try:
+        return timesteppers.schemes[str(name)]
+    except KeyError:
+        raise SpecError(f"unknown timestepper scheme {name!r} "
+                        f"(known: {sorted(timesteppers.schemes)})")
+
+
+PROBLEMS = {
+    "diffusion": _build_diffusion,
+    "rayleigh_benard": _build_rayleigh_benard,
+}
+
+
+def register_problem(name, builder):
+    """Register a named problem builder (server-side extension point:
+    import your module before `serve_forever`, or ship it behind
+    `--import-builders` dotted specs)."""
+    PROBLEMS[str(name)] = builder
+
+
+def normalize_spec(spec, check_registry=True):
+    """Validate and canonicalize one spec dict. Returns
+    {"problem"|"builder": str, "params": dict} with params JSON-clean.
+    `check_registry=False` skips the registered-problem membership test —
+    the CLIENT normalizes structurally only (the daemon's registry, which
+    may hold extra `register_problem` entries, is authoritative)."""
+    if not isinstance(spec, dict):
+        raise SpecError(f"spec must be a JSON object, got "
+                        f"{type(spec).__name__}")
+    kind = [k for k in ("problem", "builder") if spec.get(k)]
+    if len(kind) != 1:
+        raise SpecError("spec needs exactly one of 'problem' (registered "
+                        "name) or 'builder' (module:function)")
+    params = spec.get("params", {})
+    if not isinstance(params, dict):
+        raise SpecError("spec 'params' must be a JSON object")
+    try:
+        params = json.loads(json.dumps(params, sort_keys=True))
+    except (TypeError, ValueError) as exc:
+        raise SpecError(f"spec params are not JSON-serializable: {exc}")
+    out = {kind[0]: str(spec[kind[0]]), "params": params}
+    if check_registry and kind[0] == "problem" \
+            and out["problem"] not in PROBLEMS:
+        raise SpecError(f"unknown problem {out['problem']!r} "
+                        f"(registered: {sorted(PROBLEMS)})")
+    return out
+
+
+def spec_name(spec):
+    """Short human name of a spec (telemetry `config` stem)."""
+    if "problem" in spec:
+        return spec["problem"]
+    return spec.get("builder", "?").rpartition(":")[2] or "builder"
+
+
+def spec_digest(spec):
+    """Content digest of a normalized spec — the pool's fast-path alias
+    key (the authoritative identity is the assembly-cache pool key
+    computed from the BUILT solver; textually different specs that build
+    the same problem converge there)."""
+    import hashlib
+    blob = json.dumps(normalize_spec(spec), sort_keys=True).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def resolve_builder(spec, allow_imports=False):
+    """Resolve a normalized spec to a zero-argument builder callable."""
+    spec = normalize_spec(spec)
+    params = spec["params"]
+    if "problem" in spec:
+        builder = PROBLEMS[spec["problem"]]
+    else:
+        if not allow_imports:
+            raise SpecError(
+                "dotted 'builder' specs are disabled on this daemon "
+                "(start it with --import-builders to allow server-side "
+                "imports from trusted local clients)")
+        module_name, sep, func_name = spec["builder"].partition(":")
+        if not (module_name and sep and func_name):
+            raise SpecError(f"builder {spec['builder']!r} is not of the "
+                            "form 'module:function'")
+        import importlib
+        try:
+            module = importlib.import_module(module_name)
+            builder = getattr(module, func_name)
+        except (ImportError, AttributeError) as exc:
+            raise SpecError(f"cannot import builder "
+                            f"{spec['builder']!r}: {exc}")
+
+    def build():
+        try:
+            solver = builder(**params)
+        except SpecError:
+            raise
+        except TypeError as exc:
+            # bad parameter names/arity surface as spec errors, not 500s
+            raise SpecError(f"builder rejected params {params}: {exc}")
+        if solver is None or not hasattr(solver, "step"):
+            raise SpecError(
+                f"builder for {spec_name(spec)!r} did not return an IVP "
+                f"solver (got {type(solver).__name__})")
+        return solver
+
+    return build

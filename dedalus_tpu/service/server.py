@@ -1,0 +1,576 @@
+"""
+The warm-pool solver daemon: `python -m dedalus_tpu serve`.
+
+One accept loop (main thread) spawns a lightweight reader thread per
+connection: control requests (`ping`/`stats`/`shutdown`) are answered
+immediately there — never starved behind a long run — while `run`
+requests enqueue for the SINGLE executor thread that owns every solver
+in the LRU pool (service/pool.py). JAX dispatch stays single-threaded,
+and the queue wait is measured per request as `queue_sec`. Each run
+executes through the existing resilient evolve path
+(tools/resilience.ResilientLoop), so a served run gets the same
+snapshot-rewind/dt-backoff recovery and durable checkpointing as a
+local `solver.evolve_resilient(...)` call.
+
+Graceful drain: SIGTERM/SIGINT (or a `shutdown` request) stop the accept
+loop, request a cooperative stop on the in-flight loop via the PR-4
+stop-request machinery — the current step completes, a final durable
+checkpoint is written when the request configured one, and the client
+receives its telemetry + result frames — then queued-but-unstarted
+connections get a structured `draining` error and the daemon exits 0
+after flushing a `service_stats` record to the telemetry sink.
+
+Served-latency fields stamped on every request's telemetry record
+(under `serving`; tools/metrics.py documents the vocabulary):
+`queue_sec`, `pool_verdict` (hit | warm-cache | cold),
+`time_to_first_step_sec` (dispatch start -> first step complete,
+INCLUDING any build/compile a pool miss pays — the metric the warm pool
+exists to collapse), `build_sec`, and `request_id`.
+"""
+
+import argparse
+import json
+import logging
+import queue
+import signal
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+from . import protocol
+from .pool import SolverPool
+from ..tools import metrics as metrics_mod
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SolverService", "main"]
+
+
+class SolverService:
+
+    def __init__(self, host="127.0.0.1", port=0, pool_size=None, sink=None,
+                 allow_imports=False, drain_grace=600.0):
+        self.host = host
+        self.port = int(port)
+        self.pool = SolverPool(size=pool_size, allow_imports=allow_imports)
+        self.sink = str(sink) if sink else None
+        self.drain_grace = float(drain_grace)
+        self.requests_served = 0
+        self.errors = 0
+        self._request_seq = 0     # default-id counter: EVERY run request
+                                  # advances it (success or not), so ids
+                                  # in the telemetry sink never collide
+        # errors is bumped from reader threads, the worker, and the
+        # drain sweep concurrently; unguarded `+= 1` loses increments
+        self._errors_lock = threading.Lock()
+        self.started_ts = None
+        self._queue = queue.Queue()
+        self._draining = None
+        self._active_loop = None
+        self._active_lock = threading.Lock()
+        self._sock = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def request_drain(self, why):
+        """Begin a graceful drain (signal handler, `shutdown` request, or
+        tests): refuse new work and cooperatively stop the in-flight run
+        so it checkpoints before the daemon exits."""
+        if self._draining is None:
+            self._draining = str(why)
+            logger.warning(f"service: draining ({why}) — in-flight run "
+                           "will checkpoint and stop")
+        with self._active_lock:
+            loop = self._active_loop
+        if loop is not None:
+            loop.request_stop(str(why))
+
+    def _handle_signal(self, signum, frame):
+        self.request_drain(signal.Signals(signum).name)
+
+    def serve_forever(self, ready_stream=None):
+        """Bind, announce readiness, and serve until drained. Prints ONE
+        JSON line {"kind": "ready", "port": N, "pid": ...} to
+        `ready_stream` (default stdout) once accepting — the handshake
+        benchmark/test drivers wait on."""
+        previous = {}
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                previous[signum] = signal.signal(signum, self._handle_signal)
+            except (ValueError, OSError):
+                pass   # non-main thread (in-process tests): drain via
+                       # request_drain/shutdown only
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._sock.settimeout(0.2)
+        self.started_ts = time.time()
+        worker = threading.Thread(target=self._worker, name="service-worker",
+                                  daemon=True)
+        worker.start()
+        import os
+        banner = {"kind": "ready", "port": self.port, "pid": os.getpid(),
+                  "pool_size": self.pool.size}
+        stream = ready_stream if ready_stream is not None else sys.stdout
+        print(json.dumps(banner), file=stream, flush=True)
+        logger.info(f"service: listening on {self.host}:{self.port} "
+                    f"(pool size {self.pool.size})")
+        try:
+            while self._draining is None:
+                try:
+                    conn, _addr = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._receive,
+                                 args=(conn, time.perf_counter()),
+                                 daemon=True).start()
+        finally:
+            self._sock.close()
+            self._queue.put(None)           # worker stop sentinel
+            worker.join(timeout=self.drain_grace)
+            if worker.is_alive():
+                logger.error("service: worker did not drain within "
+                             f"{self.drain_grace}s; exiting anyway")
+            self._refuse_queued()
+            self._flush_stats()
+            for signum, handler in previous.items():
+                try:
+                    signal.signal(signum, handler)
+                except (ValueError, OSError):
+                    pass
+        logger.info(f"service: stopped ({self._draining})")
+
+    def _flush_stats(self):
+        """One `service_stats` record to the sink (and the log) at drain:
+        pool hit/miss/eviction counters + request totals, so the serving
+        trajectory is machine-recorded like every other subsystem."""
+        record = dict(self.stats(), kind="service_stats",
+                      ts=round(time.time(), 1))
+        if self.sink:
+            sink = metrics_mod.Metrics(sink=self.sink, enabled=True)
+            sink.emit(record)
+        logger.info(f"service: final stats {json.dumps(record)}")
+
+    def stats(self):
+        return {
+            "requests_served": self.requests_served,
+            "errors": self.errors,
+            "draining": self._draining,
+            "uptime_sec": round(time.time() - self.started_ts, 1)
+            if self.started_ts else 0.0,
+            "pool": self.pool.stats(),
+        }
+
+    # ----------------------------------------------------- reader threads
+
+    def _receive(self, conn, t_accept):
+        """Per-connection reader: parse the one request frame, answer
+        control kinds inline (so `shutdown` can drain an in-flight run
+        and `ping`/`stats` stay responsive during one), and enqueue runs
+        for the single executor. Closes the connection itself on every
+        path except a queued run (the worker owns that close)."""
+        enqueued = False
+        try:
+            conn.settimeout(60.0)
+            rfile = conn.makefile("rb")
+            wfile = conn.makefile("wb")
+            try:
+                header, payload = protocol.recv_frame(rfile)
+            except (protocol.ProtocolError, OSError) as exc:
+                self._count_error()
+                self._send_error(wfile, "bad-frame", str(exc))
+                return
+            if header is None:
+                return
+            kind = header.get("kind")
+            if kind == "ping":
+                protocol.send_frame(wfile, {"kind": "pong"})
+            elif kind == "stats":
+                protocol.send_frame(wfile, dict(self.stats(),
+                                                kind="stats"))
+            elif kind == "shutdown":
+                protocol.send_frame(wfile, {"kind": "ok",
+                                            "draining": True})
+                self.request_drain("shutdown request")
+            elif kind == "run":
+                if self._draining is not None:
+                    self._count_error()
+                    self._send_error(
+                        wfile, "draining",
+                        f"daemon is draining ({self._draining})")
+                    return
+                self._queue.put((conn, wfile, header, payload, t_accept))
+                enqueued = True
+            else:
+                self._count_error()
+                self._send_error(wfile, "unknown-kind",
+                                 f"unknown request kind {kind!r}")
+        except Exception:
+            self._count_error()
+            logger.exception("service: connection reader failed")
+        finally:
+            if not enqueued:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------- worker
+
+    def _worker(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            conn, wfile, header, payload, t_accept = item
+            try:
+                if self._draining is not None:
+                    # drain began while this run sat in the queue
+                    self._count_error()
+                    self._send_error(
+                        wfile, "draining",
+                        f"daemon is draining ({self._draining})")
+                else:
+                    self._handle_run(header, payload, wfile, t_accept)
+            except Exception:
+                self._count_error()
+                logger.exception("service: connection handler failed")
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _refuse_queued(self):
+        """After the worker exits, answer any run a reader enqueued in
+        the drain race window with a structured refusal."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is None:
+                continue
+            conn, wfile = item[0], item[1]
+            self._send_error(wfile, "draining",
+                             f"daemon is draining ({self._draining})")
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _count_error(self):
+        with self._errors_lock:
+            self.errors += 1
+
+    @staticmethod
+    def _send_error(wfile, code, message):
+        try:
+            protocol.send_frame(wfile, {"kind": "error", "code": code,
+                                        "message": message})
+        except OSError:
+            pass   # client gone; nothing to tell it
+
+    # ---------------------------------------------------------------- run
+
+    @staticmethod
+    def _run_params(header):
+        """Validate the run request's parameters (everything outside the
+        spec). Raises SpecError with a message naming the field."""
+        dt = header.get("dt")
+        if not isinstance(dt, (int, float)) or not np.isfinite(dt) or dt <= 0:
+            raise protocol.SpecError(f"run: dt must be a positive finite "
+                                     f"number, got {dt!r}")
+        stop_iteration = header.get("stop_iteration")
+        stop_sim_time = header.get("stop_sim_time")
+        if stop_iteration is None and stop_sim_time is None:
+            raise protocol.SpecError(
+                "run: one of stop_iteration / stop_sim_time is required")
+        if stop_iteration is not None and (
+                not isinstance(stop_iteration, int) or stop_iteration < 1):
+            raise protocol.SpecError(
+                f"run: stop_iteration must be a positive integer, got "
+                f"{stop_iteration!r}")
+        if stop_sim_time is not None and (
+                not isinstance(stop_sim_time, (int, float))
+                or not np.isfinite(stop_sim_time) or stop_sim_time <= 0):
+            raise protocol.SpecError(
+                f"run: stop_sim_time must be positive and finite, got "
+                f"{stop_sim_time!r}")
+        layout = header.get("layout", "c")
+        if layout not in ("c", "g"):
+            raise protocol.SpecError(f"run: layout must be 'c' or 'g', "
+                                     f"got {layout!r}")
+        outputs = header.get("outputs")
+        if outputs is not None and (
+                not isinstance(outputs, list)
+                or not all(isinstance(n, str) for n in outputs)):
+            raise protocol.SpecError("run: outputs must be a list of "
+                                     "field names")
+        checkpoint = header.get("checkpoint")
+        if checkpoint is not None:
+            if not (isinstance(checkpoint, dict) and checkpoint.get("dir")):
+                raise protocol.SpecError(
+                    "run: checkpoint must be {'dir': path, 'iter': N?}")
+            ckpt_iter = checkpoint.get("iter") or 0
+            if not isinstance(ckpt_iter, int) or ckpt_iter < 0:
+                raise protocol.SpecError(
+                    f"run: checkpoint iter must be a non-negative "
+                    f"integer, got {checkpoint.get('iter')!r}")
+            checkpoint = {"dir": str(checkpoint["dir"]), "iter": ckpt_iter}
+        progress_every = header.get("progress_every") or 0
+        if not isinstance(progress_every, int) or progress_every < 0:
+            raise protocol.SpecError(
+                f"run: progress_every must be a non-negative integer, "
+                f"got {header.get('progress_every')!r}")
+        return {
+            "dt": float(dt),
+            "stop_iteration": stop_iteration,
+            "stop_sim_time": stop_sim_time,
+            "layout": layout,
+            "outputs": outputs,
+            "checkpoint": checkpoint,
+            "resume": bool(header.get("resume")),
+            "progress_every": progress_every,
+        }
+
+    @staticmethod
+    def _fields_by_name(solver):
+        """Addressable fields of one solver: state variables plus the
+        RHS-parameter (extra) fields — both settable as initial
+        conditions and returnable as outputs."""
+        by_name = {}
+        for var in solver.state:
+            by_name[var.name] = var
+        for field in solver.eval_F.extra_fields:
+            by_name.setdefault(field.name, field)
+        return by_name
+
+    @classmethod
+    def _install_ics(cls, solver, ics):
+        """Apply the request's field payload onto the (reset) solver.
+        Targets state variables and RHS-parameter (extra) fields by name;
+        unknown names are a spec error BEFORE any stepping."""
+        by_name = cls._fields_by_name(solver)
+        for name, (layout, array) in ics.items():
+            field = by_name.get(name)
+            if field is None:
+                raise protocol.SpecError(
+                    f"run: unknown field {name!r} in initial conditions "
+                    f"(known: {sorted(k for k in by_name if k)})")
+            try:
+                field[layout] = array
+            except (ValueError, TypeError) as exc:
+                raise protocol.SpecError(
+                    f"run: initial condition for {name!r} rejected: {exc}")
+
+    @classmethod
+    def _output_fields(cls, solver, names):
+        """Resolve the requested output field list (None: all state
+        variables). Unknown names are a spec error — a typo'd output must
+        fail loudly before stepping, not return an empty payload."""
+        if names is None:
+            return list(solver.state)
+        by_name = cls._fields_by_name(solver)
+        unknown = [n for n in names if n not in by_name]
+        if unknown:
+            raise protocol.SpecError(
+                f"run: unknown output field(s) {unknown} "
+                f"(known: {sorted(k for k in by_name if k)})")
+        return [by_name[n] for n in names]
+
+    def _handle_run(self, header, payload, wfile, t_accept):
+        from ..tools.resilience import ResilientLoop
+        from ..tools.exceptions import SolverHealthError
+        import jax
+        t_dispatch = time.perf_counter()
+        queue_sec = t_dispatch - t_accept
+        self._request_seq += 1
+        request_id = str(header.get("id") or f"r{self._request_seq}")
+        try:
+            spec = protocol.normalize_spec(header.get("spec"))
+            params = self._run_params(header)
+            ics = protocol.decode_fields(payload) if payload else {}
+            entry, verdict, build_sec = self.pool.acquire(spec)
+            solver = entry.solver
+            self._install_ics(solver, ics)
+            targets = self._output_fields(solver, params["outputs"])
+        except protocol.SpecError as exc:
+            self._count_error()
+            self._send_error(wfile, "bad-spec", str(exc))
+            return
+        except Exception as exc:
+            # a builder blowing up on technically-valid params (resolution
+            # the basis rejects, singular operator, ...) must reply
+            # structurally, not drop the connection
+            self._count_error()
+            logger.exception(f"service: build for request {request_id} "
+                             "failed")
+            self._send_error(wfile, "build-failed",
+                             f"{type(exc).__name__}: {exc}")
+            return
+        if params["stop_iteration"] is not None:
+            solver.stop_iteration = params["stop_iteration"]
+        if params["stop_sim_time"] is not None:
+            solver.stop_sim_time = params["stop_sim_time"]
+        solver.metrics.sink = self.sink
+        solver.metrics.meta["config"] = f"{protocol.spec_name(spec)}_served"
+        protocol.send_frame(wfile, {
+            "kind": "ack", "id": request_id, "pool_verdict": verdict,
+            "queue_sec": round(queue_sec, 6),
+            "build_sec": round(build_sec, 4)})
+
+        ttfs = [None]
+        progress_every = params["progress_every"]
+        progress_next = [progress_every]
+
+        def step_hook(s):
+            # first completed step: block so time-to-first-step covers the
+            # device tail (and, on a miss, the build + compile it followed)
+            if ttfs[0] is None:
+                jax.block_until_ready(s.X)
+                ttfs[0] = time.perf_counter() - t_dispatch
+            if progress_every and s.iteration >= progress_next[0]:
+                progress_next[0] = s.iteration + progress_every
+                try:
+                    protocol.send_frame(wfile, {
+                        "kind": "progress", "id": request_id,
+                        "iteration": int(s.iteration),
+                        "sim_time": float(s.sim_time)})
+                except OSError:
+                    pass   # client hung up; finish the run regardless
+
+        loop_kw = {}
+        checkpoint = params["checkpoint"]
+        if checkpoint is not None:
+            loop_kw["checkpoint_dir"] = checkpoint["dir"]
+            loop_kw["checkpoint_iter"] = checkpoint["iter"]
+            loop_kw["resume"] = params["resume"]
+        # the service owns this run's single telemetry flush (serving
+        # fields stamped on it); the loop's own exit flush is suppressed
+        loop = ResilientLoop(solver, dt=params["dt"], step_hook=step_hook,
+                             install_signal_handlers=False,
+                             flush_telemetry=False, **loop_kw)
+        with self._active_lock:
+            self._active_loop = loop
+        if self._draining is not None:
+            # drain began between queue pop and loop construction: stop at
+            # the first boundary, still writing the final checkpoint
+            loop.request_stop(self._draining)
+        try:
+            summary = loop.run(log_cadence=0)
+        except SolverHealthError as exc:
+            self._count_error()
+            serving = {"queue_sec": round(queue_sec, 6),
+                       "pool_verdict": verdict,
+                       "time_to_first_step_sec": ttfs[0],
+                       "build_sec": round(build_sec, 4),
+                       "request_id": request_id}
+            try:
+                solver.flush_metrics(extra={"serving": serving})
+            except Exception:
+                pass
+            self._send_error(
+                wfile, "health",
+                f"run halted unrecoverably: {getattr(exc, 'reason', exc)}")
+            return
+        except Exception as exc:
+            self._count_error()
+            logger.exception(f"service: request {request_id} failed")
+            self._send_error(wfile, "internal",
+                             f"{type(exc).__name__}: {exc}")
+            return
+        finally:
+            with self._active_lock:
+                self._active_loop = None
+        serving = {
+            "queue_sec": round(queue_sec, 6),
+            "pool_verdict": verdict,
+            "time_to_first_step_sec": round(ttfs[0], 6)
+            if ttfs[0] is not None else None,
+            "build_sec": round(build_sec, 4),
+            "request_id": request_id,
+        }
+        record = None
+        try:
+            record = solver.flush_metrics(extra={"serving": serving})
+        except Exception as exc:
+            logger.warning(f"service: telemetry flush failed: {exc}")
+        if record is not None:
+            try:
+                protocol.send_frame(wfile, record)
+            except (TypeError, ValueError):
+                logger.warning("service: telemetry record not "
+                               "JSON-serializable; skipped")
+            except OSError:
+                pass
+        out_fields = {}
+        for var in targets:
+            if params["layout"] == "c":
+                out_fields[var.name] = ("c", np.asarray(var.coeff_data()))
+            else:
+                out_fields[var.name] = ("g", np.array(var["g"]))
+        result = {
+            "kind": "result", "id": request_id,
+            "iteration": int(solver.iteration),
+            "sim_time": float(solver.sim_time),
+            "stopped_by": summary.get("stopped_by"),
+            "rewinds": summary.get("rewinds", 0),
+            "serving": serving,
+        }
+        if summary.get("resumed_from"):
+            result["resumed_from"] = summary["resumed_from"]
+        try:
+            protocol.send_frame(wfile, result,
+                                payload=protocol.encode_fields(out_fields))
+        except OSError:
+            logger.warning(f"service: client for {request_id} hung up "
+                           "before the result frame")
+        self.requests_served += 1
+
+
+# --------------------------------------------------------------- CLI
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m dedalus_tpu serve",
+        description="Warm-pool solver daemon: LRU pool of live compiled "
+                    "solvers served over a local socket (docs/serving.md).")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address (default: %(default)s)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="TCP port; 0 picks an ephemeral port, "
+                             "announced on stdout (default: %(default)s)")
+    parser.add_argument("--pool-size", type=int, default=None,
+                        help="warm solver entries kept (default: "
+                             "[service] POOL_SIZE, else 4)")
+    parser.add_argument("--sink", default=None,
+                        help="JSONL telemetry sink for served records "
+                             "(tools/metrics.py format)")
+    parser.add_argument("--import-builders", action="store_true",
+                        help="allow dotted module:function builder specs "
+                             "(server-side imports; trusted clients only)")
+    parser.add_argument("--drain-grace", type=float, default=600.0,
+                        help="seconds to wait for the in-flight run at "
+                             "drain (default: %(default)s)")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s :: %(message)s")
+    service = SolverService(
+        host=args.host, port=args.port, pool_size=args.pool_size,
+        sink=args.sink, allow_imports=args.import_builders,
+        drain_grace=args.drain_grace)
+    service.serve_forever()
+    return 0

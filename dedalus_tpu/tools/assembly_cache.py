@@ -46,7 +46,7 @@ from .config import config
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["AssemblyCache", "resolve", "solver_key", "clear"]
+__all__ = ["AssemblyCache", "pool_key", "resolve", "solver_key", "clear"]
 
 FORMAT_VERSION = 2
 
@@ -261,6 +261,41 @@ def solver_key(solver, names):
     except Exception as exc:
         logger.debug(f"assembly cache: fingerprint failed ({exc!r})")
         return None
+
+
+def pool_key(solver):
+    """Warm-pool identity of a BUILT solver — the key the service tier
+    (dedalus_tpu/service/pool.py) stores live compiled solvers under.
+
+    It is the assembly-cache content key (reusing the key stashed at
+    build time as `solver.assembly_key` when the persistent cache
+    computed one, recomputing otherwise) composed with everything else
+    that makes two LIVE solvers interchangeable but that the assembly
+    key deliberately excludes (M/L matrices are scheme-independent, so
+    cached matrices shard across these):
+
+      * the timestepper scheme — the compiled step programs and
+        factorizations a pooled entry holds are scheme-specific;
+      * the run-behavior knobs (`warmup_iterations`,
+        `enforce_real_cadence`) — two specs that build identical
+        matrices but different Hermitian-projection cadences would
+        produce DIFFERENT trajectories from one shared entry.
+
+    Returns None when the problem graph cannot be fingerprinted; the
+    pool then falls back to its normalized-spec digest."""
+    key = getattr(solver, "assembly_key", None)
+    if key is None:
+        key = solver_key(solver, solver.matrices)
+    if key is None:
+        return None
+    ts = getattr(solver, "timestepper", None)
+    h = hashlib.blake2b(digest_size=20)
+    _fp_update(h, "pool", key,
+               "scheme", type(ts).__name__ if ts is not None else None,
+               "warmup", getattr(solver, "warmup_iterations", None),
+               "enforce_real", getattr(solver, "enforce_real_cadence",
+                                       None))
+    return h.hexdigest()
 
 
 # ------------------------------------------------------------- disk store

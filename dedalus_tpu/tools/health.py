@@ -171,6 +171,20 @@ class HealthMonitor:
             self.solver._health_error = None
             self.gate.reset(int(self.solver.iteration))
 
+    def reset_run(self):
+        """Fresh-run reset for a POOLED solver (service/pool.py), called
+        between served requests: clears the failure latch AND the per-run
+        forensic state — unlike `reset_failure`, which deliberately
+        preserves the ring and counters across a resilient rewind within
+        one run. The compiled probe survives (it is what makes the pool
+        warm); postmortem dumps already written stay on disk."""
+        self.ring.clear()
+        self.checks = 0
+        self.warnings = 0
+        self.postmortem_path = None
+        self._warned = set()
+        self.reset_failure()
+
     def attach_dt_source(self, cfl):
         """Register a CFL controller whose dt/frequency history feeds the
         flight recorder (extras.flow_tools.CFL self-registers)."""

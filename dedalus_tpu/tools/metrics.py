@@ -23,6 +23,16 @@ Flush emits ONE record per call, shaped like `benchmarks/results.jsonl`
 rows (flat JSON object, `ts` + `config`/`backend`/`dtype` keys) with the
 phase breakdown attached; `python -m dedalus_tpu report <file.jsonl>`
 summarizes the records.
+
+Served-latency vocabulary: records flushed by the warm-pool service
+(dedalus_tpu/service/) carry a `serving` sub-dict —
+`queue_sec` (accept -> dispatch wait), `pool_verdict`
+("hit" | "warm-cache" | "cold": warm pool reuse / fresh build off the
+persistent assembly cache / fully cold build), `time_to_first_step_sec`
+(dispatch -> first step complete, including any build+compile a miss
+pays), `build_sec`, and `request_id`. This sink format doubles as the
+service's wire format, so streamed frames and the daemon's JSONL file
+are the same records.
 """
 
 import atexit
@@ -231,6 +241,9 @@ class Metrics:
                  sampling=True, meta=None):
         self.enabled = bool(enabled)
         self.sampling = bool(sampling) and self.enabled
+        # constructed intent, restored by reset_run(): the phase-sampling
+        # firewall (_try_sample_phases) may flip `sampling` off mid-run
+        self._sampling_default = self.sampling
         self.sample_cadence = int(sample_cadence)
         self.sink = str(sink) if sink else None
         self.meta = dict(meta or {})
@@ -245,6 +258,28 @@ class Metrics:
         self._loop_t0 = None
         self._gate = CadenceGate(self.sample_cadence)
         self._warmed = set()
+
+    def reset_run(self, meta=None):
+        """Zero the per-run accounting (counters, phase samples, memory
+        watermark, loop window, dirty latch) while keeping identity:
+        sink, cadence, enabled flags, meta, and retrace-sentinel
+        subscriptions all survive. The warm-pool service
+        (dedalus_tpu/service/pool.py) calls this between requests so one
+        Metrics instance per pooled solver serves many runs without one
+        request's counters bleeding into the next record."""
+        self.counters = {}
+        self.timer = PhaseTimer()
+        self.memory = MemoryWatermark()
+        self.iterations = 0
+        self.dirty = False
+        self._loop_t0 = None
+        self._gate.reset(0)
+        self._warmed = set()
+        # a probe failure's firewall disable (sampling=False) is per-run
+        # state, not identity — the next request samples again
+        self.sampling = self._sampling_default
+        if meta:
+            self.meta.update(meta)
 
     # ------------------------------------------------------------- counters
 
@@ -382,9 +417,16 @@ class Metrics:
 #
 # A run killed by an exception or a termination signal should still leave
 # a complete results.jsonl record. Solvers register themselves here; the
-# atexit hook (and, for SIGTERM — whose default action skips atexit — a
-# chaining signal hook) flushes any registered solver whose metrics have
-# unflushed activity and a configured sink.
+# atexit hook (and, for SIGTERM/SIGINT — SIGTERM's default action skips
+# atexit entirely, and a Ctrl-C KeyboardInterrupt swallowed by broad
+# except clauses can exit without ever re-raising — chaining signal
+# hooks) flushes any registered solver whose metrics have unflushed
+# activity and a configured sink. Each signal is only hooked while its
+# DEFAULT disposition is in place (SIG_DFL for SIGTERM, the
+# KeyboardInterrupt-raising default_int_handler for SIGINT), so a user-
+# or ResilientLoop- or service-installed handler is never stomped; after
+# flushing, the previous disposition is restored and the signal
+# re-delivered, preserving the original exit semantics.
 
 _exit_solvers = []          # weakrefs to registered solvers
 _signal_previous = {}       # {signum: previous handler} once installed
@@ -408,34 +450,54 @@ def flush_pending(source="atexit"):
 
 
 def _signal_flush(signum, frame):
-    """Chaining SIGTERM hook: flush, restore the previous disposition,
-    and re-deliver so the process still terminates with the original
-    signal semantics (exit code, parent observation)."""
-    flush_pending(source=f"signal:{signum}")
+    """Chaining SIGTERM/SIGINT hook: restore the previous disposition,
+    flush, and re-deliver so the process still terminates with the
+    original signal semantics (exit code / KeyboardInterrupt, parent
+    observation). The restore comes FIRST on purpose: the flush blocks
+    on in-flight device work (flush_metrics syncs the state, and an XLA
+    compile can hold it for tens of seconds), so a SECOND Ctrl-C during
+    the flush must get default semantics — an immediate
+    KeyboardInterrupt escape that abandons the telemetry — instead of
+    re-entering this handler and blocking again."""
     previous = _signal_previous.get(signum, signal.SIG_DFL)
     try:
         signal.signal(signum, previous)
+        restored = True
     except (ValueError, OSError):
-        return
-    os.kill(os.getpid(), signum)
+        restored = False
+    flush_pending(source=f"signal:{signum}")
+    if restored:
+        os.kill(os.getpid(), signum)
+
+
+# per-signal "still the default?" test: SIGINT's default disposition in
+# CPython is the KeyboardInterrupt-raising default_int_handler, not
+# SIG_DFL, so an == SIG_DFL check would never hook Ctrl-C
+_HOOKABLE_DEFAULTS = {
+    signal.SIGTERM: (signal.SIG_DFL,),
+    signal.SIGINT: (signal.SIG_DFL, signal.default_int_handler),
+}
 
 
 def register_exit_flush(solver):
     """Register a solver for the abnormal-exit telemetry flush (atexit +
-    SIGTERM). Idempotent per solver; the signal hook is installed once,
-    and only where the default disposition is still in place (a user- or
-    ResilientLoop-installed handler is never stomped)."""
+    SIGTERM + SIGINT). Idempotent per solver; each signal hook is
+    installed once, and only where that signal's default disposition is
+    still in place (a user- or ResilientLoop- or service-installed
+    handler is never stomped)."""
     with _exit_lock:
         if not any(ref() is solver for ref in _exit_solvers):
             _exit_solvers.append(weakref.ref(solver))
         _exit_solvers[:] = [ref for ref in _exit_solvers
                             if ref() is not None]
-        if signal.SIGTERM not in _signal_previous:
+        for signum, defaults in _HOOKABLE_DEFAULTS.items():
+            if signum in _signal_previous:
+                continue
             try:
-                current = signal.getsignal(signal.SIGTERM)
-                if current == signal.SIG_DFL:
-                    _signal_previous[signal.SIGTERM] = current
-                    signal.signal(signal.SIGTERM, _signal_flush)
+                current = signal.getsignal(signum)
+                if current in defaults:
+                    _signal_previous[signum] = current
+                    signal.signal(signum, _signal_flush)
             except (ValueError, OSError):
                 pass   # non-main thread / unsupported platform
 

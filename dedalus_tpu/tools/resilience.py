@@ -379,14 +379,24 @@ class ResilientLoop:
           starting (ignored without checkpoint_dir).
       chaos — a tools/chaos.ChaosInjector exercised by tests.
       install_signal_handlers — trap SIGTERM/SIGINT for the run (the
-          previous handlers are restored on exit).
+          previous handlers are restored on exit). The warm-pool service
+          passes False and drives `request_stop` from its own drain path.
+      step_hook — callable(solver) invoked after every successfully
+          completed step (never after a failed/rewound one). The serving
+          layer uses it to stamp time-to-first-step and stream progress
+          frames; it must not mutate the solver.
+      flush_telemetry — flush one telemetry record when the loop exits
+          (default). The warm-pool service passes False because it owns
+          the run's single flush (stamping the served-latency fields on
+          it); two records per request would double-count every run.
     """
 
     def __init__(self, solver, timestep_function=None, dt=None,
                  snapshot_cadence=None, ring_size=None, max_retries=None,
                  dt_backoff=None, dt_recovery=None, retry_base_delay=None,
                  checkpoint_dir=None, checkpoint_iter=None, resume=False,
-                 chaos=None, install_signal_handlers=True):
+                 chaos=None, install_signal_handlers=True, step_hook=None,
+                 flush_telemetry=True):
         self.solver = solver
         self.timestep_function = timestep_function
         self.dt = float(dt) if dt is not None else None
@@ -415,6 +425,8 @@ class ResilientLoop:
         self.resume = bool(resume)
         self.chaos = chaos
         self.install_signal_handlers = bool(install_signal_handlers)
+        self.step_hook = step_hook
+        self.flush_telemetry = bool(flush_telemetry)
         # recovery bookkeeping
         self.rewinds = 0
         self.retries = 0
@@ -646,6 +658,9 @@ class ResilientLoop:
                     continue
                 if self.chaos is not None:
                     self.chaos.after_step(solver)
+                if self.step_hook is not None \
+                        and solver.health_error is None:
+                    self.step_hook(solver)
                 if solver.health_error is None \
                         and solver.iteration >= next_snapshot:
                     self._capture()
@@ -662,10 +677,11 @@ class ResilientLoop:
                     signal.signal(signum, handler)
                 except (ValueError, OSError):
                     pass
-            try:
-                solver.flush_metrics()
-            except Exception as exc:
-                logger.warning(f"final telemetry flush failed: {exc}")
+            if self.flush_telemetry:
+                try:
+                    solver.flush_metrics()
+                except Exception as exc:
+                    logger.warning(f"final telemetry flush failed: {exc}")
         return self.summary()
 
     def _graceful_stop(self):

@@ -44,6 +44,14 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic fault-injection tests of the resilient "
         "solve loop (tools/resilience.py + tools/chaos.py)")
+    # service: warm-pool solver daemon tests (dedalus_tpu/service/ +
+    # tests/test_service.py), including live-daemon subprocesses over a
+    # local socket. Tier-1 by default (fast, CPU) — the serving path
+    # that is not exercised does not exist.
+    config.addinivalue_line(
+        "markers",
+        "service: warm-pool solver service tests (dedalus_tpu/service/); "
+        "tier-1 by default")
 
 
 @pytest.fixture

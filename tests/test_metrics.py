@@ -244,3 +244,47 @@ def test_rb256_phase_sum_within_20pct(tmp_path):
     rec = solver.flush_metrics()
     assert rec["phase_samples"] >= 3
     assert 0.8 <= rec["phase_sum_frac"] <= 1.2
+
+
+def test_sigint_chains_abnormal_exit_flush(tmp_path):
+    """Ctrl-C (SIGINT) on an unflushed run flushes one telemetry record
+    through the chaining signal hook (tools/metrics.py installs it for
+    SIGTERM AND SIGINT wherever the default disposition is in place),
+    then restores default semantics — the process still dies by
+    KeyboardInterrupt."""
+    import json
+    import os
+    import subprocess
+    import sys
+    sink = tmp_path / "flush.jsonl"
+    # a stub stands in for the solver (same register_exit_flush path a
+    # real build takes) so the subprocess pays no core import or build —
+    # the signal semantics under test are identical
+    script = f"""
+import os, signal
+from dedalus_tpu.tools import metrics as metrics_mod
+
+class Stub:
+    metrics = metrics_mod.Metrics(sink={str(sink)!r}, enabled=True)
+    def flush_metrics(self, extra=None):
+        return self.metrics.flush(extra=extra)
+
+stub = Stub()
+metrics_mod.register_exit_flush(stub)
+stub.metrics.observe_steps(3)      # unflushed activity: dirty latch set
+os.kill(os.getpid(), signal.SIGINT)
+print("UNREACHABLE")   # the redelivered SIGINT raises KeyboardInterrupt
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=240)
+    # default semantics preserved: died by KeyboardInterrupt, not clean
+    assert proc.returncode != 0
+    assert "UNREACHABLE" not in proc.stdout
+    assert "KeyboardInterrupt" in proc.stderr
+    records = [json.loads(line)
+               for line in sink.read_text().splitlines() if line.strip()]
+    assert len(records) == 1
+    assert records[0]["flush_source"] == f"signal:{2}"
+    assert records[0]["iterations"] == 3

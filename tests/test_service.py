@@ -1,0 +1,432 @@
+"""
+Warm-pool solver service (dedalus_tpu/service/): protocol codecs, pool
+hit/miss/eviction + reset bit-identity in-process, and the live daemon
+over a real socket in a subprocess — sequential clients bit-identical to
+a direct in-process solve, structured malformed-spec errors with the
+daemon surviving, SIGTERM-during-request graceful drain with a valid
+durable checkpoint, and `report` rendering of served records. Tier-1:
+the serving path that is not exercised does not exist.
+"""
+
+import io
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from dedalus_tpu.service import protocol
+from dedalus_tpu.service.client import ServiceClient
+from dedalus_tpu.service.pool import SolverPool
+from dedalus_tpu.service.protocol import ServiceError, SpecError
+from dedalus_tpu.tools import assembly_cache
+
+REPO = pathlib.Path(__file__).parent.parent
+
+pytestmark = pytest.mark.service
+
+DIFF48 = {"problem": "diffusion", "params": {"size": 48}}
+
+
+# ------------------------------------------------------------- protocol
+
+def test_frame_roundtrip():
+    buf = io.BytesIO()
+    payload = b"\x00\x01binary\nframe"
+    protocol.send_frame(buf, {"kind": "x", "n": 3}, payload=payload)
+    protocol.send_frame(buf, {"kind": "y"})
+    buf.seek(0)
+    h1, p1 = protocol.recv_frame(buf)
+    assert h1["kind"] == "x" and h1["n"] == 3 and p1 == payload
+    h2, p2 = protocol.recv_frame(buf)
+    assert h2["kind"] == "y" and p2 is None
+    assert protocol.recv_frame(buf) == (None, None)       # clean EOF
+    # garbage header
+    with pytest.raises(protocol.ProtocolError):
+        protocol.recv_frame(io.BytesIO(b"not json\n"))
+    # truncated payload
+    trunc = io.BytesIO(b'{"kind": "x", "payload_bytes": 10}\nabc')
+    with pytest.raises(protocol.ProtocolError):
+        protocol.recv_frame(trunc)
+
+
+def test_field_payload_roundtrip():
+    rng = np.random.default_rng(7)
+    fields = {"u": ("c", rng.standard_normal(33)),
+              "b": ("g", rng.standard_normal((4, 5)).astype(np.float32))}
+    out = protocol.decode_fields(protocol.encode_fields(fields))
+    for name, (layout, arr) in fields.items():
+        got_layout, got = out[name]
+        assert got_layout == layout
+        assert got.dtype == arr.dtype
+        assert np.array_equal(got, arr)                    # bit-exact
+    with pytest.raises(SpecError):
+        protocol.encode_fields({"u": ("q", np.zeros(3))})
+    with pytest.raises(SpecError):
+        protocol.decode_fields(b"junk that is not an npz archive")
+
+
+def test_spec_validation_and_digest():
+    with pytest.raises(SpecError):
+        protocol.normalize_spec("not a dict")
+    with pytest.raises(SpecError):
+        protocol.normalize_spec({})                        # neither key
+    with pytest.raises(SpecError):
+        protocol.normalize_spec({"problem": "diffusion",
+                                 "builder": "m:f"})        # both keys
+    with pytest.raises(SpecError):
+        protocol.normalize_spec({"problem": "no_such_problem"})
+    # client-side structural normalization skips the registry test
+    protocol.normalize_spec({"problem": "no_such_problem"},
+                            check_registry=False)
+    # digest is canonical under param ordering
+    d1 = protocol.spec_digest({"problem": "diffusion",
+                               "params": {"size": 48, "scheme": "SBDF2"}})
+    d2 = protocol.spec_digest({"problem": "diffusion",
+                               "params": {"scheme": "SBDF2", "size": 48}})
+    assert d1 == d2
+    assert d1 != protocol.spec_digest(DIFF48)
+    # dotted builders are gated server-side
+    with pytest.raises(SpecError):
+        protocol.resolve_builder({"builder": "os:getcwd"},
+                                 allow_imports=False)
+    with pytest.raises(SpecError):
+        protocol.resolve_builder({"builder": "no.such.module:fn"},
+                                 allow_imports=True)()
+    # bad builder params are spec errors, not internal ones
+    with pytest.raises(SpecError):
+        protocol.resolve_builder({"problem": "diffusion",
+                                  "params": {"bogus_kw": 1}})()
+
+
+# ----------------------------------------------------------------- pool
+
+def test_pool_hit_miss_eviction():
+    pool = SolverPool(size=2)
+    e1, v1, b1 = pool.acquire(DIFF48)
+    assert v1 in ("cold", "warm-cache") and b1 > 0
+    e2, v2, b2 = pool.acquire(DIFF48)
+    assert e2 is e1 and v2 == "hit" and b2 == 0.0
+    assert (pool.hits, pool.misses, pool.evictions) == (1, 1, 0)
+    # distinct shapes fill the pool, then evict LRU
+    pool.acquire({"problem": "diffusion", "params": {"size": 16}})
+    pool.acquire({"problem": "diffusion", "params": {"size": 24}})
+    assert len(pool) == 2
+    assert pool.evictions == 1
+    assert pool.peek(DIFF48) is None              # the LRU entry is gone
+    assert pool.peek({"problem": "diffusion",
+                      "params": {"size": 24}}) is not None
+    # a re-request of the evicted spec is a fresh miss, not a stale alias
+    e4, v4, _ = pool.acquire(DIFF48)
+    assert v4 in ("cold", "warm-cache") and e4 is not e1
+    stats = pool.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 4
+    assert len(stats["entries"]) == 2
+
+
+def test_pool_reset_bit_identity():
+    """A warm entry re-run with the same ICs reproduces a fresh build's
+    trajectory bit for bit — including zeroing the RHS parameter field a
+    previous request set."""
+    pool = SolverPool(size=2)
+    entry, _, _ = pool.acquire(DIFF48)
+    solver = entry.solver
+    u = solver.state[0]
+    a = solver.eval_F.extra_fields[0]
+    x = np.linspace(0, 2 * np.pi, 48, endpoint=False)
+    # request 1: forced run (a nonzero) — this must NOT leak into run 2
+    u["g"] = np.sin(3 * x)
+    a["g"] = 0.3 * np.cos(x)
+    for _ in range(12):
+        solver.step(1e-3)
+    X_forced = np.asarray(solver.X).copy()
+    # request 2: warm hit, unforced ICs
+    entry2, verdict, _ = pool.acquire(DIFF48)
+    assert entry2 is entry and verdict == "hit"
+    u["g"] = np.sin(3 * x)
+    for _ in range(12):
+        solver.step(1e-3)
+    X_warm = np.asarray(solver.X).copy()
+    assert not np.array_equal(X_warm, X_forced), \
+        "request-1 forcing leaked through the pool reset"
+    # reference: a fresh build stepping the same unforced ICs
+    fresh = protocol.resolve_builder(DIFF48)()
+    fresh.state[0]["g"] = np.sin(3 * x)
+    for _ in range(12):
+        fresh.step(1e-3)
+    assert np.array_equal(X_warm, np.asarray(fresh.X)), \
+        "warm pooled run is not bit-identical to a fresh solve"
+    # clocks and per-run accounting were rewound
+    entry3, _, _ = pool.acquire(DIFF48)
+    s = entry3.solver
+    assert s.iteration == 0 and s.sim_time == 0.0 and s.dt is None
+    assert s.timestepper.iteration == 0
+    assert s.metrics.iterations == 0
+    assert s.health.checks == 0
+
+
+def test_pool_key_separates_schemes():
+    """Same equations, different timestepper: the assembly-cache content
+    key matches (matrices are scheme-independent) but the POOL key must
+    not — a pooled solver carries scheme-specific compiled programs."""
+    s1 = protocol.resolve_builder(
+        {"problem": "diffusion", "params": {"size": 32}})()
+    s2 = protocol.resolve_builder(
+        {"problem": "diffusion",
+         "params": {"size": 32, "scheme": "RK222"}})()
+    assert s1.assembly_key == s2.assembly_key \
+        or None in (s1.assembly_key, s2.assembly_key)
+    k1, k2 = assembly_cache.pool_key(s1), assembly_cache.pool_key(s2)
+    assert k1 is not None and k2 is not None
+    assert k1 != k2
+
+
+# ----------------------------------------------------------- live daemon
+
+def _start_daemon(stderr_path, *extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    stderr = open(stderr_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dedalus_tpu", "serve", *extra],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=stderr,
+        text=True)
+    try:
+        banner = json.loads(proc.stdout.readline())
+    except ValueError:
+        proc.kill()
+        stderr.close()
+        raise RuntimeError(
+            f"daemon died before ready banner: "
+            f"{pathlib.Path(stderr_path).read_text()[-2000:]}")
+    assert banner["kind"] == "ready"
+    return proc, banner["port"], stderr
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    """One shared daemon for the request-path tests (the drain test
+    starts its own, since it kills it)."""
+    workdir = tempfile.mkdtemp(prefix="dedalus_service_test_")
+    sink = os.path.join(workdir, "served.jsonl")
+    proc, port, stderr = _start_daemon(
+        os.path.join(workdir, "daemon.err"), "--sink", sink)
+    yield {"port": port, "sink": sink, "proc": proc, "workdir": workdir}
+    try:
+        ServiceClient(port=port, timeout=30).shutdown()
+        proc.wait(timeout=60)
+    except Exception:
+        proc.kill()
+    finally:
+        stderr.close()
+
+
+def test_served_bit_identical_to_direct(daemon):
+    """Acceptance: two sequential clients get bit-identical results, and
+    they match a direct in-process solve of the same spec + ICs."""
+    client = ServiceClient(port=daemon["port"], timeout=300)
+    x = np.linspace(0, 2 * np.pi, 48, endpoint=False)
+    ics = {"u": ("g", np.sin(3 * x)), "a": ("g", 0.2 * np.cos(x))}
+    r1 = client.run(DIFF48, ics=ics, dt=1e-3, stop_iteration=10)
+    assert r1.ack["pool_verdict"] in ("cold", "warm-cache")
+    # outputs may name state AND RHS-parameter fields explicitly
+    r2 = client.run(DIFF48, ics=ics, dt=1e-3, stop_iteration=10,
+                    outputs=["u", "a"])
+    assert r2.ack["pool_verdict"] == "hit"
+    assert "a" in r2.fields
+    layout1, u1 = r1.fields["u"]
+    layout2, u2 = r2.fields["u"]
+    assert layout1 == layout2 == "c"
+    assert np.array_equal(u1, u2)
+    # direct in-process reference
+    solver = protocol.resolve_builder(DIFF48)()
+    solver.state[0]["g"] = np.sin(3 * x)
+    solver.eval_F.extra_fields[0]["g"] = 0.2 * np.cos(x)
+    for _ in range(10):
+        solver.step(1e-3)
+    direct = np.asarray(solver.state[0].coeff_data())
+    assert u1.dtype == direct.dtype
+    assert np.array_equal(u1, direct), \
+        "served result differs from the direct in-process solve"
+    # served-latency fields ride the telemetry record and the result
+    serving = r2.serving
+    assert serving["pool_verdict"] == "hit"
+    assert serving["queue_sec"] >= 0
+    assert serving["time_to_first_step_sec"] > 0
+    # warm-hit time-to-first-step must be far below the cold build's
+    assert serving["time_to_first_step_sec"] \
+        < r1.serving["time_to_first_step_sec"]
+    assert r2.record is not None
+    assert r2.record["serving"]["pool_verdict"] == "hit"
+    assert r2.result["stopped_by"] == "completed"
+    assert r2.result["iteration"] == 10
+
+
+def test_malformed_spec_structured_error(daemon):
+    """Bad specs and bad run parameters produce structured error replies
+    — and the daemon survives to serve the next request."""
+    client = ServiceClient(port=daemon["port"], timeout=120)
+    with pytest.raises(ServiceError) as excinfo:
+        client.run({"problem": "no_such_problem"}, dt=1e-3,
+                   stop_iteration=5)
+    assert excinfo.value.code == "bad-spec"
+    assert "no_such_problem" in excinfo.value.message
+    with pytest.raises(ServiceError) as excinfo:
+        client.run(DIFF48, dt=-1.0, stop_iteration=5)
+    assert excinfo.value.code == "bad-spec"
+    with pytest.raises(ServiceError) as excinfo:
+        client.run(DIFF48, dt=1e-3, stop_iteration=5,
+                   ics={"nope": ("g", np.zeros(48))})
+    assert excinfo.value.code == "bad-spec"
+    assert "nope" in excinfo.value.message
+    with pytest.raises(ServiceError) as excinfo:
+        # a typo'd output name must fail loudly, not return empty fields
+        client.run(DIFF48, dt=1e-3, stop_iteration=5, outputs=["nope"])
+    assert excinfo.value.code == "bad-spec"
+    assert "nope" in excinfo.value.message
+    with pytest.raises(ServiceError) as excinfo:
+        # dotted builder specs are refused without --import-builders
+        client.run({"builder": "os:getcwd"}, dt=1e-3, stop_iteration=5)
+    assert excinfo.value.code == "bad-spec"
+    # raw protocol garbage is also structured
+    import socket as socket_mod
+    conn = socket_mod.create_connection(("127.0.0.1", daemon["port"]),
+                                        timeout=60)
+    with conn:
+        conn.sendall(b"this is not a frame\n")
+        reply = json.loads(conn.makefile("rb").readline())
+    assert reply["kind"] == "error" and reply["code"] == "bad-frame"
+    # daemon alive and well
+    assert client.ping()["kind"] == "pong"
+    stats = client.stats()
+    assert stats["pool"]["hits"] >= 1
+
+
+def test_draining_daemon_refuses_new_runs():
+    """Runs arriving during a drain get a structured 'draining' error —
+    on BOTH refusal sites: the reader thread (request read after drain
+    began) and the worker (run already queued when drain began).
+    Exercised deterministically against the handler internals over
+    socketpairs; the live daemon's end-to-end drain is covered by the
+    SIGTERM test."""
+    import socket as socket_mod
+    from dedalus_tpu.service.server import SolverService
+    svc = SolverService(port=0, pool_size=1)
+    svc._draining = "test drain"
+    run_header = {"kind": "run", "spec": DIFF48, "dt": 1e-3,
+                  "stop_iteration": 5}
+    # reader-side refusal
+    a, b = socket_mod.socketpair()
+    with a:
+        protocol.send_frame(a.makefile("wb"), run_header)
+        svc._receive(b, time.perf_counter())
+        header, _ = protocol.recv_frame(a.makefile("rb"))
+    assert header["kind"] == "error" and header["code"] == "draining"
+    # worker-side refusal: the run was queued BEFORE the drain began
+    a2, b2 = socket_mod.socketpair()
+    with a2:
+        svc._queue.put((b2, b2.makefile("wb"), run_header, None,
+                        time.perf_counter()))
+        svc._queue.put(None)               # stop sentinel
+        svc._worker()
+        header, _ = protocol.recv_frame(a2.makefile("rb"))
+    assert header["kind"] == "error" and header["code"] == "draining"
+    # control requests stay answerable while draining (reader-side)
+    a3, b3 = socket_mod.socketpair()
+    with a3:
+        protocol.send_frame(a3.makefile("wb"), {"kind": "stats"})
+        svc._receive(b3, time.perf_counter())
+        header, _ = protocol.recv_frame(a3.makefile("rb"))
+    assert header["kind"] == "stats"
+    assert header["draining"] == "test drain"
+    assert svc.errors == 2
+
+
+# ------------------------------------------------------------ report CLI
+
+def test_report_renders_served_records(daemon, tmp_path):
+    """The daemon's sink records (serving fields, service_stats) and the
+    serving benchmark row render through `python -m dedalus_tpu report`."""
+    # real served records exist in the module daemon's sink by now; add a
+    # synthetic service_stats + serving benchmark row alongside
+    sink = tmp_path / "served.jsonl"
+    lines = pathlib.Path(daemon["sink"]).read_text().strip().splitlines()
+    assert lines, "daemon sink is empty despite served requests"
+    extra = [
+        {"kind": "service_stats", "ts": 2.0, "requests_served": 3,
+         "errors": 1, "uptime_sec": 9.5,
+         "pool": {"hits": 2, "misses": 1, "evictions": 0,
+                  "entries": [{"key": "abc", "spec": "diffusion"}]}},
+        {"config": "rb256x64_serving", "backend": "cpu", "ts": 3.0,
+         "ttfs_cold_sec": 12.5, "ttfs_warm_sec": 0.31,
+         "ttfs_speedup": 40.3, "throughput_requests_per_sec": 2.5},
+    ]
+    sink.write_text("\n".join(lines + [json.dumps(r) for r in extra])
+                    + "\n")
+    # in-process (the subprocess CLI plumbing is covered by the other
+    # daemon tests and tests/test_cli.py; this one is about rendering)
+    import argparse
+    from dedalus_tpu import __main__ as cli
+    import contextlib
+    stream = io.StringIO()
+    with contextlib.redirect_stdout(stream):
+        cli.report(argparse.Namespace(jsonl=str(sink), last=None))
+    out = stream.getvalue()
+    assert "serving: pool=hit" in out
+    assert "queue=" in out and "ttfs=" in out
+    assert "(service) 3 requests" in out
+    assert "2 hits / 1 misses" in out
+    assert "rb256x64_serving" in out
+    assert "ttfs cold 12.5s -> warm 0.31s (40.3x)" in out
+
+
+def test_sigterm_drain_checkpoints_inflight_run(daemon, tmp_path):
+    """Acceptance: SIGTERM mid-request drains gracefully — the in-flight
+    run stops at a step boundary, writes its durable checkpoint, the
+    client still receives telemetry + result frames, and the daemon
+    exits 0. The checkpoint restores into a fresh solver.
+
+    NOTE: this test consumes (kills) the shared module daemon, so it
+    must stay the LAST daemon-using test in this file — the fixture
+    teardown tolerates the already-dead process."""
+    from dedalus_tpu.tools import resilience as res_mod
+    ckpt = tmp_path / "ckpt"
+    proc = daemon["proc"]
+    client = ServiceClient(port=daemon["port"], timeout=300)
+    x = np.linspace(0, 2 * np.pi, 48, endpoint=False)
+    fired = []
+
+    def on_progress(frame):
+        if not fired:
+            fired.append(frame)
+            proc.send_signal(signal.SIGTERM)
+
+    result = client.run(
+        DIFF48, ics={"u": ("g", np.sin(3 * x))}, dt=1e-4,
+        stop_iteration=500000, progress_every=20,
+        checkpoint=str(ckpt), on_progress=on_progress)
+    assert fired, "run finished before any progress frame"
+    assert result.result["stopped_by"] == "SIGTERM"
+    stopped_at = result.result["iteration"]
+    assert 0 < stopped_at < 500000
+    # telemetry still streamed, stamped with the serving fields
+    assert result.record is not None
+    assert result.record["serving"]["pool_verdict"] in (
+        "cold", "warm-cache", "hit")
+    assert proc.wait(timeout=120) == 0
+    # the drain-time checkpoint is valid and restores the run exactly
+    sets = sorted(ckpt.glob("*.h5"))
+    assert sets, "no durable checkpoint written during drain"
+    n_valid, reason = res_mod.validate_checkpoint(sets[-1])
+    assert n_valid >= 1, reason
+    solver = protocol.resolve_builder(DIFF48)()
+    event = res_mod.resume_latest(solver, ckpt)
+    assert event is not None and not event["fallbacks"]
+    assert solver.iteration == stopped_at
+    assert np.all(np.isfinite(np.asarray(solver.X)))
