@@ -396,6 +396,28 @@ def _attach_serving(record):
             "age_s": round(time.time() - row["ts"], 1)
             if row.get("ts") else None,
         }
+    # the overload row (benchmarks/serving.py run_overload): shed-rate +
+    # bounded accepted-latency under a 2x storm, same stale-stamping
+    row = _recent_row(
+        lambda r: (r.get("config") == "diffusion64_overload"
+                   and r.get("shed_rate") is not None))
+    if row is not None:
+        record["serving_overload"] = {
+            "queue_depth": row.get("queue_depth"),
+            "storm_rate_x": row.get("storm_rate_x"),
+            "shed_rate": row.get("shed_rate"),
+            "accepted_p50_sec": row.get("accepted_p50_sec"),
+            "accepted_p95_sec": row.get("accepted_p95_sec"),
+            "latency_bound_sec": row.get("latency_bound_sec"),
+            "max_queued_observed": row.get("max_queued_observed"),
+            "bounded_under_overload": row.get("bounded_under_overload"),
+            "daemon_restarts": row.get("daemon_restarts"),
+            "backend": row.get("backend"),
+            "stale": True,
+            "measured_ts": row.get("ts"),
+            "age_s": round(time.time() - row["ts"], 1)
+            if row.get("ts") else None,
+        }
     return record
 
 

@@ -175,6 +175,8 @@ def report(args):
                          f"ttfs={serving.get('time_to_first_step_sec')}s"]
                 if serving.get("build_sec"):
                     parts.append(f"build={serving['build_sec']}s")
+                if serving.get("deadline_sec") is not None:
+                    parts.append(f"deadline={serving['deadline_sec']}s")
                 if serving.get("request_id"):
                     parts.append(f"request={serving['request_id']}")
                 print(f"    serving: {', '.join(parts)}")
@@ -200,6 +202,33 @@ def report(args):
                   f"{pool.get('evictions', 0)} evictions, "
                   f"{len(pool.get('entries', []))} warm entr(ies), "
                   f"uptime {record.get('uptime_sec', '?')}s")
+            faults = record.get("faults") or {}
+            if faults:
+                # the fault-tolerance trajectory (service/faults.py):
+                # shed/deadline/watchdog/drop/replay + breaker counters
+                breaker = faults.get("breaker") or {}
+                line = (f"    faults: {faults.get('shed', 0)} shed, "
+                        f"{faults.get('deadline_exceeded', 0)} "
+                        "deadline-exceeded, "
+                        f"{faults.get('watchdog_fires', 0)} watchdog, "
+                        f"{faults.get('client_drops', 0)} client drops, "
+                        f"{faults.get('replays', 0)} replays, "
+                        f"breaker {breaker.get('opens', 0)} opens / "
+                        f"{breaker.get('fastfails', 0)} fast-fails")
+                if faults.get("mem_evictions"):
+                    line += (f", {faults['mem_evictions']} "
+                             "memory evictions")
+                if breaker.get("open"):
+                    line += f", OPEN circuits: {breaker['open']}"
+                print(line)
+        elif kind == "watchdog_postmortem":
+            n_post += 1
+            stacks = record.get("stacks") or []
+            print(f"(watchdog) request={record.get('request_id', '?')} "
+                  f"stuck {record.get('stuck_sec', '?')}s "
+                  f"(limit {record.get('watchdog_sec', '?')}s) at "
+                  f"iter={record.get('iteration', '?')}, "
+                  f"{len(stacks)} thread stack(s) recorded")
         else:
             n_other += 1
             ident = record.get("metric") or record.get("config") or "record"
@@ -235,6 +264,22 @@ def report(args):
                 if record.get("throughput_requests_per_sec") is not None:
                     line += (f", {record['throughput_requests_per_sec']} "
                              "requests/s")
+                print(line)
+            # overload benchmark rows (benchmarks/serving.py storm): the
+            # shed-rate and bounded-latency story in one line
+            if record.get("shed_rate") is not None:
+                shed_pct = round(100.0 * record["shed_rate"], 1)
+                line = (f"    overload: {record.get('storm_rate_x', '?')}x "
+                        f"capacity storm, {shed_pct}% shed, accepted p50 "
+                        f"{record.get('accepted_p50_sec', '?')}s / p95 "
+                        f"{record.get('accepted_p95_sec', '?')}s "
+                        f"(bound {record.get('latency_bound_sec', '?')}s), "
+                        f"{record.get('daemon_restarts', '?')} daemon "
+                        "restarts")
+                if record.get("max_queued_observed") is not None:
+                    line += (f", max queued "
+                             f"{record['max_queued_observed']}"
+                             f"/{record.get('queue_depth', '?')}")
                 print(line)
     print(f"{n_metrics} metrics record(s), {n_other} other, "
           f"{n_post} postmortem, {n_bad} unparsable")

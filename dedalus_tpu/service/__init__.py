@@ -9,10 +9,15 @@ key, serving problem specs + initial conditions over a local socket.
 Modules:
   protocol.py — spec schema, frame codec, npz field payloads, registry
   pool.py     — LRU of warm solvers (reset, eviction, hit/miss counters)
-  server.py   — accept loop, dispatch, graceful SIGTERM/SIGINT drain
-  client.py   — blocking client + `submit` CLI (no solver-stack import)
+  server.py   — accept loop, admission control, dispatch, watchdog,
+                graceful SIGTERM/SIGINT drain
+  client.py   — blocking client + `submit` CLI (no solver-stack import;
+                jittered retries, idempotent request ids)
+  faults.py   — request-path fault tolerance: per-spec circuit breaker,
+                idempotent result cache, hung-dispatch watchdog
 
-See docs/serving.md for the protocol reference and operations guide.
+See docs/serving.md for the protocol reference, the failure-modes
+runbook, and the operations guide.
 """
 
 from .protocol import (PROBLEMS, ProtocolError, ServiceError, SpecError,

@@ -3,14 +3,15 @@ Blocking client for the warm-pool solver service, plus the
 `python -m dedalus_tpu submit` CLI.
 
 Deliberately lightweight: this module itself imports only the protocol
-codecs (json/socket/numpy) and never touches the solver stack — no
-fields, bases, or compiled programs load on the client side. (Reaching
-it through the `dedalus_tpu` package still executes the package root,
-which imports jax; the point is that the DAEMON owns all solver state
-and compilation, so a client process stays cheap after import.)
+codecs (json/socket/numpy) and the host-side retry classification — it
+never touches the solver stack; no fields, bases, or compiled programs
+load on the client side. (Reaching it through the `dedalus_tpu` package
+still executes the package root, which imports jax; the point is that
+the DAEMON owns all solver state and compilation, so a client process
+stays cheap after import.)
 
     from dedalus_tpu.service.client import ServiceClient
-    client = ServiceClient(port=8751)
+    client = ServiceClient(port=8751, retries=5)
     result = client.run({"problem": "diffusion", "params": {"size": 64}},
                         ics={"u": ("g", u0)}, dt=1e-3, stop_iteration=100)
     result.fields["u"]          # ('c', ndarray) final state, bit-exact
@@ -18,19 +19,46 @@ and compilation, so a client process stays cheap after import.)
 
 Telemetry frames stream during the run; `run(on_record=...)` observes
 them live, and every streamed record is kept on the RunResult.
+
+Client-side resilience (`retries=` / `submit --retry`): connection
+failures, dropped streams, daemon drains, and `overloaded` refusals are
+retried with jittered exponential backoff (the tools/resilience
+RetryPolicy errno classification decides which OSErrors are worth
+retrying; an `overloaded` reply's `retry_after_sec` hint overrides the
+exponential schedule). Every RETRYING run carries an idempotent request
+id (auto-generated when `retries > 0` and none is supplied; explicit
+ids always work), so a retry after a dropped `result` frame replays the
+completed outcome from the daemon's result cache instead of re-running
+the solve — which is what makes a rolling daemon restart invisible to a
+retrying client. Non-retrying runs send no id, so the daemon never pins
+result payloads for clients that cannot come back. `circuit-open` is
+NOT retried: fast-failing poisoned specs to the caller is the breaker's
+point.
 """
 
 import argparse
 import json
+import logging
 import socket
 import sys
+import time
+import uuid
 
 import numpy as np
 
 from . import protocol
-from .protocol import ServiceError
+from .protocol import ProtocolError, ServiceError
+from ..tools.config import cfg_get
+from ..tools.resilience import RetryPolicy
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["RunResult", "ServiceClient", "main"]
+
+# structured error codes a retry can help with: the stream died before
+# the result ("closed"), a rolling restart is in progress ("draining"),
+# or admission control shed us ("overloaded", with a retry_after hint)
+_RETRYABLE_CODES = frozenset({"closed", "draining", "overloaded"})
 
 
 class RunResult:
@@ -42,6 +70,7 @@ class RunResult:
         self.records = []       # streamed telemetry records
         self.result = None      # final result header
         self.fields = {}        # {name: (layout, ndarray)} final state
+        self.attempts = 1       # connection attempts this run consumed
 
     @property
     def record(self):
@@ -52,42 +81,125 @@ class RunResult:
     def serving(self):
         return (self.result or {}).get("serving") or {}
 
+    @property
+    def replayed(self):
+        """Whether the result came from the daemon's idempotent result
+        cache (a retry after a dropped stream) rather than a fresh run."""
+        return bool((self.result or {}).get("replayed"))
+
 
 class ServiceClient:
     """One-request-per-connection blocking client (the daemon serializes
     execution on its worker thread; connections are cheap and keeping
-    them one-shot keeps drain semantics trivial)."""
+    them one-shot keeps drain semantics trivial).
 
-    def __init__(self, host="127.0.0.1", port=None, timeout=600.0):
+    Timeouts split connect from read ([service] CONNECT_TIMEOUT_SEC /
+    READ_TIMEOUT_SEC config defaults); the legacy `timeout=` argument
+    keeps setting the read timeout. `retries` enables jittered-backoff
+    reconnect on transient failures (0 = fail on the first)."""
+
+    def __init__(self, host="127.0.0.1", port=None, timeout=None,
+                 connect_timeout=None, read_timeout=None, retries=0,
+                 retry_base_delay=0.5):
         if port is None:
             raise ValueError("ServiceClient needs the daemon port (the "
                              "'ready' banner printed by `serve` names it)")
         self.host = host
         self.port = int(port)
-        self.timeout = float(timeout)
+        self.connect_timeout = float(
+            connect_timeout if connect_timeout is not None
+            else cfg_get("service", "CONNECT_TIMEOUT_SEC", "10"))
+        self.read_timeout = float(
+            read_timeout if read_timeout is not None
+            else timeout if timeout is not None
+            else cfg_get("service", "READ_TIMEOUT_SEC", "600"))
+        self.retries = max(int(retries), 0)
+        self.retry = RetryPolicy(max_attempts=self.retries + 1,
+                                 base_delay=float(retry_base_delay),
+                                 max_delay=30.0, jitter=0.25)
+
+    # `timeout` kept readable for callers that used the old single knob
+    @property
+    def timeout(self):
+        return self.read_timeout
 
     def _connect(self):
         conn = socket.create_connection((self.host, self.port),
-                                        timeout=self.timeout)
+                                        timeout=self.connect_timeout)
+        conn.settimeout(self.read_timeout)
         return conn, conn.makefile("rb"), conn.makefile("wb")
 
-    def _simple(self, request, expect):
-        conn, rfile, wfile = self._connect()
-        try:
-            protocol.send_frame(wfile, request)
-            header, _payload = protocol.recv_frame(rfile)
-            if header is None:
-                raise ServiceError("closed", "daemon closed the connection")
-            if header.get("kind") == "error":
-                raise ServiceError(header.get("code", "error"),
-                                   header.get("message", ""))
-            if header.get("kind") != expect:
-                raise ServiceError(
-                    "protocol", f"expected {expect!r} reply, got "
-                    f"{header.get('kind')!r}")
-            return header
-        finally:
-            conn.close()
+    @staticmethod
+    def _retryable(exc):
+        if isinstance(exc, ServiceError):
+            return exc.code in _RETRYABLE_CODES
+        if isinstance(exc, ProtocolError):
+            # a torn frame mid-stream IS the daemon dying on us (SIGKILL
+            # mid-write): the same retry/replay path as a clean close
+            return True
+        if isinstance(exc, TimeoutError):
+            # a READ timeout means the reply is slower than our patience,
+            # not that the daemon is gone — blindly re-submitting would
+            # queue a duplicate behind the still-running original (and
+            # under ON_CLIENT_DROP=abort, kill it). Surface it: the
+            # caller chose read_timeout and should raise it.
+            return False
+        if isinstance(exc, OSError):
+            return RetryPolicy.is_transient(exc)
+        return False
+
+    def _with_retries(self, fn, observe_attempt=None):
+        """Run one request attempt, reconnecting with jittered backoff on
+        transient failures. A structured `retry_after_sec` hint from the
+        daemon (overload shedding) overrides the exponential schedule.
+        The attempt budget lives in ONE place — the RetryPolicy's
+        max_attempts (retries + 1)."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except (ServiceError, ProtocolError, OSError) as exc:
+                attempt += 1
+                if attempt >= self.retry.max_attempts \
+                        or not self._retryable(exc):
+                    raise
+                # the daemon's shed hint is capped by the same max_delay
+                # as the exponential schedule: a saturated daemon can
+                # suggest minutes, but a queue slot may free in seconds
+                hint = getattr(exc, "retry_after_sec", None)
+                delay = (self.retry.jittered(min(float(hint),
+                                                 self.retry.max_delay))
+                         if hint else self.retry.delay(attempt))
+                if observe_attempt is not None:
+                    observe_attempt(attempt, exc)
+                logger.warning(
+                    f"service client: attempt {attempt}/{self.retries} "
+                    f"failed ({exc}); retrying in {delay:.2f}s")
+                time.sleep(delay)
+
+    def _simple(self, request, expect, retryable=True):
+        def attempt():
+            conn, rfile, wfile = self._connect()
+            try:
+                protocol.send_frame(wfile, request)
+                header, _payload = protocol.recv_frame(rfile)
+                if header is None:
+                    raise ServiceError("closed",
+                                       "daemon closed the connection")
+                if header.get("kind") == "error":
+                    raise ServiceError(header.get("code", "error"),
+                                       header.get("message", ""),
+                                       frame=header)
+                if header.get("kind") != expect:
+                    raise ServiceError(
+                        "protocol", f"expected {expect!r} reply, got "
+                        f"{header.get('kind')!r}")
+                return header
+            finally:
+                conn.close()
+        if not retryable:
+            return attempt()
+        return self._with_retries(attempt)
 
     def ping(self):
         return self._simple({"kind": "ping"}, "pong")
@@ -96,18 +208,32 @@ class ServiceClient:
         return self._simple({"kind": "stats"}, "stats")
 
     def shutdown(self):
-        """Ask the daemon to drain and exit (same path as SIGTERM)."""
-        return self._simple({"kind": "shutdown"}, "ok")
+        """Ask the daemon to drain and exit (same path as SIGTERM).
+        NEVER retried, whatever `retries` is set to: a shutdown whose
+        ack was lost in the drain would be re-delivered to — and drain —
+        the freshly relaunched daemon of a rolling restart."""
+        return self._simple({"kind": "shutdown"}, "ok", retryable=False)
 
     def run(self, spec, ics=None, dt=None, stop_iteration=None,
             stop_sim_time=None, outputs=None, layout="c",
             progress_every=0, checkpoint=None, resume=False,
-            request_id=None, on_record=None, on_progress=None):
+            deadline_sec=None, request_id=None, chaos=None,
+            on_record=None, on_progress=None):
         """Submit one run and block until its result frame.
 
         `ics` maps field name -> (layout, array) or a bare array (grid
-        layout). Raises ServiceError on a structured daemon error (e.g.
-        code 'bad-spec', 'draining', 'health')."""
+        layout). `deadline_sec` bounds the request end-to-end: expired in
+        the queue it fails structurally, expired mid-run it stops
+        gracefully (`stopped_by: "deadline-exceeded"`). An idempotent
+        `request_id` makes the daemon cache the completed result for
+        replay; a retrying client (`retries > 0`) auto-generates one, a
+        non-retrying client sends none — no point pinning result
+        payloads in the daemon's cache for a client that will never ask
+        again. Raises ServiceError on a structured daemon error (e.g.
+        code 'bad-spec', 'draining', 'overloaded', 'circuit-open',
+        'deadline-exceeded', 'watchdog-timeout', 'health')."""
+        if request_id is None and self.retries > 0:
+            request_id = uuid.uuid4().hex[:16]
         header = {"kind": "run",
                   "spec": protocol.normalize_spec(spec,
                                                   check_registry=False),
@@ -122,6 +248,10 @@ class ServiceClient:
             header["outputs"] = list(outputs)
         if progress_every:
             header["progress_every"] = int(progress_every)
+        if deadline_sec is not None:
+            header["deadline_sec"] = float(deadline_sec)
+        if chaos is not None:
+            header["chaos"] = dict(chaos)
         if checkpoint is not None:
             header["checkpoint"] = (checkpoint if isinstance(checkpoint,
                                                              dict)
@@ -136,40 +266,53 @@ class ServiceClient:
                 else:
                     norm[name] = ("g", np.asarray(value))
             payload = protocol.encode_fields(norm)
-        out = RunResult()
-        conn, rfile, wfile = self._connect()
-        try:
-            protocol.send_frame(wfile, header, payload=payload)
-            while True:
-                frame, frame_payload = protocol.recv_frame(rfile)
-                if frame is None:
-                    raise ServiceError(
-                        "closed", "daemon closed the stream before the "
-                        "result frame (see the daemon log)")
-                kind = frame.get("kind")
-                if kind == "error":
-                    raise ServiceError(frame.get("code", "error"),
-                                       frame.get("message", ""))
-                if kind == "ack":
-                    out.ack = frame
-                elif kind == "progress":
-                    out.progress.append(frame)
-                    if on_progress is not None:
-                        on_progress(frame)
-                elif kind == "result":
-                    out.result = frame
-                    if frame_payload:
-                        out.fields = protocol.decode_fields(frame_payload)
-                    return out
-                else:
-                    # telemetry: the metrics-sink record format IS the
-                    # wire format (kind step_metrics today; forward-
-                    # compatible with any future record kinds)
-                    out.records.append(frame)
-                    if on_record is not None:
-                        on_record(frame)
-        finally:
-            conn.close()
+
+        def attempt():
+            out = RunResult()
+            conn, rfile, wfile = self._connect()
+            try:
+                protocol.send_frame(wfile, header, payload=payload)
+                while True:
+                    frame, frame_payload = protocol.recv_frame(rfile)
+                    if frame is None:
+                        raise ServiceError(
+                            "closed", "daemon closed the stream before "
+                            "the result frame (see the daemon log)")
+                    kind = frame.get("kind")
+                    if kind == "error":
+                        raise ServiceError(frame.get("code", "error"),
+                                           frame.get("message", ""),
+                                           frame=frame)
+                    if kind == "ack":
+                        out.ack = frame
+                    elif kind == "progress":
+                        out.progress.append(frame)
+                        if on_progress is not None:
+                            on_progress(frame)
+                    elif kind == "result":
+                        out.result = frame
+                        if frame_payload:
+                            out.fields = protocol.decode_fields(
+                                frame_payload)
+                        return out
+                    else:
+                        # telemetry: the metrics-sink record format IS the
+                        # wire format (kind step_metrics today; forward-
+                        # compatible with any future record kinds)
+                        out.records.append(frame)
+                        if on_record is not None:
+                            on_record(frame)
+            finally:
+                conn.close()
+
+        attempts = [1]
+
+        def observe(attempt_n, exc):
+            attempts[0] = attempt_n + 1
+
+        out = self._with_retries(attempt, observe_attempt=observe)
+        out.attempts = attempts[0]
+        return out
 
 
 # --------------------------------------------------------------- CLI
@@ -205,9 +348,31 @@ def build_parser():
     parser.add_argument("--resume", action="store_true",
                         help="resume from the newest valid checkpoint in "
                              "--checkpoint-dir before stepping")
+    parser.add_argument("--deadline", type=float, default=None,
+                        metavar="SEC",
+                        help="per-request deadline: expired in queue fails "
+                             "structurally, expired mid-run stops the "
+                             "solve gracefully")
+    parser.add_argument("--id", default=None,
+                        help="idempotent request id (auto-generated when "
+                             "omitted AND --retry > 0; resubmitting a "
+                             "completed id replays the cached result)")
     parser.add_argument("--out", default=None,
                         help="write the returned fields to this npz path")
-    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="stream read timeout in seconds (default: "
+                             "[service] READ_TIMEOUT_SEC)")
+    parser.add_argument("--connect-timeout", type=float, default=None,
+                        help="connection timeout in seconds (default: "
+                             "[service] CONNECT_TIMEOUT_SEC)")
+    parser.add_argument("--retry", type=int, default=0, metavar="N",
+                        help="retry transient failures (dropped stream, "
+                             "draining daemon, overload shed) up to N "
+                             "times with jittered backoff — makes rolling "
+                             "daemon restarts invisible")
+    parser.add_argument("--retry-delay", type=float, default=0.5,
+                        help="backoff base seconds between retries "
+                             "(default: %(default)s)")
     parser.add_argument("--ping", action="store_true",
                         help="just ping the daemon and exit")
     parser.add_argument("--stats", action="store_true",
@@ -246,7 +411,10 @@ def _load_ics(path):
 def main(argv=None):
     args = build_parser().parse_args(argv)
     client = ServiceClient(host=args.host, port=args.port,
-                           timeout=args.timeout)
+                           timeout=args.timeout,
+                           connect_timeout=args.connect_timeout,
+                           retries=args.retry,
+                           retry_base_delay=args.retry_delay)
     try:
         if args.ping:
             client.ping()
@@ -268,6 +436,7 @@ def main(argv=None):
             stop_sim_time=args.stop_sim_time, outputs=args.outputs,
             layout=args.layout, progress_every=args.progress_every,
             checkpoint=args.checkpoint_dir, resume=args.resume,
+            deadline_sec=args.deadline, request_id=args.id,
             on_progress=lambda f: print(
                 f"progress: iteration={f['iteration']} "
                 f"sim_time={f['sim_time']:.6e}", file=sys.stderr))
@@ -282,7 +451,8 @@ def main(argv=None):
     print(f"result: iteration={result.result['iteration']} "
           f"sim_time={result.result['sim_time']:.6e} "
           f"stopped_by={result.result['stopped_by']} "
-          f"time_to_first_step={ttfs}s")
+          f"time_to_first_step={ttfs}s"
+          + (" (replayed)" if result.replayed else ""))
     rec = result.record
     if rec:
         print(f"telemetry: {rec.get('iterations')} iters at "

@@ -1,0 +1,356 @@
+"""
+Service-level fault tolerance primitives for the warm-pool daemon
+(dedalus_tpu/service/server.py): the request-path siblings of the
+step-loop machinery in tools/resilience.py.
+
+PR 4's resilience protects a single solve loop (rewind, dt backoff,
+errno-classified IO retry); this module lifts the same discipline one
+layer up, to the orchestration layer that distributed solver stacks
+assume absorbs node and task failures:
+
+  * `CircuitBreaker` — per-spec failure accounting. A spec whose build
+    or run fails `failures` consecutive times enters a cooling-off
+    period during which requests fast-fail with a structured
+    `circuit-open` error (carrying `retry_after_sec`) instead of
+    monopolizing the single executor; after the cool-off ONE probe
+    request is admitted (half-open), and its success closes the circuit
+    while a failure re-opens it with the cool-off doubled (capped).
+
+  * `ResultCache` — a small LRU of completed run results keyed by the
+    CLIENT-provided request id, so an idempotent retry after a dropped
+    `result` frame re-fetches the finished outcome instead of
+    re-running the solve.
+
+  * `Watchdog` — a monitor thread that detects a hung JAX dispatch (no
+    step progress on the active run within `watchdog_sec`) and invokes
+    the server's fire callback, which fails the request with a
+    postmortem (thread stacks + request context) and replaces the
+    wedged executor thread instead of wedging the daemon forever.
+
+  * `RunContext` / `AbandonedRun` — the per-request state the executor
+    and the watchdog share, and the exception a watchdog-abandoned run
+    raises from its step hook so the stale executor unwinds without
+    touching the (already answered, already closed) connection.
+
+Everything here is plain host-side Python — no JAX, no solver imports —
+so the primitives are unit-testable without a built solver, and the
+chaos suite (tools/chaos.py service faults) drives every branch
+deterministically in tier-1.
+"""
+
+import logging
+import sys
+import threading
+import time
+import traceback
+from collections import OrderedDict
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AbandonedRun", "CircuitBreaker", "ResultCache", "RunContext",
+           "Watchdog"]
+
+
+class AbandonedRun(Exception):
+    """Raised from a run's step hook after the watchdog declared the run
+    hung and answered the client: the stale executor must unwind without
+    replying (the watchdog already sent `watchdog-timeout` and closed
+    the connection) and without consuming further queue items."""
+
+
+class RunContext:
+    """Shared per-request state between the executor thread (writes) and
+    the watchdog thread (reads). `last_progress` is a monotonic-clock
+    float updated on dispatch start and after every completed step;
+    single-word float stores are atomic under the GIL, so no lock is
+    needed on the hot path."""
+
+    __slots__ = ("request_id", "digest", "conn", "wfile", "loop",
+                 "deadline_ts", "last_progress", "abandoned",
+                 "deadline_fired", "client_gone", "probe", "started_ts",
+                 "header")
+
+    def __init__(self, request_id, digest, conn, wfile, loop,
+                 deadline_ts=None, probe=False, header=None):
+        self.request_id = request_id
+        self.digest = digest
+        self.conn = conn
+        self.wfile = wfile
+        self.loop = loop
+        self.header = header
+        self.deadline_ts = deadline_ts
+        self.last_progress = time.monotonic()
+        self.abandoned = threading.Event()
+        self.deadline_fired = False
+        self.client_gone = False
+        self.probe = probe
+        self.started_ts = time.monotonic()
+
+
+# ------------------------------------------------------- circuit breaker
+
+class CircuitBreaker:
+    """
+    Per-key (spec-digest) circuit breaker. States per key:
+
+        closed     requests pass; consecutive failures counted
+        open       requests fast-fail until `cooloff` elapses
+        half-open  one probe request admitted; outcome decides
+
+    `admit(key)` returns (allowed, retry_after_sec, state); when it
+    admits the half-open probe, `state` is "probe" and the caller must
+    eventually report `record_success`/`record_failure` (or
+    `abandon_probe` when the outcome was the CLIENT's fault — a dropped
+    connection says nothing about the spec) or the key stays probing.
+    Keys are LRU-bounded so a storm of unique poisoned specs cannot grow
+    the table without bound. All methods are thread-safe (reader threads
+    admit, the executor records).
+    """
+
+    def __init__(self, failures=3, cooloff_sec=30.0, max_cooloff_sec=600.0,
+                 max_keys=256):
+        self.failures = max(int(failures), 1)
+        self.cooloff_sec = float(cooloff_sec)
+        self.max_cooloff_sec = float(max_cooloff_sec)
+        self.max_keys = int(max_keys)
+        self._keys = OrderedDict()   # key -> state dict
+        self._lock = threading.Lock()
+        self.opens = 0
+        self.fastfails = 0
+        self.closes = 0
+
+    def _entry(self, key):
+        entry = self._keys.get(key)
+        if entry is None:
+            entry = self._keys[key] = {
+                "fails": 0, "state": "closed", "opened_ts": 0.0,
+                "cooloff": self.cooloff_sec, "probing": False}
+            while len(self._keys) > self.max_keys:
+                self._keys.popitem(last=False)
+        self._keys.move_to_end(key)
+        return entry
+
+    def admit(self, key):
+        """Gate one request. Returns (allowed, retry_after_sec, state)
+        with state in {"closed", "probe", "open"}."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._keys.get(key)
+            if entry is None or entry["state"] == "closed":
+                return True, 0.0, "closed"
+            self._keys.move_to_end(key)
+            remaining = entry["opened_ts"] + entry["cooloff"] - now
+            if entry["state"] == "open" and remaining <= 0:
+                entry["state"] = "half-open"
+            if entry["state"] == "half-open" and not entry["probing"]:
+                entry["probing"] = True
+                logger.info(f"breaker: half-open probe admitted for "
+                            f"{key[:12]}")
+                return True, 0.0, "probe"
+            self.fastfails += 1
+            return False, round(max(remaining, 0.1), 1), "open"
+
+    def record_success(self, key):
+        with self._lock:
+            entry = self._keys.get(key)
+            if entry is None:
+                return
+            if entry["state"] != "closed":
+                self.closes += 1
+                logger.info(f"breaker: circuit for {key[:12]} closed")
+            entry.update(fails=0, state="closed", probing=False,
+                         cooloff=self.cooloff_sec)
+
+    def record_failure(self, key):
+        """Count one build/run failure; open (or re-open, with the
+        cool-off doubled) when the consecutive budget is spent. A
+        failure recorded while ALREADY open (e.g. queued work admitted
+        before the circuit tripped) counts but neither re-stamps the
+        cool-off clock — clients were already told a retry_after — nor
+        inflates the opens counter."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entry(key)
+            entry["fails"] += 1
+            if entry["state"] == "open":
+                return
+            reopened = entry["state"] == "half-open"
+            if reopened or entry["fails"] >= self.failures:
+                if reopened:
+                    entry["cooloff"] = min(entry["cooloff"] * 2.0,
+                                           self.max_cooloff_sec)
+                entry.update(state="open", opened_ts=now, probing=False)
+                self.opens += 1
+                logger.warning(
+                    f"breaker: circuit OPEN for {key[:12]} "
+                    f"({entry['fails']} consecutive failures, cool-off "
+                    f"{entry['cooloff']:.1f}s)")
+
+    def abandon_probe(self, key):
+        """The half-open probe ended without a verdict on the SPEC (the
+        client vanished, the daemon drained): return the key to
+        half-open so the next request probes again."""
+        with self._lock:
+            entry = self._keys.get(key)
+            if entry is not None and entry["state"] == "half-open":
+                entry["probing"] = False
+
+    def state(self, key):
+        with self._lock:
+            entry = self._keys.get(key)
+            return entry["state"] if entry else "closed"
+
+    def stats(self):
+        with self._lock:
+            open_keys = [k[:12] for k, e in self._keys.items()
+                         if e["state"] != "closed"]
+            return {"opens": self.opens, "closes": self.closes,
+                    "fastfails": self.fastfails, "open": open_keys}
+
+
+# ----------------------------------------------------------- result cache
+
+class ResultCache:
+    """LRU of completed run results keyed by client-provided request id:
+    (telemetry_record_or_None, result_header, payload_bytes,
+    fingerprint). The fingerprint identifies WHAT ran (spec digest +
+    outcome-affecting run params); the server refuses to replay an id
+    whose retry carries a different fingerprint — an id can never serve
+    another request's result. Sized in entries (`[service]
+    RESULT_CACHE`; 0 disables) AND bytes (`max_bytes`, default 256 MiB
+    of payload — protocol payloads can legitimately reach 256 MiB each,
+    and an entry-count bound alone would let a fleet of retrying
+    large-grid clients pin gigabytes of npz in daemon RSS). Thread-safe
+    (reader threads serve replays while the executor stores
+    completions)."""
+
+    def __init__(self, size=16, max_bytes=256 * 2**20):
+        self.size = int(size)
+        self.max_bytes = int(max_bytes)
+        self._entries = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.replays = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def payload_bytes(self):
+        with self._lock:
+            return self._bytes
+
+    def put(self, request_id, record, result, payload, fingerprint=None):
+        if self.size <= 0 or not request_id:
+            return
+        n = len(payload) if payload else 0
+        if n > self.max_bytes:
+            return   # one oversized result must not flush everything
+        with self._lock:
+            old = self._entries.pop(request_id, None)
+            if old is not None:
+                self._bytes -= len(old[2]) if old[2] else 0
+            self._entries[request_id] = (record, result, payload,
+                                         fingerprint)
+            self._bytes += n
+            while self._entries and (len(self._entries) > self.size
+                                     or self._bytes > self.max_bytes):
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= len(dropped[2]) if dropped[2] else 0
+
+    def get(self, request_id, fingerprint=None):
+        """The cached (record, result, payload, fingerprint) for one id,
+        or None. A non-None `fingerprint` must MATCH the stored one —
+        an id reused with a different spec/params is a miss (the fresh
+        run then overwrites the entry). Counts a replay when found."""
+        if self.size <= 0 or not request_id:
+            return None
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is None:
+                return None
+            if fingerprint is not None and entry[3] is not None \
+                    and entry[3] != fingerprint:
+                return None
+            self._entries.move_to_end(request_id)
+            self.replays += 1
+            return entry
+
+    def clear(self):
+        """Drop every cached result (the memory-watermark shedding path:
+        cached payloads can dominate RSS for large-grid results, and
+        replayability is worth less than the daemon staying alive).
+        Returns the number dropped."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            return n
+
+
+# --------------------------------------------------------------- watchdog
+
+def thread_stacks():
+    """Formatted stack of every live thread — the postmortem of a hung
+    dispatch (which thread is wedged, and where)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        stack = "".join(traceback.format_stack(frame, limit=12))
+        out.append(f"thread {names.get(ident, ident)}:\n{stack}")
+    return out
+
+
+class Watchdog:
+    """
+    Hung-dispatch detector: polls `get_active()` (a RunContext or None)
+    and calls `on_fire(ctx, stuck_sec)` ONCE per context when
+    `now - ctx.last_progress` exceeds `watchdog_sec`. A legitimate pool
+    miss pays its build + first-step compile before the first
+    `last_progress` update, so `watchdog_sec` must exceed the worst-case
+    cold start (docs/serving.md; the assembly + XLA caches keep that
+    small in practice). `stop()` ends the thread at drain.
+    """
+
+    def __init__(self, get_active, on_fire, watchdog_sec, poll_sec=None):
+        self.get_active = get_active
+        self.on_fire = on_fire
+        self.watchdog_sec = float(watchdog_sec)
+        self.poll_sec = (float(poll_sec) if poll_sec is not None
+                         else max(min(self.watchdog_sec / 4.0, 1.0), 0.05))
+        self._stop = threading.Event()
+        self._fired_for = None
+        self._thread = None
+
+    def start(self):
+        if self.watchdog_sec <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._watch,
+                                        name="service-watchdog",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _watch(self):
+        while not self._stop.wait(self.poll_sec):
+            ctx = self.get_active()
+            if ctx is not self._fired_for:
+                # the fired run is no longer active (idle daemon OR the
+                # replacement already serves a new one): drop the
+                # reference — it transitively pins the abandoned
+                # (quarantined) solver's memory, which is exactly what
+                # the fire freed
+                self._fired_for = None
+            if ctx is None or ctx is self._fired_for:
+                continue
+            stuck = time.monotonic() - ctx.last_progress
+            if stuck < self.watchdog_sec:
+                continue
+            self._fired_for = ctx
+            try:
+                self.on_fire(ctx, stuck)
+            except Exception:
+                logger.exception("watchdog: fire callback failed")

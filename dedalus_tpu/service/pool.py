@@ -166,12 +166,57 @@ class SolverPool:
     def _evict(self):
         """Drop LRU entries above the budget (caller holds _lock)."""
         while len(self._entries) > self.size:
-            key, entry = self._entries.popitem(last=False)
-            self._aliases = {d: k for d, k in self._aliases.items()
-                             if k != key}
-            self.evictions += 1
-            logger.info(f"pool: evicted {protocol.spec_name(entry.spec)} "
-                        f"(key {key[:12]}, {entry.uses} uses)")
+            self._pop_lru()
+
+    def _remove(self, key):
+        """Drop one entry + its aliases and count the eviction (caller
+        holds _lock). The single bookkeeping point behind LRU eviction,
+        trim, and watchdog quarantine."""
+        entry = self._entries.pop(key)
+        self._aliases = {d: k for d, k in self._aliases.items()
+                         if k != key}
+        self.evictions += 1
+        return entry
+
+    def _pop_lru(self):
+        """Evict the single least-recently-used entry (caller holds
+        _lock)."""
+        key = next(iter(self._entries))
+        entry = self._remove(key)
+        logger.info(f"pool: evicted {protocol.spec_name(entry.spec)} "
+                    f"(key {key[:12]}, {entry.uses} uses)")
+
+    def discard(self, digest):
+        """Quarantine the entry aliased by a spec digest: the watchdog's
+        path when it abandons a run. The stale executor may still be
+        inside a dispatch on this entry's solver, so the pool must drop
+        its reference — the replacement executor then builds a FRESH
+        solver for the spec instead of sharing (and racing) the wedged
+        one. Returns True when an entry was removed."""
+        with self._lock:
+            key = self._aliases.get(digest)
+            if key is None or key not in self._entries:
+                return False
+            entry = self._remove(key)
+            logger.warning(
+                f"pool: quarantined {protocol.spec_name(entry.spec)} "
+                f"(key {key[:12]}) — its executor was abandoned by the "
+                "watchdog; the next request builds fresh")
+            return True
+
+    def trim(self, keep=1):
+        """Evict LRU entries down to `keep` — the memory-watermark
+        shedding path (server._shed_memory): each entry pins one
+        problem's matrices, factorizations, and compiled programs, so
+        trimming is what turns an approaching OOM into cold starts
+        instead of a dead daemon. Returns the number evicted."""
+        keep = max(int(keep), 0)
+        n = 0
+        with self._lock:
+            while len(self._entries) > keep:
+                self._pop_lru()
+                n += 1
+        return n
 
     # ------------------------------------------------------------- reset
 

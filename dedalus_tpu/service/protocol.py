@@ -21,6 +21,16 @@ Server -> client kinds:  ready (stdout banner, not a frame), ack,
                          progress, step_metrics (telemetry), result,
                          error, pong, stats
 
+Run headers may carry `deadline_sec` (a per-request deadline the daemon
+enforces at queue pop and mid-run) and an idempotent `id` (a retry of a
+COMPLETED id replays the cached result — ack pool_verdict "replayed",
+result flagged `replayed: true` — instead of re-running). Structured
+`error` codes: bad-frame, bad-spec, build-failed, unknown-kind,
+draining, overloaded (+retry_after_sec), circuit-open
+(+retry_after_sec), deadline-exceeded, watchdog-timeout, health,
+internal — the daemon survives every one of them (docs/serving.md maps
+each to its telemetry and operator action).
+
 Field payloads are `np.savez` archives: one member per field, named
 `<layout>__<fieldname>` with layout `g` (grid) or `c` (coefficient).
 Coefficient layout round-trips bit-exactly (no transform in the path),
@@ -54,8 +64,9 @@ import numpy as np
 
 __all__ = ["PROBLEMS", "ProtocolError", "SpecError", "ServiceError",
            "decode_fields", "encode_fields", "normalize_spec",
-           "recv_frame", "register_problem", "resolve_builder",
-           "send_frame", "spec_digest", "spec_name"]
+           "recv_frame", "recv_header", "recv_payload",
+           "register_problem", "resolve_builder", "send_frame",
+           "spec_digest", "spec_name"]
 
 # Defensive bounds: a stray client writing garbage at the socket must
 # produce a structured error, not an OOM in the daemon. The payload
@@ -77,12 +88,19 @@ class SpecError(ValueError):
 
 
 class ServiceError(RuntimeError):
-    """Client-side surface of a structured `error` reply."""
+    """Client-side surface of a structured `error` reply. `frame` keeps
+    the whole reply; `retry_after_sec` surfaces the daemon's load-shed /
+    circuit cool-off hint when the reply carried one."""
 
-    def __init__(self, code, message):
+    def __init__(self, code, message, frame=None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
+        self.frame = dict(frame) if frame else {}
+
+    @property
+    def retry_after_sec(self):
+        return self.frame.get("retry_after_sec")
 
 
 # ---------------------------------------------------------------- framing
@@ -98,12 +116,15 @@ def send_frame(wfile, header, payload=None):
     wfile.flush()
 
 
-def recv_frame(rfile):
-    """Read one frame. Returns (header, payload_or_None); None header on
-    clean EOF. Raises ProtocolError on garbage or truncation."""
+def recv_header(rfile):
+    """Read and validate ONE frame header line (including its
+    payload_bytes declaration). Returns the header dict, or None on
+    clean EOF. Raises ProtocolError on garbage. Split from recv_frame so
+    a server can bound the header read and the payload read separately
+    (a 256 MiB payload legitimately takes longer than a control line)."""
     line = rfile.readline(MAX_HEADER_BYTES + 1)
     if not line:
-        return None, None
+        return None
     if len(line) > MAX_HEADER_BYTES:
         raise ProtocolError("header line exceeds the size bound")
     try:
@@ -115,13 +136,29 @@ def recv_frame(rfile):
     n = header.get("payload_bytes", 0)
     if not isinstance(n, int) or n < 0 or n > MAX_PAYLOAD_BYTES:
         raise ProtocolError(f"bad payload_bytes: {n!r}")
-    payload = None
-    if n:
-        payload = rfile.read(n)
-        if len(payload) != n:
-            raise ProtocolError(
-                f"truncated payload: expected {n} bytes, got {len(payload)}")
-    return header, payload
+    return header
+
+
+def recv_payload(rfile, header):
+    """Read the payload a validated header declared (None when it
+    declared none). Raises ProtocolError on truncation."""
+    n = header.get("payload_bytes", 0)
+    if not n:
+        return None
+    payload = rfile.read(n)
+    if len(payload) != n:
+        raise ProtocolError(
+            f"truncated payload: expected {n} bytes, got {len(payload)}")
+    return payload
+
+
+def recv_frame(rfile):
+    """Read one frame. Returns (header, payload_or_None); None header on
+    clean EOF. Raises ProtocolError on garbage or truncation."""
+    header = recv_header(rfile)
+    if header is None:
+        return None, None
+    return header, recv_payload(rfile, header)
 
 
 # ------------------------------------------------------- field payloads

@@ -12,6 +12,39 @@ executes through the existing resilient evolve path
 snapshot-rewind/dt-backoff recovery and durable checkpointing as a
 local `solver.evolve_resilient(...)` call.
 
+Request-path fault tolerance (service/faults.py; the orchestration-
+layer sibling of the PR-4 solve-loop resilience):
+
+  * admission control — the run queue is bounded ([service]
+    QUEUE_DEPTH); excess work is refused with a structured `overloaded`
+    error carrying `retry_after_sec` (estimated from the recent
+    per-request wall EWMA), and a process-RSS watermark ([service]
+    MEM_WATERMARK_MB) triggers LRU pool eviction BEFORE an OOM instead
+    of after;
+  * per-request deadlines — clients submit `deadline_sec`; the executor
+    checks it at queue pop (structured `deadline-exceeded` error before
+    any stepping) and the step hook enforces it mid-run (graceful stop
+    through the resilient loop, final durable checkpoint when the
+    request configured one, result frame with
+    `stopped_by: "deadline-exceeded"`);
+  * a watchdog — no step progress on the active run within [service]
+    WATCHDOG_SEC fails the request with a postmortem (thread stacks +
+    request context, emitted to the sink as a `watchdog_postmortem`
+    record), answers the client with `watchdog-timeout`, and REPLACES
+    the wedged executor thread (worker generations) so one hung JAX
+    dispatch cannot wedge the daemon forever;
+  * a per-spec circuit breaker — specs whose build or run fails
+    BREAKER_FAILURES consecutive times cool off with fast-fail
+    `circuit-open` replies, half-open probe on expiry, close on probe
+    success;
+  * idempotent replay — completed results are cached by client-provided
+    request id (RESULT_CACHE entries), so a retry after a dropped
+    `result` frame re-fetches the outcome instead of re-running;
+  * client-drop handling — a dead client socket detected mid-stream
+    (progress/telemetry send fails) either lets the run complete or
+    aborts it at the next step boundary ([service] ON_CLIENT_DROP),
+    counted once, with the run's single telemetry flush intact.
+
 Graceful drain: SIGTERM/SIGINT (or a `shutdown` request) stop the accept
 loop, request a cooperative stop on the in-flight loop via the PR-4
 stop-request machinery — the current step completes, a final durable
@@ -25,10 +58,13 @@ Served-latency fields stamped on every request's telemetry record
 `queue_sec`, `pool_verdict` (hit | warm-cache | cold),
 `time_to_first_step_sec` (dispatch start -> first step complete,
 INCLUDING any build/compile a pool miss pays — the metric the warm pool
-exists to collapse), `build_sec`, and `request_id`.
+exists to collapse), `build_sec`, `request_id`, and `deadline_sec`
+when the request set one. Shed/deadline/watchdog/breaker/drop/replay
+counters ride the `stats` reply and the final `service_stats` record.
 """
 
 import argparse
+import contextlib
 import json
 import logging
 import queue
@@ -40,37 +76,129 @@ import time
 
 import numpy as np
 
-from . import protocol
+from . import faults, protocol
 from .pool import SolverPool
 from ..tools import metrics as metrics_mod
+from ..tools.config import cfg_get
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["SolverService", "main"]
 
+# minimum transfer rate assumed when extending an absolute socket
+# deadline for a large declared payload: a legitimate slow link gets
+# IDLE_TIMEOUT_SEC + bytes/RATE to move the data (steady progress on a
+# big IC upload or result download must not be refused), while a
+# byte-dripper is still cut off in bounded time
+MIN_TRANSFER_BYTES_PER_SEC = 1 << 20
+
+# run-header chaos keys a --chaos daemon accepts (tools/chaos.py
+# ChaosInjector constructor surface; test machinery, never production)
+_CHAOS_KEYS = frozenset({"seed", "nan_field", "nan_iteration",
+                         "nan_member", "fail_checkpoint_write",
+                         "sigterm_iteration", "hang_iteration",
+                         "hang_sec"})
+
+
+@contextlib.contextmanager
+def _socket_deadline(conn, timeout, how):
+    """ABSOLUTE time bound on a socket read or write phase. Per-op
+    socket timeouts reset whenever any bytes (or buffer space) move, so
+    a byte-dripping slow-loris — on either side — never trips them; this
+    timer tears the affected half down (`how`: SHUT_RD leaves the write
+    half usable for a structured error reply; SHUT_RDWR for reply
+    writes), turning the stalled call into an OSError the caller's
+    error path absorbs. Yields a list that is non-empty iff the
+    deadline fired (the read path words its error with it)."""
+    expired = []
+
+    def _expire():
+        expired.append(True)
+        try:
+            conn.shutdown(how)
+        except OSError:
+            pass
+
+    timer = threading.Timer(timeout, _expire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield expired
+    finally:
+        timer.cancel()
+
 
 class SolverService:
 
     def __init__(self, host="127.0.0.1", port=0, pool_size=None, sink=None,
-                 allow_imports=False, drain_grace=600.0):
+                 allow_imports=False, drain_grace=600.0, queue_depth=None,
+                 idle_timeout=None, watchdog_sec=None, breaker_failures=None,
+                 breaker_cooloff=None, result_cache=None,
+                 mem_watermark_mb=None, on_client_drop=None,
+                 chaos_enabled=False):
         self.host = host
         self.port = int(port)
         self.pool = SolverPool(size=pool_size, allow_imports=allow_imports)
         self.sink = str(sink) if sink else None
         self.drain_grace = float(drain_grace)
+        # ---- fault-tolerance knobs (None pulls the [service] default)
+        self.queue_depth = max(int(
+            queue_depth if queue_depth is not None
+            else cfg_get("service", "QUEUE_DEPTH", "8")), 1)
+        self.idle_timeout = float(
+            idle_timeout if idle_timeout is not None
+            else cfg_get("service", "IDLE_TIMEOUT_SEC", "60"))
+        self.watchdog_sec = float(
+            watchdog_sec if watchdog_sec is not None
+            else cfg_get("service", "WATCHDOG_SEC", "300"))
+        self.on_client_drop = str(
+            on_client_drop if on_client_drop is not None
+            else cfg_get("service", "ON_CLIENT_DROP", "complete")).lower()
+        if self.on_client_drop not in ("complete", "abort"):
+            raise ValueError(f"ON_CLIENT_DROP must be 'complete' or "
+                             f"'abort', got {self.on_client_drop!r}")
+        self.mem_watermark_bytes = int(float(
+            mem_watermark_mb if mem_watermark_mb is not None
+            else cfg_get("service", "MEM_WATERMARK_MB", "0")) * 2**20)
+        self.breaker = faults.CircuitBreaker(
+            failures=int(breaker_failures if breaker_failures is not None
+                         else cfg_get("service", "BREAKER_FAILURES", "3")),
+            cooloff_sec=float(
+                breaker_cooloff if breaker_cooloff is not None
+                else cfg_get("service", "BREAKER_COOLOFF_SEC", "30")))
+        self.results = faults.ResultCache(
+            size=int(result_cache if result_cache is not None
+                     else cfg_get("service", "RESULT_CACHE", "16")))
+        self.chaos_enabled = bool(chaos_enabled)
+        # ---- request accounting
         self.requests_served = 0
         self.errors = 0
+        self.shed = 0
+        self.deadline_exceeded = 0
+        self.watchdog_fires = 0
+        self.client_drops = 0
+        self.mem_evictions = 0
         self._request_seq = 0     # default-id counter: EVERY run request
                                   # advances it (success or not), so ids
                                   # in the telemetry sink never collide
-        # errors is bumped from reader threads, the worker, and the
-        # drain sweep concurrently; unguarded `+= 1` loses increments
-        self._errors_lock = threading.Lock()
+        # counters are bumped from reader threads, workers, the watchdog,
+        # and the drain sweep concurrently; unguarded `+= 1` loses counts
+        self._counters_lock = threading.Lock()
         self.started_ts = None
+        # the queue object is unbounded; admission is bounded by the
+        # _queued_runs counter so the drain sentinel can never block on
+        # a full queue behind a wedged executor
         self._queue = queue.Queue()
+        self._queued_runs = 0
+        self._avg_run_sec = None      # EWMA of per-request executor wall
         self._draining = None
-        self._active_loop = None
+        self._active_run = None       # faults.RunContext while executing
         self._active_lock = threading.Lock()
+        self._worker_gen = 0          # bumped when the watchdog replaces
+                                      # a wedged executor thread
+        self._worker_thread = None
+        self._watchdog = faults.Watchdog(
+            self._get_active_run, self._watchdog_fire, self.watchdog_sec)
         self._sock = None
 
     # ---------------------------------------------------------- lifecycle
@@ -84,12 +212,25 @@ class SolverService:
             logger.warning(f"service: draining ({why}) — in-flight run "
                            "will checkpoint and stop")
         with self._active_lock:
-            loop = self._active_loop
-        if loop is not None:
-            loop.request_stop(str(why))
+            ctx = self._active_run
+        if ctx is not None and ctx.loop is not None:
+            ctx.loop.request_stop(str(why))
 
     def _handle_signal(self, signum, frame):
         self.request_drain(signal.Signals(signum).name)
+
+    def _start_worker(self):
+        """Start a (replacement) executor thread. The generation stamp
+        lets a watchdog-abandoned worker notice it was declared dead and
+        exit after its current run instead of racing the replacement for
+        queue items."""
+        self._worker_gen += 1
+        gen = self._worker_gen
+        thread = threading.Thread(target=self._worker, args=(gen,),
+                                  name=f"service-worker-{gen}", daemon=True)
+        self._worker_thread = thread
+        thread.start()
+        return thread
 
     def serve_forever(self, ready_stream=None):
         """Bind, announce readiness, and serve until drained. Prints ONE
@@ -110,16 +251,16 @@ class SolverService:
         self.port = self._sock.getsockname()[1]
         self._sock.settimeout(0.2)
         self.started_ts = time.time()
-        worker = threading.Thread(target=self._worker, name="service-worker",
-                                  daemon=True)
-        worker.start()
+        self._start_worker()
+        self._watchdog.start()
         import os
         banner = {"kind": "ready", "port": self.port, "pid": os.getpid(),
                   "pool_size": self.pool.size}
         stream = ready_stream if ready_stream is not None else sys.stdout
         print(json.dumps(banner), file=stream, flush=True)
         logger.info(f"service: listening on {self.host}:{self.port} "
-                    f"(pool size {self.pool.size})")
+                    f"(pool size {self.pool.size}, queue depth "
+                    f"{self.queue_depth})")
         try:
             while self._draining is None:
                 try:
@@ -133,11 +274,14 @@ class SolverService:
                                  daemon=True).start()
         finally:
             self._sock.close()
+            self._watchdog.stop()
             self._queue.put(None)           # worker stop sentinel
-            worker.join(timeout=self.drain_grace)
-            if worker.is_alive():
-                logger.error("service: worker did not drain within "
-                             f"{self.drain_grace}s; exiting anyway")
+            worker = self._worker_thread
+            if worker is not None:
+                worker.join(timeout=self.drain_grace)
+                if worker.is_alive():
+                    logger.error("service: worker did not drain within "
+                                 f"{self.drain_grace}s; exiting anyway")
             self._refuse_queued()
             self._flush_stats()
             for signum, handler in previous.items():
@@ -149,14 +293,18 @@ class SolverService:
 
     def _flush_stats(self):
         """One `service_stats` record to the sink (and the log) at drain:
-        pool hit/miss/eviction counters + request totals, so the serving
-        trajectory is machine-recorded like every other subsystem."""
+        pool hit/miss/eviction counters + request/fault totals, so the
+        serving trajectory is machine-recorded like every other
+        subsystem."""
         record = dict(self.stats(), kind="service_stats",
                       ts=round(time.time(), 1))
-        if self.sink:
-            sink = metrics_mod.Metrics(sink=self.sink, enabled=True)
-            sink.emit(record)
+        self._emit(record)
         logger.info(f"service: final stats {json.dumps(record)}")
+
+    def _emit(self, record):
+        """Append one record to the telemetry sink (no-op when sinkless)."""
+        if self.sink:
+            metrics_mod.Metrics(sink=self.sink, enabled=True).emit(record)
 
     def stats(self):
         return {
@@ -166,6 +314,18 @@ class SolverService:
             "uptime_sec": round(time.time() - self.started_ts, 1)
             if self.started_ts else 0.0,
             "pool": self.pool.stats(),
+            "faults": {
+                "queue_depth": self.queue_depth,
+                "queued": self._queued_runs,
+                "shed": self.shed,
+                "deadline_exceeded": self.deadline_exceeded,
+                "watchdog_fires": self.watchdog_fires,
+                "client_drops": self.client_drops,
+                "mem_evictions": self.mem_evictions,
+                "replays": self.results.replays,
+                "result_cache": len(self.results),
+                "breaker": self.breaker.stats(),
+            },
         }
 
     # ----------------------------------------------------- reader threads
@@ -173,19 +333,41 @@ class SolverService:
     def _receive(self, conn, t_accept):
         """Per-connection reader: parse the one request frame, answer
         control kinds inline (so `shutdown` can drain an in-flight run
-        and `ping`/`stats` stay responsive during one), and enqueue runs
-        for the single executor. Closes the connection itself on every
-        path except a queued run (the worker owns that close)."""
+        and `ping`/`stats` stay responsive during one), and admit runs
+        for the executor — bounded queue, circuit-breaker fast-fail, and
+        result-cache replay all happen here, before any solver work.
+        Closes the connection itself on every path except a queued run
+        (the worker owns that close). The connection read/write timeout
+        ([service] IDLE_TIMEOUT_SEC) bounds slow-loris clients on both
+        the request read and the result write."""
         enqueued = False
         try:
-            conn.settimeout(60.0)
+            conn.settimeout(self.idle_timeout)
             rfile = conn.makefile("rb")
             wfile = conn.makefile("wb")
+            # absolute bounds on the request read (the per-recv socket
+            # timeout cannot stop a byte-dripping slow loris); SHUT_RD
+            # leaves the write half usable for the error reply. The
+            # header line gets the flat bound; the payload budget scales
+            # with its declared size so legitimate slow uploads of large
+            # ICs are not refused while still bounding total time.
+            expired = []
             try:
-                header, payload = protocol.recv_frame(rfile)
+                with _socket_deadline(conn, self.idle_timeout,
+                                      socket.SHUT_RD) as expired:
+                    header = protocol.recv_header(rfile)
+                payload = None
+                if header is not None and header.get("payload_bytes", 0):
+                    budget = self.idle_timeout \
+                        + header["payload_bytes"] / MIN_TRANSFER_BYTES_PER_SEC
+                    with _socket_deadline(conn, budget,
+                                          socket.SHUT_RD) as expired:
+                        payload = protocol.recv_payload(rfile, header)
             except (protocol.ProtocolError, OSError) as exc:
                 self._count_error()
-                self._send_error(wfile, "bad-frame", str(exc))
+                why = (f"request not completed within its transfer "
+                       f"budget: {exc}" if expired else str(exc))
+                self._send_error(wfile, "bad-frame", why)
                 return
             if header is None:
                 return
@@ -200,14 +382,8 @@ class SolverService:
                                             "draining": True})
                 self.request_drain("shutdown request")
             elif kind == "run":
-                if self._draining is not None:
-                    self._count_error()
-                    self._send_error(
-                        wfile, "draining",
-                        f"daemon is draining ({self._draining})")
-                    return
-                self._queue.put((conn, wfile, header, payload, t_accept))
-                enqueued = True
+                enqueued = self._admit_run(conn, wfile, header, payload,
+                                           t_accept)
             else:
                 self._count_error()
                 self._send_error(wfile, "unknown-kind",
@@ -222,14 +398,145 @@ class SolverService:
                 except OSError:
                     pass
 
+    def _admit_run(self, conn, wfile, header, payload, t_accept):
+        """Admission control for one run request (reader thread). Returns
+        True when the request was enqueued (the worker then owns the
+        connection). Order matters: replay first (a finished result is
+        returned even under overload or an open circuit), then queue
+        capacity, then the breaker — so a shed request never consumes
+        the half-open probe slot."""
+        if self._draining is not None:
+            self._count_error()
+            self._send_error(wfile, "draining",
+                             f"daemon is draining ({self._draining})")
+            return False
+        client_id = header.get("id")
+        if client_id is not None and self._send_replay(conn, wfile, header,
+                                                       str(client_id)):
+            return False
+        # bounded admission: reserve a queue slot or shed
+        with self._counters_lock:
+            if self._queued_runs >= self.queue_depth:
+                self.shed += 1
+                self.errors += 1
+                shed = True
+            else:
+                self._queued_runs += 1
+                shed = False
+        if shed:
+            retry_after = self._retry_after()
+            self._send_error(
+                wfile, "overloaded",
+                f"run queue is full ({self.queue_depth} deep); retry "
+                f"in ~{retry_after}s",
+                retry_after_sec=retry_after)
+            return False
+        digest = self._spec_digest(header)
+        probe = False
+        if digest is not None:
+            allowed, retry_after, state = self.breaker.admit(digest)
+            if not allowed:
+                with self._counters_lock:
+                    self._queued_runs -= 1
+                    self.errors += 1
+                self._send_error(
+                    wfile, "circuit-open",
+                    f"spec {digest[:12]} is cooling off after repeated "
+                    f"failures; retry in ~{retry_after}s",
+                    retry_after_sec=retry_after)
+                return False
+            probe = state == "probe"
+        deadline_mono = None
+        deadline = header.get("deadline_sec")
+        if isinstance(deadline, (int, float)) and deadline > 0:
+            deadline_mono = time.monotonic() + float(deadline)
+        self._queue.put({"conn": conn, "wfile": wfile, "header": header,
+                         "payload": payload, "t_accept": t_accept,
+                         "deadline_mono": deadline_mono, "probe": probe})
+        return True
+
+    @staticmethod
+    def _spec_digest(header):
+        """Spec digest for breaker accounting, or None when the spec is
+        malformed (full validation — and the structured bad-spec reply —
+        happens at the executor)."""
+        try:
+            return protocol.spec_digest(header.get("spec"))
+        except Exception:
+            return None
+
+    @classmethod
+    def _run_fingerprint(cls, header):
+        """Identity of a run request for idempotent replay: the spec
+        digest composed with every outcome-affecting run parameter. A
+        retry with the same id but a different fingerprint must NOT be
+        answered from the cache (it re-runs, and its completion
+        overwrites the entry) — an id can never serve another request's
+        result."""
+        import hashlib
+        blob = json.dumps(
+            [cls._spec_digest(header), header.get("dt"),
+             header.get("stop_iteration"), header.get("stop_sim_time"),
+             header.get("layout", "c"), header.get("outputs"),
+             header.get("deadline_sec"), header.get("resume"),
+             bool(header.get("checkpoint"))],
+            sort_keys=True, default=str)
+        return hashlib.blake2b(blob.encode(), digest_size=12).hexdigest()
+
+    def _send_replay(self, conn, wfile, header, client_id):
+        """Serve a cached completed result for an idempotent retry.
+        Returns True when the id hit the cache WITH a matching run
+        fingerprint (frames sent, connection done) — the solve is NOT
+        re-run; an id reused with a different spec/params is a miss and
+        executes fresh. The replayed payload write gets the same
+        ABSOLUTE slow-reader bound as the executor's reply phase
+        (per-send timeouts reset on every freed buffer byte, and replay
+        is served before admission — a byte-at-a-time reader must not
+        pin reader threads and payloads unboundedly)."""
+        cached = self.results.get(client_id,
+                                  fingerprint=self._run_fingerprint(header))
+        if cached is None:
+            return False
+        record, result, payload, _fingerprint = cached
+        budget = self.idle_timeout + (len(payload) if payload else 0) \
+            / MIN_TRANSFER_BYTES_PER_SEC
+        with _socket_deadline(conn, budget, socket.SHUT_RDWR):
+            try:
+                protocol.send_frame(wfile, {
+                    "kind": "ack", "id": client_id,
+                    "pool_verdict": "replayed",
+                    "queue_sec": 0.0, "build_sec": 0.0})
+                if record is not None:
+                    try:
+                        protocol.send_frame(wfile, record)
+                    except (TypeError, ValueError):
+                        # a sinkless daemon never JSON-validated the
+                        # record at flush time; skip it on replay exactly
+                        # like the direct path does — the result frame
+                        # must still go
+                        logger.warning("service: cached telemetry record "
+                                       "not JSON-serializable; skipped")
+                protocol.send_frame(wfile, dict(result, replayed=True),
+                                    payload=payload)
+            except OSError:
+                pass   # the retrying client vanished; cache entry stays
+        logger.info(f"service: replayed cached result for request "
+                    f"{client_id}")
+        return True
+
     # ------------------------------------------------------------- worker
 
-    def _worker(self):
-        while True:
+    def _worker(self, gen=None):
+        if gen is None:
+            gen = self._worker_gen
+        while gen == self._worker_gen:
             item = self._queue.get()
             if item is None:
                 return
-            conn, wfile, header, payload, t_accept = item
+            conn, wfile = item["conn"], item["wfile"]
+            with self._counters_lock:
+                self._queued_runs -= 1
+            abandoned = False
             try:
                 if self._draining is not None:
                     # drain began while this run sat in the queue
@@ -238,7 +545,13 @@ class SolverService:
                         wfile, "draining",
                         f"daemon is draining ({self._draining})")
                 else:
-                    self._handle_run(header, payload, wfile, t_accept)
+                    self._handle_run(item)
+            except faults.AbandonedRun:
+                # the watchdog failed this run and is replacing this
+                # worker; the reply and the close already happened there
+                logger.warning("service: abandoned run unwound; stale "
+                               "executor exiting")
+                abandoned = True
             except Exception:
                 self._count_error()
                 logger.exception("service: connection handler failed")
@@ -247,6 +560,16 @@ class SolverService:
                     conn.close()
                 except OSError:
                     pass
+            if abandoned:
+                # exit UNCONDITIONALLY, not via the generation check: the
+                # fire sets ctx.abandoned BEFORE it bumps the generation,
+                # so an unwinding worker can observe itself still
+                # "current" — looping back here would leave TWO live
+                # executors racing the queue (and wedge the drain
+                # sentinel, which only one of them can consume)
+                return
+        # generation mismatch: this worker was declared dead mid-run and
+        # a replacement owns the queue now — exit without touching it
 
     def _refuse_queued(self):
         """After the worker exits, answer any run a reader enqueued in
@@ -258,25 +581,116 @@ class SolverService:
                 return
             if item is None:
                 continue
-            conn, wfile = item[0], item[1]
-            self._send_error(wfile, "draining",
+            # same accounting as the worker-side drain refusal: release
+            # the reserved queue slot and count the error, or the final
+            # service_stats record claims phantom queued work
+            with self._counters_lock:
+                self._queued_runs -= 1
+                self.errors += 1
+            self._send_error(item["wfile"], "draining",
                              f"daemon is draining ({self._draining})")
             try:
-                conn.close()
+                item["conn"].close()
             except OSError:
                 pass
 
     def _count_error(self):
-        with self._errors_lock:
+        with self._counters_lock:
             self.errors += 1
 
+    def _count(self, name, n=1):
+        with self._counters_lock:
+            setattr(self, name, getattr(self, name) + n)
+
     @staticmethod
-    def _send_error(wfile, code, message):
+    def _send_error(wfile, code, message, **extra):
         try:
-            protocol.send_frame(wfile, {"kind": "error", "code": code,
-                                        "message": message})
+            frame = {"kind": "error", "code": code, "message": message}
+            frame.update(extra)
+            protocol.send_frame(wfile, frame)
         except OSError:
             pass   # client gone; nothing to tell it
+
+    # ----------------------------------------------------------- watchdog
+
+    def _get_active_run(self):
+        with self._active_lock:
+            return self._active_run
+
+    def _watchdog_fire(self, ctx, stuck_sec):
+        """The active run made no step progress within WATCHDOG_SEC: fail
+        it with a postmortem and replace the wedged executor. Runs on the
+        watchdog thread — the executor is hung by premise, so writing the
+        error frame from here cannot interleave with a healthy stream
+        (the pathological case, a hang INSIDE a partial frame write,
+        degrades to a protocol error on the client, never a wrong
+        result)."""
+        with self._active_lock:
+            if self._active_run is not ctx:
+                # the run finished between the watchdog's poll and this
+                # fire: it was never hung — leave the reply alone
+                return
+            self._active_run = None
+        # abandon FIRST: a slow-but-alive executor must stop writing to
+        # this socket (its next step hook raises AbandonedRun) before we
+        # put the structured error frame on it
+        ctx.abandoned.set()
+        self._count("watchdog_fires")
+        self._count_error()
+        iteration = None
+        if ctx.loop is not None:
+            try:
+                iteration = int(ctx.loop.solver.iteration)
+            except Exception:
+                pass
+        record = {
+            "kind": "watchdog_postmortem",
+            "request_id": ctx.request_id,
+            "stuck_sec": round(stuck_sec, 3),
+            "watchdog_sec": self.watchdog_sec,
+            "request_age_sec": round(time.monotonic() - ctx.started_ts, 3),
+            "iteration": iteration,
+            "stacks": faults.thread_stacks(),
+        }
+        logger.error(
+            f"service: WATCHDOG — request {ctx.request_id} made no step "
+            f"progress for {stuck_sec:.1f}s (> {self.watchdog_sec}s); "
+            "failing it with a postmortem and replacing the executor")
+        self._emit(record)
+        if ctx.digest is not None:
+            if ctx.client_gone:
+                # the stall followed a known-dead client (same
+                # attribution rule as the ack/drop paths: a dropped
+                # connection says nothing about the SPEC) — release any
+                # probe slot instead of blaming the circuit
+                self.breaker.abandon_probe(ctx.digest)
+            else:
+                self.breaker.record_failure(ctx.digest)
+            # quarantine the pool entry BEFORE the replacement executor
+            # starts: the stale executor may still be inside a dispatch
+            # on this solver, and a pool hit by the replacement would
+            # share (and race) the very instance that is wedged — a
+            # spurious fire on a slow-but-alive step would then serve
+            # corrupted state as a healthy result
+            self.pool.discard(ctx.digest)
+        # the error write shares ctx.wfile's buffered-writer lock with
+        # the (possibly mid-send) wedged executor: if the stall IS a
+        # blocked send to a byte-dripping client, writing here would
+        # deadlock the watchdog on that lock. The bounded deadline tears
+        # the socket down in that case — unblocking BOTH writers — and
+        # in the ordinary hung-dispatch case (wfile idle) the structured
+        # error goes out normally.
+        with _socket_deadline(ctx.conn, min(self.idle_timeout, 10.0),
+                              socket.SHUT_RDWR):
+            self._send_error(
+                ctx.wfile, "watchdog-timeout",
+                f"no step progress within {self.watchdog_sec}s "
+                f"(request {ctx.request_id}); postmortem recorded")
+        try:
+            ctx.conn.close()
+        except OSError:
+            pass
+        self._start_worker()
 
     # ---------------------------------------------------------------- run
 
@@ -330,6 +744,13 @@ class SolverService:
             raise protocol.SpecError(
                 f"run: progress_every must be a non-negative integer, "
                 f"got {header.get('progress_every')!r}")
+        deadline = header.get("deadline_sec")
+        if deadline is not None and (
+                not isinstance(deadline, (int, float))
+                or not np.isfinite(deadline) or deadline <= 0):
+            raise protocol.SpecError(
+                f"run: deadline_sec must be a positive finite number, "
+                f"got {deadline!r}")
         return {
             "dt": float(dt),
             "stop_iteration": stop_iteration,
@@ -339,7 +760,44 @@ class SolverService:
             "checkpoint": checkpoint,
             "resume": bool(header.get("resume")),
             "progress_every": progress_every,
+            "deadline_sec": float(deadline) if deadline is not None
+            else None,
         }
+
+    def _build_chaos(self, header):
+        """Construct a per-run ChaosInjector from the request header —
+        ONLY on a daemon started with --chaos (test machinery: the chaos
+        suite drives daemon-side faults deterministically)."""
+        spec = header.get("chaos")
+        if spec is None:
+            return None
+        if not self.chaos_enabled:
+            raise protocol.SpecError(
+                "run: chaos injection is disabled on this daemon "
+                "(start it with --chaos; test deployments only)")
+        if not isinstance(spec, dict):
+            raise protocol.SpecError("run: chaos must be a JSON object")
+        unknown = sorted(set(spec) - _CHAOS_KEYS)
+        if unknown:
+            raise protocol.SpecError(
+                f"run: unknown chaos key(s) {unknown} "
+                f"(known: {sorted(_CHAOS_KEYS)})")
+        from ..tools.chaos import ChaosInjector
+        try:
+            injector = ChaosInjector(**spec)
+            # pre-coerce the lazily-used numeric knobs so a bad value is
+            # a structured bad-spec now, not a mid-run executor blowup
+            if injector.hang_sec is not None:
+                injector.hang_sec = float(injector.hang_sec)
+            if injector.hang_iteration is not None:
+                injector.hang_iteration = int(injector.hang_iteration)
+            if injector.nan_iteration is not None:
+                injector.nan_iteration = int(injector.nan_iteration)
+            if injector.sigterm_iteration is not None:
+                injector.sigterm_iteration = int(injector.sigterm_iteration)
+            return injector
+        except (TypeError, ValueError) as exc:
+            raise protocol.SpecError(f"run: bad chaos block: {exc}")
 
     @staticmethod
     def _fields_by_name(solver):
@@ -386,66 +844,235 @@ class SolverService:
                 f"(known: {sorted(k for k in by_name if k)})")
         return [by_name[n] for n in names]
 
-    def _handle_run(self, header, payload, wfile, t_accept):
+    def _retry_after(self):
+        """Load-shed hint: roughly how long until a queue slot drains,
+        from the per-request executor-wall EWMA."""
+        base = self._avg_run_sec if self._avg_run_sec else 1.0
+        return round(min(max(base * (self._queued_runs + 1), 1.0), 600.0), 1)
+
+    def _observe_run_wall(self, t_dispatch):
+        wall = time.perf_counter() - t_dispatch
+        if self._avg_run_sec is None:
+            self._avg_run_sec = wall
+        else:
+            self._avg_run_sec = 0.7 * self._avg_run_sec + 0.3 * wall
+
+    def _shed_memory(self):
+        """Process-RSS watermark: above [service] MEM_WATERMARK_MB, evict
+        warm pool entries down to one BEFORE the next build can OOM the
+        daemon (each entry pins matrices + factorizations + compiled
+        programs)."""
+        if not self.mem_watermark_bytes:
+            return
+        rss = metrics_mod.process_rss_bytes()
+        if not rss or rss <= self.mem_watermark_bytes:
+            return
+        if len(self.pool) <= 1 and not len(self.results):
+            return
+        # both warm tiers are shed: pool entries pin matrices + compiled
+        # programs, cached results pin whole npz payloads — either can
+        # dominate RSS, and the daemon staying alive outranks both
+        evicted = self.pool.trim(keep=1)
+        dropped = self.results.clear()
+        if evicted or dropped:
+            self._count("mem_evictions", evicted)
+            logger.warning(
+                f"service: RSS {rss / 2**20:.0f} MiB over the "
+                f"{self.mem_watermark_bytes / 2**20:.0f} MiB watermark; "
+                f"evicted {evicted} warm pool entr(ies), dropped "
+                f"{dropped} cached result(s)")
+
+    def _handle_run(self, item):
         from ..tools.resilience import ResilientLoop
         from ..tools.exceptions import SolverHealthError
         import jax
+        header, payload = item["header"], item["payload"]
+        wfile, conn = item["wfile"], item["conn"]
         t_dispatch = time.perf_counter()
-        queue_sec = t_dispatch - t_accept
-        self._request_seq += 1
-        request_id = str(header.get("id") or f"r{self._request_seq}")
+        queue_sec = t_dispatch - item["t_accept"]
+        # locked: after a watchdog fire a stale executor can briefly
+        # overlap the replacement, and colliding default ids would break
+        # the never-collide invariant the telemetry sink relies on
+        with self._counters_lock:
+            self._request_seq += 1
+            seq = self._request_seq
+        client_id = header.get("id")
+        request_id = str(client_id or f"r{seq}")
+        # replay re-check: the original of an idempotent retry may have
+        # completed while the retry sat in the queue
+        if client_id is not None and self._send_replay(conn, wfile, header,
+                                                       str(client_id)):
+            if item.get("probe"):
+                # this request was admitted as the half-open probe but
+                # resolved without running: free the slot or the circuit
+                # could never close
+                replay_digest = self._spec_digest(header)
+                if replay_digest is not None:
+                    self.breaker.abandon_probe(replay_digest)
+            return
+        probe = item.get("probe", False)
+        digest = None
         try:
             spec = protocol.normalize_spec(header.get("spec"))
+            digest = protocol.spec_digest(spec)
             params = self._run_params(header)
-            ics = protocol.decode_fields(payload) if payload else {}
-            entry, verdict, build_sec = self.pool.acquire(spec)
-            solver = entry.solver
-            self._install_ics(solver, ics)
-            targets = self._output_fields(solver, params["outputs"])
+            chaos = self._build_chaos(header)
         except protocol.SpecError as exc:
             self._count_error()
             self._send_error(wfile, "bad-spec", str(exc))
+            if probe and digest is not None:
+                self.breaker.abandon_probe(digest)
+            return
+        if not probe:
+            # the circuit may have opened (or half-opened) while this
+            # request sat in the queue
+            allowed, retry_after, state = self.breaker.admit(digest)
+            if not allowed:
+                self._count_error()
+                self._send_error(
+                    wfile, "circuit-open",
+                    f"spec {digest[:12]} is cooling off after repeated "
+                    f"failures; retry in ~{retry_after}s",
+                    retry_after_sec=retry_after)
+                return
+            probe = state == "probe"
+        deadline_mono = item.get("deadline_mono")
+        if deadline_mono is not None and time.monotonic() >= deadline_mono:
+            self._count("deadline_exceeded")
+            self._count_error()
+            self._send_error(
+                wfile, "deadline-exceeded",
+                f"run: deadline_sec={params['deadline_sec']} elapsed "
+                f"while queued ({queue_sec:.2f}s in queue)")
+            if probe:
+                self.breaker.abandon_probe(digest)
+            return
+        self._shed_memory()
+        # the active-run context is registered BEFORE the build so the
+        # watchdog also covers a hung build/compile (WATCHDOG_SEC must
+        # exceed the worst-case cold start — docs/serving.md)
+        ctx = faults.RunContext(request_id, digest, conn, wfile, None,
+                                deadline_ts=deadline_mono, probe=probe,
+                                header=header)
+        with self._active_lock:
+            self._active_run = ctx
+        try:
+            self._execute_run(ctx, spec, params, payload, chaos,
+                              t_dispatch, queue_sec, client_id,
+                              ResilientLoop, SolverHealthError, jax)
+        finally:
+            with self._active_lock:
+                if self._active_run is ctx:
+                    self._active_run = None
+
+    def _execute_run(self, ctx, spec, params, payload, chaos, t_dispatch,
+                     queue_sec, client_id, ResilientLoop,
+                     SolverHealthError, jax):
+        wfile = ctx.wfile
+        request_id, digest, probe = ctx.request_id, ctx.digest, ctx.probe
+        try:
+            ics = protocol.decode_fields(payload) if payload else {}
+            entry, verdict, build_sec = self.pool.acquire(spec)
+            if ctx.abandoned.is_set():
+                # the watchdog fired during OUR build: its quarantine ran
+                # before this build finished and re-inserted the entry,
+                # so drop it again — the replacement executor must never
+                # share a solver this (stale) thread has touched
+                self.pool.discard(digest)
+                raise faults.AbandonedRun(request_id)
+            solver = entry.solver
+            self._install_ics(solver, ics)
+            targets = self._output_fields(solver, params["outputs"])
+        except faults.AbandonedRun:
+            raise
+        except protocol.SpecError as exc:
+            self._count_error()
+            self._send_error(wfile, "bad-spec", str(exc))
+            if probe:
+                self.breaker.abandon_probe(digest)
             return
         except Exception as exc:
+            if ctx.abandoned.is_set():
+                # the watchdog fired during this build and already judged
+                # the request (breaker failure recorded, client answered,
+                # connection closed): a second count or a reply on the
+                # dead socket would double-book the one wedged request
+                raise faults.AbandonedRun(request_id)
             # a builder blowing up on technically-valid params (resolution
             # the basis rejects, singular operator, ...) must reply
-            # structurally, not drop the connection
+            # structurally, not drop the connection — and it counts
+            # against the spec's circuit
             self._count_error()
             logger.exception(f"service: build for request {request_id} "
                              "failed")
+            self.breaker.record_failure(digest)
             self._send_error(wfile, "build-failed",
                              f"{type(exc).__name__}: {exc}")
             return
+        if ctx.abandoned.is_set():
+            raise faults.AbandonedRun(request_id)
         if params["stop_iteration"] is not None:
             solver.stop_iteration = params["stop_iteration"]
         if params["stop_sim_time"] is not None:
             solver.stop_sim_time = params["stop_sim_time"]
         solver.metrics.sink = self.sink
         solver.metrics.meta["config"] = f"{protocol.spec_name(spec)}_served"
-        protocol.send_frame(wfile, {
-            "kind": "ack", "id": request_id, "pool_verdict": verdict,
-            "queue_sec": round(queue_sec, 6),
-            "build_sec": round(build_sec, 4)})
+        try:
+            protocol.send_frame(wfile, {
+                "kind": "ack", "id": request_id, "pool_verdict": verdict,
+                "queue_sec": round(queue_sec, 6),
+                "build_sec": round(build_sec, 4)})
+        except OSError:
+            # the client died before its ack: nothing to serve. Says
+            # nothing about the SPEC, so a half-open probe slot must be
+            # released, not judged — otherwise the circuit never closes
+            self._count("client_drops")
+            if probe:
+                self.breaker.abandon_probe(digest)
+            logger.warning(f"service: client for {request_id} vanished "
+                           "before the ack; run skipped")
+            return
 
         ttfs = [None]
         progress_every = params["progress_every"]
         progress_next = [progress_every]
 
         def step_hook(s):
+            # ctx.loop is assigned before loop.run() and the hook only
+            # fires inside it, so the reference is always live here
+            if ctx.abandoned.is_set():
+                raise faults.AbandonedRun(request_id)
+            ctx.last_progress = time.monotonic()
             # first completed step: block so time-to-first-step covers the
             # device tail (and, on a miss, the build + compile it followed)
             if ttfs[0] is None:
                 jax.block_until_ready(s.X)
                 ttfs[0] = time.perf_counter() - t_dispatch
+            if ctx.deadline_ts is not None and not ctx.deadline_fired \
+                    and time.monotonic() >= ctx.deadline_ts:
+                ctx.deadline_fired = True
+                self._count("deadline_exceeded")
+                logger.warning(
+                    f"service: request {request_id} exceeded its "
+                    f"{params['deadline_sec']}s deadline at iteration "
+                    f"{int(s.iteration)}; stopping gracefully")
+                ctx.loop.request_stop("deadline-exceeded")
             if progress_every and s.iteration >= progress_next[0]:
                 progress_next[0] = s.iteration + progress_every
+                # no per-send deadline timer here (a Timer thread per
+                # progress frame would tax the hot loop): a send stalled
+                # by a byte-dripping client freezes last_progress, so
+                # the WATCHDOG reaps it like any other executor stall.
+                # The absolute _socket_deadline timers guard only the
+                # phases outside watchdog coverage (reader-thread request
+                # reads, the post-run reply).
                 try:
                     protocol.send_frame(wfile, {
                         "kind": "progress", "id": request_id,
                         "iteration": int(s.iteration),
                         "sim_time": float(s.sim_time)})
                 except OSError:
-                    pass   # client hung up; finish the run regardless
+                    self._client_dropped(ctx, ctx.loop)
 
         loop_kw = {}
         checkpoint = params["checkpoint"]
@@ -457,22 +1084,46 @@ class SolverService:
         # fields stamped on it); the loop's own exit flush is suppressed
         loop = ResilientLoop(solver, dt=params["dt"], step_hook=step_hook,
                              install_signal_handlers=False,
-                             flush_telemetry=False, **loop_kw)
-        with self._active_lock:
-            self._active_loop = loop
+                             flush_telemetry=False, chaos=chaos, **loop_kw)
+        ctx.loop = loop
         if self._draining is not None:
             # drain began between queue pop and loop construction: stop at
             # the first boundary, still writing the final checkpoint
             loop.request_stop(self._draining)
+        serving = {
+            "queue_sec": round(queue_sec, 6),
+            "pool_verdict": verdict,
+            "time_to_first_step_sec": None,
+            "build_sec": round(build_sec, 4),
+            "request_id": request_id,
+        }
+        if params["deadline_sec"] is not None:
+            serving["deadline_sec"] = params["deadline_sec"]
         try:
-            summary = loop.run(log_cadence=0)
+            try:
+                summary = loop.run(log_cadence=0)
+            finally:
+                # the solve is over (or failed): everything below is
+                # reply-phase IO — telemetry flush, result encode, and a
+                # possibly SLOW-READING client draining a large payload.
+                # None of that is a hung dispatch, so the run must stop
+                # being watchdog-eligible here, not after the reply.
+                # (The graceful-stop final checkpoint runs INSIDE run()
+                # and stays covered: a wedged checkpoint write really
+                # does wedge the executor.)
+                with self._active_lock:
+                    if self._active_run is ctx:
+                        self._active_run = None
         except SolverHealthError as exc:
+            if ctx.abandoned.is_set():
+                # the watchdog already judged, answered, and postmortemed
+                # this request: a second breaker failure / error count /
+                # telemetry flush would double-book the one wedged run
+                raise faults.AbandonedRun(request_id)
             self._count_error()
-            serving = {"queue_sec": round(queue_sec, 6),
-                       "pool_verdict": verdict,
-                       "time_to_first_step_sec": ttfs[0],
-                       "build_sec": round(build_sec, 4),
-                       "request_id": request_id}
+            self.breaker.record_failure(digest)
+            self._observe_run_wall(t_dispatch)
+            serving["time_to_first_step_sec"] = ttfs[0]
             try:
                 solver.flush_metrics(extra={"serving": serving})
             except Exception:
@@ -481,36 +1132,40 @@ class SolverService:
                 wfile, "health",
                 f"run halted unrecoverably: {getattr(exc, 'reason', exc)}")
             return
+        except faults.AbandonedRun:
+            raise
         except Exception as exc:
+            if ctx.abandoned.is_set():
+                raise faults.AbandonedRun(request_id)
             self._count_error()
+            # counted against the circuit too: without a verdict a
+            # half-open probe slot would stay consumed forever
+            self.breaker.record_failure(digest)
             logger.exception(f"service: request {request_id} failed")
             self._send_error(wfile, "internal",
                              f"{type(exc).__name__}: {exc}")
             return
-        finally:
-            with self._active_lock:
-                self._active_loop = None
-        serving = {
-            "queue_sec": round(queue_sec, 6),
-            "pool_verdict": verdict,
-            "time_to_first_step_sec": round(ttfs[0], 6)
-            if ttfs[0] is not None else None,
-            "build_sec": round(build_sec, 4),
-            "request_id": request_id,
-        }
+        if ctx.abandoned.is_set():
+            # spurious watchdog fire on a run that then completed: the
+            # client was already answered with watchdog-timeout and the
+            # connection closed; nothing more to send
+            raise faults.AbandonedRun(request_id)
+        # breaker outcome: a client-drop abort says nothing about the
+        # spec, so the probe slot is released instead of judged
+        if ctx.client_gone and summary.get("stopped_by") == "client-drop":
+            if probe:
+                self.breaker.abandon_probe(digest)
+        else:
+            self.breaker.record_success(digest)
+        self._observe_run_wall(t_dispatch)
+        serving["time_to_first_step_sec"] = (round(ttfs[0], 6)
+                                             if ttfs[0] is not None
+                                             else None)
         record = None
         try:
             record = solver.flush_metrics(extra={"serving": serving})
         except Exception as exc:
             logger.warning(f"service: telemetry flush failed: {exc}")
-        if record is not None:
-            try:
-                protocol.send_frame(wfile, record)
-            except (TypeError, ValueError):
-                logger.warning("service: telemetry record not "
-                               "JSON-serializable; skipped")
-            except OSError:
-                pass
         out_fields = {}
         for var in targets:
             if params["layout"] == "c":
@@ -527,13 +1182,73 @@ class SolverService:
         }
         if summary.get("resumed_from"):
             result["resumed_from"] = summary["resumed_from"]
-        try:
-            protocol.send_frame(wfile, result,
-                                payload=protocol.encode_fields(out_fields))
-        except OSError:
-            logger.warning(f"service: client for {request_id} hung up "
-                           "before the result frame")
-        self.requests_served += 1
+        result_payload = protocol.encode_fields(out_fields)
+        # cache BEFORE sending: the idempotent retry exists precisely for
+        # the client that vanishes between here and its result frame. A
+        # client-drop ABORT is the one outcome that must NOT be cached —
+        # replaying a deliberately truncated run to a retrying client
+        # would dress a partial result up as the completed outcome (the
+        # retry should re-execute instead)
+        if client_id is not None \
+                and summary.get("stopped_by") != "client-drop":
+            self.results.put(str(client_id), record, result, result_payload,
+                             fingerprint=self._run_fingerprint(ctx.header))
+        # a client draining the result one byte at a time would hold the
+        # single executor in sendall indefinitely — the write-side slow
+        # loris; the absolute bound (scaled for the payload size, so a
+        # slow-but-steady reader of a big result survives) turns the
+        # stalled send into an OSError the client-drop path absorbs
+        reply_budget = self.idle_timeout \
+            + len(result_payload) / MIN_TRANSFER_BYTES_PER_SEC
+        with _socket_deadline(ctx.conn, reply_budget,
+                              socket.SHUT_RDWR):
+            if record is not None:
+                try:
+                    protocol.send_frame(wfile, record)
+                except (TypeError, ValueError):
+                    logger.warning("service: telemetry record not "
+                                   "JSON-serializable; skipped")
+                except OSError:
+                    self._client_dropped(ctx, loop)
+            try:
+                protocol.send_frame(wfile, result, payload=result_payload)
+            except OSError:
+                self._client_dropped(ctx, loop)
+                logger.warning(f"service: client for {request_id} hung "
+                               "up before the result frame")
+        self._count("requests_served")
+
+    def _client_dropped(self, ctx, loop):
+        """A send to the client failed mid-stream: the socket is dead.
+        Counted ONCE per request; per [service] ON_CLIENT_DROP the run
+        either completes (its result stays replayable from the cache) or
+        aborts at the next step boundary through the resilient loop's
+        stop-request path — the run's single telemetry flush happens on
+        the normal exit path either way."""
+        if ctx.client_gone:
+            return
+        ctx.client_gone = True
+        self._count("client_drops")
+        running = loop is not None and loop.stopped_by is None
+        if not running:
+            # detected in the reply phase: the solve already finished —
+            # nothing to abort, and the completed result stays
+            # replayable from the cache
+            logger.warning(
+                f"service: client for {ctx.request_id} disconnected "
+                "during the reply; run already complete (result stays "
+                "replayable)")
+        elif self.on_client_drop == "abort":
+            logger.warning(
+                f"service: client for {ctx.request_id} disconnected "
+                "mid-stream; aborting the run at the next step boundary "
+                "(ON_CLIENT_DROP = abort)")
+            loop.request_stop("client-drop")
+        else:
+            logger.warning(
+                f"service: client for {ctx.request_id} disconnected "
+                "mid-stream; completing the run "
+                "(ON_CLIENT_DROP = complete)")
 
 
 # --------------------------------------------------------------- CLI
@@ -560,6 +1275,41 @@ def build_parser():
     parser.add_argument("--drain-grace", type=float, default=600.0,
                         help="seconds to wait for the in-flight run at "
                              "drain (default: %(default)s)")
+    parser.add_argument("--queue-depth", type=int, default=None,
+                        help="bounded run-queue depth; excess requests get "
+                             "a structured 'overloaded' refusal (default: "
+                             "[service] QUEUE_DEPTH)")
+    parser.add_argument("--idle-timeout", type=float, default=None,
+                        help="per-connection read/write timeout in seconds "
+                             "(default: [service] IDLE_TIMEOUT_SEC)")
+    parser.add_argument("--watchdog-sec", type=float, default=None,
+                        help="hung-dispatch watchdog: no step progress "
+                             "within this many seconds fails the request "
+                             "with a postmortem; must exceed the worst "
+                             "cold build (default: [service] WATCHDOG_SEC)")
+    parser.add_argument("--breaker-failures", type=int, default=None,
+                        help="consecutive per-spec failures before the "
+                             "circuit opens (default: [service] "
+                             "BREAKER_FAILURES)")
+    parser.add_argument("--breaker-cooloff", type=float, default=None,
+                        help="circuit cool-off seconds (default: [service] "
+                             "BREAKER_COOLOFF_SEC)")
+    parser.add_argument("--result-cache", type=int, default=None,
+                        help="completed results kept for idempotent "
+                             "retries (default: [service] RESULT_CACHE)")
+    parser.add_argument("--mem-watermark-mb", type=float, default=None,
+                        help="process-RSS watermark triggering pool "
+                             "eviction; 0 disables (default: [service] "
+                             "MEM_WATERMARK_MB)")
+    parser.add_argument("--on-client-drop", choices=("complete", "abort"),
+                        default=None,
+                        help="dead client socket mid-run: finish the solve "
+                             "or abort at the next step boundary (default: "
+                             "[service] ON_CLIENT_DROP)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="accept per-run 'chaos' fault-injection "
+                             "blocks (tools/chaos.py; TEST DEPLOYMENTS "
+                             "ONLY)")
     return parser
 
 
@@ -571,6 +1321,12 @@ def main(argv=None):
     service = SolverService(
         host=args.host, port=args.port, pool_size=args.pool_size,
         sink=args.sink, allow_imports=args.import_builders,
-        drain_grace=args.drain_grace)
+        drain_grace=args.drain_grace, queue_depth=args.queue_depth,
+        idle_timeout=args.idle_timeout, watchdog_sec=args.watchdog_sec,
+        breaker_failures=args.breaker_failures,
+        breaker_cooloff=args.breaker_cooloff,
+        result_cache=args.result_cache,
+        mem_watermark_mb=args.mem_watermark_mb,
+        on_client_drop=args.on_client_drop, chaos_enabled=args.chaos)
     service.serve_forever()
     return 0

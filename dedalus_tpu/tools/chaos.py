@@ -1,35 +1,64 @@
 """
-Deterministic fault injection (chaos harness) for the resilient loop.
+Deterministic fault injection (chaos harness) for the resilient loop AND
+the serving daemon.
 
 Production fault tolerance that has never seen a fault is a hypothesis,
-not a feature. This module injects the faults tools/resilience.py claims
-to absorb — deterministically, from a seed/config, so every recovery
-branch is an ordinary reproducible test (tests/test_resilience.py, the
-`chaos` pytest marker):
+not a feature. This module injects the faults tools/resilience.py and
+dedalus_tpu/service/ claim to absorb — deterministically, from a
+seed/config, so every recovery branch is an ordinary reproducible test
+(tests/test_resilience.py + tests/test_service_faults.py, the `chaos`
+pytest marker):
+
+Solve-loop faults (`ChaosInjector`, driven by ResilientLoop hooks):
 
   * NaN poisoning of a named field at iteration N (divergence without
     waiting for physics to diverge),
   * a transient `OSError` on the Nth checkpoint write (flaky disk/NFS),
   * simulated SIGTERM delivery at iteration N (pool preemption),
+  * an artificially HUNG step at iteration N (`hang_iteration` +
+    `hang_sec`: the post-step hook sleeps, starving step progress — the
+    deterministic stand-in for a wedged JAX dispatch that drives the
+    serving watchdog),
   * checkpoint-file truncation/corruption (a crash mid-write).
 
-Each armed fault fires ONCE (rewind replays the triggering iteration; a
-re-firing fault would deadlock the recovery it is testing) and is logged
-loudly when it fires. `ChaosInjector` is test machinery: it is never
-constructed by the production path, only handed to `ResilientLoop(...,
-chaos=...)` or used standalone on files.
+Service faults (plain socket clients misbehaving at the daemon — each
+helper returns once the fault has been delivered, so a test can assert
+the daemon's reaction deterministically):
+
+  * `slow_loris` — hold a connection open, dribbling a never-completed
+    header (the [service] IDLE_TIMEOUT_SEC defense),
+  * `half_frame` — send a header promising a payload, then disconnect
+    (a truncated frame: crash mid-write at the client),
+  * `vanish_client` — submit a real run, then close the socket without
+    reading anything (client gone before/while the daemon streams),
+  * `sigkill_client` — spawn a real `submit` subprocess and SIGKILL it
+    once its run is in flight (the OS-level version of vanishing),
+  * `queue_storm` — a burst of concurrent run requests sized to
+    overflow the bounded admission queue (drives load shedding).
+
+Each armed ChaosInjector fault fires ONCE (rewind replays the
+triggering iteration; a re-firing fault would deadlock the recovery it
+is testing) and is logged loudly when it fires. Everything here is test
+machinery: never constructed by the production path, only handed to
+`ResilientLoop(..., chaos=...)` / a `--chaos` daemon, or aimed at a
+daemon socket by tests.
 """
 
 import errno
+import json
 import logging
 import os
 import signal
+import socket
+import threading
+import time
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ChaosInjector", "corrupt_checkpoint"]
+__all__ = ["ChaosInjector", "corrupt_checkpoint", "half_frame",
+           "queue_storm", "sigkill_client", "slow_loris", "vanish_client"]
 
 
 def _field_slice(solver, name):
@@ -88,19 +117,25 @@ class ChaosInjector:
           retry.
       sigterm_iteration           — deliver a real SIGTERM to this
           process after completing iteration N.
+      hang_iteration + hang_sec   — sleep `hang_sec` seconds after
+          completing iteration N, BEFORE the loop's step hook runs: from
+          the serving watchdog's point of view this is a hung JAX
+          dispatch (no step progress), driven deterministically.
 
     `fired` records what fired and when, for test assertions.
     """
 
     def __init__(self, seed=0, nan_field=None, nan_iteration=None,
                  fail_checkpoint_write=None, sigterm_iteration=None,
-                 nan_member=None):
+                 nan_member=None, hang_iteration=None, hang_sec=None):
         self.seed = int(seed)
         self.nan_field = nan_field
         self.nan_iteration = nan_iteration
         self.nan_member = nan_member
         self.fail_checkpoint_write = fail_checkpoint_write
         self.sigterm_iteration = sigterm_iteration
+        self.hang_iteration = hang_iteration
+        self.hang_sec = hang_sec
         self.fired = []
         self._checkpoint_writes = 0
         self._armed = set()
@@ -110,6 +145,8 @@ class ChaosInjector:
             self._armed.add("sigterm")
         if fail_checkpoint_write is not None:
             self._armed.add("io")
+        if hang_iteration is not None and hang_sec is not None:
+            self._armed.add("hang")
 
     def attach(self, loop):
         """Wire the IO fault into the loop's checkpoint path: the Nth
@@ -152,6 +189,10 @@ class ChaosInjector:
             self._armed.discard("sigterm")
             self._fire("sigterm", iteration=it)
             os.kill(os.getpid(), signal.SIGTERM)
+        if "hang" in self._armed and it >= self.hang_iteration:
+            self._armed.discard("hang")
+            self._fire("hang", iteration=it, hang_sec=self.hang_sec)
+            time.sleep(float(self.hang_sec))
 
     # ----------------------------------------------------- fault bodies
 
@@ -180,3 +221,162 @@ class ChaosInjector:
         # solver sees
         solver.defer_scatter(solver.X)
         solver.snapshot_versions()
+
+
+# --------------------------------------------------------- service faults
+#
+# Misbehaving clients aimed at a live `dedalus_tpu serve` daemon. Each
+# helper is synchronous and deterministic: it returns once the fault has
+# been delivered (and, where the daemon replies, returns the reply), so
+# tests assert the daemon's reaction without sleeps-and-hope. None of
+# these import the solver stack.
+
+def slow_loris(port, host="127.0.0.1", hold_sec=2.0, drip=b"x"):
+    """Hold a connection open dribbling a header that never completes —
+    the classic slow-loris. Returns the daemon's reply header (the
+    structured `bad-frame` produced when [service] IDLE_TIMEOUT_SEC
+    expires the read), or None if the daemon just closed the socket."""
+    deadline = time.monotonic() + float(hold_sec)
+    with socket.create_connection((host, port), timeout=hold_sec + 30) as c:
+        while time.monotonic() < deadline:
+            try:
+                c.sendall(drip)       # never a "\n": the frame never ends
+            except OSError:
+                break                 # daemon gave up on us already
+            time.sleep(min(0.05, hold_sec / 10))
+        logger.warning(f"chaos: slow-loris held port {port} for "
+                       f"{hold_sec}s")
+        try:
+            line = c.makefile("rb").readline()
+            return json.loads(line) if line else None
+        except (OSError, ValueError):
+            return None
+
+
+def half_frame(port, host="127.0.0.1", claim_bytes=4096):
+    """Send a header that PROMISES a payload, then disconnect — a frame
+    torn exactly where a crashing client tears it. Returns immediately;
+    the daemon must treat the truncation as a structured protocol error
+    and survive."""
+    header = json.dumps({"kind": "run", "payload_bytes": claim_bytes})
+    with socket.create_connection((host, port), timeout=30) as c:
+        c.sendall(header.encode() + b"\nonly-a-few-bytes")
+    logger.warning(f"chaos: half-written frame (claimed {claim_bytes} "
+                   f"payload bytes) delivered to port {port}")
+
+
+def vanish_client(port, header, payload=None, host="127.0.0.1",
+                  read_frames=0, linger_sec=0.0):
+    """Submit a real frame, optionally read `read_frames` reply frames
+    (e.g. 1 to consume the ack so the run is definitely in flight), then
+    close the socket without warning. Returns the frames read."""
+    from ..service import protocol
+    frames = []
+    with socket.create_connection((host, port), timeout=60) as c:
+        wfile = c.makefile("wb")
+        rfile = c.makefile("rb")
+        protocol.send_frame(wfile, header, payload=payload)
+        for _ in range(int(read_frames)):
+            frame, _ = protocol.recv_frame(rfile)
+            if frame is None:
+                break
+            frames.append(frame)
+        if linger_sec:
+            time.sleep(float(linger_sec))
+    logger.warning(f"chaos: client vanished mid-stream on port {port} "
+                   f"(after {len(frames)} frame(s))")
+    return frames
+
+
+def sigkill_client(port, spec, dt, stop_iteration, host="127.0.0.1",
+                   after_progress_frames=1, timeout=120.0):
+    """Spawn a real `python -m dedalus_tpu submit` subprocess streaming
+    progress frames and SIGKILL it once `after_progress_frames` progress
+    lines have appeared on its stderr — the OS-level client vanish (no
+    FIN from a cooperative close(); the daemon discovers the dead peer
+    only when a send fails). Returns the killed subprocess (already
+    waited on)."""
+    import subprocess
+    import sys
+    cmd = [sys.executable, "-m", "dedalus_tpu", "submit",
+           "--host", host, "--port", str(port),
+           "--spec", json.dumps(spec), "--dt", str(dt),
+           "--stop-iteration", str(stop_iteration),
+           "--progress-every", "5"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    seen = 0
+    deadline = time.monotonic() + float(timeout)
+    while seen < int(after_progress_frames):
+        if time.monotonic() > deadline:
+            proc.kill()
+            proc.wait()
+            raise RuntimeError("chaos: submit client produced no "
+                               "progress frames before the timeout")
+        line = proc.stderr.readline()
+        if not line:
+            break
+        if line.startswith("progress:"):
+            seen += 1
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    logger.warning(f"chaos: SIGKILLed submit client pid {proc.pid} after "
+                   f"{seen} progress frame(s)")
+    return proc
+
+
+def queue_storm(port, header, payload=None, n=8, host="127.0.0.1",
+                timeout=300.0):
+    """Fire `n` concurrent run requests at the daemon and collect every
+    terminal reply — the admission-control storm. Returns a list of
+    result dicts: {"ok": bool, "code": error code or None, "frames": N,
+    "retry_after_sec": hint or None, "wall_sec": request wall}. With n
+    above the daemon's queue depth (+1 executing), the excess must come
+    back as structured `overloaded` refusals."""
+    from ..service import protocol
+    results = [None] * int(n)
+
+    def one(i):
+        t0 = time.perf_counter()
+        out = {"ok": False, "code": None, "frames": 0,
+               "retry_after_sec": None, "wall_sec": None}
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout) as c:
+                wfile = c.makefile("wb")
+                rfile = c.makefile("rb")
+                protocol.send_frame(wfile, dict(header),
+                                    payload=payload)
+                while True:
+                    frame, _ = protocol.recv_frame(rfile)
+                    if frame is None:
+                        break
+                    out["frames"] += 1
+                    kind = frame.get("kind")
+                    if kind == "error":
+                        out["code"] = frame.get("code")
+                        out["retry_after_sec"] = frame.get(
+                            "retry_after_sec")
+                        break
+                    if kind == "result":
+                        out["ok"] = True
+                        break
+        except OSError as exc:
+            out["code"] = f"oserror:{exc.errno}"
+        out["wall_sec"] = round(time.perf_counter() - t0, 4)
+        results[i] = out
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(int(n))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    logger.warning(
+        f"chaos: queue storm of {n} requests -> "
+        f"{sum(1 for r in results if r and r['ok'])} served, "
+        f"{sum(1 for r in results if r and r['code'] == 'overloaded')} "
+        "shed")
+    return results

@@ -30,7 +30,13 @@ Served-latency vocabulary: records flushed by the warm-pool service
 ("hit" | "warm-cache" | "cold": warm pool reuse / fresh build off the
 persistent assembly cache / fully cold build), `time_to_first_step_sec`
 (dispatch -> first step complete, including any build+compile a miss
-pays), `build_sec`, and `request_id`. This sink format doubles as the
+pays), `build_sec`, `request_id`, and `deadline_sec` when the request
+set one. Service-level fault-tolerance counters (shed, deadline
+exceeded, watchdog fires, circuit-breaker opens/fast-fails, client
+drops, idempotent replays, memory-watermark evictions) ride the `stats`
+reply and the drain-time `service_stats` record under `faults`; a hung
+dispatch additionally leaves a `watchdog_postmortem` record (request
+id, stuck seconds, thread stacks). This sink format doubles as the
 service's wire format, so streamed frames and the daemon's JSONL file
 are the same records.
 """
@@ -51,7 +57,7 @@ from .config import config
 __all__ = ["PHASES", "BUILD_PHASES", "CadenceGate", "Counter", "PhaseTimer",
            "MemoryWatermark", "Metrics", "BuildPhases", "trace_scope",
            "annotate", "scoped", "resolve", "format_phase_table",
-           "register_exit_flush", "flush_pending"]
+           "register_exit_flush", "flush_pending", "process_rss_bytes"]
 
 # The hot-path phase vocabulary (shared with trace annotations).
 PHASES = ("transform", "matsolve", "transpose", "evaluator")
@@ -192,6 +198,30 @@ class PhaseTimer:
     @property
     def samples(self):
         return max(self.counts.values(), default=0)
+
+
+def process_rss_bytes():
+    """Resident-set size of THIS process in bytes (0 when unreadable).
+    The device-side MemoryWatermark tracks accelerator allocations; this
+    is its host-side sibling — the number the serving daemon's
+    memory-watermark shedding ([service] MEM_WATERMARK_MB) compares
+    against, since on CPU backends the pooled solvers' matrices and
+    compiled programs all live in process RSS."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError, AttributeError):
+        try:
+            import resource
+            import sys
+            # ru_maxrss is KiB on Linux but BYTES on macOS (peak, not
+            # current — still a usable over-estimate where /proc is
+            # unavailable)
+            scale = 1 if sys.platform == "darwin" else 1024
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * scale
+        except Exception:
+            return 0
 
 
 class MemoryWatermark:

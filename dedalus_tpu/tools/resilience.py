@@ -86,14 +86,20 @@ class RetryPolicy:
     backoff, up to `max_attempts` total attempts. Non-transient
     exceptions — and transient ones past the attempt budget — propagate.
     `on_retry(attempt, exc)` observes each retry (the metrics hook).
+
+    `jitter` (a fraction, default 0: deterministic) spreads each delay
+    uniformly over [d*(1-jitter), d*(1+jitter)] — the service client
+    uses it so a fleet of retrying clients does not re-stampede a
+    recovering daemon in lockstep.
     """
 
     def __init__(self, max_attempts=3, base_delay=0.05, max_delay=2.0,
-                 on_retry=None):
+                 on_retry=None, jitter=0.0):
         self.max_attempts = max(int(max_attempts), 1)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
         self.on_retry = on_retry
+        self.jitter = float(jitter)
 
     @staticmethod
     def is_transient(exc):
@@ -103,8 +109,19 @@ class RetryPolicy:
         return False
 
     def delay(self, attempt):
-        """Backoff before retry `attempt` (1-based): base * 2^(attempt-1)."""
-        return min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay)
+        """Backoff before retry `attempt` (1-based): base * 2^(attempt-1),
+        capped, jittered."""
+        return self.jittered(
+            min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay))
+
+    def jittered(self, seconds):
+        """Apply this policy's jitter fraction to a delay (used directly
+        for server-suggested retry_after_sec hints)."""
+        if self.jitter <= 0:
+            return seconds
+        import random
+        return max(seconds * (1.0 + random.uniform(-self.jitter,
+                                                   self.jitter)), 0.0)
 
     def call(self, fn, label="io"):
         for attempt in range(1, self.max_attempts + 1):

@@ -30,8 +30,99 @@ if "DEDALUS_TPU_ASSEMBLY_CACHE" not in os.environ:
     os.environ["DEDALUS_TPU_ASSEMBLY_CACHE"] = _assembly_cache_tmp
     atexit.register(shutil.rmtree, _assembly_cache_tmp, ignore_errors=True)
 
+import pathlib  # noqa: E402
+import signal  # noqa: E402
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# ------------------------------------------------- service test watchdog
+#
+# Hard per-test timeout for the `service` and `chaos` markers: a daemon
+# subprocess (or an in-process daemon thread) that hangs must not eat
+# the tier-1 budget silently — the SIGALRM handler kills every
+# registered stray daemon, appends their captured logs to the failure
+# message, and fails THIS test instead of stalling the whole sweep.
+# Tests that spawn daemon subprocesses register them (with their log
+# path) via `register_daemon`, imported from this conftest.
+
+SERVICE_TEST_TIMEOUT_SEC = 180.0
+
+_live_daemons = []   # [(Popen, log_path or None)]
+
+
+def register_daemon(proc, log_path=None):
+    """Track a daemon subprocess so the per-test watchdog can kill it
+    and surface its log if the test hangs. Append-only: the watchdog
+    snapshots a registry index when each test starts, so entries must
+    not shift mid-test (pruning happens when the watchdog arms)."""
+    _live_daemons.append((proc, str(log_path) if log_path else None))
+
+
+def _kill_stray_daemons(since=0):
+    """Kill still-running daemons registered at-or-after index `since`
+    (the hanging test's own spawns); OLDER live daemons — e.g. a healthy
+    module-scoped shared fixture other tests still need — are reported
+    but left running. Returns log tails / notes."""
+    tails = []
+    for i, (proc, log_path) in enumerate(list(_live_daemons)):
+        if proc.poll() is not None:
+            continue
+        if i < since:
+            tails.append(f"pre-existing daemon pid {proc.pid} left "
+                         "running (shared fixture?)")
+            continue
+        proc.kill()
+        tails.append(f"killed stray daemon pid {proc.pid}")
+        if log_path:
+            try:
+                text = pathlib.Path(log_path).read_text()[-2000:]
+                tails.append(f"--- {log_path} (tail) ---\n{text}")
+            except OSError:
+                pass
+    del _live_daemons[since:]
+    return tails
+
+
+@pytest.fixture(autouse=True)
+def _service_test_watchdog(request):
+    """Per-test hard watchdog for service/chaos-marked tests (SIGALRM;
+    main thread only — pytest runs tests there). On expiry: stray
+    daemons are killed, their logs attached, and the test fails with a
+    timeout instead of wedging tier-1."""
+    marked = (request.node.get_closest_marker("service") is not None
+              or request.node.get_closest_marker("chaos") is not None)
+    if not marked or threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    timeout = SERVICE_TEST_TIMEOUT_SEC
+    # drop exited entries (safe here: no test is mid-flight), then mark:
+    # only daemons registered DURING this test are killed on expiry — a
+    # healthy shared module fixture must survive one slow neighbor
+    _live_daemons[:] = [(p, lg) for p, lg in _live_daemons
+                        if p.poll() is None]
+    registry_mark = len(_live_daemons)
+
+    def on_alarm(signum, frame):
+        tails = _kill_stray_daemons(since=registry_mark)
+        pytest.fail(
+            f"service/chaos test exceeded the {timeout:.0f}s hard "
+            "watchdog (tests/conftest.py); "
+            + ("; ".join(tails) if tails else "no stray daemons found"),
+            pytrace=False)
+
+    try:
+        previous = signal.signal(signal.SIGALRM, on_alarm)
+    except (ValueError, OSError):   # non-main thread / no SIGALRM
+        yield
+        return
+    signal.setitimer(signal.ITIMER_REAL, timeout)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, previous)
 
 
 def pytest_configure(config):

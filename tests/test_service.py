@@ -190,6 +190,7 @@ def test_pool_key_separates_schemes():
 # ----------------------------------------------------------- live daemon
 
 def _start_daemon(stderr_path, *extra):
+    from conftest import register_daemon
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     stderr = open(stderr_path, "w")
@@ -197,6 +198,7 @@ def _start_daemon(stderr_path, *extra):
         [sys.executable, "-m", "dedalus_tpu", "serve", *extra],
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=stderr,
         text=True)
+    register_daemon(proc, stderr_path)
     try:
         banner = json.loads(proc.stdout.readline())
     except ValueError:
@@ -331,8 +333,11 @@ def test_draining_daemon_refuses_new_runs():
     # worker-side refusal: the run was queued BEFORE the drain began
     a2, b2 = socket_mod.socketpair()
     with a2:
-        svc._queue.put((b2, b2.makefile("wb"), run_header, None,
-                        time.perf_counter()))
+        svc._queue.put({"conn": b2, "wfile": b2.makefile("wb"),
+                        "header": run_header, "payload": None,
+                        "t_accept": time.perf_counter(),
+                        "deadline_mono": None, "probe": False})
+        svc._queued_runs += 1
         svc._queue.put(None)               # stop sentinel
         svc._worker()
         header, _ = protocol.recv_frame(a2.makefile("rb"))
@@ -362,10 +367,23 @@ def test_report_renders_served_records(daemon, tmp_path):
         {"kind": "service_stats", "ts": 2.0, "requests_served": 3,
          "errors": 1, "uptime_sec": 9.5,
          "pool": {"hits": 2, "misses": 1, "evictions": 0,
-                  "entries": [{"key": "abc", "spec": "diffusion"}]}},
+                  "entries": [{"key": "abc", "spec": "diffusion"}]},
+         "faults": {"queue_depth": 8, "queued": 0, "shed": 4,
+                    "deadline_exceeded": 2, "watchdog_fires": 1,
+                    "client_drops": 1, "mem_evictions": 0, "replays": 3,
+                    "result_cache": 2,
+                    "breaker": {"opens": 1, "closes": 1, "fastfails": 5,
+                                "open": []}}},
+        {"kind": "watchdog_postmortem", "ts": 2.5, "request_id": "r9",
+         "stuck_sec": 12.3, "watchdog_sec": 5.0, "iteration": 41,
+         "stacks": ["thread service-worker-1:\n  ..."]},
         {"config": "rb256x64_serving", "backend": "cpu", "ts": 3.0,
          "ttfs_cold_sec": 12.5, "ttfs_warm_sec": 0.31,
          "ttfs_speedup": 40.3, "throughput_requests_per_sec": 2.5},
+        {"config": "diffusion64_overload", "backend": "cpu", "ts": 4.0,
+         "queue_depth": 4, "storm_rate_x": 2.0, "shed_rate": 0.3,
+         "accepted_p50_sec": 0.61, "accepted_p95_sec": 1.1,
+         "latency_bound_sec": 1.8, "daemon_restarts": 0},
     ]
     sink.write_text("\n".join(lines + [json.dumps(r) for r in extra])
                     + "\n")
@@ -382,8 +400,19 @@ def test_report_renders_served_records(daemon, tmp_path):
     assert "queue=" in out and "ttfs=" in out
     assert "(service) 3 requests" in out
     assert "2 hits / 1 misses" in out
+    # fault-tolerance counters render on the service_stats line
+    assert "faults: 4 shed, 2 deadline-exceeded, 1 watchdog" in out
+    assert "breaker 1 opens / 5 fast-fails" in out
+    assert "3 replays" in out
+    # watchdog postmortems get their own line
+    assert "(watchdog) request=r9 stuck 12.3s" in out
+    assert "1 thread stack(s)" in out
     assert "rb256x64_serving" in out
     assert "ttfs cold 12.5s -> warm 0.31s (40.3x)" in out
+    # overload benchmark rows render the shed/bounded-latency story
+    assert "2.0x capacity storm, 30.0% shed" in out
+    assert "p50 0.61s / p95 1.1s" in out
+    assert "0 daemon restarts" in out
 
 
 def test_sigterm_drain_checkpoints_inflight_run(daemon, tmp_path):
