@@ -312,6 +312,7 @@ def _attach_progression(record):
             }
     _attach_ensemble(record)
     _attach_serving(record)
+    _attach_adjoint(record)
     return record
 
 
@@ -418,6 +419,41 @@ def _attach_serving(record):
             "age_s": round(time.time() - row["ts"], 1)
             if row.get("ts") else None,
         }
+    return record
+
+
+def _attach_adjoint(record):
+    """Attach the newest in-window adjoint benchmark headline (grad-step
+    vs forward-step cost ratio + checkpoint-segment memory sweep,
+    benchmarks/adjoint.py) to the official bench line. Same provenance
+    discipline as the ensemble/serving rows: a CACHED prior measurement,
+    stamped stale with its original measured_ts and age, dropped once
+    outside the 48h window. Adjoint rows are CPU-measured by design
+    (ROADMAP platform note), so no backend filter."""
+    row = _recent_row(
+        lambda r: (r.get("config") == "diffusion64_adjoint"
+                   and r.get("grad_forward_ratio") is not None
+                   and r.get("finite")))
+    if row is None:
+        return record
+    best_mem = min((p for p in (row.get("segments_sweep") or [])
+                    if p.get("peak_rss_bytes")),
+                   key=lambda p: p["peak_rss_bytes"], default=None)
+    record["adjoint_diffusion64"] = {
+        "grad_forward_ratio": row.get("grad_forward_ratio"),
+        "grad_steps_per_sec": row.get("grad_steps_per_sec"),
+        "forward_steps_per_sec": row.get("forward_steps_per_sec"),
+        "fd_rel_err": row.get("fd_rel_err"),
+        "n_steps": row.get("n_steps"),
+        "best_mem_segments": best_mem.get("segments") if best_mem else None,
+        "best_mem_peak_rss_bytes":
+            best_mem.get("peak_rss_bytes") if best_mem else None,
+        "backend": row.get("backend"),
+        "stale": True,
+        "measured_ts": row.get("ts"),
+        "age_s": round(time.time() - row["ts"], 1)
+        if row.get("ts") else None,
+    }
     return record
 
 

@@ -1,0 +1,199 @@
+"""
+Adjoint benchmark: grad-step vs forward-step cost, and peak memory vs
+checkpoint_segments, on the diffusion64 problem (1-D forced heat with a
+parameter field — the same problem as the ensemble/serving benchmarks).
+
+Two measurements:
+
+  * cost ratio — post-compile steps/sec of the pure forward program vs
+    the value-and-grad program over the same n steps (theory: the
+    backward pass is one adjoint solve + one transposed RHS per step, so
+    the ratio should sit in the 2-4x band; the row records reality);
+  * memory sweep — peak process RSS of one grad call per
+    checkpoint_segments value, each measured in a FRESH subprocess so
+    ru_maxrss is that configuration's own high-water mark (on CPU the
+    backward's stored segment states live in process RSS; the
+    MemoryWatermark device number rides along where available).
+
+Appends one `diffusion64_adjoint` row to benchmarks/results.jsonl (with
+a one-shot finite-difference trust check on the gradient) — bench.py
+re-reports it stale-stamped like the ensemble/serving rows.
+
+Run: python benchmarks/adjoint.py [--quick]
+  --quick   shortens windows and trims the sweep (CI smoke; no row
+            appended, so a smoke run never shadows the full sweep).
+"""
+
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[adjoint {time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def build_diffusion(size=64):
+    """1-D forced heat IVP with parameter field `a` and forcing `f` —
+    all three differentiable operand classes present."""
+    import dedalus_tpu.public as d3
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=size, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    a = dist.Field(name="a", bases=xb)
+    f = dist.Field(name="f", bases=xb)
+    dx = lambda A: d3.Differentiate(A, xc)
+    problem = d3.IVP([u], namespace={"u": u, "a": a, "f": f,
+                                     "lap": d3.lap, "dx": dx})
+    # the Burgers term matters twice over: it exercises the dealiased
+    # transform chain under the adjoint, and it is what makes the
+    # backward pass STORE per-step residuals — a linear RHS needs none,
+    # and the checkpoint_segments memory sweep would show nothing
+    problem.add_equation("dt(u) - lap(u) = a*u + f - u*dx(u)")
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(3 * x)
+    a["g"] = 0.1 * np.cos(x)
+    f["g"] = 0.05 * np.sin(2 * x)
+    return problem.build_solver(d3.SBDF2, warmup_iterations=2,
+                                enforce_real_cadence=0)
+
+
+def build_div(segments):
+    import jax.numpy as jnp
+    solver = build_diffusion()
+    return solver.differentiable(
+        wrt=("initial_state", "a", "f"),
+        loss=lambda X: jnp.sum(X ** 2),
+        checkpoint_segments=segments)
+
+
+def measure_ratio(n, dt, repeats):
+    """Post-compile forward vs grad steps/sec (+ a one-shot FD trust
+    check so the recorded ratio is a ratio of CORRECT programs)."""
+    div = build_div(None)
+    mark(f"compiling forward + grad programs (n={n})")
+    div.forward(n, dt)
+    div.value_and_grad(n, dt)
+    for _ in range(repeats):
+        div.forward(n, dt)
+        div.value_and_grad(n, dt)
+    s = div.summary()
+    # gradient trust: one central-difference probe on the IC operand
+    X0 = np.asarray(div.solver.gather_fields()).copy()
+    _, grads = div.value_and_grad(n, dt, initial_state=X0)
+    v = np.random.default_rng(0).standard_normal(X0.shape)
+    eps = 1e-6
+    fd = (div.value(n, dt, initial_state=X0 + eps * v)
+          - div.value(n, dt, initial_state=X0 - eps * v)) / (2 * eps)
+    an = float(np.sum(np.asarray(grads["initial_state"]) * v))
+    fd_rel = abs(fd - an) / max(abs(fd), 1e-30)
+    finite = bool(np.isfinite(np.asarray(grads["initial_state"])).all())
+    mark(f"forward {s['forward_steps_per_sec']} steps/s, grad "
+         f"{s['grad_steps_per_sec']} steps/s "
+         f"(ratio {s['grad_forward_ratio']}x), fd_rel={fd_rel:.2e}")
+    return {
+        "forward_steps_per_sec": s["forward_steps_per_sec"],
+        "grad_steps_per_sec": s["grad_steps_per_sec"],
+        "grad_forward_ratio": s["grad_forward_ratio"],
+        "auto_segments": s["checkpoint_segments"],
+        "fd_rel_err": round(fd_rel, 10),
+        "finite": finite,
+    }
+
+
+def child_measure(n, dt, segments):
+    """One grad call at a fixed segment count; prints its own peak RSS
+    (this process's high-water mark — why each point runs in a fresh
+    interpreter)."""
+    div = build_div(segments)
+    div.value_and_grad(n, dt)        # compile
+    t0 = time.perf_counter()
+    div.value_and_grad(n, dt)
+    wall = time.perf_counter() - t0
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    print(json.dumps({
+        "segments": div.summary()["checkpoint_segments"],
+        "grad_steps_per_sec": round(n / wall, 2),
+        "peak_rss_bytes": peak,
+        "device_mem_peak_bytes":
+            div.summary()["device_mem_peak_bytes"] or None,
+    }), flush=True)
+
+
+def sweep_segments(n, dt, sweep):
+    points = []
+    for K in sweep:
+        mark(f"memory sweep: checkpoint_segments={K} (fresh subprocess)")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             str(n), str(dt), str(K)],
+            capture_output=True, text=True, timeout=900)
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode != 0 or line is None:
+            mark(f"sweep point K={K} FAILED (rc={proc.returncode}): "
+                 f"{proc.stderr[-500:]}")
+            points.append({"segments": K, "error": f"rc={proc.returncode}"})
+            continue
+        point = json.loads(line)
+        points.append(point)
+        mark(f"K={point['segments']}: {point['grad_steps_per_sec']} "
+             f"grad-steps/s, peak RSS "
+             f"{point['peak_rss_bytes'] / 1e6:.1f} MB")
+    return points
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        n, dt, K = int(sys.argv[2]), float(sys.argv[3]), int(sys.argv[4])
+        child_measure(n, dt, K)
+        return
+    quick = "--quick" in sys.argv
+    from __graft_entry__ import _append_result
+    if quick:
+        _append_result = lambda record: None  # noqa: E731
+    n = 128 if quick else 512
+    dt = 1e-3
+    # The memory sweep runs MANY more steps than the ratio window: the
+    # diffusion64 per-step carry is ~2.5 KB, so the K=1 backward only
+    # rises visibly above the interpreter's RSS baseline once tens of
+    # thousands of step states are stored — exactly the regime
+    # checkpointing exists for.
+    n_mem = 1024 if quick else 65536
+    sweep = [1, 16] if quick else [1, 16, 256]
+    ratio = measure_ratio(n, dt, repeats=1 if quick else 3)
+    points = sweep_segments(n_mem, dt, sweep)
+    row = {
+        "config": "diffusion64_adjoint",
+        "backend": os.environ.get("JAX_PLATFORMS", "cpu").split(",")[0],
+        "n_steps": n,
+        "mem_sweep_steps": n_mem,
+        "dt": dt,
+        "wrt": ["initial_state", "a", "f"],
+        "segments_sweep": points,
+    }
+    row.update(ratio)
+    print(json.dumps(row), flush=True)
+    if not ratio["finite"] or ratio["fd_rel_err"] > 1e-4:
+        # the trust gate runs BEFORE the append: a wrong-but-finite
+        # gradient must never become the re-reported bench headline
+        mark("FAIL: gradient non-finite or FD mismatch; row not recorded")
+        sys.exit(1)
+    _append_result(row)
+
+
+if __name__ == "__main__":
+    main()

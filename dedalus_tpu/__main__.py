@@ -166,6 +166,25 @@ def report(args):
                         f"resumed from {resilience['resumed_from']} "
                         f"(write {resilience.get('resume_write', '?')})")
                 print(f"    resilience: {', '.join(parts)}")
+            adjoint = record.get("adjoint")
+            if isinstance(adjoint, dict):
+                # differentiable-solve telemetry (core/adjoint.py):
+                # grad throughput, remat segments, memory
+                parts = [f"{adjoint.get('grad_calls', 0)} grad calls",
+                         f"{adjoint.get('grad_steps_per_sec', '?')} "
+                         f"grad-steps/s"]
+                if adjoint.get("grad_forward_ratio") is not None:
+                    parts.append(f"{adjoint['grad_forward_ratio']}x "
+                                 "forward cost")
+                if adjoint.get("checkpoint_segments") is not None:
+                    parts.append(
+                        f"{adjoint['checkpoint_segments']} segments")
+                mem = adjoint.get("device_mem_peak_bytes")
+                if mem:
+                    parts.append(f"peak {mem / 1e9:.3f} GB")
+                if adjoint.get("wrt"):
+                    parts.append(f"wrt={','.join(adjoint['wrt'])}")
+                print(f"    adjoint: {', '.join(parts)}")
             serving = record.get("serving")
             if isinstance(serving, dict):
                 # served-latency columns (dedalus_tpu/service/): the pool
@@ -265,6 +284,29 @@ def report(args):
                     line += (f", {record['throughput_requests_per_sec']} "
                              "requests/s")
                 print(line)
+            # adjoint benchmark rows (benchmarks/adjoint.py): the grad/
+            # forward cost ratio and the segment-memory sweep in one block
+            if record.get("grad_forward_ratio") is not None:
+                line = (f"    adjoint: grad "
+                        f"{record.get('grad_steps_per_sec', '?')} steps/s "
+                        f"vs forward "
+                        f"{record.get('forward_steps_per_sec', '?')} "
+                        f"steps/s ({record['grad_forward_ratio']}x)")
+                if record.get("fd_rel_err") is not None:
+                    line += f", fd_rel={record['fd_rel_err']:.1e}"
+                print(line)
+                for point in record.get("segments_sweep") or []:
+                    if point.get("error"):
+                        print(f"      K={point.get('segments', '?')}: "
+                              f"{point['error']}")
+                        continue
+                    rss = point.get("peak_rss_bytes")
+                    line = (f"      K={point.get('segments', '?')}: "
+                            f"{point.get('grad_steps_per_sec', '?')} "
+                            f"grad-steps/s")
+                    if rss:
+                        line += f", peak RSS {rss / 1e6:.1f} MB"
+                    print(line)
             # overload benchmark rows (benchmarks/serving.py storm): the
             # shed-rate and bounded-latency story in one line
             if record.get("shed_rate") is not None:

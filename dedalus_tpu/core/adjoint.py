@@ -1,0 +1,514 @@
+"""
+DifferentiableIVP: adjoint gradients through the IVP step loop.
+
+The one capability the MPI/FFTW reference can never have is `jax.grad`
+through the timestepping loop — here the whole step is already JAX, so
+this module opens the workload class: adjoint sensitivities of a scalar
+loss of the final state w.r.t. initial conditions, RHS parameter/NCC
+data fields, and forcing operands, for data assimilation, inverse
+design, and solver-in-the-loop ML training.
+
+Design:
+
+  * The step loop is reconstructed as a PURE `(operands, state0) ->
+    (loss, stateT)` function over the existing raw step bodies
+    (`MultistepIMEX.advance_body` / `RungeKuttaIMEX.step_body`) — the
+    same compositions the forward programs compile, so the adjoint's
+    forward pass is bit-identical to the stepping loop. The multistep
+    startup ramp (order build-up) is replayed from the host-side
+    `coefficient_schedule`; the stationary remainder runs as a
+    `lax.scan`.
+  * Backprop memory is bounded by `jax.checkpoint` over fixed-size
+    segments of that scan: K segments store K boundary carries and
+    recompute inside a segment, so peak memory is O(G*S*(K + n/K))
+    instead of O(G*S*n) — the PR-4 snapshot insight (device states are
+    cheap to hold) applied to remat policy. `checkpoint_segments=None`
+    picks K ~ sqrt(n).
+  * The batched pivoted-LU pencil solve is opaque to autodiff at the
+    factorization boundary; `libraries/pencilops.AdjointSolveOps` gives
+    it a `jax.custom_vjp` whose backward pass is the adjoint solve —
+    solve against the transposed factorization, reusing the cached LHS
+    factors (the adjoint of a linear solve is a linear solve with the
+    same matrix). Factorizations are computed OUTSIDE the differentiated
+    program (host dispatches, like the stepping loop) and enter as
+    non-differentiated operands, so gradients w.r.t. M/L assembly
+    scalars are NOT available (documented in docs/differentiable.md).
+  * The compiled value-and-grad program goes through `lifted_jit`
+    (device constants lifted, retrace sentinel armed) and its outputs
+    through the health monitor's fused non-finite check
+    (`HealthMonitor.check_values`), so a NaN in the backward pass raises
+    a structured `SolverHealthError` naming the adjoint phase instead of
+    silently propagating into an optimizer.
+
+Telemetry: `adjoint/...` counters plus an `adjoint` summary block
+(grad_steps_per_sec, checkpoint segments, grad/forward cost ratio, peak
+device memory) in every flushed record — `python -m dedalus_tpu report`
+renders it; `benchmarks/adjoint.py` records the `diffusion64_adjoint`
+bench row.
+"""
+
+import logging
+import time as time_mod
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .subsystems import scatter_state, state_key
+from . import timesteppers as timesteppers_mod
+from ..tools import metrics as metrics_mod
+from ..tools import retrace as retrace_mod
+from ..tools.jitlift import lifted_jit
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DifferentiableIVP"]
+
+# wrt tokens: the named operand groups of the differentiable program.
+# "parameters" and "forcing" both resolve to the RHS's non-variable field
+# operands (structurally indistinguishable: every extra field enters F the
+# same way); individual field names select subsets.
+WRT_STATE = "initial_state"
+WRT_EXTRA_GROUPS = ("parameters", "forcing")
+
+
+class DifferentiableIVP:
+    """
+    Differentiable view of one built `InitialValueSolver`: compiled
+    value-and-grad programs over n constant-dt steps from the solver's
+    current state and RHS operands.
+
+    Parameters
+    ----------
+    solver : InitialValueSolver
+        Built, undistributed, native-precision template (same
+        constraints as EnsembleSolver: no spatial mesh, no emulated-f64
+        runner).
+    wrt : tuple of str
+        Operands to differentiate: "initial_state", the group tokens
+        "parameters"/"forcing" (all RHS non-variable fields), and/or
+        individual field names.
+    loss : callable
+        `loss(XT) -> scalar` over the final (G, S) pencil state; must be
+        traceable jnp code. `self.state_arrays(XT)` splits XT back into
+        per-field coefficient arrays for field-space losses.
+    checkpoint_segments : int or None
+        Remat segments K over the scanned steps (None: K ~ sqrt(n)).
+        K=1 disables segmenting (full-memory backprop).
+    """
+
+    def __init__(self, solver, wrt=(WRT_STATE,), loss=None,
+                 checkpoint_segments=None, metrics=None, metrics_file=None):
+        if loss is None or not callable(loss):
+            raise ValueError(
+                "DifferentiableIVP requires loss=fn with fn(XT) -> scalar "
+                "(traceable jnp code over the final pencil state).")
+        if getattr(solver, "_dd", None) is not None:
+            raise ValueError(
+                "DifferentiableIVP requires the native step path; the "
+                "solver uses the emulated-f64 (double-double) runner. "
+                "Build it with [execution] EMULATED_F64 = never.")
+        if getattr(solver.dist, "mesh", None) is not None:
+            raise ValueError(
+                "DifferentiableIVP requires an undistributed solver (the "
+                "shard_map-routed solves have no transpose rule yet).")
+        ts = solver.timestepper
+        self._multistep = isinstance(ts, timesteppers_mod.MultistepIMEX)
+        if not self._multistep and not isinstance(
+                ts, timesteppers_mod.RungeKuttaIMEX):
+            raise ValueError(f"Unsupported timestepper {type(ts).__name__}")
+        self.solver = solver
+        self.timestepper = ts
+        self.loss = loss
+        self.rd = solver.real_dtype
+        if checkpoint_segments is not None:
+            checkpoint_segments = int(checkpoint_segments)
+            if checkpoint_segments < 1:
+                raise ValueError("checkpoint_segments must be >= 1")
+        self.checkpoint_segments = checkpoint_segments
+        # ------------------------------------------------- wrt resolution
+        extra_fields = solver.eval_F.extra_fields
+        self.extra_names = [state_key(f) for f in extra_fields]
+        sel = set()
+        self._wrt_state = False
+        for token in tuple(wrt):
+            if token == WRT_STATE:
+                self._wrt_state = True
+            elif token in WRT_EXTRA_GROUPS:
+                if not extra_fields:
+                    raise ValueError(
+                        f"wrt={token!r} selects the RHS's non-variable "
+                        "field operands, but this problem's F has none.")
+                sel.update(range(len(extra_fields)))
+            elif token in self.extra_names:
+                sel.add(self.extra_names.index(token))
+            else:
+                raise ValueError(
+                    f"unknown wrt operand {token!r}: expected "
+                    f"'initial_state', 'parameters', 'forcing', or one of "
+                    f"the RHS field names {self.extra_names}")
+        self._wrt_idx = tuple(sorted(sel))
+        self._const_idx = tuple(i for i in range(len(extra_fields))
+                                if i not in sel)
+        if not self._wrt_state and not self._wrt_idx:
+            raise ValueError("wrt selects no differentiable operand")
+        self.wrt = ((WRT_STATE,) if self._wrt_state else ()) + tuple(
+            self.extra_names[i] for i in self._wrt_idx)
+        # --------------------------------------------------------- caches
+        self._factor_cache = {}   # (rounded lead coeffs) -> lhs aux
+        self._programs = {}       # (kind, n, K) -> lifted_jit wrapper
+        self._last_segments = None
+        # ------------------------------------------------------ telemetry
+        self._grad_calls = 0
+        self._grad_steps = 0
+        self._grad_wall = 0.0
+        self._fwd_calls = 0
+        self._fwd_steps = 0
+        self._fwd_wall = 0.0
+        self._compile_sec = 0.0
+        self.metrics = metrics_mod.resolve(
+            metrics, sink=metrics_file,
+            meta={"config": "adjoint",
+                  "backend": jax.default_backend(),
+                  "dtype": str(np.dtype(solver.pencil_dtype)),
+                  "pencil_shape": list(solver.pencil_shape),
+                  "wrt": list(self.wrt)})
+        logger.info(
+            f"DifferentiableIVP: wrt={list(self.wrt)}, "
+            f"checkpoint_segments="
+            f"{self.checkpoint_segments or 'auto(sqrt n)'}")
+
+    # -------------------------------------------------------------- helpers
+
+    def state_arrays(self, X):
+        """Split a (G, S) pencil state into per-field coefficient arrays
+        keyed by field name (traceable: safe inside a loss function)."""
+        return scatter_state(self.solver.layout, self.solver.variables, X)
+
+    def _merge_extras(self, diff_extras, const_extras):
+        out = [None] * (len(self._wrt_idx) + len(self._const_idx))
+        for i, v in zip(self._wrt_idx, diff_extras):
+            out[i] = v
+        for i, v in zip(self._const_idx, const_extras):
+            out[i] = v
+        return out
+
+    def _segments(self, n_scan):
+        K = self.checkpoint_segments
+        if K is None:
+            K = int(np.ceil(np.sqrt(max(n_scan, 1))))
+        return max(1, min(int(K), max(n_scan, 1)))
+
+    def _scan_checkpointed(self, step_once, carry, n_scan):
+        """n_scan applications of `step_once` (carry -> carry) as a
+        K-segment remat'd scan plus one plain remainder scan: backward
+        stores K boundary carries and recomputes within a segment."""
+        if n_scan <= 0:
+            return carry
+        K = self._segments(n_scan)
+        self._last_segments = K
+        L = n_scan // K
+        rem = n_scan - K * L
+
+        def body(c, _):
+            return step_once(c), None
+
+        def segment(c):
+            c, _ = jax.lax.scan(body, c, None, length=L)
+            return c
+
+        if L > 0:
+            if K > 1:
+                seg = jax.checkpoint(segment)
+                carry, _ = jax.lax.scan(lambda c, _: (seg(c), None),
+                                        carry, None, length=K)
+            else:
+                carry = segment(carry)
+        if rem:
+            carry, _ = jax.lax.scan(body, carry, None, length=rem)
+        return carry
+
+    # ----------------------------------------------------- factorizations
+
+    def _factors_multistep(self, dt, n):
+        """Device coefficient triples + LHS auxes for an n-step constant-dt
+        run: ([(a, b, c)...] ramp, [aux...] ramp, (a, b, c) stationary,
+        aux stationary). Factors are host dispatches cached per leading
+        coefficient pair — they enter the differentiable program as
+        non-differentiated operands."""
+        ts = self.timestepper
+        solver = self.solver
+        rd = self.rd
+        ramp_np, stat_np = ts.coefficient_schedule(dt, n)
+
+        def aux_for(a, b):
+            key = (round(float(a[0]), 14), round(float(b[0]), 14))
+            aux = self._factor_cache.get(key)
+            if aux is None:
+                aux = self._factor_cache[key] = ts._factor(
+                    solver.M_mat, solver.L_mat,
+                    jnp.asarray(a[0], dtype=rd), jnp.asarray(b[0], dtype=rd))
+            return aux
+
+        dev = lambda abc: tuple(jnp.asarray(v, dtype=rd) for v in abc)
+        ramp = [dev(abc) for abc in ramp_np]
+        ramp_auxs = [aux_for(a, b) for a, b, _ in ramp_np]
+        return ramp, ramp_auxs, dev(stat_np), aux_for(*stat_np[:2])
+
+    def _factors_rk(self, dt):
+        key = round(float(dt), 14)
+        auxs = self._factor_cache.get(key)
+        if auxs is None:
+            auxs = self._factor_cache[key] = self.timestepper._factor(
+                self.solver.M_mat, self.solver.L_mat,
+                jnp.asarray(float(dt), dtype=self.rd))
+        return auxs
+
+    # ----------------------------------------------------------- programs
+
+    def _build_raw(self, n):
+        """The pure (operands -> (loss, stateT)) function over n steps,
+        composed from the timestepper's raw step body."""
+        solver = self.solver
+        ts = self.timestepper
+        loss_fn = self.loss
+        merge = self._merge_extras
+        scan_ck = self._scan_checkpointed
+
+        if self._multistep:
+            s = ts.steps
+            n_ramp = min(s - 1, n)
+            advance = ts.advance_body
+            G, S = solver.pencil_shape
+            pdtype = solver.pencil_dtype
+
+            def raw(M, L, X0, t0, dt, diff_extras, const_extras,
+                    ramp, ramp_auxs, abc, aux):
+                extras = merge(diff_extras, const_extras)
+                hists = (jnp.zeros((s, G, S), dtype=pdtype),) * 3
+                X, t = X0, t0
+                with metrics_mod.trace_scope("adjoint", "forward"):
+                    for (a, b, c), auxr in zip(ramp, ramp_auxs):
+                        X, *hists = advance(M, L, X, t, extras, *hists,
+                                            a, b, c, auxr)
+                        t = t + dt
+                    if n > n_ramp:
+                        a, b, c = abc
+
+                        def one(carry):
+                            X, t, Fh, MXh, LXh = carry
+                            Xn, Fh, MXh, LXh = advance(
+                                M, L, X, t, extras, Fh, MXh, LXh,
+                                a, b, c, aux)
+                            return (Xn, t + dt, Fh, MXh, LXh)
+
+                        X, t, *hists = scan_ck(one, (X, t, *hists),
+                                               n - n_ramp)
+                with metrics_mod.trace_scope("adjoint", "loss"):
+                    val = loss_fn(X)
+                return val, X
+        else:
+            step_body = ts.step_body
+
+            def raw(M, L, X0, t0, dt, diff_extras, const_extras, lhs_auxs):
+                extras = merge(diff_extras, const_extras)
+
+                def one(carry):
+                    X, t = carry
+                    return (step_body(M, L, X, t, dt, extras, lhs_auxs),
+                            t + dt)
+
+                with metrics_mod.trace_scope("adjoint", "forward"):
+                    X, _ = scan_ck(one, (X0, t0), n)
+                with metrics_mod.trace_scope("adjoint", "loss"):
+                    val = loss_fn(X)
+                return val, X
+        return raw
+
+    def _program(self, kind, n):
+        """Memoized lifted_jit program per (kind, n, K): retraces after
+        warmup surface through the retrace sentinel exactly like the
+        solver's step programs."""
+        key = (kind, int(n), self.checkpoint_segments)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        raw = self._build_raw(int(n))
+        if kind == "grad":
+            argnums = ((2,) if self._wrt_state else ()) + \
+                ((5,) if self._wrt_idx else ())
+            fn = jax.value_and_grad(raw, argnums=argnums, has_aux=True)
+        else:
+            fn = raw
+        prog = self._programs[key] = lifted_jit(fn)
+        return prog
+
+    # ------------------------------------------------------------ operands
+
+    def _operands(self, initial_state, fields):
+        solver = self.solver
+        if initial_state is not None:
+            X0 = jnp.asarray(initial_state, dtype=solver.pencil_dtype)
+        else:
+            X0 = solver.gather_fields() if solver.fields_dirty() \
+                else solver.X
+        extras = [jnp.asarray(a) for a in solver.rhs_extra()]
+        if fields:
+            unknown = set(fields) - set(self.extra_names)
+            if unknown:
+                raise ValueError(
+                    f"field overrides {sorted(unknown)} are not RHS "
+                    f"operands of this problem ({self.extra_names})")
+            for name, arr in fields.items():
+                i = self.extra_names.index(name)
+                extras[i] = jnp.asarray(arr, dtype=extras[i].dtype)
+        diff_extras = [extras[i] for i in self._wrt_idx]
+        const_extras = [extras[i] for i in self._const_idx]
+        return X0, diff_extras, const_extras
+
+    def _args(self, n, dt, X0, diff_extras, const_extras):
+        solver = self.solver
+        t0 = jnp.asarray(float(solver.sim_time), dtype=self.rd)
+        dtj = jnp.asarray(float(dt), dtype=self.rd)
+        base = (solver.M_mat, solver.L_mat, X0, t0, dtj,
+                diff_extras, const_extras)
+        if self._multistep:
+            ramp, ramp_auxs, abc, aux = self._factors_multistep(dt, n)
+            return base + (ramp, ramp_auxs, abc, aux)
+        return base + (self._factors_rk(dt),)
+
+    def _grads_dict(self, grads):
+        out = {}
+        pos = 0
+        if self._wrt_state:
+            out[WRT_STATE] = grads[pos]
+            pos += 1
+        if self._wrt_idx:
+            for i, g in zip(self._wrt_idx, grads[pos]):
+                out[self.extra_names[i]] = g
+        return out
+
+    # -------------------------------------------------------------- public
+
+    def forward(self, n_steps, dt, initial_state=None, fields=None):
+        """Run the pure forward pass: (loss value as float, final pencil
+        state). Numerically identical to n solver.step(dt) calls from a
+        fresh history, and the denominator of the grad/forward cost
+        ratio (benchmarks/adjoint.py)."""
+        n = int(n_steps)
+        if n < 1:
+            raise ValueError("n_steps must be >= 1")
+        args = self._args(n, dt, *self._operands(initial_state, fields))
+        prog = self._program("forward", n)
+        first = ("forward", n, self.checkpoint_segments) not in \
+            self._compiled_keys()
+        t0 = time_mod.perf_counter()
+        with metrics_mod.annotate("dedalus/adjoint/forward"):
+            val, XT = prog(*args)
+            jax.block_until_ready(XT)
+        wall = time_mod.perf_counter() - t0
+        self._note_run("fwd", n, wall, first,
+                       ("forward", n, self.checkpoint_segments))
+        return float(val), XT
+
+    def value(self, n_steps, dt, initial_state=None, fields=None):
+        """The scalar loss of the forward pass (finite-difference probes
+        and optimizer line searches)."""
+        return self.forward(n_steps, dt, initial_state=initial_state,
+                            fields=fields)[0]
+
+    def value_and_grad(self, n_steps, dt, initial_state=None, fields=None,
+                       check_health=True):
+        """
+        Loss and adjoint gradients of n constant-dt steps from the
+        solver's current state (or the explicit operand overrides).
+        Returns `(loss, grads)` with grads keyed by wrt operand name
+        ("initial_state" and/or RHS field names). With `check_health`
+        (default), a non-finite loss or gradient raises a structured
+        `SolverHealthError` naming the adjoint phase
+        (HealthMonitor.check_values).
+        """
+        n = int(n_steps)
+        if n < 1:
+            raise ValueError("n_steps must be >= 1")
+        args = self._args(n, dt, *self._operands(initial_state, fields))
+        prog = self._program("grad", n)
+        first = ("grad", n, self.checkpoint_segments) not in \
+            self._compiled_keys()
+        t0 = time_mod.perf_counter()
+        with metrics_mod.annotate("dedalus/adjoint/grad"):
+            (val, XT), grads = prog(*args)
+            jax.block_until_ready(grads)
+        wall = time_mod.perf_counter() - t0
+        self._note_run("grad", n, wall, first,
+                       ("grad", n, self.checkpoint_segments))
+        grads = self._grads_dict(grads)
+        if check_health:
+            self.solver.health.check_values(
+                (val, grads), phase="adjoint",
+                context=f"backward pass over {n} steps, "
+                        f"wrt={list(self.wrt)}, dt={float(dt):.3e}")
+        return float(val), grads
+
+    # ----------------------------------------------------------- telemetry
+
+    def _compiled_keys(self):
+        keys = getattr(self, "_compiled", None)
+        if keys is None:
+            keys = self._compiled = set()
+        return keys
+
+    def _note_run(self, kind, n, wall, first, key):
+        """Loop accounting: the first run of each program carries its
+        trace+compile and is recorded as compile time, not throughput."""
+        if first:
+            self._compiled_keys().add(key)
+            self._compile_sec += wall
+            self.metrics.inc(f"adjoint/{kind}_compiles")
+        elif kind == "grad":
+            self._grad_steps += n
+            self._grad_wall += wall
+        else:
+            self._fwd_steps += n
+            self._fwd_wall += wall
+        if kind == "grad":
+            self._grad_calls += 1
+            self.metrics.inc("adjoint/grad_calls")
+            self.metrics.inc("adjoint/grad_steps", n)
+        else:
+            self._fwd_calls += 1
+            self.metrics.inc("adjoint/forward_calls")
+            self.metrics.inc("adjoint/forward_steps", n)
+        self.metrics.memory.sample()
+
+    def summary(self):
+        """Compact adjoint record (the `adjoint` block of flushed
+        telemetry; `report` renders it). Rates exclude each program's
+        compile-bearing first run."""
+        grad_sps = round(self._grad_steps / self._grad_wall, 4) \
+            if self._grad_wall > 0 else None
+        fwd_sps = round(self._fwd_steps / self._fwd_wall, 4) \
+            if self._fwd_wall > 0 else None
+        ratio = None
+        if grad_sps and fwd_sps and grad_sps > 0:
+            ratio = round(fwd_sps / grad_sps, 3)
+        return {
+            "wrt": list(self.wrt),
+            "checkpoint_segments": self._last_segments,
+            "grad_calls": self._grad_calls,
+            "grad_steps": self._grad_steps,
+            "grad_steps_per_sec": grad_sps,
+            "forward_steps_per_sec": fwd_sps,
+            "grad_forward_ratio": ratio,
+            "compile_sec": round(self._compile_sec, 4),
+            "device_mem_peak_bytes": self.metrics.memory.peak_bytes,
+        }
+
+    def flush_metrics(self, extra=None):
+        """Flush one telemetry record with the `adjoint` summary block
+        (and the retrace-sentinel verdict) attached."""
+        extra = dict(extra or {})
+        extra.setdefault("adjoint", self.summary())
+        extra.setdefault("retraces_post_warmup",
+                         retrace_mod.sentinel.post_arm_retraces)
+        return self.metrics.flush(extra=extra)

@@ -1040,6 +1040,19 @@ class InitialValueSolver(SolverBase):
         from .ensemble import EnsembleSolver
         return EnsembleSolver(self, members, **kw)
 
+    def differentiable(self, wrt=("initial_state",), loss=None,
+                       checkpoint_segments=None, **kw):
+        """Build a DifferentiableIVP over this (built, undistributed)
+        IVP: compiled `jax.grad`-able value-and-grad programs of a
+        scalar `loss` of the final state over n constant-dt steps, with
+        adjoint pencil solves against the cached LHS factorization and
+        `jax.checkpoint`-bounded backprop memory (core/adjoint.py,
+        docs/differentiable.md)."""
+        from .adjoint import DifferentiableIVP
+        return DifferentiableIVP(self, wrt=wrt, loss=loss,
+                                 checkpoint_segments=checkpoint_segments,
+                                 **kw)
+
     def evolve(self, timestep_function=None, log_cadence=100):
         """Run the main loop to completion (reference: core/solvers.py:713)."""
         try:

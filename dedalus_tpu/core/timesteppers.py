@@ -229,6 +229,34 @@ class MultistepIMEX:
         """Return (a[0..order], b[0..order], c[1..order])."""
         raise NotImplementedError
 
+    def _pad_coeffs(self, a, b, c):
+        """Pad (a, b, c) to the stationary lengths (s+1, s+1, s) that
+        advance_body consumes, exactly as step() does."""
+        s = self.steps
+        a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+        b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+        c = np.concatenate([c, np.zeros(s - len(c))])
+        return a, b, c
+
+    def coefficient_schedule(self, dt, n):
+        """
+        Host-side constant-dt coefficient schedule for an n-step run from
+        a FRESH history (zero F/MX/LX hists), replaying exactly what n
+        calls of step(dt) would produce: the startup ramp's per-step
+        padded (a, b, c) triples (orders 1..min(s-1, n)) followed by the
+        stationary triple covering every later step. The differentiable
+        scan (core/adjoint.py) consumes this so adjoint forward passes
+        are bit-identical to the stepping loop.
+        """
+        s = self.steps
+        dt = float(dt)
+        ramp = []
+        for it in range(1, min(s - 1, int(n)) + 1):
+            a, b, c = self.compute_coefficients([dt] * it, it)
+            ramp.append(self._pad_coeffs(a, b, c))
+        a, b, c = self.compute_coefficients([dt] * s, s)
+        return ramp, self._pad_coeffs(a, b, c)
+
     def reset_run(self):
         """Rewind per-run state to just-constructed values IN PLACE (the
         warm-pool service's between-request reset, service/pool.py) —
@@ -254,10 +282,8 @@ class MultistepIMEX:
         self.dt_hist = [float(dt)] + self.dt_hist[:s - 1]
         self.iteration += 1
         order = min(s, self.iteration)
-        a, b, c = self.compute_coefficients(self.dt_hist, order)
-        a = np.concatenate([a, np.zeros(s + 1 - len(a))])
-        b = np.concatenate([b, np.zeros(s + 1 - len(b))])
-        c = np.concatenate([c, np.zeros(s - len(c))])
+        a, b, c = self._pad_coeffs(
+            *self.compute_coefficients(self.dt_hist, order))
         key = (round(float(a[0]), 14), round(float(b[0]), 14))
         rd = self.solver.real_dtype
         if key != self._lhs_key:
@@ -350,11 +376,8 @@ class MultistepIMEX:
             M, L, X = solver.M_mat, solver.L_mat, solver.X
             t = jnp.asarray(float(solver.sim_time), dtype=rd)
             extra = solver.rhs_extra()
-            a, b, c = self.compute_coefficients(
-                self.dt_hist, min(s, max(self.iteration, 1)))
-            a = np.concatenate([a, np.zeros(s + 1 - len(a))])
-            b = np.concatenate([b, np.zeros(s + 1 - len(b))])
-            c = np.concatenate([c, np.zeros(s - len(c))])
+            a, b, c = self._pad_coeffs(*self.compute_coefficients(
+                self.dt_hist, min(s, max(self.iteration, 1))))
             aj, bj, cj = (jnp.asarray(v, dtype=rd) for v in (a, b, c))
             Fn, MXn, LXn = self._eval_parts(M, L, X, t, extra)
             # probe-input warm: runs once per LHS key under the metrics

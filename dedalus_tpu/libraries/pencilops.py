@@ -79,6 +79,85 @@ def active_pencil_mesh():
     return getattr(_PENCIL_MESH, "state", None)
 
 
+# -------------------------------------------------- adjoint solve funnel
+#
+# The batched pivoted-LU solves are opaque to JAX's autodiff at the
+# factorization boundary: the factors (aux) are precomputed OUTSIDE the
+# differentiated program (they are value-dependent host dispatches), and
+# letting autodiff transpose the solve's internals op-by-op would drag
+# the substitution scans through linearization for no reason. The
+# mathematical fact is simpler: x = A^-1 f is LINEAR in f, and the vjp
+# of a linear solve is one more linear solve against the SAME matrix,
+# transposed. Every ops.solve therefore routes through one
+# jax.custom_vjp whose backward pass is `solve_transpose` — an adjoint
+# solve reusing the cached LHS factors (core/adjoint.py is the
+# consumer; the primal lowering is unchanged, so forward-only stepping
+# compiles exactly as before).
+#
+# Factors and matrices receive ZERO cotangents: gradients w.r.t. the
+# M/L assembly data are not implemented (the factorization is outside
+# the trace; see docs/differentiable.md for the contract).
+
+def _zeros_like_tree(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _adjoint_solve_primal(ops, aux, rhs, mats):
+    return ops._solve_impl(aux, rhs, mats)
+
+
+_adjoint_solve = jax.custom_vjp(_adjoint_solve_primal, nondiff_argnums=(0,))
+
+
+def _adjoint_solve_fwd(ops, aux, rhs, mats):
+    # residuals are references to the already-resident factor buffers,
+    # never copies — the backward solve reuses them in place
+    return ops._solve_impl(aux, rhs, mats), (aux, mats)
+
+
+def _adjoint_solve_bwd(ops, res, ct):
+    aux, mats = res
+    ct_rhs = ops.solve_transpose(aux, ct, mats=mats)
+    return (_zeros_like_tree(aux), ct_rhs, _zeros_like_tree(mats))
+
+
+_adjoint_solve.defvjp(_adjoint_solve_fwd, _adjoint_solve_bwd)
+
+
+class AdjointSolveOps:
+    """Shared solve surface of the pencil-ops classes: the public `solve`
+    is the custom-VJP funnel above; `solve_transpose` is its backward
+    pass (and a public API in its own right — data assimilation codes
+    want A^T solves against the forward factorization)."""
+
+    def solve(self, aux, rhs, mats=None):
+        """Solve A x = rhs against the cached factorization. Linear in
+        `rhs` with a registered custom VJP: the backward pass is
+        `solve_transpose` against the same factors, and aux/mats get
+        zero cotangents (M/L data is not differentiable)."""
+        return _adjoint_solve(self, aux, rhs, mats)
+
+    def solve_transpose(self, aux, rhs, mats=None):
+        """Solve A^T x = rhs against the SAME factorization: the solve
+        is linear in its RHS, so its transpose re-expresses the compiled
+        substitution chain transposed — triangular solves against the
+        transposed factors, run in reverse order, plus the transposed
+        Woodbury/refinement corrections — without ever refactoring (the
+        adjoint of a linear solve is a linear solve with the same
+        matrix). Routed through jax.vjp rather than jax.linear_transpose
+        because raw `lax.scan` equations (the blocked banded
+        substitutions) carry no linearity flags for the direct transpose
+        rule; linearizing first marks them. The linearization point is
+        zeros, so every primal-side value is a DCE-able constant and the
+        compiled backward contains just the transposed solve."""
+        with jax.named_scope(f"dedalus/matsolve/{self.kind}.solve_T"):
+            _, f_vjp = jax.vjp(
+                lambda r: self._solve_impl(aux, r, mats),
+                jnp.zeros_like(rhs))
+            (out,) = f_vjp(rhs)
+            return out
+
+
 def shard_groups(fn, G, *args):
     """
     Run `fn(*args)` with the length-G leading batch axis sharded over the
@@ -110,7 +189,7 @@ def shard_groups(fn, G, *args):
     return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec)(*args)
 
 
-class DenseOps:
+class DenseOps(AdjointSolveOps):
     """Dense (G, S, S) pencil operators (small problems / fallback)."""
 
     kind = "dense"
@@ -138,7 +217,7 @@ class DenseOps:
     def factor_lincomb(self, a, A, b, B):
         return self.factor(self.lincomb(a, A, b, B))
 
-    def solve(self, aux, rhs, mats=None):
+    def _solve_impl(self, aux, rhs, mats=None):
         with jax.named_scope("dedalus/matsolve/dense.solve"):
             return shard_groups(self.solver_cls.solve, rhs.shape[0],
                                 aux, rhs)
@@ -172,7 +251,7 @@ class BandedMatrix:
         return cls(bands, Vt, dsel)
 
 
-class BandedOps:
+class BandedOps(AdjointSolveOps):
     """
     Banded + pinned-row pencil operators.
 
@@ -786,7 +865,7 @@ class BandedOps:
         xp = y[:, :self.n]
         return xp[:, self.pos_col]
 
-    def solve(self, aux, rhs, mats=None):
+    def _solve_impl(self, aux, rhs, mats=None):
         with jax.named_scope("dedalus/matsolve/banded.solve"):
             x = self._solve_once(aux, rhs)
             if mats is None and "A" not in aux:

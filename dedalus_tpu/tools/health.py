@@ -357,6 +357,56 @@ class HealthMonitor:
         self._evaluate(record)
         return record
 
+    def check_values(self, tree, phase="adjoint", context=None):
+        """
+        Explicit fused non-finite check over an arbitrary pytree of device
+        values (the differentiable-solve path routes its loss + gradients
+        through here, core/adjoint.py): one jitted reduction, one scalar
+        host pull, and a structured `SolverHealthError` naming `phase`
+        when anything is non-finite. Unlike the cadence-gated state probe
+        this is an explicit-call API: it runs even on a monitor built
+        with enabled=False (the zero-overhead contract covers the step
+        loop's implicit ticks, not a caller asking for a verdict), it
+        counts toward `checks`, and it does NOT latch the monitor failed
+        — the solver state itself may be fine; only the requested
+        computation is poisoned. Returns the non-finite entry count (0
+        when healthy; the error is raised, not returned).
+        """
+        import jax
+        import jax.numpy as jnp
+        probe = getattr(self, "_value_probe", None)
+        if probe is None:
+            from . import retrace as retrace_mod
+
+            def raw(leaves):
+                with metrics_mod.trace_scope("health", "values"):
+                    total = jnp.zeros((), dtype=jnp.int32)
+                    for leaf in leaves:
+                        total = total + jnp.sum(
+                            (~jnp.isfinite(leaf)).astype(jnp.int32))
+                    return total
+            # memoized on self just above (one wrapper per monitor, so
+            # the retrace sentinel counts real signature churn only)
+            probe = self._value_probe = jax.jit(  # dedalus-lint: disable=DTL003
+                retrace_mod.noted(raw, "health/values"))
+        leaves = [leaf for leaf in jax.tree.leaves(tree)
+                  if hasattr(leaf, "dtype")]
+        self.checks += 1
+        if not leaves:
+            return 0
+        with metrics_mod.annotate(f"dedalus/health/{phase}"):
+            bad = int(jax.device_get(probe(leaves)))
+        if bad:
+            solver = self.solver
+            reason = (f"{phase}: non-finite values "
+                      f"({bad} entries across the checked outputs)"
+                      + (f" — {context}" if context else ""))
+            raise SolverHealthError(
+                reason,
+                iteration=int(solver.iteration) if solver else None,
+                sim_time=float(solver.sim_time) if solver else None)
+        return 0
+
     def _evaluate(self, record):
         fatal = None
         for name, s in record["fields"].items():

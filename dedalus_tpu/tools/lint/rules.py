@@ -41,6 +41,11 @@ FUNNEL_MODULES = (
     "tools/jitlift.py",
 )
 
+STEP_BODY_MODULES = (
+    "core/timesteppers.py",
+    "core/ddstep.py",
+)
+
 
 def _contains_jax_call(ctx, node):
     """Whether the expression contains a call into jax/jax.numpy."""
@@ -337,3 +342,127 @@ class PrivateJaxApi(Rule):
                         ctx, node, "jax._src attribute access (no "
                         "stability contract); prefer the public jax.* "
                         "surface with a guarded fallback")
+
+
+@register
+class NonDifferentiableOpInStepBody(Rule):
+    """DTL006: gradient-breaking op in a raw step body.
+
+    The raw step bodies (`MultistepIMEX.advance_body`,
+    `RungeKuttaIMEX.step_body`) are the pure functions the differentiable
+    subsystem scans and backpropagates through (core/adjoint.py), and the
+    ensemble solver vmaps. Three op classes silently break that contract:
+
+      * `jax.lax.stop_gradient` — zeroes the cotangent flow mid-loop, so
+        adjoint gradients come back wrong with no error;
+      * host callbacks (`io_callback`, `pure_callback`,
+        `jax.debug.callback`, `host_callback.call`) — have no transpose
+        rule, so `jax.grad` through the step raises (or, for debug
+        callbacks, detaches silently);
+      * `.at[...].set()` on a DONATED buffer — in-place aliasing of an
+        input whose value the backward pass still needs to replay.
+
+    Heuristics: fires only in STEP_BODY_MODULES. stop_gradient and the
+    callbacks flag anywhere in those modules (the whole file compiles
+    into step programs). The donated-buffer case flags `.at[...].set()`
+    whose base is a PARAMETER of a function that some jit wrapper in the
+    same module marks with donate_argnums (lexical detection only —
+    donation via call sites in other modules is invisible to this pass;
+    carry a suppression naming the owner if such a case is ever
+    deliberate).
+    """
+
+    id = "DTL006"
+    severity = "error"
+    title = "non-differentiable-op-in-step-body"
+
+    _CALLBACKS = ("jax.experimental.io_callback", "io_callback",
+                  "jax.pure_callback", "jax.debug.callback",
+                  "jax.experimental.host_callback.call")
+
+    def _donated_functions(self, ctx):
+        """Names of functions traced by a jit-ish call (or decorated)
+        that passes donate_argnums in this module."""
+        names = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            if "donate_argnums" not in kwargs:
+                continue
+            name = ctx.canon(node.func)
+            if name is None:
+                continue
+            jitish = name_matches(name, "jax.jit", "lifted_jit") or (
+                name_matches(name, "functools.partial") and node.args
+                and (inner := ctx.canon(node.args[0])) is not None
+                and name_matches(inner, "jax.jit", "lifted_jit"))
+            if not jitish:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+            parent = ctx.parent(node)
+            # decorator form: @functools.partial(jax.jit, donate_argnums=..)
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node in parent.decorator_list:
+                names.add(parent.name)
+        return names
+
+    @staticmethod
+    def _at_set_base(node):
+        """For a call `X.at[...].set(...)`, the root expression X (None
+        when the call is not an at-set chain)."""
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "set"):
+            return None
+        sub = func.value
+        if not isinstance(sub, ast.Subscript):
+            return None
+        base = sub.value
+        if not (isinstance(base, ast.Attribute) and base.attr == "at"):
+            return None
+        return base.value
+
+    def check(self, ctx):
+        if not module_matches(ctx.rel, STEP_BODY_MODULES):
+            return
+        donated = None
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.canon(node.func)
+            if name is not None and name_matches(name,
+                                                 "jax.lax.stop_gradient"):
+                yield self.finding(
+                    ctx, node, "stop_gradient inside a step body zeroes "
+                    "the adjoint cotangent flow silently (core/adjoint.py "
+                    "backpropagates through these bodies); compute the "
+                    "detached value outside the step")
+                continue
+            if name is not None and name_matches(name, *self._CALLBACKS):
+                yield self.finding(
+                    ctx, node, "host callback inside a step body has no "
+                    "transpose rule: jax.grad through the step loop "
+                    "raises (or silently detaches); hoist the host work "
+                    "out of the traced step")
+                continue
+            base = self._at_set_base(node)
+            if base is None or not isinstance(base, ast.Name):
+                continue
+            enclosing = ctx.enclosing_function(node)
+            if enclosing is None:
+                continue
+            if donated is None:
+                donated = self._donated_functions(ctx)
+            if enclosing.name not in donated:
+                continue
+            params = {a.arg for a in enclosing.args.args
+                      + enclosing.args.posonlyargs
+                      + enclosing.args.kwonlyargs}
+            if base.id in params:
+                yield self.finding(
+                    ctx, node, f".at[].set on parameter '{base.id}' of a "
+                    "donate_argnums-jitted step body aliases a donated "
+                    "input the backward pass still needs; drop the "
+                    "donation or write to a fresh buffer")

@@ -16,8 +16,13 @@ Naming scheme: phase timer names are the `jax.named_scope` labels on the
 corresponding traced code, prefixed `dedalus/` — `dedalus/transform/...`,
 `dedalus/matsolve/...`, `dedalus/transpose/...`, `dedalus/evaluator/...`,
 `dedalus/step...`, `dedalus/health/...` (the numerical-health probe,
-tools/health.py) — so per-phase wall aggregates in the JSONL record and
-op rows in a `jax.profiler` trace share one vocabulary.
+tools/health.py), `dedalus/adjoint/...` (the differentiable-solve
+forward/loss scopes and grad dispatch annotations, core/adjoint.py) — so
+per-phase wall aggregates in the JSONL record and op rows in a
+`jax.profiler` trace share one vocabulary. Records flushed by a
+DifferentiableIVP carry an `adjoint` sub-dict (grad_steps_per_sec,
+checkpoint segments, grad/forward cost ratio, peak device memory) that
+`report` renders as its own block.
 
 Flush emits ONE record per call, shaped like `benchmarks/results.jsonl`
 rows (flat JSON object, `ts` + `config`/`backend`/`dtype` keys) with the

@@ -1,0 +1,92 @@
+"""
+Inverse initial-condition recovery by adjoint gradient descent
+(the DifferentiableIVP workload end to end, docs/differentiable.md).
+
+Setup: a 1-D diffusion equation is stepped forward from a band-limited
+"true" temperature field to produce a terminal observation. The inverse
+problem — recover the initial field from that single terminal snapshot —
+is then solved by gradient descent on
+
+    J(u0) = || XT(u0) - X_obs ||^2
+
+with dJ/du0 from `solver.differentiable(...)`: each optimizer iteration
+is ONE compiled value-and-grad call (checkpointed adjoint backprop
+through all n steps, adjoint pencil solves against the cached LHS
+factors). Diffusion damps mode k by exp(-k^2 T), so the observation
+window is kept short and the true field band-limited — the classic
+ill-posedness of backward diffusion, visible here as slower recovery of
+the higher modes.
+
+Run: python examples/adjoint_diffusion.py
+"""
+
+import logging
+
+import numpy as np
+import jax.numpy as jnp
+
+import dedalus_tpu.public as d3
+
+logger = logging.getLogger(__name__)
+
+# Parameters
+Nx = 64
+n_steps = 100
+dt = 1e-4
+iterations = 60
+learning_rate = 0.45
+dtype = np.float64
+
+# Problem
+xcoord = d3.Coordinate('x')
+dist = d3.Distributor(xcoord, dtype=dtype)
+xbasis = d3.RealFourier(xcoord, size=Nx, bounds=(0, 2 * np.pi))
+u = dist.Field(name='u', bases=xbasis)
+problem = d3.IVP([u], namespace={'u': u, 'lap': d3.lap})
+problem.add_equation("dt(u) - lap(u) = 0")
+x = dist.local_grid(xbasis)
+
+# True initial condition (band-limited: modes the short window keeps
+# observable) -> terminal observation, produced by the plain stepping
+# loop BEFORE any differentiable program exists (the loss closes over
+# X_obs, and compiled programs bake closure values in at trace time —
+# a placeholder observation would be baked in permanently)
+u['g'] = np.sin(x) + 0.5 * np.cos(2 * x) - 0.3 * np.sin(3 * x)
+fwd_solver = problem.build_solver(d3.SBDF2, warmup_iterations=2,
+                                  enforce_real_cadence=0)
+X_true = np.asarray(fwd_solver.gather_fields()).copy()
+for _ in range(n_steps):
+    fwd_solver.step(dt)
+X_obs = jnp.asarray(fwd_solver.X)
+
+# Inverse problem: a fresh solver (clock at t=0) differentiated against
+# the now-final observation
+solver = problem.build_solver(d3.SBDF2, warmup_iterations=2,
+                              enforce_real_cadence=0)
+div = solver.differentiable(
+    wrt=("initial_state",),
+    loss=lambda X: jnp.sum((X - X_obs) ** 2),
+    checkpoint_segments=10)
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    # Gradient descent from a cold (zero) initial guess
+    X_guess = np.zeros_like(X_true)
+    for i in range(iterations):
+        loss, grads = div.value_and_grad(n_steps, dt,
+                                         initial_state=X_guess)
+        X_guess = X_guess - learning_rate * np.asarray(
+            grads["initial_state"])
+        if i % 10 == 0 or i == iterations - 1:
+            err = np.linalg.norm(X_guess - X_true) / np.linalg.norm(X_true)
+            logger.info(f"iter {i:3d}: J = {loss:.3e}, "
+                        f"|u0 - u0_true|/|u0_true| = {err:.3e}")
+    record = div.flush_metrics()
+    if record:
+        adj = record["adjoint"]
+        logger.info(f"adjoint: {adj['grad_calls']} grad calls, "
+                    f"{adj['grad_steps_per_sec']} grad-steps/s, "
+                    f"{adj['checkpoint_segments']} segments")
+    final_err = np.linalg.norm(X_guess - X_true) / np.linalg.norm(X_true)
+    logger.info(f"recovered initial field, relative error {final_err:.3e}")
+    assert final_err < 1e-2, "inverse-IC recovery did not converge"

@@ -1,0 +1,299 @@
+"""
+Differentiable solves (core/adjoint.py + libraries/pencilops adjoint
+funnel): finite-difference validation of adjoint gradients through the
+step loop (ICs, parameter fields, forcing; SBDF2 + RK222; diffusion and
+KdV-Burgers), checkpoint-segment invariance, forward fidelity against
+the stepping loop, the solve_transpose identity on both pencil-ops
+kinds, linear-transpose round-trip of the transform chain, the
+zero-retrace assertion on the compiled grad program, and the structured
+health error for a NaN backward pass.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.tools import retrace as retrace_mod
+from dedalus_tpu.tools.exceptions import SolverHealthError
+
+RNG = np.random.default_rng(7)
+
+_RB_CACHE = {}
+
+
+def rb_solver(matsolver):
+    """One shared RB 8x32 build per matsolver kind (these builds dominate
+    this file's runtime; the tests using them are read-only on the
+    solver: explicit initial_state everywhere, no stepping)."""
+    if matsolver not in _RB_CACHE:
+        import sys
+        import pathlib
+        sys.path.insert(0, str(pathlib.Path(__file__).parent))
+        from test_banded import build_rb
+        _RB_CACHE[matsolver] = build_rb(8, 32, matsolver=matsolver,
+                                        timestepper=d3.RK222)
+    return _RB_CACHE[matsolver]
+
+
+def build_diffusion(scheme, size=64):
+    """1-D forced heat IVP with a parameter field `a` and a forcing
+    field `f` as distinct RHS operands (the three differentiable operand
+    classes: IC / parameter / forcing)."""
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=size, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    a = dist.Field(name="a", bases=xb)
+    f = dist.Field(name="f", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "a": a, "f": f, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = a*u + f")
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(3 * x) + 0.2 * np.cos(x)
+    a["g"] = 0.1 * np.cos(x)
+    f["g"] = 0.05 * np.sin(2 * x)
+    solver = problem.build_solver(scheme, warmup_iterations=2,
+                                  enforce_real_cadence=0)
+    return solver
+
+
+def build_kdv(scheme, size=128):
+    """KdV-Burgers (reference example): nonlinear RHS through the
+    dealiased transform chain."""
+    Lx = 10
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=size, bounds=(0, Lx), dealias=3 / 2)
+    u = dist.Field(name="u", bases=xb)
+    dx = lambda A: d3.Differentiate(A, xc)
+    a, b = 1e-4, 2e-4
+    problem = d3.IVP([u], namespace=locals())
+    problem.add_equation("dt(u) - a*dx(dx(u)) - b*dx(dx(dx(u))) = - u*dx(u)")
+    x = dist.local_grid(xb)
+    n = 20
+    u["g"] = np.log(1 + np.cosh(n) ** 2
+                    / np.cosh(n * (x - 0.2 * Lx)) ** 2) / (2 * n)
+    return problem.build_solver(scheme, warmup_iterations=2,
+                                enforce_real_cadence=0)
+
+
+def fd_directional(div, n, dt, base, v, eps, operand):
+    """Central finite difference of the loss along direction v."""
+    if operand == "initial_state":
+        plus = div.value(n, dt, initial_state=base + eps * v)
+        minus = div.value(n, dt, initial_state=base - eps * v)
+    else:
+        plus = div.value(n, dt, fields={operand: base + eps * v})
+        minus = div.value(n, dt, fields={operand: base - eps * v})
+    return (plus - minus) / (2 * eps)
+
+
+# ------------------------------------------------- gradient validation
+
+@pytest.mark.parametrize("scheme", ["SBDF2", "RK222"])
+def test_diffusion_gradients_match_fd(scheme):
+    """jax.grad of a scalar loss through >=100 steps matches central
+    finite differences (rtol ~1e-5, f64) for initial-condition,
+    parameter-field, and forcing operands (acceptance criteria)."""
+    solver = build_diffusion(getattr(d3, scheme))
+    div = solver.differentiable(
+        wrt=("initial_state", "a", "f"),
+        loss=lambda X: jnp.sum(X ** 2), checkpoint_segments=8)
+    n, dt = 120, 1e-3
+    X0 = np.asarray(solver.gather_fields()).copy()
+    val, grads = div.value_and_grad(n, dt, initial_state=X0)
+    assert np.isfinite(val)
+    assert sorted(grads) == ["a", "f", "initial_state"]
+    bases = {"initial_state": X0,
+             "a": np.asarray(solver.eval_F.extra_fields[0].coeff_data()),
+             "f": np.asarray(solver.eval_F.extra_fields[1].coeff_data())}
+    for operand, g in grads.items():
+        g = np.asarray(g)
+        assert np.isfinite(g).all(), operand
+        base = bases[operand]
+        v = RNG.standard_normal(base.shape)
+        fd = fd_directional(div, n, dt, base, v, 1e-6, operand)
+        an = float(np.sum(g * v))
+        assert fd == pytest.approx(an, rel=1e-5), (scheme, operand)
+
+
+@pytest.mark.parametrize("scheme", ["SBDF2", "RK222"])
+def test_kdv_burgers_ic_gradient_matches_fd(scheme):
+    """Nonlinear dealiased RHS: IC gradient through >=100 KdV-Burgers
+    steps matches finite differences."""
+    solver = build_kdv(getattr(d3, scheme))
+    div = solver.differentiable(
+        wrt=("initial_state",), loss=lambda X: jnp.sum(X ** 2))
+    n, dt = 100, 2e-3
+    X0 = np.asarray(solver.gather_fields()).copy()
+    val, grads = div.value_and_grad(n, dt, initial_state=X0)
+    g = np.asarray(grads["initial_state"])
+    assert np.isfinite(g).all()
+    v = RNG.standard_normal(X0.shape)
+    fd = fd_directional(div, n, dt, X0, v, 1e-6, "initial_state")
+    an = float(np.sum(g * v))
+    assert fd == pytest.approx(an, rel=1e-5), scheme
+
+
+def test_banded_path_gradient_matches_fd():
+    """The banded (blocked pivoted-LU + Woodbury) solve differentiates
+    through the custom VJP: RB gradient vs finite differences."""
+    solver = rb_solver("banded")
+    assert solver.ops.kind == "banded"
+    X0 = np.asarray(solver.gather_fields()).copy()
+    div = solver.differentiable(
+        wrt=("initial_state",), loss=lambda X: jnp.sum(X ** 2),
+        checkpoint_segments=2)
+    _, grads = div.value_and_grad(5, 0.01, initial_state=X0)
+    g = np.asarray(grads["initial_state"])
+    assert np.isfinite(g).all()
+    v = RNG.standard_normal(X0.shape)
+    fd = fd_directional(div, 5, 0.01, X0, v, 1e-6, "initial_state")
+    assert fd == pytest.approx(float(np.sum(g * v)), rel=1e-5)
+
+
+# ------------------------------------------- forward + segment identity
+
+def test_forward_matches_step_loop():
+    """The differentiable forward pass is bit-identical to n solver.step
+    calls (multistep ramp included)."""
+    for scheme in (d3.SBDF2, d3.RK222):
+        ref = build_diffusion(scheme)
+        for _ in range(9):
+            ref.step(1e-3)
+        div_solver = build_diffusion(scheme)
+        div = div_solver.differentiable(
+            wrt=("initial_state",), loss=lambda X: jnp.sum(X ** 2))
+        _, XT = div.forward(9, 1e-3)
+        assert np.array_equal(np.asarray(XT), np.asarray(ref.X)), \
+            scheme.__name__
+
+
+def test_checkpoint_segments_do_not_change_gradients():
+    """Remat segmentation is a memory policy, not a numerics knob: K=1,
+    K=4, and an n-indivisible K produce identical losses and gradients."""
+    results = []
+    for K in (1, 4, 7):
+        solver = build_diffusion(d3.SBDF2)
+        div = solver.differentiable(
+            wrt=("initial_state",), loss=lambda X: jnp.sum(X ** 2),
+            checkpoint_segments=K)
+        val, grads = div.value_and_grad(30, 1e-3)
+        results.append((val, np.asarray(grads["initial_state"])))
+        assert div.summary()["checkpoint_segments"] == min(K, 28)
+    v0, g0 = results[0]
+    for val, g in results[1:]:
+        assert val == pytest.approx(v0, rel=1e-14)
+        np.testing.assert_allclose(g, g0, rtol=1e-12, atol=1e-14)
+
+
+# --------------------------------------------------- adjoint solve unit
+
+def test_solve_transpose_identity_dense_and_banded():
+    """ops.solve_transpose solves A^T x = b against the forward
+    factorization: <x, A y> == <b, y> for random b, y on both pencil-ops
+    kinds (including the banded Woodbury pin correction)."""
+    for ms in (None, "banded"):
+        solver = rb_solver(ms)
+        ops = solver.ops
+        ts = solver.timestepper
+        dt = 0.01
+        aux = ts._factor(solver.M_mat, solver.L_mat,
+                         jnp.asarray(dt, dtype=solver.real_dtype))[0]
+        h = ts.uniq_H_diag[ts.stage_slot[0]]
+        rng = np.random.default_rng(11)
+        b = jnp.asarray(rng.standard_normal(solver.pencil_shape))
+        y = jnp.asarray(rng.standard_normal(solver.pencil_shape))
+        x = ops.solve_transpose(aux, b, mats=(solver.M_mat, solver.L_mat))
+        Ay = ops.matvec(solver.M_mat, y) + dt * h * ops.matvec(
+            solver.L_mat, y)
+        lhs = float(jnp.sum(x * Ay))
+        rhs = float(jnp.sum(b * y))
+        assert lhs == pytest.approx(rhs, rel=1e-10), ops.kind
+
+
+def test_transform_chain_linear_transposes():
+    """The Chebyshev/Jacobi MMT + dealiasing chain round-trips under
+    jax.linear_transpose: the dealiased projection P (coeff -> grid ->
+    coeff) of a Fourier x Chebyshev state satisfies <P x, y> ==
+    <x, P^T y>, and P^T traces without error — the property the adjoint
+    step relies on."""
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=8, bounds=(0, 4), dealias=3 / 2)
+    zb = d3.ChebyshevT(coords["z"], size=16, bounds=(0, 1), dealias=3 / 2)
+    b = dist.Field(name="b", bases=(xb, zb))
+    tau = dist.Field(name="tau", bases=xb)
+    lift = lambda A: d3.Lift(A, zb.derivative_basis(1), -1)
+    problem = d3.IVP([b, tau], namespace=locals())
+    problem.add_equation("dt(b) - lap(b) + lift(tau) = 0")
+    problem.add_equation("b(z=0) = 0")
+    solver = problem.build_solver(d3.RK222, warmup_iterations=2,
+                                  enforce_real_cadence=0)
+    solver._ensure_project()
+    project = solver._project_body
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal(solver.pencil_shape))
+    y = jnp.asarray(rng.standard_normal(solver.pencil_shape))
+    Px = project(x)
+    (PTy,) = jax.linear_transpose(project, x)(y)
+    assert float(jnp.sum(Px * y)) == pytest.approx(
+        float(jnp.sum(x * PTy)), rel=1e-10)
+
+
+# ------------------------------------------------ hygiene + health
+
+def test_grad_program_zero_post_warmup_retraces():
+    """The compiled grad program traces once: repeated value_and_grad
+    calls after the sentinel arms are retrace-free (the PR-3 lint/
+    sentinel contract extended to the adjoint path)."""
+    sentinel = retrace_mod.sentinel
+    sentinel.reset()
+    try:
+        solver = build_diffusion(d3.SBDF2)
+        div = solver.differentiable(
+            wrt=("initial_state", "a"), loss=lambda X: jnp.sum(X ** 2),
+            checkpoint_segments=4)
+        div.value_and_grad(20, 1e-3)   # compile
+        sentinel.arm()
+        for _ in range(3):
+            div.value_and_grad(20, 1e-3)
+        assert sentinel.post_arm_retraces == 0
+        record = div.flush_metrics()
+        assert record["retraces_post_warmup"] == 0
+        assert record["adjoint"]["grad_calls"] == 4
+    finally:
+        sentinel.reset()
+
+
+def test_nan_backward_raises_structured_health_error():
+    """A NaN produced in the loss/backward pass raises a
+    SolverHealthError naming the adjoint phase (routed through
+    HealthMonitor.check_values) instead of silently reaching an
+    optimizer."""
+    solver = build_diffusion(d3.SBDF2)
+    div = solver.differentiable(
+        wrt=("initial_state",),
+        loss=lambda X: jnp.log(-jnp.sum(X ** 2)))   # log of negative: nan
+    with pytest.raises(SolverHealthError) as excinfo:
+        div.value_and_grad(10, 1e-3)
+    assert "adjoint" in str(excinfo.value)
+    # check_health=False opts out: the caller gets raw values
+    val, grads = div.value_and_grad(10, 1e-3, check_health=False)
+    assert np.isnan(val)
+
+
+def test_wrt_validation_and_summary():
+    solver = build_diffusion(d3.SBDF2)
+    with pytest.raises(ValueError, match="wrt"):
+        solver.differentiable(wrt=("nope",), loss=lambda X: jnp.sum(X))
+    with pytest.raises(ValueError, match="loss"):
+        solver.differentiable(wrt=("initial_state",))
+    div = solver.differentiable(wrt=("parameters",),
+                                loss=lambda X: jnp.sum(X ** 2))
+    assert set(div.wrt) == {"a", "f"}
+    div.value_and_grad(5, 1e-3)
+    summary = div.summary()
+    assert summary["grad_calls"] == 1
+    assert summary["wrt"] == ["a", "f"]

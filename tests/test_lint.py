@@ -49,7 +49,7 @@ def _rules_fired(result):
 def test_rule_catalog():
     rules = all_rules()
     assert [r.id for r in rules] == ["DTL001", "DTL002", "DTL003",
-                                     "DTL004", "DTL005"]
+                                     "DTL004", "DTL005", "DTL006"]
     for r in rules:
         assert r.severity in ("error", "warning")
         assert r.title
@@ -211,6 +211,62 @@ def clean():
     return trace_state_clean()
 """)
     assert result.findings == []
+
+
+def test_dtl006_fires_on_gradient_breakers_in_step_body(tmp_path):
+    result = _lint_src(tmp_path, "core/timesteppers.py", """
+import functools
+import jax
+from jax.experimental import io_callback
+
+def step_body(M, L, X, t):
+    Xd = jax.lax.stop_gradient(X)
+    io_callback(print, None, t)
+    return Xd
+
+@functools.partial(jax.jit, donate_argnums=0)
+def write_state(store, X):
+    return store.at[0].set(X)
+""")
+    assert "DTL006" in _rules_fired(result)
+    dtl6 = [f for f in result.findings if f.rule == "DTL006"]
+    assert len(dtl6) == 3
+    messages = " ".join(f.message for f in dtl6)
+    assert "stop_gradient" in messages
+    assert "host callback" in messages
+    assert "donate" in messages
+
+
+def test_dtl006_quiet_outside_step_bodies_and_without_donation(tmp_path):
+    # stop_gradient in a non-step-body module: out of scope
+    outside = _lint_src(tmp_path, "core/adjoint_helpers.py", """
+import jax
+
+def detach(x):
+    return jax.lax.stop_gradient(x)
+""")
+    assert "DTL006" not in _rules_fired(outside)
+    # .at[].set without donation, and on a local (not a donated
+    # parameter): fine — functional updates are the jnp idiom
+    undonated = _lint_src(tmp_path, "core/ddstep.py", """
+import jax
+import jax.numpy as jnp
+
+def update(store, X):
+    fresh = jnp.zeros_like(store)
+    return fresh.at[0].set(X)
+
+update_j = jax.jit(update)
+""")
+    assert undonated.findings == []
+
+
+def test_dtl006_suppression_and_baseline_zero():
+    """The shipped step bodies carry ZERO grandfathered DTL006 entries —
+    the differentiable path depends on them staying gradient-clean."""
+    import json
+    data = json.loads(DEFAULT_BASELINE.read_text())
+    assert [e for e in data["entries"] if e["rule"] == "DTL006"] == []
 
 
 # -------------------------------------------- suppressions and the baseline
