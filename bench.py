@@ -313,6 +313,7 @@ def _attach_progression(record):
     _attach_ensemble(record)
     _attach_serving(record)
     _attach_adjoint(record)
+    _attach_checkpoint(record)
     return record
 
 
@@ -448,6 +449,38 @@ def _attach_adjoint(record):
         "best_mem_segments": best_mem.get("segments") if best_mem else None,
         "best_mem_peak_rss_bytes":
             best_mem.get("peak_rss_bytes") if best_mem else None,
+        "backend": row.get("backend"),
+        "stale": True,
+        "measured_ts": row.get("ts"),
+        "age_s": round(time.time() - row["ts"], 1)
+        if row.get("ts") else None,
+    }
+    return record
+
+
+def _attach_checkpoint(record):
+    """Attach the newest in-window checkpointing benchmark headline
+    (per-checkpoint step-loop stall by mode + restore-after-fault wall,
+    benchmarks/checkpointing.py) to the official bench line. Same
+    provenance discipline as the serving/adjoint rows: a CACHED prior
+    measurement, stamped stale with its original measured_ts and age,
+    dropped once outside the 48h window. Checkpoint rows are
+    CPU-measured by design (ROADMAP platform note), so no backend
+    filter."""
+    row = _recent_row(
+        lambda r: (r.get("config") == "rb256x64_checkpoint"
+                   and r.get("stall_async_sharded_sec") is not None
+                   and r.get("finite")))
+    if row is None:
+        return record
+    record["checkpoint_rb256x64"] = {
+        "stall_sync_hdf5_sec": row.get("stall_sync_hdf5_sec"),
+        "stall_sync_sharded_sec": row.get("stall_sync_sharded_sec"),
+        "stall_async_sharded_sec": row.get("stall_async_sharded_sec"),
+        "stall_reduction_async_vs_hdf5":
+            row.get("stall_reduction_async_vs_hdf5"),
+        "restore_after_fault_sec": row.get("restore_after_fault_sec"),
+        "checkpoints": row.get("checkpoints"),
         "backend": row.get("backend"),
         "stale": True,
         "measured_ts": row.get("ts"),

@@ -1,0 +1,198 @@
+"""
+Checkpointing benchmark: per-checkpoint STEP-LOOP STALL by format
+(synchronous HDF5 vs synchronous sharded vs asynchronous sharded) and
+restore-after-fault wall time, on the RB 256x64 flagship (CPU).
+
+The number that matters is the stall: the wall time one durable
+checkpoint write holds the step loop. The synchronous HDF5 path gathers
+the full state to host and blocks until h5py flushes; the synchronous
+sharded path (tools/dcheckpoint.py) writes per-shard npy files with
+checksums and a manifest-last commit (still blocking, but no handler/
+transform machinery in the way); the ASYNC sharded path submits
+immutable device references to a background writer and returns — the
+acceptance bar is a >= 5x stall reduction async-sharded vs sync-HDF5,
+with durability verified (everything submitted restores bit-identically
+after a drain) so the speedup cannot come from dropped writes.
+
+Restore-after-fault: the newest sharded checkpoint is silently
+corrupted (chaos.corrupt_shard — post-commit byte damage the checksums
+must catch) and the restore walks back to the previous manifest; the
+measured wall is detection + quarantine + fallback + load.
+
+Methodology: one solver, warmed past compile; per mode, N_CHECKPOINTS
+writes interleaved with STEPS_BETWEEN steps (the loop keeps stepping
+between writes, so async writers genuinely overlap IO with compute);
+the recorded stall is the MEDIAN over writes of the wall time the
+checkpoint call held the loop. Appends one `rb256x64_checkpoint` row to
+benchmarks/results.jsonl and exits nonzero when the 5x bar is missed or
+a durability/bit-identity check fails.
+
+Run: python benchmarks/checkpointing.py [--quick]
+  --quick   64x32 grid, fewer writes, no row appended (CI smoke).
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import shutil
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+T0 = time.time()
+RESULTS = pathlib.Path(__file__).parent / "results.jsonl"
+N_CHECKPOINTS = 5
+STEPS_BETWEEN = 3
+DT = 0.01
+ACCEPTANCE_X = 5.0
+
+
+def mark(msg):
+    print(f"[checkpointing {time.time() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def build_solver(nx, nz):
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, b = build_rb_solver(nx, nz, np.float64, matsolver="banded")
+    solver.stop_iteration = 10 ** 9
+    for _ in range(3):          # past compile + warmup accounting
+        solver.step(DT)
+    return solver
+
+
+def measure_mode(solver, workdir, fmt, async_write):
+    """Median per-checkpoint stall for one (format, async) mode, stepping
+    STEPS_BETWEEN steps between writes. Returns (median_stall, loop,
+    host_X_at_last_write)."""
+    from dedalus_tpu.tools.resilience import ResilientLoop
+    loop = ResilientLoop(solver, dt=DT, checkpoint_dir=workdir,
+                         checkpoint_format=fmt, checkpoint_async=async_write,
+                         checkpoint_inflight=2, checkpoint_keep=N_CHECKPOINTS + 1,
+                         install_signal_handlers=False,
+                         flush_telemetry=False)
+    stalls = []
+    X_last = None
+    for _ in range(N_CHECKPOINTS):
+        for _ in range(STEPS_BETWEEN):
+            solver.step(DT)
+        t0 = time.perf_counter()
+        loop.write_checkpoint()
+        stalls.append(time.perf_counter() - t0)
+        X_last = np.asarray(solver.X)
+    if loop._checkpointer is not None:
+        errors = loop._checkpointer.close()
+        if errors:
+            raise RuntimeError(f"async writer errors: {errors}")
+    return statistics.median(stalls), loop, X_last
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="64x32 smoke run, no results row")
+    args = parser.parse_args()
+    nx, nz = (64, 32) if args.quick else (256, 64)
+    config = "rb256x64_checkpoint" if not args.quick \
+        else "rb64x32_checkpoint_quick"
+
+    import jax
+    from dedalus_tpu.tools import chaos as chaos_mod
+    from dedalus_tpu.tools import dcheckpoint as dc
+
+    work = pathlib.Path(__file__).parent / "_checkpoint_bench"
+    shutil.rmtree(work, ignore_errors=True)
+    work.mkdir(parents=True)
+    mark(f"building RB {nx}x{nz} (banded, f64, CPU)")
+    solver = build_solver(nx, nz)
+    G, S = solver.pencil_shape
+    mark(f"solver ready: pencil {G}x{S}")
+
+    errors = []
+    row = {
+        "config": config,
+        "ts": round(time.time(), 1),
+        "backend": jax.default_backend(),
+        "dtype": "float64",
+        "nx": nx, "nz": nz,
+        "checkpoints": N_CHECKPOINTS,
+        "steps_between": STEPS_BETWEEN,
+        "finite": True,
+    }
+
+    # ---- 1. synchronous HDF5 (the PR-4 baseline)
+    stall_hdf5, _, _ = measure_mode(solver, work / "hdf5", "hdf5", False)
+    row["stall_sync_hdf5_sec"] = round(stall_hdf5, 6)
+    mark(f"sync hdf5 stall: {stall_hdf5:.4f}s/checkpoint")
+
+    # ---- 2. synchronous sharded
+    stall_sharded, _, _ = measure_mode(solver, work / "sharded", "sharded",
+                                       False)
+    row["stall_sync_sharded_sec"] = round(stall_sharded, 6)
+    mark(f"sync sharded stall: {stall_sharded:.4f}s/checkpoint")
+
+    # ---- 3. asynchronous sharded, durability verified
+    stall_async, loop, X_last = measure_mode(solver, work / "async",
+                                             "sharded", True)
+    row["stall_async_sharded_sec"] = round(stall_async, 6)
+    event = dc.restore_latest(work / "async")
+    durable = np.array_equal(event["arrays"]["X"], X_last)
+    row["async_durable_bit_identical"] = bool(durable)
+    if not durable:
+        errors.append("async-written checkpoint does not bit-match the "
+                      "state at its write")
+    reduction = stall_hdf5 / stall_async if stall_async > 0 else float("inf")
+    row["stall_reduction_async_vs_hdf5"] = round(reduction, 1)
+    mark(f"async sharded stall: {stall_async:.4f}s/checkpoint "
+         f"({reduction:.1f}x less than sync hdf5), durable+bit-identical="
+         f"{durable}")
+    if reduction < ACCEPTANCE_X:
+        errors.append(f"async stall reduction {reduction:.1f}x under the "
+                      f"{ACCEPTANCE_X}x acceptance bar")
+
+    # ---- 4. restore-after-fault: silently corrupt the newest, time the
+    #         checksum detection + quarantine + fallback + load
+    prev = dc.list_checkpoints(work / "async")[-2]
+    prev_arrays, _ = dc.load_checkpoint(prev)
+    chaos_mod.corrupt_shard(dc.list_checkpoints(work / "async")[-1],
+                            mode="garbage")
+    t0 = time.perf_counter()
+    event = dc.restore_latest(work / "async")
+    restore_wall = time.perf_counter() - t0
+    row["restore_after_fault_sec"] = round(restore_wall, 6)
+    ok = (len(event["fallbacks"]) == 1
+          and np.array_equal(event["arrays"]["X"], prev_arrays["X"]))
+    row["restore_after_fault_bit_identical"] = bool(ok)
+    if not ok:
+        errors.append("restore-after-fault did not recover the previous "
+                      "checkpoint bit-identically")
+    mark(f"restore-after-fault: {restore_wall:.4f}s "
+         f"(fallback to previous manifest, bit-identical={ok})")
+
+    if errors:
+        row["finite"] = False
+        row["error"] = "; ".join(errors)
+    shutil.rmtree(work, ignore_errors=True)
+
+    if args.quick:
+        mark("quick mode: no results row appended")
+    else:
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        mark(f"row appended to {RESULTS}")
+    print(json.dumps(row, indent=2))
+    if errors:
+        for err in errors:
+            mark(f"FAILED: {err}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

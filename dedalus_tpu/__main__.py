@@ -144,6 +144,8 @@ def report(args):
                          f"{ensemble.get('dropped', 0)} dropped"]
                 if ensemble.get("rewinds"):
                     parts.append(f"{ensemble['rewinds']} rewinds")
+                if ensemble.get("reshards"):
+                    parts.append(f"{ensemble['reshards']} reshards")
                 parts.append(
                     f"{ensemble.get('ensemble_steps_per_sec', 0.0)} "
                     f"member-steps/s")
@@ -165,7 +167,27 @@ def report(args):
                     parts.append(
                         f"resumed from {resilience['resumed_from']} "
                         f"(write {resilience.get('resume_write', '?')})")
+                if resilience.get("sdc_checks") is not None:
+                    # the SDC sentinel trajectory: checks run / silent
+                    # corruptions caught (tools/resilience.py)
+                    parts.append(f"sdc {resilience.get('sdc_detected', 0)}"
+                                 f"/{resilience['sdc_checks']}")
                 print(f"    resilience: {', '.join(parts)}")
+                ckpt = resilience.get("checkpoint")
+                if isinstance(ckpt, dict):
+                    # durable-checkpoint stall column: format (+async),
+                    # cumulative step-loop stall, writes landed
+                    line = (f"    checkpoint: {ckpt.get('format', '?')}"
+                            f"{'+async' if ckpt.get('async') else ''}, "
+                            f"stall {ckpt.get('stall_sec', 0.0)}s")
+                    if ckpt.get("written") is not None:
+                        line += f", {ckpt['written']} written"
+                    if ckpt.get("max_inflight"):
+                        line += (f", max in-flight "
+                                 f"{ckpt['max_inflight']}")
+                    if ckpt.get("errors"):
+                        line += f", {ckpt['errors']} ERRORS"
+                    print(line)
             adjoint = record.get("adjoint")
             if isinstance(adjoint, dict):
                 # differentiable-solve telemetry (core/adjoint.py):
@@ -307,6 +329,19 @@ def report(args):
                     if rss:
                         line += f", peak RSS {rss / 1e6:.1f} MB"
                     print(line)
+            # checkpoint benchmark rows (benchmarks/checkpointing.py):
+            # per-checkpoint step-loop stall by mode + fault-restore wall
+            if record.get("stall_async_sharded_sec") is not None:
+                line = (f"    checkpoint: stall hdf5 "
+                        f"{record.get('stall_sync_hdf5_sec', '?')}s / "
+                        f"sharded {record.get('stall_sync_sharded_sec', '?')}"
+                        f"s / async {record['stall_async_sharded_sec']}s"
+                        f" ({record.get('stall_reduction_async_vs_hdf5', '?')}"
+                        f"x less stall)")
+                if record.get("restore_after_fault_sec") is not None:
+                    line += (f", restore-after-fault "
+                             f"{record['restore_after_fault_sec']}s")
+                print(line)
             # overload benchmark rows (benchmarks/serving.py storm): the
             # shed-rate and bounded-latency story in one line
             if record.get("shed_rate") is not None:

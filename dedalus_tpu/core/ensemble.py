@@ -44,10 +44,32 @@ with a per-member dt backoff (`policy="rewind"`, RK + per_member_dt) —
 without stopping the batch, and without retracing the compiled step (the
 active mask is a value operand, not a shape).
 
+Device loss: a fleet dispatch that loses a device (in production: an
+XlaRuntimeError from the runtime; in tests: the chaos `lose_device`
+fault) is reported through `notify_device_loss(d)` and handled before
+the next dispatch — the fleet RE-SHARDS onto the surviving devices: live
+member blocks are reconstructed host-side from the surviving shards
+only, the lost device's members are restored from the newest finite
+FleetSnapshot ring slot or from the last durable sharded checkpoint
+(tools/dcheckpoint.py, `evolve(checkpoint_dir=...)`), a fresh 1-D mesh
+over the survivors is built (members re-padded to the new device
+multiple), and every block-memoized fleet program is rebuilt for the new
+layout. Members with no finite snapshot and no checkpoint drop. Reshard
+events are counted (`ensemble/reshards`) and itemized in
+`reshard_events`.
+
+Durable fleet checkpoints use the sharded format exclusively — each
+device's member block is already the natural shard — written
+synchronously or asynchronously on a cadence from `evolve`, and restored
+ELASTICALLY: `restore_checkpoint` re-pads the true member rows onto
+whatever mesh the restoring fleet has, so a checkpoint taken on 8
+devices restores onto 4 or 1 (and vice versa) bit-identically.
+
 Telemetry: `ensemble/...` counters (fleet_steps, member_steps, dropped,
-rewinds, health_checks) plus an `ensemble` summary block (members /
-active / dropped / ensemble-steps-per-s) in every flushed record —
-`python -m dedalus_tpu report` renders it as its own column set.
+rewinds, health_checks, reshards, checkpoints_written) plus an
+`ensemble` summary block (members / active / dropped / reshards /
+ensemble-steps-per-s) in every flushed record — `python -m dedalus_tpu
+report` renders it as its own column set.
 """
 
 import functools
@@ -61,16 +83,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .subsystems import scatter_state, state_key
 from . import timesteppers as timesteppers_mod
+from ..tools import dcheckpoint
 from ..tools import metrics as metrics_mod
 from ..tools import retrace as retrace_mod
 from ..tools.compat import shard_map
 from ..tools.config import cfg_get
+from ..tools.exceptions import CheckpointError
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["EnsembleSolver", "FleetSnapshot"]
 
 MEMBER_AXIS = "batch"
+
+
+def _repad(a, members, n_pad, pad_value=None):
+    """Re-pad a member-leading host array onto a new padded length: the
+    true member rows are kept, padding rows are clones of member 0 (or
+    `pad_value`-filled for masks/counters). The single helper behind the
+    two recovery paths that must stay bit-identical (device-loss reshard
+    and elastic checkpoint restore)."""
+    a = np.asarray(a)[:members]
+    pad = n_pad - members
+    if not pad:
+        return a
+    if pad_value is None:
+        tail = np.broadcast_to(a[:1], (pad,) + a.shape[1:])
+    else:
+        tail = np.full((pad,) + a.shape[1:], pad_value, a.dtype)
+    return np.concatenate([a, tail])
 
 
 class FleetSnapshot:
@@ -80,9 +121,9 @@ class FleetSnapshot:
     recovery path (restores are per-member `where` masks)."""
 
     __slots__ = ("X", "T", "hists", "iteration", "sim_times",
-                 "wall_ts", "_finite")
+                 "wall_ts", "_finite", "_probe")
 
-    def __init__(self, X, T, hists, iteration, sim_times):
+    def __init__(self, X, T, hists, iteration, sim_times, probe=None):
         self.X = X
         self.T = T
         self.hists = hists          # (F, MX, LX) or None for RK
@@ -90,14 +131,21 @@ class FleetSnapshot:
         self.sim_times = np.array(sim_times)
         self.wall_ts = time_mod.time()
         self._finite = None
+        self._probe = probe
 
     def member_finite(self, m):
-        """Whether member m's captured state is fully finite. Host-syncs
-        the fleet state ONCE per snapshot on first call — recovery path
+        """Whether member m's captured state is fully finite. Routed
+        through the fleet's jitted per-member probe (`probe` at capture):
+        the reduction runs on device and only the (N,) nonfinite-count
+        vector comes back — never the full fleet state. Recovery path
         only, never the stepping loop."""
         if self._finite is None:
-            flat = np.asarray(self.X).reshape(self.X.shape[0], -1)
-            self._finite = np.all(np.isfinite(flat), axis=1)
+            if self._probe is not None:
+                nonfinite, _ = jax.device_get(self._probe(self.X))
+                self._finite = np.asarray(nonfinite) == 0
+            else:
+                flat = np.asarray(self.X).reshape(self.X.shape[0], -1)
+                self._finite = np.all(np.isfinite(flat), axis=1)
         return bool(self._finite[m])
 
 
@@ -230,6 +278,12 @@ class EnsembleSolver:
         self._retries = np.zeros(self.n_pad, dtype=int)
         self.dropped = []
         self.rewound = []
+        # device-loss / reshard bookkeeping
+        self._lost_devices = []
+        self.reshard_events = []
+        # durable sharded checkpoints (tools/dcheckpoint.py)
+        self._checkpoint_dir = None
+        self._checkpointer = None
         # ------------------------------------------------------ telemetry
         self.warmup_iterations = int(
             warmup_iterations if warmup_iterations is not None
@@ -446,9 +500,11 @@ class EnsembleSolver:
                 (True, True))
         self.X = self._project_prog(self.X, self._active_dev)
 
-    def _probe(self):
+    def _probe(self, X=None):
         """Per-member health reduction: (nonfinite count, max |coeff|) —
-        one jitted program, host-read only on the health cadence."""
+        one jitted program, host-read only on the health cadence. Also
+        runs over ring-snapshot states (FleetSnapshot.member_finite), so
+        snapshot validation never gathers the fleet to host."""
         if self._probe_prog is None:
             def raw(X):
                 def one(x):
@@ -458,7 +514,7 @@ class EnsembleSolver:
                     return jax.vmap(one)(X)
             self._probe_prog = jax.jit(
                 retrace_mod.noted(raw, "ensemble/probe"))
-        return self._probe_prog(self.X)
+        return self._probe_prog(self.X if X is None else X)
 
     # ------------------------------------------------------ factorization
 
@@ -578,6 +634,11 @@ class EnsembleSolver:
         n = int(n)
         if n <= 0:
             return
+        if self._lost_devices:
+            # pending device-loss notifications are drained BEFORE any
+            # dispatch (and before the health probe can mistake the lost
+            # shard's garbage for per-member divergence)
+            self._handle_device_loss()
         solver = self.solver
         ts = self.timestepper
         if dt is not None:
@@ -738,19 +799,493 @@ class EnsembleSolver:
         hists = ((self.F_hist, self.MX_hist, self.LX_hist)
                  if self._multistep else None)
         self.ring.append(FleetSnapshot(
-            self.X, self.T, hists, self.iteration, self.sim_times))
+            self.X, self.T, hists, self.iteration, self.sim_times,
+            probe=self._probe))
         del self.ring[:-self.ring_size]
         self.metrics.inc("ensemble/snapshots")
+
+    # ------------------------------------------------- device-loss recovery
+
+    def members_on_device(self, device_index):
+        """Member indices (including inactive padding clones) whose shard
+        lives on local device `device_index` under the 1-D batch
+        sharding (contiguous equal blocks)."""
+        if self.mesh is None:
+            return list(range(self.n_pad)) if device_index == 0 else []
+        D = self.mesh.shape[MEMBER_AXIS]
+        per = self.n_pad // D
+        d = int(device_index)
+        return list(range(d * per, min((d + 1) * per, self.n_pad)))
+
+    def notify_device_loss(self, device_index):
+        """Report that a mesh device is lost (its shard of every fleet
+        array is unreadable or garbage). In production this is the
+        XlaRuntimeError path of a fleet dispatch; the chaos harness
+        (`lose_device`) delivers the same notification deterministically.
+        Handled before the next dispatch (`step_many` drains pending
+        losses first)."""
+        self._lost_devices.append(int(device_index))
+
+    def _host_from_shards(self, arr, lost_devices, failed_out=None):
+        """Host copy of a fleet array assembled from its SURVIVING shards
+        only — the lost device's block is never read (it is gone, or
+        garbage pretending not to be). Lost rows come back zero-filled
+        and MUST be overwritten by the caller before use. A surviving
+        shard that FAILS to read is recorded in `failed_out` — the
+        caller promotes its device to lost so those members are restored
+        too, never left as silently-finite zeros."""
+        out = np.zeros(arr.shape, arr.dtype)
+        shards = getattr(arr, "addressable_shards", None)
+        if not shards:
+            return np.array(arr)
+        for sh in shards:
+            if sh.device in lost_devices:
+                continue
+            try:
+                out[sh.index] = np.asarray(sh.data)
+            except Exception as exc:
+                logger.warning(f"ensemble: surviving shard on "
+                               f"{sh.device} unreadable: {exc}")
+                if failed_out is not None:
+                    failed_out.add(sh.device)
+        return out
+
+    def _host_best_effort(self, arr, failed_out=None):
+        """Host copy of a fleet array trying EVERY shard — recovery may
+        still be able to read a 'lost' device's block (poisoned-not-
+        destroyed); shards that fail to read leave zeros for the caller
+        to overwrite from the durable checkpoint, and are recorded in
+        `failed_out` so their devices' members count as affected. Read
+        failures must never escape: they would turn recovery into the
+        crash it prevents."""
+        shards = getattr(arr, "addressable_shards", None)
+        if not shards:
+            return np.array(arr)
+        out = np.zeros(arr.shape, arr.dtype)
+        for sh in shards:
+            try:
+                out[sh.index] = np.asarray(sh.data)
+            except Exception as exc:
+                logger.warning(f"ensemble: shard on {sh.device} "
+                               f"unreadable during recovery: {exc}")
+                if failed_out is not None:
+                    failed_out.add(sh.device)
+        return out
+
+    def _validate_fleet_meta(self, meta, path):
+        """Raise CheckpointError unless `meta` describes THIS fleet (an
+        incompatible checkpoint must never be installed member-wise)."""
+        if meta.get("kind") != "ensemble":
+            raise CheckpointError(
+                f"checkpoint {path} holds {meta.get('kind')!r} state, "
+                f"not a fleet", path=path)
+        if int(meta.get("members", -1)) != self.members:
+            raise CheckpointError(
+                f"checkpoint {path} holds {meta.get('members')} members, "
+                f"this fleet has {self.members}", path=path)
+        if list(meta.get("pencil_shape", [])) != \
+                list(self.solver.pencil_shape):
+            raise CheckpointError(
+                f"checkpoint {path} pencil shape "
+                f"{meta.get('pencil_shape')} does not match this solver's "
+                f"{list(self.solver.pencil_shape)}", path=path)
+        if meta.get("scheme") != type(self.timestepper).__name__:
+            raise CheckpointError(
+                f"checkpoint {path} was written by scheme "
+                f"{meta.get('scheme')}, this fleet runs "
+                f"{type(self.timestepper).__name__}", path=path)
+        n_extras = meta.get("n_extras")
+        if n_extras is not None and int(n_extras) != len(self._extras):
+            raise CheckpointError(
+                f"checkpoint {path} carries {n_extras} RHS parameter "
+                f"operand(s), this fleet's problem has "
+                f"{len(self._extras)} — different problem configuration",
+                path=path)
+
+    def _checkpoint_members(self):
+        """Member-row arrays + meta from the newest valid durable sharded
+        checkpoint, or None (no directory / nothing restorable /
+        incompatible). Drains the async writer first so an in-flight
+        (manifest-less) write is never quarantined out from under it."""
+        if self._checkpoint_dir is None:
+            return None
+        quarantine = True
+        if self._checkpointer is not None:
+            self._checkpointer.drain()
+            # drain can time out with a write still in flight: restore
+            # must then leave its manifest-less directory alone
+            quarantine = self._checkpointer.pending == 0
+        try:
+            event = dcheckpoint.restore_latest(self._checkpoint_dir,
+                                               quarantine=quarantine)
+            if event is not None:
+                self._validate_fleet_meta(event["meta"], event["path"])
+        except CheckpointError as exc:
+            logger.warning(f"ensemble: durable checkpoint unusable for "
+                           f"member restore: {exc}")
+            return None
+        return event
+
+    def _handle_device_loss(self):
+        """Re-shard the fleet onto the surviving devices. Live member
+        blocks are rebuilt host-side from surviving shards; the lost
+        device's members are restored from the newest finite
+        FleetSnapshot slot (its arrays predate the loss) or, when the
+        ring has nothing finite for a member, from the last durable
+        sharded checkpoint; members with neither drop. Then a fresh 1-D
+        mesh over the survivors is built, members re-pad to the new
+        device multiple, and every block-memoized program is rebuilt for
+        the new layout (fresh wrappers — a compile, not a retrace)."""
+        pending = sorted(set(self._lost_devices))
+        self._lost_devices = []
+        if self.mesh is None:
+            if pending:
+                raise RuntimeError(
+                    "device loss reported without a device mesh: a single-"
+                    "device fleet has no surviving devices to reshard onto")
+            return
+        old_devices = list(self.mesh.devices.flat)
+        # range-filter BEFORE deciding anything happened: a stale/bogus
+        # index must not trigger a spurious reshard (program rebuilds +
+        # a cleared snapshot ring are expensive AND destroy rewind
+        # targets)
+        lost = sorted({d for d in pending if 0 <= d < len(old_devices)})
+        if not lost:
+            if pending:
+                logger.warning(f"ensemble: device-loss notification(s) "
+                               f"{pending} out of range for a "
+                               f"{len(old_devices)}-device mesh; ignored")
+            return
+        t0 = time_mod.perf_counter()
+        lost_devs = {old_devices[d] for d in lost}
+        # ---- host reconstruction from surviving shards only; a surviving
+        # shard that fails to read promotes its device to lost so its
+        # members are restored below instead of running on zeros
+        failed = set()
+        host = {"X": self._host_from_shards(self.X, lost_devs, failed),
+                "T": self._host_from_shards(self.T, lost_devs, failed)}
+        if self._multistep:
+            host["F_hist"] = self._host_from_shards(
+                self.F_hist, lost_devs, failed)
+            host["MX_hist"] = self._host_from_shards(
+                self.MX_hist, lost_devs, failed)
+            host["LX_hist"] = self._host_from_shards(
+                self.LX_hist, lost_devs, failed)
+        # RHS parameter operands: constant per member mid-run; every
+        # readable shard is recovered best-effort (a poisoned-not-
+        # destroyed device's blocks survive), and the checkpoint branch
+        # below overwrites affected rows from the durable extra<k> arrays
+        host_extras = [self._host_best_effort(e, failed)
+                       for e in self._extras]
+        promoted = sorted(old_devices.index(dev) for dev in failed
+                          if dev in old_devices and dev not in lost_devs)
+        if promoted:
+            logger.warning(f"ensemble: device(s) {promoted} failed reads "
+                           f"during recovery; treating as lost too")
+            lost = sorted(set(lost) | set(promoted))
+            lost_devs |= {old_devices[d] for d in promoted}
+        from . import meshctx
+        survivors = meshctx.surviving_devices(self.mesh, lost)
+        if not survivors:
+            raise RuntimeError("ensemble: every mesh device lost")
+        affected = sorted({m for d in lost
+                           for m in self.members_on_device(d)
+                           if m < self.members})
+        # ---- restore the lost device's members. Ring first (its
+        # snapshots predate the loss), durable checkpoint second, drop
+        # last — and NOTHING here may raise for a read failure: a ring
+        # slot whose shards died with the device must fall through to
+        # the checkpoint, not crash the fleet.
+        checkpoint = None
+        restored, dropped_now, frozen_lost = [], [], []
+        for m in affected:
+            # INACTIVE members are walked too: a previously-dropped
+            # member's row is its frozen last-good state (the drop
+            # policy's contract) — losing its device must restore that
+            # row, not silently replace it with zeros
+            was_active = bool(self.active_host[m])
+            rows = None
+            try:
+                snap = self._newest_finite_slot(m)
+                if snap is not None:
+                    rows = {"X": np.asarray(snap.X[m]),
+                            "T": np.asarray(snap.T[m])}
+                    if self._multistep and snap.hists is not None:
+                        for name, h in zip(
+                                ("F_hist", "MX_hist", "LX_hist"),
+                                snap.hists):
+                            rows[name] = np.asarray(h[m])
+                    sim_time = snap.sim_times[m]
+                    iteration = snap.iteration
+            except Exception as exc:
+                logger.warning(
+                    f"ensemble: ring restore for member {m} failed "
+                    f"({exc}); trying the durable checkpoint")
+                rows = None
+            if rows is not None:
+                for name, row in rows.items():
+                    host[name][m] = row
+                self.sim_times[m] = sim_time
+                entry = {"member": m, "source": "ring",
+                         "iteration": iteration}
+                if not was_active:
+                    entry["frozen"] = True
+                restored.append(entry)
+                continue
+            if checkpoint is None:
+                checkpoint = self._checkpoint_members() or False
+            if checkpoint:
+                arrays, meta = checkpoint["arrays"], checkpoint["meta"]
+                host["X"][m] = arrays["X"][m]
+                host["T"][m] = arrays["T"][m]
+                if self._multistep and "F_hist" in arrays:
+                    for name in ("F_hist", "MX_hist", "LX_hist"):
+                        host[name][m] = arrays[name][m]
+                for k in range(len(host_extras)):
+                    if f"extra{k}" in arrays:
+                        host_extras[k][m] = arrays[f"extra{k}"][m]
+                self.sim_times[m] = float(meta["sim_times"][m])
+                entry = {"member": m, "source": "checkpoint",
+                         "iteration": int(meta["iteration"])}
+                if not was_active:
+                    entry["frozen"] = True
+                restored.append(entry)
+                continue
+            if not was_active:
+                # already dropped AND no source: the frozen state is
+                # genuinely gone — say so instead of pretending the
+                # zero-filled row is data
+                frozen_lost.append(m)
+                logger.warning(
+                    f"ensemble: dropped member {m}'s frozen state was on "
+                    f"the lost device and no snapshot/checkpoint holds "
+                    f"it; its row is zeroed")
+                continue
+            self.active_host[m] = False
+            event = {"member": m, "iteration": self.iteration,
+                     "reason": f"device {lost} lost, no finite snapshot "
+                               f"or durable checkpoint to restore from",
+                     "outcome": "dropped", "frozen_iteration": None}
+            self.dropped.append(event)
+            dropped_now.append(m)
+            self.metrics.inc("ensemble/dropped")
+        # ring-restored members got their X/hists from the (pre-loss)
+        # snapshot, but their RHS parameter rows came from the
+        # best-effort read of the LOST device — untrusted by definition.
+        # When a durable checkpoint exists, its extra<k> rows (constant
+        # per member mid-run, so any checkpoint's copy is the original)
+        # replace them; without one the best-effort read stands (the
+        # poisoned-not-destroyed case, as documented).
+        ring_members = [r["member"] for r in restored
+                        if r["source"] == "ring"]
+        if ring_members and self._checkpoint_dir is not None \
+                and host_extras:
+            if checkpoint is None:
+                checkpoint = self._checkpoint_members() or False
+            if checkpoint:
+                arrays = checkpoint["arrays"]
+                for m in ring_members:
+                    for k in range(len(host_extras)):
+                        if f"extra{k}" in arrays:
+                            host_extras[k][m] = arrays[f"extra{k}"][m]
+        # ---- rebuild the mesh over the survivors and re-pad (same
+        # meshctx.surviving_devices filter behind both, so the mesh and
+        # the padding can never disagree)
+        D2 = len(survivors)
+        self.mesh = meshctx.surviving_mesh(self.mesh, lost)
+        n_pad2 = -(-self.members // D2) * D2 if self.mesh is not None \
+            else self.members
+        repad = functools.partial(_repad, members=self.members,
+                                  n_pad=n_pad2)
+        self.n_pad = n_pad2
+        self.X = self._put(jnp.asarray(repad(host["X"])))
+        self.T = self._put(jnp.asarray(repad(host["T"])))
+        if self._multistep:
+            self.F_hist = self._put(jnp.asarray(repad(host["F_hist"])))
+            self.MX_hist = self._put(jnp.asarray(repad(host["MX_hist"])))
+            self.LX_hist = self._put(jnp.asarray(repad(host["LX_hist"])))
+        self._extras = [self._put(jnp.asarray(repad(e)))
+                        for e in host_extras]
+        self.sim_times = repad(self.sim_times)
+        self.dts = repad(self.dts)
+        self.DT = self._put(jnp.asarray(self.dts, dtype=self.rd))
+        self.active_host = repad(self.active_host, pad_value=False)
+        self._retries = repad(self._retries, pad_value=0)
+        self._active_dev = self._put(jnp.asarray(self.active_host))
+        # the compiled fleet programs are layout-specific: rebuild (fresh
+        # wrappers trace once each — a compile, not a retrace)
+        self._programs = {}
+        self._project_prog = None
+        self._probe_prog = None
+        self._vfactor_prog = None
+        self._lhs_key = None
+        self._lhs_aux = None
+        # ring snapshots reference the old layout; fresh post-reshard anchor
+        self.ring = []
+        self.snapshot()
+        event = {
+            "iteration": self.iteration,
+            "lost_devices": lost,
+            "devices": D2,
+            "restored": restored,
+            "dropped": dropped_now,
+            "wall_sec": round(time_mod.perf_counter() - t0, 4),
+        }
+        if frozen_lost:
+            event["frozen_lost"] = frozen_lost
+        self.reshard_events.append(event)
+        self.metrics.inc("ensemble/reshards")
+        sources = (", ".join(sorted({r["source"] for r in restored}))
+                   if restored else "none")
+        logger.warning(
+            f"ensemble: lost device(s) {lost} at iteration "
+            f"{self.iteration}; resharded {self.members} members onto "
+            f"{D2} surviving device(s) — {len(restored)} member(s) "
+            f"restored (source: {sources}), {len(dropped_now)} dropped, "
+            f"{event['wall_sec']}s")
+
+    # ---------------------------------------------------- durable checkpoints
+
+    def init_checkpoints(self, directory, async_write=None, inflight=None,
+                         keep=None, chaos=None):
+        """Arm durable sharded fleet checkpoints under `directory`
+        (tools/dcheckpoint.py; defaults from [resilience]
+        CHECKPOINT_ASYNC / CHECKPOINT_INFLIGHT / CHECKPOINT_KEEP)."""
+        from ..tools.resilience import _as_bool, io_retry_policy
+        if async_write is None:
+            async_write = _as_bool(cfg_get(
+                "resilience", "CHECKPOINT_ASYNC", "False"))
+        self._checkpoint_dir = directory
+        self._checkpointer = dcheckpoint.ShardedCheckpointer(
+            directory, async_write=_as_bool(async_write),
+            inflight=int(inflight if inflight is not None
+                         else cfg_get("resilience", "CHECKPOINT_INFLIGHT",
+                                      "2")),
+            keep=int(keep if keep is not None
+                     else cfg_get("resilience", "CHECKPOINT_KEEP", "2")),
+            io_retry=io_retry_policy(on_retry=lambda attempt, exc:
+                self.metrics.inc("ensemble/io_retries")))
+        if chaos is not None:
+            wire = getattr(chaos, "wire_checkpointer", None)
+            if wire is not None:
+                wire(self._checkpointer)
+        return self._checkpointer
+
+    def write_checkpoint(self):
+        """Write (or, async, submit) one durable sharded fleet checkpoint:
+        the member axis is already the shard axis, so each device's block
+        goes to its own checksummed file and the capture is a dict of
+        immutable references — sync-free."""
+        if self._checkpointer is None:
+            raise ValueError("call init_checkpoints(directory) first (or "
+                             "evolve(checkpoint_dir=...))")
+        arrays = {"X": self.X, "T": self.T}
+        if self._multistep:
+            arrays.update(F_hist=self.F_hist, MX_hist=self.MX_hist,
+                          LX_hist=self.LX_hist)
+        for k, extra in enumerate(self._extras):
+            arrays[f"extra{k}"] = extra
+        meta = {
+            "kind": "ensemble",
+            "members": self.members,
+            "n_pad": self.n_pad,
+            "n_extras": len(self._extras),
+            "iteration": int(self.iteration),
+            "scheme": type(self.timestepper).__name__,
+            "per_member_dt": self.per_member_dt,
+            "pencil_shape": list(self.solver.pencil_shape),
+            "sim_times": [float(v) for v in self.sim_times],
+            "dts": [float(v) for v in self.dts],
+            "active": [bool(v) for v in self.active_host],
+            "retries": [int(v) for v in self._retries],
+        }
+        if self._multistep:
+            meta["ms_iter"] = int(self._ms_iter)
+            meta["dt_hist"] = [float(v) for v in self._dt_hist]
+        result = self._checkpointer.save(arrays, meta)
+        self.metrics.inc("ensemble/checkpoints_written")
+        return result
+
+    def restore_checkpoint(self, directory=None):
+        """Elastic restore from the newest valid sharded fleet checkpoint
+        (per-shard checksums validated, torn checkpoints quarantined with
+        fallback): the TRUE member rows are re-padded onto THIS fleet's
+        mesh — the writing and restoring device counts are independent,
+        and member states restore bit-identically. Raises CheckpointError
+        when nothing under `directory` is restorable."""
+        directory = directory if directory is not None \
+            else self._checkpoint_dir
+        if directory is None:
+            raise ValueError("restore_checkpoint requires a directory")
+        quarantine = True
+        if self._checkpointer is not None:
+            # never quarantine a write the async writer has in flight
+            self._checkpointer.drain()
+            quarantine = self._checkpointer.pending == 0
+        event = dcheckpoint.restore_latest(directory, quarantine=quarantine)
+        if event is None:
+            raise CheckpointError(
+                f"no sharded checkpoint under {directory}", path=directory)
+        arrays = event.pop("arrays")
+        meta = event["meta"]
+        self._validate_fleet_meta(meta, event["path"])
+        repad = functools.partial(_repad, members=self.members,
+                                  n_pad=self.n_pad)
+        self.X = self._put(jnp.asarray(repad(arrays["X"])))
+        self.T = self._put(jnp.asarray(repad(arrays["T"])))
+        if self._multistep and "F_hist" in arrays:
+            self.F_hist = self._put(jnp.asarray(repad(arrays["F_hist"])))
+            self.MX_hist = self._put(jnp.asarray(repad(arrays["MX_hist"])))
+            self.LX_hist = self._put(jnp.asarray(repad(arrays["LX_hist"])))
+            self._ms_iter = int(meta.get("ms_iter", 0))
+            self._dt_hist = [float(v) for v in meta.get("dt_hist", [])]
+        extras = []
+        for k in range(len(self._extras)):
+            name = f"extra{k}"
+            if name not in arrays:
+                # _validate_fleet_meta already rejects count mismatches
+                # for checkpoints that record n_extras; this guards the
+                # same hazard for older manifests — a partial install
+                # (checkpoint state + current parameters) would be a
+                # silently inconsistent fleet
+                raise CheckpointError(
+                    f"checkpoint {event['path']} lacks the RHS parameter "
+                    f"operand {name} this fleet's problem requires",
+                    path=event["path"])
+            extras.append(self._put(jnp.asarray(repad(arrays[name]))))
+        self._extras = extras
+        self.iteration = int(meta["iteration"])
+        self.sim_times = repad(np.asarray(meta["sim_times"], dtype=float))
+        self.dts = repad(np.asarray(meta["dts"], dtype=float))
+        self.DT = self._put(jnp.asarray(self.dts, dtype=self.rd))
+        self.active_host = repad(
+            np.asarray(meta["active"], dtype=bool), pad_value=False)
+        self._retries = repad(
+            np.asarray(meta["retries"], dtype=int), pad_value=0)
+        self._active_dev = self._put(jnp.asarray(self.active_host))
+        self._lhs_key = None
+        self._lhs_aux = None
+        self.ring = []
+        self.snapshot()
+        self.metrics.inc("ensemble/restores")
+        logger.info(
+            f"ensemble: restored {self.members} members from "
+            f"{event['path']} (iteration {self.iteration}) onto "
+            f"{self.mesh.shape[MEMBER_AXIS] if self.mesh else 1} device(s)")
+        return event
 
     # ------------------------------------------------------------ the loop
 
     def evolve(self, dt=None, stop_iteration=None, block=None, chaos=None,
-               log_cadence=100):
+               log_cadence=100, checkpoint_dir=None, checkpoint_iter=0,
+               checkpoint_async=None):
         """
         Drive the fleet to `stop_iteration` in fixed-size scanned blocks
         (sizes {block, 1} only, so each program traces once): snapshot
         ring + per-member health on their cadences, chaos hooks for fault
-        injection, telemetry flush at the end. Returns the summary dict.
+        injection, durable sharded checkpoints every `checkpoint_iter`
+        iterations (plus one final write) when `checkpoint_dir` is given,
+        telemetry flush at the end. Returns the summary dict.
         """
         if stop_iteration is None:
             raise ValueError("evolve requires stop_iteration")
@@ -759,6 +1294,13 @@ class EnsembleSolver:
             self._set_common_dt(dt)
         elif dt is not None:
             self.set_member_dts(dt)
+        ckpt_gate = None
+        if checkpoint_dir is not None:
+            self.init_checkpoints(checkpoint_dir,
+                                  async_write=checkpoint_async, chaos=chaos)
+            if checkpoint_iter:
+                ckpt_gate = metrics_mod.CadenceGate(int(checkpoint_iter))
+                ckpt_gate.reset(self.iteration)
         self.snapshot()   # iteration-0 anchor
         while self.iteration < stop_iteration and self.n_active:
             n = block if stop_iteration - self.iteration >= block else 1
@@ -767,11 +1309,28 @@ class EnsembleSolver:
                 chaos.after_step(self)
             if self._snapshot_gate.due(self.iteration):
                 self.snapshot()
+            if ckpt_gate is not None and ckpt_gate.due(self.iteration):
+                try:
+                    self.write_checkpoint()
+                except Exception as exc:
+                    logger.warning(f"periodic fleet checkpoint failed: "
+                                   f"{exc}")
             if log_cadence and self.iteration % log_cadence < n:
                 logger.info(
                     f"Ensemble iteration={self.iteration}, "
                     f"active={self.n_active}/{self.members}, "
                     f"dropped={len(self.dropped)}")
+        if self._lost_devices:
+            # a loss delivered after the last dispatch: recover before
+            # the final checkpoint/flush reads the fleet state
+            self._handle_device_loss()
+        if self._checkpointer is not None:
+            try:
+                self.write_checkpoint()
+            except Exception as exc:
+                logger.warning(f"final fleet checkpoint failed: {exc}")
+            for exc in self._checkpointer.close():
+                logger.error(f"async fleet checkpoint write failed: {exc}")
         self.flush_metrics()
         return self.summary()
 
@@ -797,6 +1356,9 @@ class EnsembleSolver:
             "per_member_dt": self.per_member_dt,
             "policy": self.policy,
             "dropped_members": [e["member"] for e in self.dropped],
+            "reshards": len(self.reshard_events),
+            **({"checkpoint": self._checkpointer.summary()}
+               if self._checkpointer is not None else {}),
         }
 
     def flush_metrics(self, extra=None):

@@ -16,11 +16,41 @@ dedalus/core/transposes.pyx moves data so that stays true).
 import threading
 from functools import partial
 
-from jax.sharding import PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 
 from ..tools.compat import shard_map
 
 _CTX = threading.local()
+
+
+def surviving_devices(mesh, lost_indices):
+    """Devices of a 1-D `mesh` left after losing `lost_indices` (local
+    device indices; out-of-range entries ignored), in their original
+    order. The single filter rule behind device-loss recovery — the mesh
+    built from it (surviving_mesh) and the member re-padding derived
+    from its length (core/ensemble.py) must never disagree."""
+    if len(mesh.axis_names) != 1:
+        raise ValueError("surviving_devices supports 1-D meshes only")
+    devices = list(mesh.devices.flat)
+    lost = {i for i in lost_indices if 0 <= i < len(devices)}
+    return [dev for i, dev in enumerate(devices) if i not in lost]
+
+
+def surviving_mesh(mesh, lost_indices):
+    """
+    The 1-D mesh left after losing `lost_indices` of a 1-D `mesh`: same
+    axis name, surviving devices in their original order. Returns None
+    when a single device survives — a single-device layout needs no
+    mesh — and raises when nothing survives. The device-loss recovery
+    path (core/ensemble.py) reshards onto this.
+    """
+    survivors = surviving_devices(mesh, lost_indices)
+    if not survivors:
+        raise RuntimeError("no surviving devices to build a mesh from")
+    if len(survivors) < 2:
+        return None
+    import numpy as np
+    return Mesh(np.array(survivors), mesh.axis_names)
 
 
 def set_walk(mesh, layout):

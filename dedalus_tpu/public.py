@@ -44,7 +44,8 @@ from .core.solvers import (InitialValueSolver, LinearBoundaryValueSolver,
 from .core.ensemble import EnsembleSolver
 from .core.evaluator import Evaluator
 from .extras.flow_tools import CFL, GlobalFlowProperty, GlobalArrayReducer
-from .tools.exceptions import CheckpointError, SolverHealthError
+from .tools.exceptions import (CheckpointError, SilentCorruptionError,
+                               SolverHealthError)
 from .tools.health import HealthMonitor
 
 # lowercase operator aliases (reference: core/operators.py aliases)

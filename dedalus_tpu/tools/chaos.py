@@ -19,7 +19,20 @@ Solve-loop faults (`ChaosInjector`, driven by ResilientLoop hooks):
     `hang_sec`: the post-step hook sleeps, starving step progress — the
     deterministic stand-in for a wedged JAX dispatch that drives the
     serving watchdog),
-  * checkpoint-file truncation/corruption (a crash mid-write).
+  * checkpoint-file truncation/corruption (a crash mid-write),
+  * ONE flipped mantissa bit in a state shard at iteration N
+    (`flip_bit_iteration`: seed-chosen element and bit — the value stays
+    finite and plausible, so only the SDC sentinel's redundant
+    re-execution can catch it),
+  * a lost/poisoned device shard at iteration N (`lose_device` +
+    `lose_iteration`, EnsembleSolver targets: the device's member block
+    is overwritten with NaN and the fleet receives the loss
+    notification that triggers re-sharding onto the survivors),
+  * a torn sharded-checkpoint write (`torn_shard_write` +
+    `torn_after_shards`: the writer dies after K shard files, BEFORE the
+    manifest commits — plus `corrupt_shard` for post-commit silent shard
+    corruption, and `slow_shard_sec` to stretch writes so async overrun
+    and kill-mid-write windows are deterministic).
 
 Service faults (plain socket clients misbehaving at the daemon — each
 helper returns once the fault has been delivered, so a test can assert
@@ -57,8 +70,9 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ChaosInjector", "corrupt_checkpoint", "half_frame",
-           "queue_storm", "sigkill_client", "slow_loris", "vanish_client"]
+__all__ = ["ChaosInjector", "corrupt_checkpoint", "corrupt_shard",
+           "half_frame", "queue_storm", "sigkill_client", "slow_loris",
+           "vanish_client"]
 
 
 def _field_slice(solver, name):
@@ -100,6 +114,57 @@ def corrupt_checkpoint(path, mode="truncate", seed=0):
     logger.warning(f"chaos: corrupted checkpoint {path} (mode={mode})")
 
 
+def corrupt_shard(ckpt_dir, shard=0, mode="garbage", seed=0):
+    """
+    Damage one shard file of a COMMITTED sharded checkpoint
+    (tools/dcheckpoint.py) the way silent media corruption would — after
+    the manifest's checksums were recorded, so restore must catch it:
+      garbage  — overwrite the middle third of the payload with seeded
+                 random bytes (np header left intact: the file loads,
+                 the blake2b mismatches — true silent corruption),
+      truncate — cut the file in half (np.load fails: torn file),
+      delete   — remove the shard file entirely (lost block).
+    Returns the damaged file's path.
+    """
+    ckpt_dir = os.fspath(ckpt_dir)
+    files = sorted(f for f in os.listdir(ckpt_dir) if f.endswith(".npy"))
+    if not files:
+        raise FileNotFoundError(f"no shard files under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, files[int(shard) % len(files)])
+    size = os.path.getsize(path)
+    if mode == "garbage":
+        start, stop = max(size // 3, 128), max(2 * size // 3, 192)
+        blob = np.random.default_rng(seed).bytes(stop - start)
+        with open(path, "r+b") as f:
+            f.seek(start)
+            f.write(blob)
+    elif mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    elif mode == "delete":
+        os.remove(path)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    logger.warning(f"chaos: corrupted shard {path} (mode={mode})")
+    return path
+
+
+def _flip_mantissa_bit(value, bit):
+    """Flip one mantissa bit of a scalar float/complex value (complex:
+    the real part). Exponent and sign untouched, so the result stays
+    finite and the same order of magnitude — silent by construction."""
+    a = np.atleast_1d(np.asarray(value)).copy()
+    if np.iscomplexobj(a):
+        flipped = _flip_mantissa_bit(a.real.copy(), bit)
+        out = np.empty(1, dtype=a.dtype)
+        out[0] = complex(flipped, float(a.imag[0]))
+        return out[0]
+    mantissa = {4: 23, 8: 52}[a.dtype.itemsize]
+    uint = a.view({4: np.uint32, 8: np.uint64}[a.dtype.itemsize])
+    uint[0] ^= np.asarray(1, dtype=uint.dtype) << (int(bit) % mantissa)
+    return a[0]
+
+
 class ChaosInjector:
     """
     Seed/config-driven fault injector driven by ResilientLoop hooks
@@ -121,13 +186,37 @@ class ChaosInjector:
           completing iteration N, BEFORE the loop's step hook runs: from
           the serving watchdog's point of view this is a hung JAX
           dispatch (no step progress), driven deterministically.
+      flip_bit_iteration          — flip ONE seed-chosen mantissa bit of
+          one element of the state after completing iteration N: silent
+          data corruption (finite, plausible, invisible to the health
+          probe) that only the SDC sentinel's redundant re-execution can
+          detect. With `flip_bit_member` and a 3-D fleet state, the flip
+          lands in that member's shard.
+      lose_device + lose_iteration — EnsembleSolver targets: overwrite
+          device `lose_device`'s member block with NaN (its shard is
+          gone/garbage) and deliver the loss notification
+          (`notify_device_loss`) that triggers fleet re-sharding onto
+          the surviving devices before the next dispatch.
+      torn_shard_write + torn_after_shards — kill the Nth sharded
+          checkpoint write (1-based) after K shard files have landed,
+          BEFORE the manifest commits (a crash/disk-full mid-write; the
+          manifest-last protocol must make the torn directory invisible
+          to restore). Requires `wire_checkpointer(ckpt)` — the
+          ResilientLoop wires it automatically when built with chaos.
+      slow_shard_sec              — sleep after every shard file write:
+          stretches checkpoint IO so async overrun barriers and
+          kill-mid-write windows are deterministic, not timing luck.
 
     `fired` records what fired and when, for test assertions.
     """
 
     def __init__(self, seed=0, nan_field=None, nan_iteration=None,
                  fail_checkpoint_write=None, sigterm_iteration=None,
-                 nan_member=None, hang_iteration=None, hang_sec=None):
+                 nan_member=None, hang_iteration=None, hang_sec=None,
+                 flip_bit_iteration=None, flip_bit_member=None,
+                 lose_device=None, lose_iteration=None,
+                 torn_shard_write=None, torn_after_shards=1,
+                 slow_shard_sec=None):
         self.seed = int(seed)
         self.nan_field = nan_field
         self.nan_iteration = nan_iteration
@@ -136,8 +225,16 @@ class ChaosInjector:
         self.sigterm_iteration = sigterm_iteration
         self.hang_iteration = hang_iteration
         self.hang_sec = hang_sec
+        self.flip_bit_iteration = flip_bit_iteration
+        self.flip_bit_member = flip_bit_member
+        self.lose_device = lose_device
+        self.lose_iteration = lose_iteration
+        self.torn_shard_write = torn_shard_write
+        self.torn_after_shards = int(torn_after_shards)
+        self.slow_shard_sec = slow_shard_sec
         self.fired = []
         self._checkpoint_writes = 0
+        self._shard_writes = 0
         self._armed = set()
         if nan_field is not None and nan_iteration is not None:
             self._armed.add("nan")
@@ -147,6 +244,12 @@ class ChaosInjector:
             self._armed.add("io")
         if hang_iteration is not None and hang_sec is not None:
             self._armed.add("hang")
+        if flip_bit_iteration is not None:
+            self._armed.add("flip")
+        if lose_device is not None and lose_iteration is not None:
+            self._armed.add("lose")
+        if torn_shard_write is not None:
+            self._armed.add("torn")
 
     def attach(self, loop):
         """Wire the IO fault into the loop's checkpoint path: the Nth
@@ -166,6 +269,38 @@ class ChaosInjector:
             return handler_write()
 
         loop.write_checkpoint = chaotic_write
+
+    def wire_checkpointer(self, checkpointer):
+        """Wire the sharded-write faults into a
+        dcheckpoint.ShardedCheckpointer: the per-shard hook tears the
+        `torn_shard_write`-th checkpoint after `torn_after_shards` files
+        (the manifest never commits) and/or sleeps `slow_shard_sec` per
+        shard. Called by ResilientLoop/EnsembleSolver when built with a
+        chaos injector."""
+        if "torn" not in self._armed and self.slow_shard_sec is None:
+            return
+
+        state = {"write": 0, "shards": 0}
+
+        def hook(shards_written):
+            if shards_written == 1:
+                state["write"] += 1
+            state["shards"] = shards_written
+            if self.slow_shard_sec:
+                time.sleep(float(self.slow_shard_sec))
+            if ("torn" in self._armed
+                    and state["write"] == self.torn_shard_write
+                    and shards_written >= self.torn_after_shards):
+                self._armed.discard("torn")
+                self._fire("torn_shard", write=state["write"],
+                           shards=shards_written)
+                # NOT an OSError: a crash mid-write is not retryable, so
+                # the fault must bypass the transient-IO RetryPolicy and
+                # leave the directory exactly as the crash would
+                raise RuntimeError("chaos: writer died mid-checkpoint "
+                                   "(torn sharded write)")
+
+        checkpointer.shard_hook = hook
 
     def _fire(self, kind, **info):
         info["kind"] = kind
@@ -193,6 +328,15 @@ class ChaosInjector:
             self._armed.discard("hang")
             self._fire("hang", iteration=it, hang_sec=self.hang_sec)
             time.sleep(float(self.hang_sec))
+        if "flip" in self._armed and it >= self.flip_bit_iteration:
+            self._armed.discard("flip")
+            index, bit = self.flip_bit(solver)
+            self._fire("flip_bit", iteration=it, index=index, bit=bit)
+        if "lose" in self._armed and it >= self.lose_iteration:
+            self._armed.discard("lose")
+            members = self.kill_device(solver, self.lose_device)
+            self._fire("lose_device", iteration=it,
+                       device=self.lose_device, members=members)
 
     # ----------------------------------------------------- fault bodies
 
@@ -221,6 +365,52 @@ class ChaosInjector:
         # solver sees
         solver.defer_scatter(solver.X)
         solver.snapshot_versions()
+
+    def flip_bit(self, solver):
+        """Flip one seed-chosen mantissa bit of one element of the state
+        — in place, finite, and invisible to the NaN/growth health probe:
+        the canonical silent data corruption. The element and bit come
+        from the injector seed; a 3-D fleet state with `flip_bit_member`
+        set flips inside that member's shard. Returns (index, bit) for
+        test assertions. (The one-scalar host pull here is test
+        machinery, never a production path.)"""
+        X = solver.X
+        rng = np.random.default_rng(self.seed)
+        shape = X.shape
+        if X.ndim == 3 and self.flip_bit_member is not None:
+            m = int(self.flip_bit_member)
+            if not 0 <= m < shape[0]:
+                raise ValueError(f"flip_bit_member={m} out of range for a "
+                                 f"{shape[0]}-member fleet")
+            index = (m,) + tuple(int(rng.integers(s)) for s in shape[1:])
+        else:
+            index = tuple(int(rng.integers(s)) for s in shape)
+        itemsize = np.dtype(X.dtype).itemsize
+        if np.issubdtype(X.dtype, np.complexfloating):
+            itemsize //= 2
+        bit = int(rng.integers({4: 23, 8: 52}[itemsize]))
+        value = np.asarray(X[index])
+        flipped = _flip_mantissa_bit(value, bit)
+        solver.X = X.at[index].set(flipped)
+        if hasattr(solver, "defer_scatter"):
+            solver.defer_scatter(solver.X)
+            solver.snapshot_versions()
+        return index, bit
+
+    def kill_device(self, ens, device_index):
+        """Simulate losing device `device_index` of an EnsembleSolver's
+        member mesh: its member block of the fleet state is overwritten
+        with NaN (the shard's data is gone — recovery must NOT read it
+        back) and the fleet gets the loss notification an
+        XlaRuntimeError-catching dispatch wrapper would deliver in
+        production. Returns the affected member indices."""
+        import jax.numpy as jnp
+        d = int(device_index)
+        members = ens.members_on_device(d)
+        if members:
+            ens.X = ens.X.at[members[0]:members[-1] + 1].set(jnp.nan)
+        ens.notify_device_loss(d)
+        return members
 
 
 # --------------------------------------------------------- service faults

@@ -1,0 +1,525 @@
+"""
+Sharded, asynchronous, elastically-restorable checkpoints.
+
+The PR-4 durable-checkpoint path is a synchronous full-state HDF5 write:
+the step loop gathers every field to host, transposes to grid or
+coefficient layout, and blocks until h5py has flushed — a stall that
+grows with state size and with device count (the gather is exactly the
+all-to-host collective the sharded step avoids). At fleet scale the
+dominant faults are preemption, device loss, and silent corruption, and
+the durability layer has to follow the data: per-device, asynchronous,
+and verifiable. This module is that layer.
+
+Format (`dedalus-sharded-v1`): one checkpoint = one directory
+
+    ckpt_<seq>_i<iteration>/
+        <name>.shard0000.npy     raw np.save of ONE device shard's block
+        <name>.shard0001.npy     ...
+        MANIFEST.json            written LAST, atomically
+
+  * **Per-shard files.** Each array is written as its device shards:
+    `shard_blocks(arr)` walks `arr.addressable_shards` and host-copies
+    one shard at a time (`_copy_out`, a module-level hook so tests can
+    assert the no-full-gather property) — the global array is never
+    materialized on host. Replicated shards are deduplicated by index.
+  * **blake2b checksums.** The manifest records a blake2b digest, the
+    byte count, and the global index of every shard; restore verifies
+    each shard before installing it, so silent media corruption (bit
+    rot, torn DMA) is caught at the only moment it can still be routed
+    around.
+  * **Manifest-written-last commit.** Shard files are fsync'd, then the
+    manifest is committed with the `assembly_cache` tmp+fsync+replace
+    discipline, then the directory entry is fsync'd. A directory
+    without a valid manifest is torn by definition and is quarantined
+    (renamed `quarantine_*`) at restore — a crash at ANY byte of a
+    write leaves the previous checkpoint untouched and discoverable.
+  * **Asynchronous writes.** JAX device arrays are immutable, so a
+    checkpoint "capture" is a dict of references; `ShardedCheckpointer`
+    in async mode enqueues that dict and returns, and the host copy-out
+    + IO run on a background writer thread. The queue has a bounded
+    in-flight budget: a submit beyond it blocks (the overrun barrier),
+    and the blocked time is the only step-loop stall — recorded as
+    `checkpoint_stall_sec`.
+  * **Elastic restore.** Shards carry global indices, so restore
+    assembles the exact global array regardless of how many devices
+    wrote it; the caller re-places it on whatever mesh the restoring
+    process has. A checkpoint taken on 8 devices restores onto 4 or 1
+    (and vice versa) bit-identically — resharding is a placement
+    decision, not a data transformation.
+
+Consumers: `tools/resilience.ResilientLoop` (`[resilience]
+CHECKPOINT_FORMAT = sharded`, `CHECKPOINT_ASYNC`) for single solvers,
+`core/ensemble.EnsembleSolver.evolve(checkpoint_dir=...)` for fleets
+(including the device-loss restore path). Chaos coverage:
+`tools/chaos.py` `torn_shard` + `corrupt_shard` drive the quarantine
+and fallback branches deterministically in tests/test_dcheckpoint.py.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import pathlib
+import re
+import threading
+import time
+
+import numpy as np
+
+from .exceptions import CheckpointError
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FORMAT", "ShardedCheckpointer", "list_checkpoints",
+           "load_checkpoint", "read_manifest", "restore_latest",
+           "shard_blocks", "write_checkpoint"]
+
+FORMAT = "dedalus-sharded-v1"
+MANIFEST = "MANIFEST.json"
+_CKPT_RE = re.compile(r"^ckpt_(\d+)(?:_i\d+)?$")
+_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]+$")
+
+
+def _copy_out(block):
+    """Host copy of ONE device shard. Module-level on purpose: the
+    zero-full-state-gather test (tests/test_collectives.py) spies on this
+    hook and asserts every copied block is shard-sized, never
+    global-sized."""
+    return np.ascontiguousarray(np.asarray(block))
+
+
+def _digest(arr):
+    """blake2b of a C-contiguous array's raw bytes."""
+    return hashlib.blake2b(arr.data, digest_size=16).hexdigest()
+
+
+def shard_blocks(arr):
+    """
+    Yield `(index, host_block)` for each unique addressable shard of
+    `arr`: `index` is a per-dimension `(start, stop)` tuple into the
+    global shape, `host_block` the shard's data copied to host. Host
+    values (np arrays, scalars) yield one full-extent block. Replicated
+    device shards (same index on several devices) are deduplicated, so a
+    replicated array is written once, not once per device.
+    """
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        a = np.ascontiguousarray(np.asarray(arr))
+        yield tuple((0, s) for s in a.shape), a
+        return
+    shape = arr.shape
+    seen = set()
+    for sh in shards:
+        index = tuple(
+            (0 if sl.start is None else int(sl.start),
+             shape[d] if sl.stop is None else int(sl.stop))
+            for d, sl in enumerate(sh.index))
+        if index in seen:
+            continue
+        seen.add(index)
+        yield index, _copy_out(sh.data)
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass   # not all filesystems support directory fsync
+
+
+def list_checkpoints(directory):
+    """Committed-or-torn checkpoint directories under `directory`,
+    oldest first by sequence number (quarantined ones excluded)."""
+    directory = pathlib.Path(directory)
+    if not directory.is_dir():
+        return []
+    out = []
+    for entry in directory.iterdir():
+        m = _CKPT_RE.match(entry.name)
+        if m is not None and entry.is_dir():
+            out.append((int(m.group(1)), entry))
+    return [path for _, path in sorted(out)]
+
+
+def read_manifest(path):
+    """Parse and structurally validate one checkpoint's manifest. Raises
+    CheckpointError on a missing/torn/garbage manifest (= an uncommitted
+    write: the manifest is written last)."""
+    path = pathlib.Path(path)
+    mpath = path / MANIFEST
+    try:
+        manifest = json.loads(mpath.read_text())
+    except OSError as exc:
+        raise CheckpointError(
+            f"checkpoint {path} has no readable manifest (torn write?): "
+            f"{exc}", path=path) from exc
+    except ValueError as exc:
+        raise CheckpointError(
+            f"checkpoint {path} manifest is not valid JSON: {exc}",
+            path=path) from exc
+    if not isinstance(manifest, dict) \
+            or manifest.get("format") != FORMAT \
+            or not isinstance(manifest.get("arrays"), dict):
+        raise CheckpointError(
+            f"checkpoint {path} manifest is not a {FORMAT} manifest",
+            path=path)
+    return manifest
+
+
+def write_checkpoint(directory, arrays, meta=None, shard_hook=None):
+    """
+    Write one sharded checkpoint under `directory` (created if needed)
+    and commit it manifest-last. `arrays` maps names to device/host
+    arrays (device arrays are walked shard-by-shard); `meta` is an
+    arbitrary JSON-able dict stored in the manifest. `shard_hook`, when
+    given, is called as `shard_hook(shards_written)` after each shard
+    file lands — the chaos harness uses it to tear or slow a write
+    deterministically. Returns the committed checkpoint path.
+    """
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    existing = list_checkpoints(directory)
+    seq = 1
+    if existing:
+        seq = int(_CKPT_RE.match(existing[-1].name).group(1)) + 1
+    iteration = int((meta or {}).get("iteration", 0))
+    path = directory / f"ckpt_{seq:08d}_i{iteration:08d}"
+    path.mkdir()
+    manifest = {"format": FORMAT, "seq": seq, "ts": round(time.time(), 3),
+                "meta": dict(meta or {}), "arrays": {}}
+    shards_written = 0
+    for name, arr in arrays.items():
+        if not _NAME_RE.match(name):
+            raise ValueError(f"unsafe checkpoint array name {name!r}")
+        entry = {"shape": [int(s) for s in np.shape(arr)],
+                 "dtype": str(np.dtype(getattr(arr, "dtype", type(arr)))),
+                 "shards": []}
+        for k, (index, block) in enumerate(shard_blocks(arr)):
+            fname = f"{name}.shard{k:04d}.npy"
+            with open(path / fname, "wb") as f:
+                np.save(f, block)
+                f.flush()
+                os.fsync(f.fileno())
+            entry["shards"].append({
+                "file": fname,
+                "index": [[int(a), int(b)] for a, b in index],
+                "blake2b": _digest(block),
+                "nbytes": int(block.nbytes),
+            })
+            shards_written += 1
+            if shard_hook is not None:
+                shard_hook(shards_written)
+        manifest["arrays"][name] = entry
+    # commit: manifest written last, atomically (tmp + fsync + replace,
+    # the assembly_cache torn-file discipline), then the dir entry synced
+    tmp = path / (MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path / MANIFEST)
+    _fsync_dir(path)
+    _fsync_dir(directory)
+    return path
+
+
+def load_checkpoint(path):
+    """
+    Load one committed checkpoint: validates the manifest, then every
+    shard's blake2b checksum and block shape before assembling the
+    global arrays. Returns `(arrays, meta)` with `arrays` mapping names
+    to host np arrays. Raises CheckpointError naming the first bad
+    shard — the caller (restore_latest) quarantines and falls back.
+    """
+    path = pathlib.Path(path)
+    manifest = read_manifest(path)
+    arrays = {}
+    for name, entry in manifest["arrays"].items():
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        # zeros, not empty: an undetected coverage gap must never hand
+        # back heap garbage — and the element count below catches the
+        # gap itself (a manifest whose shards do not tile the global
+        # shape, e.g. one written per-process on a multi-process mesh,
+        # would otherwise pass every per-shard checksum)
+        out = np.zeros(shape, dtype)
+        covered = 0
+        for shard in entry["shards"]:
+            fpath = path / shard["file"]
+            try:
+                block = np.load(fpath)
+            except (OSError, ValueError) as exc:
+                raise CheckpointError(
+                    f"checkpoint {path}: shard {shard['file']} unreadable "
+                    f"(truncated/corrupt?): {exc}", path=path) from exc
+            block = np.ascontiguousarray(block)
+            if _digest(block) != shard["blake2b"]:
+                raise CheckpointError(
+                    f"checkpoint {path}: shard {shard['file']} checksum "
+                    f"mismatch (silent corruption)", path=path)
+            index = tuple(slice(a, b) for a, b in shard["index"])
+            expect = tuple(b - a for a, b in shard["index"])
+            if block.shape != expect or block.dtype != dtype:
+                raise CheckpointError(
+                    f"checkpoint {path}: shard {shard['file']} "
+                    f"shape/dtype {block.shape}/{block.dtype} does not "
+                    f"match its manifest entry {expect}/{dtype}",
+                    path=path)
+            out[index] = block
+            covered += block.size
+        if covered != out.size:
+            raise CheckpointError(
+                f"checkpoint {path}: array {name!r} shards cover "
+                f"{covered} of {out.size} elements — incomplete "
+                f"coverage (multi-process write? missing shard entry?)",
+                path=path)
+        arrays[name] = out
+    return arrays, manifest.get("meta", {})
+
+
+def _quarantine(path):
+    """Move a torn/corrupt checkpoint aside (forensic evidence, excluded
+    from future candidate walks). Best-effort: an un-renameable directory
+    is simply skipped on later walks by its recorded rejection."""
+    target = path.parent / f"quarantine_{path.name}"
+    n = 0
+    while target.exists():
+        n += 1
+        target = path.parent / f"quarantine_{path.name}_{n}"
+    try:
+        path.rename(target)
+        return target
+    except OSError as exc:
+        logger.warning(f"could not quarantine {path}: {exc}")
+        return None
+
+
+def restore_latest(directory, quarantine=True):
+    """
+    Load the newest valid checkpoint under `directory`: walks the
+    sequence newest-first, quarantining torn (manifest-less) and
+    checksum-failed checkpoints and falling back to the previous
+    manifest. Returns an event dict `{"path", "seq", "arrays", "meta",
+    "fallbacks", "validated"}`, or None when the directory holds no
+    checkpoints at all (fresh start). Raises CheckpointError when
+    checkpoints exist but none are loadable.
+    """
+    directory = pathlib.Path(directory)
+    candidates = list_checkpoints(directory)
+    if not candidates:
+        return None
+    rejected = []
+    validated = 0
+    for path in reversed(candidates):
+        validated += 1
+        try:
+            arrays, meta = load_checkpoint(path)
+        except CheckpointError as exc:
+            logger.warning(f"sharded checkpoint {path} rejected: {exc}")
+            entry = {"path": str(path), "reason": str(exc)}
+            if quarantine:
+                moved = _quarantine(path)
+                if moved is not None:
+                    entry["quarantined"] = str(moved)
+            rejected.append(entry)
+            continue
+        seq = int(_CKPT_RE.match(path.name).group(1))
+        logger.info(
+            f"restored sharded checkpoint {path} (seq {seq})"
+            + (f" after skipping {len(rejected)} bad checkpoint(s)"
+               if rejected else ""))
+        return {"path": str(path), "seq": seq, "arrays": arrays,
+                "meta": meta, "fallbacks": rejected, "validated": validated}
+    raise CheckpointError(
+        f"no loadable sharded checkpoint under {directory} "
+        f"({len(rejected)} rejected: "
+        f"{'; '.join(r['reason'] for r in rejected)})", path=directory)
+
+
+class ShardedCheckpointer:
+    """
+    Write-side driver: sequential sharded checkpoints under one
+    directory, synchronous or asynchronous, with bounded retention.
+
+    Async mode: `save(arrays, meta)` snapshots the (immutable) device
+    references, enqueues the job, and returns — host copy-out and IO run
+    on the daemon writer thread. The in-flight budget bounds device
+    memory pinned by pending checkpoints: a `save` beyond it blocks
+    until the writer catches up (the overrun barrier), and that blocked
+    time is the step loop's only stall. `stall_sec` accumulates the
+    wall time every `save` call held the caller (in sync mode: the whole
+    write); `max_inflight` records the deepest pending queue observed.
+
+    Failures: a write that dies (IO error, injected tear) leaves an
+    uncommitted manifest-less directory — harmless by the commit
+    protocol — and is recorded in `errors`; `drain()` waits for the
+    queue to empty and returns the errors accumulated so far. Writer
+    exceptions never propagate into the step loop.
+
+    `io_retry` (a tools/resilience.RetryPolicy) wraps each whole
+    checkpoint commit, so transient IO faults retry with backoff under
+    the [resilience] IO_RETRIES budget like the HDF5 path's writes.
+    """
+
+    def __init__(self, directory, async_write=False, inflight=2, keep=2,
+                 io_retry=None, shard_hook=None):
+        self.directory = pathlib.Path(directory)
+        self.async_write = bool(async_write)
+        self.inflight = max(int(inflight), 1)
+        self.keep = max(int(keep), 1)
+        self.io_retry = io_retry
+        # chaos hook: called after every shard file write (see
+        # tools/chaos.ChaosInjector.wire_checkpointer)
+        self.shard_hook = shard_hook
+        self.written = 0
+        self.submitted = 0
+        self.stall_sec = 0.0
+        self.max_inflight = 0
+        self.errors = []
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._drained = threading.Condition(self._lock)
+        self._pending = []
+        self._closed = False
+        self._thread = None
+
+    # ------------------------------------------------------------- write
+
+    def _commit(self, arrays, meta):
+        def write():
+            return write_checkpoint(self.directory, arrays, meta,
+                                    shard_hook=self.shard_hook)
+        try:
+            if self.io_retry is not None:
+                path = self.io_retry.call(write, label="sharded checkpoint")
+            else:
+                path = write()
+        except Exception as exc:
+            # the torn directory left behind is invisible to restore by
+            # the manifest-last protocol; record and keep going
+            logger.error(f"sharded checkpoint write failed: {exc}")
+            self.errors.append(exc)
+            return None
+        self.written += 1
+        self._prune()
+        return path
+
+    def _prune(self):
+        """Retention: keep the newest `keep` committed checkpoints (the
+        previous manifest must survive for torn-newest fallback, so keep
+        is floored at 1 and defaults to 2). Uncommitted (manifest-less)
+        directories older than the newest committed one are removed too."""
+        import shutil
+        committed = [p for p in list_checkpoints(self.directory)
+                     if (p / MANIFEST).exists()]
+        for path in committed[:-self.keep] if self.keep else committed:
+            shutil.rmtree(path, ignore_errors=True)
+        if committed:
+            newest = committed[-1].name
+            for path in list_checkpoints(self.directory):
+                if not (path / MANIFEST).exists() and path.name < newest:
+                    shutil.rmtree(path, ignore_errors=True)
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                while not self._pending and not self._closed:
+                    self._drained.wait(timeout=0.5)
+                if not self._pending:
+                    if self._closed:
+                        return
+                    continue
+                arrays, meta = self._pending[0]
+            self._commit(arrays, meta)
+            with self._lock:
+                self._pending.pop(0)
+                self._not_full.notify_all()
+                self._drained.notify_all()
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            # daemon: a process killed mid-write leaves a torn directory,
+            # which the manifest-last protocol makes invisible to restore
+            with self._lock:
+                self._closed = False   # save() after close() re-opens
+            self._thread = threading.Thread(
+                target=self._worker, name="dcheckpoint-writer", daemon=True)
+            self._thread.start()
+
+    def save(self, arrays, meta=None):
+        """Write (sync) or enqueue (async) one checkpoint. `arrays` holds
+        immutable device references, so async capture is sync-free; the
+        returned value is the committed path in sync mode, None in async
+        mode (use drain() before trusting durability)."""
+        arrays = dict(arrays)
+        meta = dict(meta or {})
+        t0 = time.perf_counter()
+        self.submitted += 1
+        if not self.async_write:
+            path = self._commit(arrays, meta)
+            self.stall_sec += time.perf_counter() - t0
+            if path is None and self.errors:
+                # synchronous callers must SEE the failure (the HDF5 path
+                # raises; the resilient loop's final-checkpoint retry and
+                # escalation depend on it) — async callers get the same
+                # errors from drain()/close()
+                raise self.errors[-1]
+            return path
+        self._ensure_thread()
+        with self._not_full:
+            while len(self._pending) >= self.inflight:
+                self._not_full.wait()   # the overrun barrier
+            self._pending.append((arrays, meta))
+            self.max_inflight = max(self.max_inflight, len(self._pending))
+            self._drained.notify_all()
+        self.stall_sec += time.perf_counter() - t0
+        return None
+
+    def drain(self, timeout=60.0):
+        """Block until every enqueued checkpoint has committed (or
+        `timeout` expires). Returns the list of accumulated WRITE errors
+        (empty = nothing failed); a drain timeout is logged and left
+        visible via `pending` — it is the caller's wait giving up, not a
+        write failing, so it must not poison later error reporting."""
+        deadline = time.monotonic() + float(timeout)
+        with self._drained:
+            while self._pending:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        f"checkpoint drain timed out with "
+                        f"{len(self._pending)} write(s) still pending")
+                    break
+                self._drained.wait(timeout=min(remaining, 0.5))
+        return list(self.errors)
+
+    def close(self, timeout=60.0):
+        """Drain and stop the writer thread."""
+        errors = self.drain(timeout=timeout)
+        with self._lock:
+            self._closed = True
+            self._drained.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        return errors
+
+    @property
+    def pending(self):
+        with self._lock:
+            return len(self._pending)
+
+    def summary(self):
+        """Compact stats block for telemetry records."""
+        return {
+            "format": "sharded",
+            "async": self.async_write,
+            "written": self.written,
+            "submitted": self.submitted,
+            "stall_sec": round(self.stall_sec, 6),
+            "max_inflight": self.max_inflight,
+            "errors": len(self.errors),
+        }

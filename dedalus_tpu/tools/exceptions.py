@@ -46,6 +46,28 @@ class SolverHealthError(DedalusError, ValueError):
         super().__init__(reason)
 
 
+class SilentCorruptionError(SolverHealthError):
+    """
+    Silent data corruption detected by the SDC sentinel
+    (tools/resilience.py, [resilience] SDC_CADENCE): a redundant
+    re-execution of the last step from the anchor snapshot did not
+    reproduce the live state bit-for-bit. Unlike a NaN/growth failure
+    the corrupted state is still *plausible* — nothing downstream would
+    have noticed — which is exactly why detection has its own error
+    type: recovery must rewind without a dt backoff (the numerics are
+    fine; the bits are not).
+
+    Extra attributes: mismatched (element count that differed),
+    anchor_iteration (the trusted snapshot the re-execution ran from).
+    """
+
+    def __init__(self, reason, mismatched=None, anchor_iteration=None,
+                 **kwargs):
+        self.mismatched = mismatched
+        self.anchor_iteration = anchor_iteration
+        super().__init__(reason, **kwargs)
+
+
 class CheckpointError(DedalusError, OSError):
     """
     Structured checkpoint load/validation failure: names the file and the

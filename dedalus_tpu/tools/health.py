@@ -357,21 +357,10 @@ class HealthMonitor:
         self._evaluate(record)
         return record
 
-    def check_values(self, tree, phase="adjoint", context=None):
-        """
-        Explicit fused non-finite check over an arbitrary pytree of device
-        values (the differentiable-solve path routes its loss + gradients
-        through here, core/adjoint.py): one jitted reduction, one scalar
-        host pull, and a structured `SolverHealthError` naming `phase`
-        when anything is non-finite. Unlike the cadence-gated state probe
-        this is an explicit-call API: it runs even on a monitor built
-        with enabled=False (the zero-overhead contract covers the step
-        loop's implicit ticks, not a caller asking for a verdict), it
-        counts toward `checks`, and it does NOT latch the monitor failed
-        — the solver state itself may be fine; only the requested
-        computation is poisoned. Returns the non-finite entry count (0
-        when healthy; the error is raised, not returned).
-        """
+    def _ensure_value_probe(self):
+        """The fused non-finite count over a list of device leaves (one
+        jitted reduction, scalar output) shared by `check_values` and
+        `nonfinite_count`."""
         import jax
         import jax.numpy as jnp
         probe = getattr(self, "_value_probe", None)
@@ -389,11 +378,51 @@ class HealthMonitor:
             # the retrace sentinel counts real signature churn only)
             probe = self._value_probe = jax.jit(  # dedalus-lint: disable=DTL003
                 retrace_mod.noted(raw, "health/values"))
+        return probe
+
+    def nonfinite_count(self, tree, phase="values"):
+        """
+        Fused device-side non-finite entry count over a pytree of device
+        values: one jitted reduction, ONE scalar host pull, no verdict.
+        This is the sync-light spelling of "is this state finite?" — the
+        snapshot-validation paths (tools/resilience.Snapshot.is_finite,
+        core/ensemble.FleetSnapshot) route through it instead of
+        gathering the whole state to host (`np.asarray(X)` was a full
+        device→host transfer per capture validation). Like
+        `check_values` it is an explicit-call API: it works on a monitor
+        built with enabled=False and never latches a failure.
+        """
+        import jax
+        leaves = [leaf for leaf in jax.tree.leaves(tree)
+                  if hasattr(leaf, "dtype")]
+        if not leaves:
+            return 0
+        probe = self._ensure_value_probe()
+        with metrics_mod.annotate(f"dedalus/health/{phase}"):
+            return int(jax.device_get(probe(leaves)))
+
+    def check_values(self, tree, phase="adjoint", context=None):
+        """
+        Explicit fused non-finite check over an arbitrary pytree of device
+        values (the differentiable-solve path routes its loss + gradients
+        through here, core/adjoint.py): one jitted reduction, one scalar
+        host pull, and a structured `SolverHealthError` naming `phase`
+        when anything is non-finite. Unlike the cadence-gated state probe
+        this is an explicit-call API: it runs even on a monitor built
+        with enabled=False (the zero-overhead contract covers the step
+        loop's implicit ticks, not a caller asking for a verdict), it
+        counts toward `checks`, and it does NOT latch the monitor failed
+        — the solver state itself may be fine; only the requested
+        computation is poisoned. Returns the non-finite entry count (0
+        when healthy; the error is raised, not returned).
+        """
+        import jax
         leaves = [leaf for leaf in jax.tree.leaves(tree)
                   if hasattr(leaf, "dtype")]
         self.checks += 1
         if not leaves:
             return 0
+        probe = self._ensure_value_probe()
         with metrics_mod.annotate(f"dedalus/health/{phase}"):
             bad = int(jax.device_get(probe(leaves)))
         if bad:

@@ -24,7 +24,18 @@ HOT_PATH_MODULES = (
     "core/ddstep.py",
     "libraries/pencilops.py",
     "parallel/transposes.py",
+    # the resilient loop brackets every step: a stray sync here (the
+    # shipped case: Snapshot.is_finite gathering the full state per
+    # capture validation) stalls the same pipeline the step modules do
+    "tools/resilience.py",
 )
+
+# Device-state attribute names (the gathered pencil/fleet state and its
+# companions). By codebase contract these attributes hold jax device
+# arrays; `np.asarray` of one is a full device->host gather.
+STATE_ARRAY_ATTRS = frozenset({
+    "X", "dd_X", "T", "DT", "F_hist", "MX_hist", "LX_hist",
+})
 
 TRACED_CONTEXT_MODULES = (
     "core/transforms.py",
@@ -75,6 +86,13 @@ class HostSyncInHotPath(Rule):
     contains a jax/jnp call (`float(dt)` on host scalars is fine);
     `np.asarray/np.array` only flag bare-Name arguments inside traced code
     (attribute chains like `scheme.A` are host tableau constants).
+    Additionally, anywhere in HOT_PATH_MODULES, `np.asarray/np.array`
+    of a STATE-array attribute (`.X`, `.F_hist`, ... — device arrays by
+    codebase contract, see STATE_ARRAY_ATTRS) with no dtype= flags as a
+    full device->host state gather: the shipped case was
+    `np.all(np.isfinite(np.asarray(self.X)))` in the snapshot-capture
+    validation (tools/resilience.py), fixed by routing through the
+    HealthMonitor's fused device-side probe.
     """
 
     id = "DTL001"
@@ -123,6 +141,23 @@ class HostSyncInHotPath(Rule):
                     ctx, node, f"{name.split('.')[-1]}() on a local inside "
                     "traced code concretizes a tracer (host sync or trace "
                     "error); use jnp, or hoist host work out of the trace")
+                continue
+            # state-attribute gather: np.asarray(self.X) and friends in a
+            # hot module is a full device->host transfer of the pencil/
+            # fleet state (dtype= marks a deliberate host conversion of
+            # host-side data and is exempt, matching DTL002's convention)
+            if hot and name in ("numpy.asarray", "numpy.array") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Attribute) \
+                    and node.args[0].attr in STATE_ARRAY_ATTRS \
+                    and len(node.args) < 2 \
+                    and not any(kw.arg == "dtype" for kw in node.keywords):
+                yield self.finding(
+                    ctx, node, f"{name.split('.')[-1]}() of the device "
+                    f"state attribute .{node.args[0].attr} gathers the "
+                    "full state to host; use the HealthMonitor fused "
+                    "probe (nonfinite_count) or a jitted device-side "
+                    "reduction with a scalar pull instead")
 
 
 @register

@@ -29,6 +29,21 @@ rows (flat JSON object, `ts` + `config`/`backend`/`dtype` keys) with the
 phase breakdown attached; `python -m dedalus_tpu report <file.jsonl>`
 summarizes the records.
 
+Resilience vocabulary: the `resilience/...` counter scope carries the
+recovery trajectory (rewinds, retries, dt_backoffs, snapshots,
+io_retries, checkpoints_written/validated, resumes) plus the durability
+and integrity columns added with the sharded tier —
+`resilience/checkpoint_stall_sec` (cumulative wall the step loop was
+held by durable checkpoint writes: the whole write for synchronous
+formats, just the submit/overrun-barrier wait for async sharded ones),
+`resilience/sdc_checks` / `resilience/sdc_detected` (silent-corruption
+sentinel re-executions and caught mismatches). The flushed `resilience`
+block mirrors them and adds a `checkpoint` sub-dict
+(format/async/written/stall_sec/max_inflight/errors from the
+dcheckpoint writer). Fleet records add `ensemble/reshards` and a
+`reshards` field in the `ensemble` block — one per device-loss
+re-sharding event (core/ensemble.py).
+
 Served-latency vocabulary: records flushed by the warm-pool service
 (dedalus_tpu/service/) carry a `serving` sub-dict —
 `queue_sec` (accept -> dispatch wait), `pool_verdict`

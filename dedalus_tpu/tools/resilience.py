@@ -39,11 +39,38 @@ stepping loop with four layers of protection:
      backoff; structural ones (ENOENT, EACCES, EISDIR) escalate
      immediately.
 
+  5. **Sharded + asynchronous durable checkpoints** — `[resilience]
+     CHECKPOINT_FORMAT = sharded` swaps the synchronous full-state HDF5
+     gather for the per-shard blake2b-checksummed manifest-last format
+     (tools/dcheckpoint.py); `CHECKPOINT_ASYNC = True` moves host
+     copy-out and IO onto a background writer with a bounded in-flight
+     budget, so the step loop's only checkpoint cost is the submit (and
+     the overrun barrier when the writer falls behind). The stall is
+     measured per write (`resilience/checkpoint_stall_sec`); restores
+     are elastic — a checkpoint written under any device layout
+     restores bit-identically under any other.
+
+  6. **Silent-corruption (SDC) sentinel** — every `SDC_CADENCE`
+     iterations the loop captures an anchor snapshot, steps, then
+     redundantly re-executes that step from the anchor and compares
+     against the live state value-exactly (NaN-aware). A mismatch means
+     the bits changed without the math changing — flipped DRAM/HBM bit,
+     torn DMA — and raises a structured `SilentCorruptionError` with a
+     flight-recorder postmortem; under the resilient loop it recovers
+     by rewinding to the anchor (no dt backoff: the numerics were never
+     wrong). The sentinel SAMPLES: each check covers corruption landing
+     between its anchor capture and its comparison (~one step window);
+     corruption in an unchecked window is absorbed into the next anchor
+     and never detected — raise the cadence for more coverage. Cost per
+     check is ~one extra step (+ an LHS refactor); scheduled outputs
+     are suppressed during the re-execution so replays never
+     double-write.
+
 Everything is observable: rewinds, retries, dt backoffs, checkpoints
-written/validated and resume events are counted under the
-`resilience/...` metrics scope (tools/metrics.py), ride in every flushed
-telemetry record and bench row, and surface in
-`python -m dedalus_tpu report`.
+written/validated, checkpoint stall seconds, SDC checks/detections and
+resume events are counted under the `resilience/...` metrics scope
+(tools/metrics.py), ride in every flushed telemetry record and bench
+row, and surface in `python -m dedalus_tpu report`.
 
 The chaos harness (tools/chaos.py) drives every branch of this machinery
 deterministically in tests/test_resilience.py.
@@ -60,12 +87,16 @@ import time
 import numpy as np
 
 from .config import config
-from .exceptions import CheckpointError, SolverHealthError
+from .exceptions import (CheckpointError, SilentCorruptionError,
+                         SolverHealthError)
+from . import dcheckpoint
+from . import metrics as metrics_mod
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ResilientLoop", "RetryPolicy", "Snapshot", "SnapshotRing",
-           "resume_latest", "validate_checkpoint"]
+__all__ = ["ResilientLoop", "RetryPolicy", "SilentCorruptionError",
+           "Snapshot", "SnapshotRing", "resume_latest",
+           "validate_checkpoint"]
 
 
 # --------------------------------------------------------------- IO retry
@@ -151,10 +182,10 @@ class Snapshot:
     """
 
     __slots__ = ("X", "sim_time", "iteration", "dt", "timestepper_state",
-                 "evaluator_state", "dd_X", "wall_ts", "_finite")
+                 "evaluator_state", "dd_X", "wall_ts", "_finite", "_probe")
 
     def __init__(self, X, sim_time, iteration, dt, timestepper_state,
-                 evaluator_state, dd_X=None):
+                 evaluator_state, dd_X=None, probe=None):
         self.X = X
         self.sim_time = sim_time
         self.iteration = iteration
@@ -164,18 +195,31 @@ class Snapshot:
         self.dd_X = dd_X
         self.wall_ts = time.time()
         self._finite = None
+        self._probe = probe
 
     def is_finite(self):
-        """Whether the captured state is fully finite. Host-syncs the
-        snapshot array on first call — only ever invoked on the recovery
-        path, never in the stepping loop."""
+        """Whether the captured state is fully finite. Routed through the
+        HealthMonitor's fused jitted non-finite probe (`probe` at
+        capture): the reduction runs ON DEVICE and only one scalar comes
+        back — never a full state gather. Only ever invoked on the
+        recovery path, never in the stepping loop."""
         if self._finite is None:
-            self._finite = bool(np.all(np.isfinite(np.asarray(self.X))))
+            if self._probe is not None:
+                self._finite = self._probe(self.X) == 0
+            else:
+                # standalone snapshots (no monitor wired): an eager
+                # device-side reduction, still a single-scalar pull
+                import jax
+                import jax.numpy as jnp
+                self._finite = bool(jax.device_get(
+                    jnp.all(jnp.isfinite(self.X))))
         return self._finite
 
 
 def capture_snapshot(solver):
-    """Capture the solver's current state as a Snapshot (sync-free)."""
+    """Capture the solver's current state as a Snapshot (sync-free). The
+    attached HealthMonitor's fused value probe rides along so a later
+    `is_finite()` costs one device-side reduction, not a state gather."""
     ts = solver.timestepper
     ts_state = {"iteration": int(ts.iteration)}
     if hasattr(ts, "F_hist"):
@@ -184,6 +228,7 @@ def capture_snapshot(solver):
             dt_hist=list(ts.dt_hist))
     ev_state = [h.schedule_state() for h in solver.evaluator.handlers]
     dd = getattr(solver, "_dd", None)
+    health = getattr(solver, "health", None)
     return Snapshot(
         X=solver.X,
         sim_time=float(solver.sim_time),
@@ -191,7 +236,8 @@ def capture_snapshot(solver):
         dt=float(solver.dt) if solver.dt is not None else None,
         timestepper_state=ts_state,
         evaluator_state=ev_state,
-        dd_X=dd.X if dd is not None else None)
+        dd_X=dd.X if dd is not None else None,
+        probe=health.nonfinite_count if health is not None else None)
 
 
 def restore_snapshot(solver, snap):
@@ -360,6 +406,12 @@ def _cfg(key, fallback):
         return fallback
 
 
+def _as_bool(value):
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    return bool(value)
+
+
 def io_retry_policy(on_retry=None):
     """The [resilience]-configured transient-IO RetryPolicy — the single
     construction point for checkpoint writes AND telemetry-sink emits
@@ -392,8 +444,19 @@ class ResilientLoop:
           a final write).
       checkpoint_iter — iterations between durable checkpoints (0: only
           the final preemption/completion write).
+      checkpoint_format — "hdf5" (the evaluator FileHandler path) or
+          "sharded" (tools/dcheckpoint.py: per-shard files + blake2b
+          checksums + manifest-last commit, elastic restore).
+      checkpoint_async — sharded format only: host copy-out + IO on a
+          background writer thread with a bounded in-flight budget
+          (CHECKPOINT_INFLIGHT); the step loop pays only the submit.
+      sdc_cadence — iterations between silent-corruption sentinel
+          checks (0 disables): each check re-executes the step just
+          taken from an anchor snapshot and compares value-exactly.
       resume — locate/validate/load the newest checkpoint before
-          starting (ignored without checkpoint_dir).
+          starting (ignored without checkpoint_dir; the format is
+          auto-detected from what the directory holds, so a run can
+          migrate formats across restarts).
       chaos — a tools/chaos.ChaosInjector exercised by tests.
       install_signal_handlers — trap SIGTERM/SIGINT for the run (the
           previous handlers are restored on exit). The warm-pool service
@@ -411,7 +474,10 @@ class ResilientLoop:
     def __init__(self, solver, timestep_function=None, dt=None,
                  snapshot_cadence=None, ring_size=None, max_retries=None,
                  dt_backoff=None, dt_recovery=None, retry_base_delay=None,
-                 checkpoint_dir=None, checkpoint_iter=None, resume=False,
+                 checkpoint_dir=None, checkpoint_iter=None,
+                 checkpoint_format=None, checkpoint_async=None,
+                 checkpoint_inflight=None, checkpoint_keep=None,
+                 sdc_cadence=None, resume=False,
                  chaos=None, install_signal_handlers=True, step_hook=None,
                  flush_telemetry=True):
         self.solver = solver
@@ -439,6 +505,42 @@ class ResilientLoop:
         self.checkpoint_iter = int(checkpoint_iter
                                    if checkpoint_iter is not None
                                    else _cfg("CHECKPOINT_ITER", "0"))
+        self.checkpoint_format = str(
+            checkpoint_format if checkpoint_format is not None
+            else _cfg("CHECKPOINT_FORMAT", "hdf5")).strip().lower()
+        if self.checkpoint_format not in ("hdf5", "sharded"):
+            raise ValueError(
+                f"checkpoint_format must be 'hdf5' or 'sharded', got "
+                f"{self.checkpoint_format!r}")
+        self.checkpoint_async = _as_bool(
+            checkpoint_async if checkpoint_async is not None
+            else _cfg("CHECKPOINT_ASYNC", "False"))
+        if self.checkpoint_async and self.checkpoint_format != "sharded":
+            raise ValueError(
+                "checkpoint_async requires checkpoint_format='sharded' "
+                "(the HDF5 FileHandler path is synchronous by design)")
+        if self.checkpoint_format == "sharded" \
+                and getattr(solver, "_dd", None) is not None:
+            raise ValueError(
+                "sharded checkpoints support the native step path only; "
+                "this solver runs the emulated-f64 (double-double) "
+                "runner — use checkpoint_format='hdf5' or build with "
+                "[execution] EMULATED_F64 = never")
+        self.checkpoint_inflight = int(
+            checkpoint_inflight if checkpoint_inflight is not None
+            else _cfg("CHECKPOINT_INFLIGHT", "2"))
+        self.checkpoint_keep = int(
+            checkpoint_keep if checkpoint_keep is not None
+            else _cfg("CHECKPOINT_KEEP", "2"))
+        self.sdc_cadence = int(sdc_cadence if sdc_cadence is not None
+                               else _cfg("SDC_CADENCE", "0"))
+        self._sdc_gate = metrics_mod.CadenceGate(self.sdc_cadence)
+        self._ckpt_gate = metrics_mod.CadenceGate(self.checkpoint_iter)
+        self.sdc_checks = 0
+        self.sdc_detected = 0
+        self.checkpoint_stall_sec = 0.0
+        self._checkpointer = None
+        self._compare_prog = None
         self.resume = bool(resume)
         self.chaos = chaos
         self.install_signal_handlers = bool(install_signal_handlers)
@@ -477,13 +579,59 @@ class ResilientLoop:
             self._checkpoint_handler = handler
         return self._checkpoint_handler
 
+    def _ensure_checkpointer(self):
+        """The sharded-checkpoint writer (tools/dcheckpoint.py): per-shard
+        commit with the transient-IO retry policy inside the writer, so
+        async writes retry on their own thread under the same
+        IO_RETRIES/IO_BASE_DELAY budget as everything else."""
+        if self._checkpointer is None:
+            self._checkpointer = dcheckpoint.ShardedCheckpointer(
+                self.checkpoint_dir, async_write=self.checkpoint_async,
+                inflight=self.checkpoint_inflight, keep=self.checkpoint_keep,
+                io_retry=io_retry_policy(on_retry=lambda attempt, exc:
+                    self.solver.metrics.inc("resilience/io_retries")))
+            if self.chaos is not None:
+                wire = getattr(self.chaos, "wire_checkpointer", None)
+                if wire is not None:
+                    wire(self._checkpointer)
+        return self._checkpointer
+
+    def _sharded_state(self):
+        """The solver state as named arrays + JSON meta for the sharded
+        format. Arrays are device REFERENCES (immutable), so async
+        capture is sync-free — the writer thread does the per-shard host
+        copies."""
+        solver = self.solver
+        if solver.fields_dirty():
+            solver.X = solver.gather_fields()
+        ts = solver.timestepper
+        arrays = {"X": solver.X}
+        meta = {
+            "kind": "ivp",
+            "iteration": int(solver.iteration),
+            "sim_time": float(solver.sim_time),
+            "dt": float(solver.dt) if solver.dt is not None else None,
+            "ts_iteration": int(ts.iteration),
+            "scheme": type(ts).__name__,
+            "pencil_shape": [int(s) for s in solver.pencil_shape],
+        }
+        if hasattr(ts, "F_hist"):
+            arrays.update(F_hist=ts.F_hist, MX_hist=ts.MX_hist,
+                          LX_hist=ts.LX_hist)
+            meta["dt_hist"] = [float(v) for v in ts.dt_hist]
+        return arrays, meta
+
     def write_checkpoint(self):
         """Force one durable checkpoint write now (the preemption and
-        end-of-run path; periodic writes ride the evaluator schedule).
-        Refuses a known-poisoned state: a checkpoint is a promise of
-        restartability. Retry is the CALLER's job here (_final_checkpoint
-        wraps this whole call), so the handler's own per-write retry is
-        suspended to keep the attempt budget single-layered."""
+        end-of-run path; periodic writes ride the evaluator schedule for
+        HDF5, the loop's own gate for sharded). Refuses a known-poisoned
+        state: a checkpoint is a promise of restartability. The wall
+        time this call holds the step loop is the measured
+        `checkpoint_stall_sec` — for async sharded writes that is just
+        the submit (plus any overrun-barrier wait). On the HDF5 path,
+        retry is the CALLER's job (_final_checkpoint wraps this whole
+        call), so the handler's own per-write retry is suspended to keep
+        the attempt budget single-layered."""
         if self.checkpoint_dir is None:
             return None
         solver = self.solver
@@ -493,18 +641,28 @@ class ResilientLoop:
                 f"{solver.health_error.reason}",
                 iteration=int(solver.iteration),
                 sim_time=float(solver.sim_time))
-        handler = self._ensure_checkpoint_handler()
-        saved, handler.io_retry = handler.io_retry, None
-        try:
-            handler.process(
-                iteration=int(solver.iteration),
-                wall_time=time.time() - solver.start_time,
-                sim_time=float(solver.sim_time),
-                timestep=float(solver.dt) if solver.dt is not None else None)
-        finally:
-            handler.io_retry = saved
+        t0 = time.perf_counter()
+        if self.checkpoint_format == "sharded":
+            arrays, meta = self._sharded_state()
+            result = self._ensure_checkpointer().save(arrays, meta)
+        else:
+            handler = self._ensure_checkpoint_handler()
+            saved, handler.io_retry = handler.io_retry, None
+            try:
+                handler.process(
+                    iteration=int(solver.iteration),
+                    wall_time=time.time() - solver.start_time,
+                    sim_time=float(solver.sim_time),
+                    timestep=float(solver.dt)
+                    if solver.dt is not None else None)
+            finally:
+                handler.io_retry = saved
+            result = handler.current_file
+        stall = time.perf_counter() - t0
+        self.checkpoint_stall_sec += stall
+        solver.metrics.inc("resilience/checkpoint_stall_sec", stall)
         solver.metrics.inc("resilience/checkpoints_written")
-        return handler.current_file
+        return result
 
     # ----------------------------------------------------------- signals
 
@@ -562,8 +720,12 @@ class ResilientLoop:
             logger.error("resilience: snapshot ring exhausted (no finite "
                          "state to rewind to); escalating")
             raise err
-        # dt backoff: cap future timesteps below the dt that failed
-        failed_dt = solver.dt or snap.dt or self.dt
+        # dt backoff: cap future timesteps below the dt that failed —
+        # except for silent corruption, where the numerics were never
+        # wrong (the bits were): shrinking dt would slow the run for a
+        # fault dt cannot influence
+        failed_dt = None if isinstance(err, SilentCorruptionError) \
+            else (solver.dt or snap.dt or self.dt)
         if failed_dt:
             base = self.dt_limit if self.dt_limit is not None else failed_dt
             self.dt_limit = min(base, failed_dt) * self.dt_backoff
@@ -637,14 +799,13 @@ class ResilientLoop:
         previous_handlers = self._install_signals()
         try:
             if self.resume and self.checkpoint_dir is not None:
-                self.resume_event = resume_latest(
-                    solver, self.checkpoint_dir, metrics=solver.metrics)
-                if self.resume_event is not None:
-                    solver.metrics.inc("resilience/resumes")
-                    if self.dt is None and self.resume_event["dt"]:
-                        self.dt = self.resume_event["dt"]
+                self._resume_any()
             if self.checkpoint_dir is not None:
-                self._ensure_checkpoint_handler()
+                if self.checkpoint_format == "hdf5":
+                    self._ensure_checkpoint_handler()
+                else:
+                    self._ensure_checkpointer()
+                    self._ckpt_gate.reset(int(solver.iteration))
             self._capture()   # iteration-0 (or resume-point) anchor
             next_snapshot = solver.iteration + self.snapshot_cadence
             while True:
@@ -663,6 +824,16 @@ class ResilientLoop:
                     self.stopped_by = "completed"
                     break
                 dt = self._effective_dt()
+                # SDC sentinel anchor: captured BEFORE the step that the
+                # sentinel will re-execute; pushed on the ring so a
+                # detection rewinds exactly here
+                sdc_anchor = None
+                if self.sdc_cadence \
+                        and self._sdc_gate.due(solver.iteration + 1):
+                    if solver.fields_dirty():
+                        solver.X = solver.gather_fields()
+                    sdc_anchor = capture_snapshot(solver)
+                    self.ring.push(sdc_anchor)
                 try:
                     if self.chaos is not None:
                         self.chaos.before_step(solver)
@@ -678,10 +849,28 @@ class ResilientLoop:
                 if self.step_hook is not None \
                         and solver.health_error is None:
                     self.step_hook(solver)
+                if sdc_anchor is not None and solver.health_error is None:
+                    err = self._sdc_check(sdc_anchor, dt)
+                    if err is not None:
+                        self._recover(err)
+                        next_snapshot = (solver.iteration
+                                         + self.snapshot_cadence)
+                        continue
                 if solver.health_error is None \
                         and solver.iteration >= next_snapshot:
                     self._capture()
                     next_snapshot = solver.iteration + self.snapshot_cadence
+                if solver.health_error is None \
+                        and self.checkpoint_dir is not None \
+                        and self.checkpoint_format == "sharded" \
+                        and self.checkpoint_iter \
+                        and self._ckpt_gate.due(solver.iteration):
+                    # periodic sharded writes run from the loop (the HDF5
+                    # path rides the evaluator schedule instead)
+                    try:
+                        self.write_checkpoint()
+                    except Exception as exc:
+                        logger.warning(f"periodic checkpoint failed: {exc}")
                 if log_cadence and solver.iteration % log_cadence == 0:
                     logger.info(
                         f"Iteration={solver.iteration}, "
@@ -694,12 +883,250 @@ class ResilientLoop:
                     signal.signal(signum, handler)
                 except (ValueError, OSError):
                     pass
+            if self._checkpointer is not None:
+                for exc in self._checkpointer.close():
+                    logger.error(f"async checkpoint write failed: {exc}")
             if self.flush_telemetry:
                 try:
                     solver.flush_metrics()
                 except Exception as exc:
                     logger.warning(f"final telemetry flush failed: {exc}")
         return self.summary()
+
+    def _newest_sharded_ts(self):
+        """Commit timestamp of the newest COMMITTED sharded checkpoint
+        under checkpoint_dir (torn, manifest-less directories skipped),
+        or None."""
+        for path in reversed(dcheckpoint.list_checkpoints(
+                self.checkpoint_dir)):
+            try:
+                return float(dcheckpoint.read_manifest(path).get("ts", 0))
+            except CheckpointError:
+                continue
+        return None
+
+    def _newest_hdf5_ts(self):
+        """mtime of the newest HDF5 set file under checkpoint_dir, or
+        None."""
+        from .post import get_assigned_sets
+        base = pathlib.Path(self.checkpoint_dir)
+        if not base.is_dir():
+            return None
+        sets = get_assigned_sets(base)
+        if not sets:
+            return None
+        try:
+            return os.path.getmtime(sets[-1])
+        except OSError:
+            return None
+
+    def _resume_any(self):
+        """Resume from whatever the checkpoint directory holds — by
+        RECENCY when both formats are present (a run can migrate
+        CHECKPOINT_FORMAT in either direction across restarts without
+        silently resuming older work), with each format falling back to
+        the other when its newest data turns out unloadable (e.g. the
+        half-migrated case where the first sharded write tore while
+        valid HDF5 sets exist). Only when neither format yields anything
+        does a failure escalate: checkpoints existed, and a silent fresh
+        start would discard the history the operator asked to resume."""
+        solver = self.solver
+
+        def try_sharded():
+            return self._sharded_resume()
+
+        def try_hdf5():
+            return resume_latest(solver, self.checkpoint_dir,
+                                 metrics=solver.metrics)
+
+        sharded_ts = self._newest_sharded_ts()
+        hdf5_ts = self._newest_hdf5_ts()
+        # torn-only sharded dirs (no committed manifest) still mean "a
+        # sharded write was attempted"; try that path first only when a
+        # commit exists or there is no HDF5 alternative
+        if sharded_ts is not None and (hdf5_ts is None
+                                       or sharded_ts >= hdf5_ts):
+            order = (try_sharded, try_hdf5)
+        elif hdf5_ts is not None:
+            order = (try_hdf5, try_sharded)
+        elif dcheckpoint.list_checkpoints(self.checkpoint_dir):
+            order = (try_sharded,)   # torn sharded dirs only: structured
+        else:
+            order = (try_hdf5,)      # nothing at all: fresh start (None)
+        event = None
+        first_error = None
+        for attempt in order:
+            try:
+                event = attempt()
+            except CheckpointError as exc:
+                if first_error is None:
+                    first_error = exc
+                logger.warning(f"resume attempt failed ({exc}); trying "
+                               f"the other checkpoint format")
+                continue
+            if event is not None:
+                break
+        if event is None and first_error is not None:
+            raise first_error
+        self.resume_event = event
+        if self.resume_event is not None:
+            solver.metrics.inc("resilience/resumes")
+            if self.dt is None and self.resume_event["dt"]:
+                self.dt = self.resume_event["dt"]
+
+    def _sharded_resume(self):
+        """Restore the solver from the newest valid sharded checkpoint:
+        per-shard checksums validated, torn/corrupt checkpoints
+        quarantined with fallback to the previous manifest
+        (tools/dcheckpoint.restore_latest). The restored global arrays
+        are placed on the restoring process's own device layout — a
+        checkpoint written under any device count restores under any
+        other, bit-identically."""
+        import jax.numpy as jnp
+        solver = self.solver
+        event = dcheckpoint.restore_latest(self.checkpoint_dir)
+        if event is None:
+            return None
+        solver.metrics.inc("resilience/checkpoints_validated",
+                           event.pop("validated", 1))
+        arrays = event.pop("arrays")
+        meta = event["meta"]
+        if meta.get("kind") != "ivp":
+            raise CheckpointError(
+                f"sharded checkpoint {event['path']} holds "
+                f"{meta.get('kind')!r} state, not a single-solver IVP",
+                path=event["path"])
+        # an incompatible checkpoint must fail HERE with a named cause,
+        # not as a downstream shape error — or worse, a silently wrong
+        # multistep history under a different scheme
+        if meta.get("scheme") is not None \
+                and meta["scheme"] != type(solver.timestepper).__name__:
+            raise CheckpointError(
+                f"sharded checkpoint {event['path']} was written by "
+                f"scheme {meta['scheme']}, this solver runs "
+                f"{type(solver.timestepper).__name__}", path=event["path"])
+        if meta.get("pencil_shape") is not None \
+                and list(meta["pencil_shape"]) != \
+                [int(s) for s in solver.pencil_shape]:
+            raise CheckpointError(
+                f"sharded checkpoint {event['path']} pencil shape "
+                f"{meta['pencil_shape']} does not match this solver's "
+                f"{list(solver.pencil_shape)}", path=event["path"])
+        solver.X = jnp.asarray(arrays["X"])
+        ts = solver.timestepper
+        ts.iteration = int(meta.get("ts_iteration", 0))
+        if "F_hist" in arrays:
+            ts.F_hist = jnp.asarray(arrays["F_hist"])
+            ts.MX_hist = jnp.asarray(arrays["MX_hist"])
+            ts.LX_hist = jnp.asarray(arrays["LX_hist"])
+            ts.dt_hist = [float(v) for v in meta.get("dt_hist", [])]
+        ts._lhs_key = None
+        ts._lhs_aux = None
+        solver.sim_time = solver.initial_sim_time = float(meta["sim_time"])
+        solver.iteration = solver.initial_iteration = int(meta["iteration"])
+        solver.dt = meta.get("dt")
+        solver.problem.sim_time = solver.sim_time
+        solver.defer_scatter(solver.X)
+        solver.snapshot_versions()
+        event.update({
+            "write": event.pop("seq"),
+            "iteration": int(solver.iteration),
+            "sim_time": float(solver.sim_time),
+            "dt": solver.dt,
+            "format": "sharded",
+        })
+        logger.info(
+            f"resumed from sharded checkpoint {event['path']} (iteration "
+            f"{solver.iteration}, sim_time {solver.sim_time:.6e})")
+        return event
+
+    # ------------------------------------------------------- SDC sentinel
+
+    def _ensure_compare(self):
+        """Memoized jitted state comparison over two lists of device
+        arrays: the count of elements that differ, NaN-aware (NaN == NaN
+        for this purpose), one scalar back to host. Lists, so the check
+        covers the multistep history arrays alongside X — corruption in
+        F_hist would leave this step's X intact and poison every later
+        one."""
+        if self._compare_prog is None:
+            import jax
+            import jax.numpy as jnp
+            from . import retrace as retrace_mod
+
+            def raw(live, replay):
+                with metrics_mod.trace_scope("resilience", "sdc_compare"):
+                    total = jnp.zeros((), dtype=jnp.int32)
+                    for a, b in zip(live, replay):
+                        same = (a == b) | (jnp.isnan(a) & jnp.isnan(b))
+                        total = total + jnp.sum((~same).astype(jnp.int32))
+                    return total
+
+            # memoized on self just above (one wrapper per loop)
+            self._compare_prog = jax.jit(  # dedalus-lint: disable=DTL003
+                retrace_mod.noted(raw, "resilience/sdc_compare"))
+        return self._compare_prog
+
+    def _sdc_check(self, anchor, dt):
+        """Redundantly re-execute the step just taken from `anchor` and
+        compare against the live state. Returns None on a value-exact
+        match (the solver is left on the — identical — re-executed
+        state), or a SilentCorruptionError (postmortem already dumped)
+        for the caller to route through recovery. Scheduled outputs are
+        suppressed during the re-execution so a replayed step can never
+        double-write analysis files; the redundant step is subtracted
+        from the iteration throughput accounting."""
+        import jax
+        solver = self.solver
+        self.sdc_checks += 1
+        solver.metrics.inc("resilience/sdc_checks")
+        live = capture_snapshot(solver)
+        restore_snapshot(solver, anchor)
+        evaluator = solver.evaluator
+        saved_eval = evaluator.evaluate_scheduled
+        evaluator.evaluate_scheduled = lambda **kw: None
+        try:
+            solver.step(dt)
+        finally:
+            evaluator.evaluate_scheduled = saved_eval
+        solver.metrics.observe_steps(-1)   # verification, not progress
+        live_leaves = [live.X]
+        replay_leaves = [solver.X]
+        st = live.timestepper_state
+        if "F_hist" in st:
+            ts = solver.timestepper
+            live_leaves += [st["F_hist"], st["MX_hist"], st["LX_hist"]]
+            replay_leaves += [ts.F_hist, ts.MX_hist, ts.LX_hist]
+        # one scalar pull per SDC_CADENCE iterations — the sentinel IS the
+        # cadence gate this rule asks for
+        mismatched = int(jax.device_get(  # dedalus-lint: disable=DTL001
+            self._ensure_compare()(live_leaves, replay_leaves)))
+        if mismatched == 0:
+            # bit-for-bit agreement: the solver now holds the (identical)
+            # re-executed state; only the evaluator's schedule counters
+            # need the live values back (the replay skipped them)
+            for handler, state in zip(evaluator.handlers,
+                                      live.evaluator_state):
+                handler.restore_schedule_state(state)
+            return None
+        self.sdc_detected += 1
+        solver.metrics.inc("resilience/sdc_detected")
+        reason = (f"silent corruption detected: re-executing step "
+                  f"{anchor.iteration} -> {live.iteration} from the anchor "
+                  f"snapshot diverges from the live state in {mismatched} "
+                  f"element(s)")
+        pm = None
+        try:
+            pm = solver.health.dump_postmortem(reason)
+        except Exception as exc:
+            logger.warning(f"SDC flight-recorder dump failed: {exc}")
+        logger.error(f"resilience: {reason}"
+                     + (f" (post-mortem: {pm})" if pm else ""))
+        return SilentCorruptionError(
+            reason, mismatched=mismatched,
+            anchor_iteration=anchor.iteration,
+            iteration=live.iteration, sim_time=live.sim_time,
+            postmortem_dir=str(pm) if pm else None)
 
     def _graceful_stop(self):
         solver = self.solver
@@ -732,9 +1159,24 @@ class ResilientLoop:
         if self.checkpoint_dir is None:
             return
         try:
-            path = self.io_retry.call(self.write_checkpoint,
-                                      label="final checkpoint")
-            logger.info(f"final checkpoint written: {path}")
+            if self.checkpoint_format == "sharded":
+                # the ShardedCheckpointer already wraps each commit in
+                # the io_retry policy (on its writer thread for async);
+                # wrapping the call again would square the attempt
+                # budget — the exact double-layering the HDF5 branch
+                # suspends the handler's retry to avoid
+                path = self.write_checkpoint()
+            else:
+                path = self.io_retry.call(self.write_checkpoint,
+                                          label="final checkpoint")
+            if path is None:
+                # async submit: durability is confirmed (or denied) at
+                # the writer drain in run()'s finally — do not log a
+                # "written" line the operator could mistake for durable
+                logger.info("final checkpoint submitted to the async "
+                            "writer; durability confirmed at drain")
+            else:
+                logger.info(f"final checkpoint written: {path}")
         except Exception as exc:
             logger.error(f"final checkpoint failed: {exc}")
 
@@ -751,6 +1193,19 @@ class ResilientLoop:
             "dt_limit": self.dt_limit,
             "stopped_by": self.stopped_by,
         }
+        if self.sdc_cadence:
+            out["sdc_checks"] = self.sdc_checks
+            out["sdc_detected"] = self.sdc_detected
+        if self.checkpoint_dir is not None:
+            ckpt = (dict(self._checkpointer.summary())
+                    if self._checkpointer is not None else {})
+            ckpt["format"] = self.checkpoint_format
+            # authoritative stall: the wall the STEP LOOP was held per
+            # write_checkpoint (includes the state capture), matching the
+            # resilience/checkpoint_stall_sec counter — NOT the writer-
+            # internal save() time the checkpointer summary reports
+            ckpt["stall_sec"] = round(self.checkpoint_stall_sec, 6)
+            out["checkpoint"] = ckpt
         if self.lineage:
             out["lineage"] = list(self.lineage)
         if self.resume_event is not None:

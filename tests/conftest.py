@@ -39,13 +39,14 @@ import pytest  # noqa: E402
 
 # ------------------------------------------------- service test watchdog
 #
-# Hard per-test timeout for the `service` and `chaos` markers: a daemon
-# subprocess (or an in-process daemon thread) that hangs must not eat
-# the tier-1 budget silently — the SIGALRM handler kills every
-# registered stray daemon, appends their captured logs to the failure
-# message, and fails THIS test instead of stalling the whole sweep.
-# Tests that spawn daemon subprocesses register them (with their log
-# path) via `register_daemon`, imported from this conftest.
+# Hard per-test timeout for the `service`, `chaos` and `ensemble`
+# markers: a daemon subprocess (or an in-process daemon thread, or a
+# wedged fleet reshard/collective) that hangs must not eat the tier-1
+# budget silently — the SIGALRM handler kills every registered stray
+# daemon, appends their captured logs to the failure message, and fails
+# THIS test instead of stalling the whole sweep. Tests that spawn
+# daemon subprocesses register them (with their log path) via
+# `register_daemon`, imported from this conftest.
 
 SERVICE_TEST_TIMEOUT_SEC = 180.0
 
@@ -87,12 +88,16 @@ def _kill_stray_daemons(since=0):
 
 @pytest.fixture(autouse=True)
 def _service_test_watchdog(request):
-    """Per-test hard watchdog for service/chaos-marked tests (SIGALRM;
-    main thread only — pytest runs tests there). On expiry: stray
-    daemons are killed, their logs attached, and the test fails with a
-    timeout instead of wedging tier-1."""
+    """Per-test hard watchdog for service/chaos/ensemble-marked tests
+    (SIGALRM; main thread only — pytest runs tests there). On expiry:
+    stray daemons are killed, their logs attached, and the test fails
+    with a timeout instead of wedging tier-1. The ensemble marker rides
+    the same guard because a hung fleet reshard (a collective waiting on
+    a device that will never answer) stalls exactly like a hung
+    daemon."""
     marked = (request.node.get_closest_marker("service") is not None
-              or request.node.get_closest_marker("chaos") is not None)
+              or request.node.get_closest_marker("chaos") is not None
+              or request.node.get_closest_marker("ensemble") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
         yield
         return
@@ -143,6 +148,14 @@ def pytest_configure(config):
         "markers",
         "service: warm-pool solver service tests (dedalus_tpu/service/); "
         "tier-1 by default")
+    # ensemble: fleet execution tests (core/ensemble.py), including
+    # device-loss resharding. Tier-1 by default; covered by the same
+    # hard watchdog as service/chaos so a hung reshard cannot eat the
+    # tier-1 budget.
+    config.addinivalue_line(
+        "markers",
+        "ensemble: fleet execution tests (core/ensemble.py: vmapped/"
+        "sharded stepping, device-loss resharding); tier-1 by default")
 
 
 @pytest.fixture

@@ -1,0 +1,358 @@
+"""
+Sharded/async/elastic checkpoints (tools/dcheckpoint.py).
+
+The contract under test is the durability tier of the distributed
+resilience PR:
+  * per-shard files + blake2b checksums, manifest-written-last commit:
+    a write torn at ANY point (no manifest, truncated shard, silently
+    corrupted shard bytes) is quarantined at restore and the PREVIOUS
+    manifest is used;
+  * asynchronous writes with a bounded in-flight budget: the overrun
+    barrier blocks the submitter instead of pinning unbounded device
+    memory, and everything submitted lands durably, in order;
+  * a real SIGTERM killing the process mid-async-write leaves the
+    previous checkpoint valid (the torn directory is invisible);
+  * ELASTIC restore: an 8-virtual-device fleet checkpoint restores onto
+    4 and 1 devices (and 1 -> 8) with member state EXACTLY equal to the
+    source — resharding is placement, not data transformation.
+
+All CPU, deterministic, tier-1 (chaos marker: watchdogged).
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import dedalus_tpu.public as d3
+from dedalus_tpu.tools import chaos as chaos_mod
+from dedalus_tpu.tools import dcheckpoint as dc
+from dedalus_tpu.tools.exceptions import CheckpointError
+
+REPO = pathlib.Path(__file__).parent.parent
+
+pytestmark = pytest.mark.chaos
+
+N_DEV = len(jax.devices())
+needs_devices = pytest.mark.skipif(N_DEV < 8, reason="needs 8 devices")
+
+
+def sharded(arr, n_devices):
+    """Place an array on a 1-D batch mesh over the first n devices
+    (n_devices=1: plain single-device placement)."""
+    if n_devices <= 1:
+        return jnp.asarray(arr)
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("batch",))
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P("batch")))
+
+
+# ------------------------------------------------------------ raw format
+
+@needs_devices
+def test_write_restore_roundtrip_sharded_array(tmp_path):
+    """An 8-way sharded array writes one file per shard (plus checksums
+    and global indices in the manifest) and restores bit-identically;
+    host arrays and meta ride along."""
+    X = np.arange(16 * 6, dtype=np.float64).reshape(16, 6)
+    path = dc.write_checkpoint(
+        tmp_path, {"X": sharded(X, 8), "host": np.eye(3)},
+        {"iteration": 7, "sim_time": 0.125})
+    manifest = dc.read_manifest(path)
+    assert len(manifest["arrays"]["X"]["shards"]) == 8
+    for shard in manifest["arrays"]["X"]["shards"]:
+        assert shard["nbytes"] == X.nbytes // 8
+        assert (path / shard["file"]).exists()
+    assert len(manifest["arrays"]["host"]["shards"]) == 1
+    arrays, meta = dc.load_checkpoint(path)
+    assert np.array_equal(arrays["X"], X)
+    assert np.array_equal(arrays["host"], np.eye(3))
+    assert meta == {"iteration": 7, "sim_time": 0.125}
+
+
+@needs_devices
+def test_elastic_placement_bit_identical(tmp_path):
+    """Restored global arrays re-place onto 4, 1, and back to 8 devices
+    with bytes exactly equal to the 8-device source."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(16, 5))
+    dc.write_checkpoint(tmp_path / "w8", {"X": sharded(X, 8)}, {})
+    restored = dc.restore_latest(tmp_path / "w8")["arrays"]["X"]
+    for n in (4, 1):
+        placed = sharded(restored, n)
+        assert np.array_equal(np.asarray(placed), X)
+    # and the reverse direction: written on 1 device, restored onto 8
+    dc.write_checkpoint(tmp_path / "w1", {"X": sharded(X, 1)}, {})
+    ev = dc.restore_latest(tmp_path / "w1")
+    placed8 = sharded(ev["arrays"]["X"], 8)
+    assert np.array_equal(np.asarray(placed8), X)
+
+
+def test_replicated_shards_deduplicated(tmp_path):
+    """A replicated-on-mesh array writes ONE shard, not one per device."""
+    if N_DEV < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("batch",))
+    rep = jax.device_put(jnp.arange(12.0), NamedSharding(mesh, P()))
+    assert len(rep.addressable_shards) == 4
+    path = dc.write_checkpoint(tmp_path, {"rep": rep}, {})
+    manifest = dc.read_manifest(path)
+    assert len(manifest["arrays"]["rep"]["shards"]) == 1
+
+
+# --------------------------------------------- torn/corrupt + quarantine
+
+def _write_two(tmp_path):
+    X0 = np.arange(32.0).reshape(8, 4)
+    dc.write_checkpoint(tmp_path, {"X": X0}, {"iteration": 1})
+    dc.write_checkpoint(tmp_path, {"X": X0 + 100}, {"iteration": 2})
+    return X0
+
+
+def test_torn_manifestless_dir_invisible_and_quarantined(tmp_path):
+    """A checkpoint directory without a manifest (the writer died before
+    the commit point) falls back to the previous manifest and is
+    quarantined out of future walks."""
+    X0 = _write_two(tmp_path)
+    newest = dc.list_checkpoints(tmp_path)[-1]
+    (newest / dc.MANIFEST).unlink()          # sever the commit marker
+    event = dc.restore_latest(tmp_path)
+    assert event["meta"]["iteration"] == 1
+    assert np.array_equal(event["arrays"]["X"], X0)
+    assert len(event["fallbacks"]) == 1
+    assert "manifest" in event["fallbacks"][0]["reason"]
+    assert "quarantined" in event["fallbacks"][0]
+    # quarantined: a second walk no longer sees the torn directory
+    assert len(dc.list_checkpoints(tmp_path)) == 1
+    assert list(tmp_path.glob("quarantine_*"))
+
+
+@pytest.mark.parametrize("mode", ["garbage", "truncate", "delete"])
+def test_corrupt_shard_quarantine_fallback(tmp_path, mode):
+    """Every shard-level damage mode — silent byte corruption (checksum
+    mismatch), truncation, deletion — is detected at restore and falls
+    back to the previous manifest."""
+    X0 = _write_two(tmp_path)
+    newest = dc.list_checkpoints(tmp_path)[-1]
+    chaos_mod.corrupt_shard(newest, mode=mode)
+    event = dc.restore_latest(tmp_path)
+    assert event["meta"]["iteration"] == 1
+    assert np.array_equal(event["arrays"]["X"], X0)
+    assert len(event["fallbacks"]) == 1
+    if mode == "garbage":
+        assert "checksum" in event["fallbacks"][0]["reason"]
+
+
+def test_all_corrupt_raises_structured(tmp_path):
+    _write_two(tmp_path)
+    for path in dc.list_checkpoints(tmp_path):
+        chaos_mod.corrupt_shard(path, mode="truncate")
+    with pytest.raises(CheckpointError) as excinfo:
+        dc.restore_latest(tmp_path)
+    assert "no loadable sharded checkpoint" in str(excinfo.value)
+    # an empty/absent directory is a fresh start, not an error
+    assert dc.restore_latest(tmp_path / "nowhere") is None
+
+
+def test_torn_shard_chaos_fault_fires_once(tmp_path):
+    """The chaos torn_shard fault kills the Nth write after K shards —
+    before the manifest — exactly once. Synchronous callers SEE the
+    failure (raised, like the HDF5 path would), and the next write
+    commits."""
+    ck = dc.ShardedCheckpointer(tmp_path, keep=4)
+    injector = chaos_mod.ChaosInjector(torn_shard_write=2,
+                                       torn_after_shards=1)
+    injector.wire_checkpointer(ck)
+    X = np.arange(8.0)
+    assert ck.save({"X": X}, {"iteration": 1}) is not None
+    with pytest.raises(RuntimeError, match="torn"):
+        ck.save({"X": X + 1}, {"iteration": 2})
+    assert [f["kind"] for f in injector.fired] == ["torn_shard"]
+    assert len(ck.errors) == 1
+    assert ck.save({"X": X + 2}, {"iteration": 3}) is not None
+    event = dc.restore_latest(tmp_path)
+    assert event["meta"]["iteration"] == 3
+    assert len(event["fallbacks"]) == 0    # pruned: torn dir older than newest
+
+
+# ------------------------------------------------------------ async writer
+
+def test_async_overrun_barrier_blocks_and_lands_everything(tmp_path):
+    """inflight=1 with a slowed writer: the second submit returns
+    immediately, the third blocks at the barrier (recorded stall), and
+    after drain every submitted checkpoint is durable, newest last."""
+    ck = dc.ShardedCheckpointer(tmp_path, async_write=True, inflight=1,
+                                keep=8)
+    injector = chaos_mod.ChaosInjector(slow_shard_sec=0.2)
+    injector.wire_checkpointer(ck)
+    X = np.arange(16.0)
+    t0 = time.perf_counter()
+    ck.save({"X": X}, {"iteration": 1})
+    first_two = time.perf_counter() - t0
+    assert first_two < 0.15, "submit should not wait for the slow write"
+    ck.save({"X": X + 1}, {"iteration": 2})   # blocks: budget is 1
+    assert ck.stall_sec > 0.05, "overrun barrier never engaged"
+    errors = ck.drain()
+    assert errors == []
+    assert ck.written == 2 and ck.max_inflight == 1
+    sequence = [dc.read_manifest(p)["meta"]["iteration"]
+                for p in dc.list_checkpoints(tmp_path)]
+    assert sequence == [1, 2]
+    event = dc.restore_latest(tmp_path)
+    assert event["meta"]["iteration"] == 2
+    assert np.array_equal(event["arrays"]["X"], X + 1)
+
+
+def test_retention_keeps_newest_k(tmp_path):
+    ck = dc.ShardedCheckpointer(tmp_path, keep=2)
+    for i in range(5):
+        ck.save({"X": np.full(4, float(i))}, {"iteration": i})
+    kept = dc.list_checkpoints(tmp_path)
+    assert len(kept) == 2
+    assert [dc.read_manifest(p)["meta"]["iteration"] for p in kept] == [3, 4]
+
+
+def test_sigterm_mid_async_write_leaves_previous_valid(tmp_path):
+    """A real SIGTERM (default disposition: die now) delivered while the
+    async writer is mid-checkpoint: the torn write never commits, and
+    restore finds the previous checkpoint intact — the acceptance
+    property of the manifest-written-last protocol."""
+    script = r"""
+import sys, time
+import numpy as np
+from dedalus_tpu.tools import dcheckpoint as dc
+
+d = sys.argv[1]
+ck = dc.ShardedCheckpointer(d, async_write=True, inflight=2, keep=8)
+arrays = {k: np.full((64, 64), float(i))
+          for i, k in enumerate(("X", "F_hist", "MX_hist"))}
+ck.save(arrays, {"iteration": 1})
+assert ck.drain() == []                      # checkpoint 1 fully durable
+ck.shard_hook = lambda k: time.sleep(0.5)    # ~1.5 s write window
+ck.save({k: v + 1 for k, v in arrays.items()}, {"iteration": 2})
+time.sleep(0.2)                              # writer is inside the write
+print("INFLIGHT", flush=True)
+time.sleep(60)                               # SIGTERM lands here
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen([sys.executable, "-c", script, str(tmp_path)],
+                            cwd=REPO, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.strip() == "INFLIGHT", proc.stderr.read()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    finally:
+        proc.kill()
+    assert proc.returncode != 0              # died by signal, mid-write
+    dirs = dc.list_checkpoints(tmp_path)
+    assert len(dirs) == 2                    # committed + torn
+    assert not (dirs[-1] / dc.MANIFEST).exists(), \
+        "the interrupted write must not have committed"
+    event = dc.restore_latest(tmp_path)
+    assert event["meta"]["iteration"] == 1
+    assert np.array_equal(event["arrays"]["X"], np.full((64, 64), 0.0))
+
+
+# -------------------------------------------------- elastic fleet restore
+
+AMPS = [0.1, 0.5, 1.0, 2.0, 0.3, 0.7, 1.5, 0.05]
+KS = [1, 2, 3, 4, 1, 2, 3, 4]
+
+
+def build_heat_solver():
+    """The ensemble test problem: 1-D forced heat with a parameter field
+    riding as an RHS extra operand (so elastic restore covers parameter
+    operands too)."""
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=np.float64)
+    xb = d3.RealFourier(xc, size=32, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    a = dist.Field(name="a", bases=xb)
+    problem = d3.IVP([u], namespace={"u": u, "a": a, "lap": d3.lap})
+    problem.add_equation("dt(u) - lap(u) = a*u")
+    solver = problem.build_solver(d3.SBDF2, warmup_iterations=2,
+                                  enforce_real_cadence=10)
+    x = dist.local_grid(xb)
+
+    def member_init(i):
+        u["g"] = np.sin(KS[i] * x)
+        a["g"] = AMPS[i] * np.cos(x)
+
+    return solver, member_init
+
+
+@needs_devices
+@pytest.mark.ensemble
+def test_elastic_fleet_restore_8_to_4_to_1_and_back(tmp_path):
+    """Acceptance: an 8-virtual-device fleet checkpoint restores onto 4
+    and 1 devices (and a 1-device checkpoint onto 8) with member state
+    EXACTLY equal to the source, and the restored fleets step onward
+    identically to the source fleet."""
+    solver8, member_init = build_heat_solver()
+    ens8 = solver8.ensemble(8, mesh="auto")
+    ens8.init_members(member_init)
+    ens8.evolve(dt=1e-3, stop_iteration=24, block=4,
+                checkpoint_dir=tmp_path / "fleet", checkpoint_iter=8,
+                log_cadence=0)
+    assert ens8.summary()["devices"] == 8
+    X8 = np.asarray(ens8.X[:8]).copy()
+    T8 = np.asarray(ens8.sim_times[:8]).copy()
+
+    restored = {}
+    for n_devices in (4, 1):
+        solver, _ = build_heat_solver()
+        mesh = (Mesh(np.array(jax.devices()[:n_devices]), ("batch",))
+                if n_devices > 1 else None)
+        ens = solver.ensemble(8, mesh=mesh)
+        event = ens.restore_checkpoint(tmp_path / "fleet")
+        assert event["meta"]["iteration"] == 24
+        assert ens.iteration == 24
+        assert np.array_equal(np.asarray(ens.X[:8]), X8), \
+            f"8 -> {n_devices} restore not bit-identical"
+        assert np.array_equal(ens.sim_times[:8], T8)
+        restored[n_devices] = ens
+
+    # 1 -> 8: write from the single-device fleet, restore onto the mesh
+    ens1 = restored[1]
+    ens1.init_checkpoints(tmp_path / "fleet1")
+    ens1.write_checkpoint()
+    solver8b, _ = build_heat_solver()
+    ens8b = solver8b.ensemble(8, mesh="auto")
+    ens8b.restore_checkpoint(tmp_path / "fleet1")
+    assert np.array_equal(np.asarray(ens8b.X[:8]), X8), \
+        "1 -> 8 restore not bit-identical"
+
+    # the restored fleets continue the SAME trajectory as the source
+    for ens in (ens8, restored[4], ens8b):
+        ens.step_many(8, 1e-3)
+    for label, ens in (("4dev", restored[4]), ("8dev-from-1", ens8b)):
+        err = np.max(np.abs(np.asarray(ens.X[:8])
+                            - np.asarray(ens8.X[:8])))
+        assert err <= 1e-12, (label, err)
+
+
+@needs_devices
+@pytest.mark.ensemble
+def test_fleet_restore_validates_compatibility(tmp_path):
+    """Member count / scheme / shape mismatches are structured errors,
+    not silent shape corruption."""
+    solver, member_init = build_heat_solver()
+    ens = solver.ensemble(8, mesh="auto")
+    ens.init_members(member_init)
+    ens.init_checkpoints(tmp_path / "fleet")
+    ens.write_checkpoint()
+    other, _ = build_heat_solver()
+    with pytest.raises(CheckpointError, match="members"):
+        other.ensemble(4, mesh=None).restore_checkpoint(tmp_path / "fleet")
+    with pytest.raises(CheckpointError, match="no sharded checkpoint"):
+        other.ensemble(8, mesh=None).restore_checkpoint(tmp_path / "empty")

@@ -29,6 +29,11 @@ from dedalus_tpu.tools import retrace as retrace_mod
 
 REPO = pathlib.Path(__file__).parent.parent
 
+# module-wide ensemble marker: tier-1 by default, and covered by the
+# conftest hard watchdog (a hung reshard/collective must fail ITS test,
+# not eat the tier-1 budget)
+pytestmark = pytest.mark.ensemble
+
 AMPS = [0.1, 0.5, 1.0, 2.0, 0.3, 0.7, 1.5, 0.05]
 KS = [1, 2, 3, 4, 1, 2, 3, 4]
 
@@ -241,6 +246,136 @@ def test_rewind_backoff_survives_scalar_dt_driving():
     assert ens.dts[5] == pytest.approx(0.5e-3)
     assert ens.n_active == 8
     assert np.all(np.isfinite(np.asarray(ens.X)))
+
+
+# ------------------------------------------------ chaos: device loss
+
+@pytest.mark.chaos
+def test_chaos_device_loss_reshards_onto_survivors(tmp_path):
+    """Acceptance: chaos kills one of the 8 virtual mesh devices mid-run
+    (its member block poisoned + loss notification). The fleet re-shards
+    onto the 7 survivors before the next dispatch, the lost device's
+    member restores from the snapshot ring, the run completes with every
+    member ACTIVE — and the final states bit-match fault-free serial
+    references (survivors: the full 60 steps; the restored member: its
+    snapshot iteration 16 plus the remaining 40 = 56 steps). Zero
+    post-warmup retraces: rebuilt programs are fresh wrappers, each
+    tracing once."""
+    sink = tmp_path / "metrics.jsonl"
+    solver, member_init = build_heat_solver("SBDF2")
+    ens = solver.ensemble(8, mesh="auto", snapshot_cadence=8,
+                          health_cadence=4, metrics_file=str(sink))
+    ens.init_members(member_init)
+    retrace_mod.sentinel.reset()
+    injector = chaos_mod.ChaosInjector(lose_device=2, lose_iteration=20)
+    summary = ens.evolve(dt=1e-3, stop_iteration=60, block=4,
+                         chaos=injector, log_cadence=0)
+    assert [f["kind"] for f in injector.fired] == ["lose_device"]
+    assert ens.iteration == 60
+    assert summary["reshards"] == 1
+    assert summary["devices"] == 7
+    assert summary["active"] == 8 and summary["dropped"] == 0
+    event = ens.reshard_events[0]
+    assert event["lost_devices"] == [2]
+    assert [r["source"] for r in event["restored"]] == ["ring"]
+    affected = [r["member"] for r in event["restored"]]
+    assert affected == injector.fired[0]["members"]
+    restored_iter = event["restored"][0]["iteration"]
+    assert restored_iter == 16          # newest pre-loss snapshot
+    # bit-identity against fault-free references: the restored member
+    # plus two survivors (one per side of the lost block) — each
+    # reference is a full serial build+run, so spot-checking keeps this
+    # inside the tier-1 budget without weakening the claim
+    steps_for = lambda i: (restored_iter + (60 - 20)) if i in affected \
+        else 60
+    for i in sorted(set(affected) | {0, 7}):
+        ref_solver, ref_init = build_heat_solver("SBDF2")
+        ref_init(i)
+        ref_solver.step_many(steps_for(i), 1e-3)
+        err = np.max(np.abs(np.asarray(ens.X[i]) - np.asarray(ref_solver.X)))
+        assert err <= 1e-12, (i, err)
+    assert retrace_mod.sentinel.post_arm_retraces == 0
+    # telemetry: reshard count in the flushed block and the report CLI
+    record = ens.flush_metrics()
+    assert record["ensemble"]["reshards"] == 1
+    assert record["counters"]["ensemble/reshards"] == 1
+    out = subprocess.run(
+        [sys.executable, "-m", "dedalus_tpu", "report", str(sink)],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "1 reshards" in out.stdout
+
+
+@pytest.mark.chaos
+def test_device_loss_restores_from_durable_checkpoint(tmp_path):
+    """With the snapshot ring unusable (a real device loss destroys its
+    slices too), the lost members restore from the last durable sharded
+    checkpoint — and the post-fault run still bit-matches the fault-free
+    reference (checkpoint iteration + remaining steps)."""
+    solver, member_init = build_heat_solver("SBDF2")
+    ens = solver.ensemble(8, mesh="auto", snapshot_cadence=1000,
+                          health_cadence=4)
+    ens.init_members(member_init)
+    ens.init_checkpoints(tmp_path / "fleet")
+    ens.snapshot()
+    ens.step_many(16, 1e-3)
+    ens.write_checkpoint()              # durable at iteration 16
+    ens.step_many(4)
+    # a REAL loss kills the ring slices with the device; model that
+    ens.ring.clear()
+    injector = chaos_mod.ChaosInjector(lose_device=2, lose_iteration=20)
+    injector.after_step(ens)            # poison + notify at iteration 20
+    ens.step_many(40)                   # reshard happens on entry
+    assert ens.iteration == 60
+    event = ens.reshard_events[0]
+    assert [r["source"] for r in event["restored"]] == ["checkpoint"]
+    assert event["restored"][0]["iteration"] == 16
+    assert ens.n_active == 8
+    affected = [r["member"] for r in event["restored"]]
+    for i in sorted(set(affected) | {0, 7}):
+        n = 16 + 40 if i in affected else 60
+        ref_solver, ref_init = build_heat_solver("SBDF2")
+        ref_init(i)
+        ref_solver.step_many(n, 1e-3)
+        err = np.max(np.abs(np.asarray(ens.X[i]) - np.asarray(ref_solver.X)))
+        assert err <= 1e-12, (i, err)
+
+
+@pytest.mark.chaos
+def test_device_loss_without_any_source_drops_members(tmp_path):
+    """No finite ring slot AND no durable checkpoint: the lost device's
+    members drop (recorded, masked out) and the rest of the fleet
+    completes untouched."""
+    solver, member_init = build_heat_solver("SBDF2")
+    ens = solver.ensemble(8, mesh="auto", snapshot_cadence=1000,
+                          health_cadence=4)
+    ens.init_members(member_init)
+    ens.step_many(20, 1e-3)
+    ens.ring.clear()
+    injector = chaos_mod.ChaosInjector(lose_device=3, lose_iteration=20)
+    injector.after_step(ens)
+    ens.step_many(40)
+    assert ens.iteration == 60
+    event = ens.reshard_events[0]
+    assert event["restored"] == []
+    assert event["dropped"] == [3]
+    assert ens.n_active == 7
+    assert ens.dropped[0]["member"] == 3
+    for i in (0, 4, 7):     # spot-check survivors (tier-1 budget)
+        ref_solver, ref_init = build_heat_solver("SBDF2")
+        ref_init(i)
+        ref_solver.step_many(60, 1e-3)
+        err = np.max(np.abs(np.asarray(ens.X[i]) - np.asarray(ref_solver.X)))
+        assert err <= 1e-12, (i, err)
+
+
+def test_notify_device_loss_without_mesh_raises():
+    solver, member_init = build_heat_solver("SBDF2")
+    ens = solver.ensemble(2, mesh=None)
+    ens.init_members(member_init)
+    ens.notify_device_loss(0)
+    with pytest.raises(RuntimeError, match="without a device mesh"):
+        ens.step_many(1, 1e-3)
 
 
 @pytest.mark.chaos

@@ -82,6 +82,52 @@ def coefficients(dt_hist):
     assert result.findings == []
 
 
+def test_dtl001_state_gather_in_resilience_module(tmp_path):
+    """tools/resilience.py is hot-module scoped, and np.asarray of a
+    device-state attribute there (the shipped Snapshot.is_finite full
+    gather) flags — so the fix stays fixed."""
+    bad = _lint_src(tmp_path, "tools/resilience.py", """
+import numpy as np
+
+def is_finite(snap):
+    # the shipped hazard: full device->host gather per capture validation
+    return bool(np.all(np.isfinite(np.asarray(snap.X))))
+
+def fleet_finite(snap):
+    return np.asarray(snap.F_hist)
+""")
+    assert _rules_fired(bad) == ["DTL001"]
+    assert len(bad.findings) == 2
+    assert "gathers the full state" in bad.findings[0].message
+
+
+def test_dtl001_state_gather_quiet_on_host_conversions(tmp_path):
+    """The dtype= convention and non-state attributes stay quiet: host
+    bookkeeping in the hot modules is not a device sync."""
+    result = _lint_src(tmp_path, "tools/resilience.py", """
+import numpy as np
+
+def bookkeeping(snap, times):
+    a = np.asarray(times)                       # bare Name: host data
+    b = np.asarray(snap.sim_times, dtype=float) # dtype=: deliberate host
+    c = np.array(snap.lineage)                  # not a state attribute
+    return a, b, c
+""")
+    assert result.findings == []
+
+
+def test_dtl001_state_gather_scoped_to_hot_modules(tmp_path):
+    """The state-attribute heuristic is hot-module scoped: analysis/
+    plotting code reading solver.X to host is legitimate."""
+    result = _lint_src(tmp_path, "tools/post.py", """
+import numpy as np
+
+def to_host(solver):
+    return np.asarray(solver.X)
+""")
+    assert result.findings == []
+
+
 def test_dtl001_traced_concretization_any_module(tmp_path):
     bad = _lint_src(tmp_path, "anywhere.py", """
 import numpy as np

@@ -339,6 +339,202 @@ def test_transient_io_fault_retried(tmp_path):
     assert rec["counters"]["resilience/checkpoints_written"] >= 1
 
 
+# --------------------------------------- sharded + async checkpointing
+
+def test_sharded_sigterm_checkpoint_resume_roundtrip(tmp_path):
+    """The PR-4 SIGTERM acceptance, on the sharded format: preemption
+    writes a manifest-committed sharded checkpoint, and the resumed run
+    (format auto-detected) finishes bitwise-identical to an
+    uninterrupted reference."""
+    ckpt = tmp_path / "ckpt"
+    ref, _ = build_diffusion_solver(tmp_path, metrics=False)
+    ref.stop_iteration = 20
+    for _ in range(20):
+        ref.step(1e-3)
+
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    solver.stop_iteration = 20
+    injector = chaos_mod.ChaosInjector(sigterm_iteration=10)
+    summary = solver.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, checkpoint_format="sharded",
+        chaos=injector)
+    assert summary["stopped_by"] == "SIGTERM"
+    assert summary["checkpoint"]["format"] == "sharded"
+    from dedalus_tpu.tools import dcheckpoint as dc
+    assert dc.list_checkpoints(ckpt), "no sharded checkpoint on SIGTERM"
+
+    resumed, _ = build_diffusion_solver(tmp_path, metrics=False)
+    resumed.stop_iteration = 20
+    summary2 = resumed.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, checkpoint_format="sharded",
+        resume=True)
+    assert summary2["resumed_from"]
+    event = resumed.resilience.resume_event
+    assert event["format"] == "sharded"
+    assert event["iteration"] == 10
+    assert summary2["stopped_by"] == "completed"
+    assert resumed.iteration == 20
+    assert np.array_equal(np.asarray(resumed.X), np.asarray(ref.X))
+    assert resumed.sim_time == ref.sim_time
+    # the stall accounting and writer stats ride the summary block
+    ck = summary2["checkpoint"]
+    assert ck["format"] == "sharded" and ck["written"] >= 1
+    assert ck["stall_sec"] > 0.0
+
+
+def test_sharded_multistep_history_resumes_bitwise(tmp_path):
+    """Multistep (SBDF2) history arrays ride the sharded checkpoint: a
+    resume mid-ramp continues bitwise-identical to uninterrupted."""
+    ckpt = tmp_path / "ckpt"
+    ref, _ = build_diffusion_solver(tmp_path, scheme="SBDF2",
+                                    metrics=False)
+    for _ in range(20):
+        ref.step(1e-3)
+    solver, _ = build_diffusion_solver(tmp_path, scheme="SBDF2",
+                                       metrics=False)
+    solver.stop_iteration = 20
+    injector = chaos_mod.ChaosInjector(sigterm_iteration=9)
+    solver.evolve_resilient(dt=1e-3, checkpoint_dir=ckpt,
+                            checkpoint_format="sharded", chaos=injector)
+    resumed, _ = build_diffusion_solver(tmp_path, scheme="SBDF2",
+                                        metrics=False)
+    resumed.stop_iteration = 20
+    resumed.evolve_resilient(dt=1e-3, checkpoint_dir=ckpt,
+                             checkpoint_format="sharded", resume=True)
+    assert resumed.iteration == 20
+    assert np.array_equal(np.asarray(resumed.X), np.asarray(ref.X))
+
+
+def test_async_periodic_checkpoints_durable_and_corrupt_fallback(tmp_path):
+    """Async periodic sharded checkpoints: the loop's stall is submits
+    only, everything lands durably by loop exit, and a silently
+    corrupted newest checkpoint falls back to the previous one at
+    resume."""
+    ckpt = tmp_path / "ckpt"
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    solver.stop_iteration = 18
+    summary = solver.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, checkpoint_format="sharded",
+        checkpoint_async=True, checkpoint_iter=5)
+    ck = summary["checkpoint"]
+    assert ck["async"] is True
+    assert ck["errors"] == 0
+    assert ck["written"] >= 3      # periodic 5/10/15 + final 18
+    from dedalus_tpu.tools import dcheckpoint as dc
+    X18 = np.asarray(solver.X).copy()
+    # newest (iteration 18) silently corrupted -> quarantine + the
+    # retained previous checkpoint (iteration 15) used, steps replayed
+    newest = dc.list_checkpoints(ckpt)[-1]
+    chaos_mod.corrupt_shard(newest, mode="garbage")
+    resumed, _ = build_diffusion_solver(tmp_path, metrics=False)
+    resumed.stop_iteration = 18
+    summary2 = resumed.evolve_resilient(
+        dt=1e-3, checkpoint_dir=ckpt, checkpoint_format="sharded",
+        resume=True)
+    event = resumed.resilience.resume_event
+    assert len(event["fallbacks"]) == 1
+    assert event["iteration"] == 15
+    assert resumed.iteration == 18
+    assert np.array_equal(np.asarray(resumed.X), X18), \
+        "resume-after-corruption did not reproduce the reference run"
+
+
+def test_sharded_rejects_async_hdf5_and_dd(tmp_path):
+    """Config validation is explicit: async needs the sharded format."""
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    with pytest.raises(ValueError, match="sharded"):
+        res_mod.ResilientLoop(solver, dt=1e-3,
+                              checkpoint_format="hdf5",
+                              checkpoint_async=True,
+                              install_signal_handlers=False)
+    with pytest.raises(ValueError, match="hdf5"):
+        res_mod.ResilientLoop(solver, dt=1e-3, checkpoint_format="zip",
+                              install_signal_handlers=False)
+
+
+# --------------------------------------------------------- SDC sentinel
+
+def test_sdc_clean_run_replays_are_invisible(tmp_path):
+    """With the sentinel armed and no fault, every check agrees and the
+    trajectory is bitwise identical to a plain run — the re-executions
+    are genuinely side-effect-free."""
+    ref, _ = build_diffusion_solver(tmp_path, metrics=False)
+    for _ in range(30):
+        ref.step(1e-3)
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    solver.stop_iteration = 30
+    summary = solver.evolve_resilient(dt=1e-3, sdc_cadence=5)
+    assert summary["sdc_checks"] >= 5
+    assert summary["sdc_detected"] == 0
+    assert np.array_equal(np.asarray(solver.X), np.asarray(ref.X))
+
+
+def test_sdc_detects_flip_bit_and_recovers_bitwise(tmp_path):
+    """Acceptance: a chaos-flipped mantissa bit (finite, plausible,
+    invisible to the health probe) inside a checked window is detected
+    by the redundant re-execution; the loop rewinds to the anchor
+    WITHOUT a dt backoff and the finished state bit-matches the
+    fault-free reference. The flight recorder holds the postmortem."""
+    ref, _ = build_diffusion_solver(tmp_path, metrics=False)
+    for _ in range(30):
+        ref.step(1e-3)
+    solver, u = build_diffusion_solver(tmp_path)
+    solver.stop_iteration = 30
+    # cadence 5 checks the steps into iterations 5, 10, 15, ...; the
+    # flip fires after step 15 — inside the 14 -> 15 checked window
+    injector = chaos_mod.ChaosInjector(seed=3, flip_bit_iteration=15)
+    summary = solver.evolve_resilient(
+        dt=1e-3, sdc_cadence=5, snapshot_cadence=50,
+        retry_base_delay=0.0, chaos=injector)
+    assert [f["kind"] for f in injector.fired] == ["flip_bit"]
+    assert summary["sdc_detected"] == 1
+    assert summary["rewinds"] == 1
+    assert summary["dt_limit"] is None, "SDC recovery must not back off dt"
+    assert solver.iteration == 30
+    assert np.array_equal(np.asarray(solver.X), np.asarray(ref.X)), \
+        "post-SDC state does not bit-match the fault-free reference"
+    # lineage + counters + postmortem
+    assert "silent corruption" in summary["lineage"][0]["reason"]
+    rec = solver.flush_metrics()
+    assert rec["counters"]["resilience/sdc_detected"] == 1
+    assert rec["resilience"]["sdc_checks"] == summary["sdc_checks"]
+    pm_dirs = sorted((tmp_path / "pm").iterdir())
+    assert pm_dirs, "SDC detection left no flight recording"
+    from dedalus_tpu.tools.health import read_postmortem
+    record, _ = read_postmortem(pm_dirs[-1])
+    assert "silent corruption" in record["reason"]
+
+
+def test_sdc_mismatch_escalates_structured(tmp_path):
+    """With the retry budget exhausted the sentinel raises the
+    structured SilentCorruptionError (mismatch count + anchor)."""
+    from dedalus_tpu.tools.exceptions import SilentCorruptionError
+    solver, u = build_diffusion_solver(tmp_path)
+    solver.stop_iteration = 30
+    injector = chaos_mod.ChaosInjector(seed=3, flip_bit_iteration=15)
+    with pytest.raises(SilentCorruptionError) as excinfo:
+        solver.evolve_resilient(dt=1e-3, sdc_cadence=5, max_retries=0,
+                                retry_base_delay=0.0, chaos=injector)
+    err = excinfo.value
+    assert err.mismatched >= 1
+    assert err.anchor_iteration == 14
+    assert isinstance(err, SolverHealthError)   # recovery-machinery compat
+    assert err.postmortem_dir
+
+
+def test_sdc_flip_outside_checked_window_is_absorbed(tmp_path):
+    """Honesty check of the documented sampling semantics: a flip
+    landing in an UNchecked window is absorbed into the next anchor and
+    never detected — the sentinel is coverage-by-cadence, not a proof."""
+    solver, u = build_diffusion_solver(tmp_path, metrics=False)
+    solver.stop_iteration = 30
+    injector = chaos_mod.ChaosInjector(seed=3, flip_bit_iteration=12)
+    summary = solver.evolve_resilient(dt=1e-3, sdc_cadence=5,
+                                      chaos=injector)
+    assert [f["kind"] for f in injector.fired] == ["flip_bit"]
+    assert summary["sdc_detected"] == 0
+
+
 # ------------------------------------------------- load_state hardening
 
 def test_load_state_structured_errors_and_fallback(tmp_path):
