@@ -398,6 +398,30 @@ def _attach_serving(record):
             "age_s": round(time.time() - row["ts"], 1)
             if row.get("ts") else None,
         }
+    # the continuous-batching row (benchmarks/serving.py run_batching):
+    # batched vs single-executor requests/s under the same-spec
+    # closed-loop storm, same stale-stamping discipline
+    row = _recent_row(
+        lambda r: (r.get("config") == "diffusion64_batching"
+                   and r.get("requests_speedup") is not None))
+    if row is not None:
+        record["serving_batching"] = {
+            "clients": row.get("clients"),
+            "baseline_requests_per_sec":
+                row.get("baseline_requests_per_sec"),
+            "batched_requests_per_sec":
+                row.get("batched_requests_per_sec"),
+            "requests_speedup": row.get("requests_speedup"),
+            "meets_1p5x": row.get("meets_1p5x"),
+            "batches": row.get("batches"),
+            "late_joins": row.get("late_joins"),
+            "peak_batch_members": row.get("peak_batch_members"),
+            "backend": row.get("backend"),
+            "stale": True,
+            "measured_ts": row.get("ts"),
+            "age_s": round(time.time() - row["ts"], 1)
+            if row.get("ts") else None,
+        }
     # the overload row (benchmarks/serving.py run_overload): shed-rate +
     # bounded accepted-latency under a 2x storm, same stale-stamping
     row = _recent_row(

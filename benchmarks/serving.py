@@ -20,6 +20,12 @@ Three scenarios:
                         stay under the (queue_depth+3) x single-request
                         bound — load shedding, not unbounded queueing),
                         and zero daemon restarts.
+  diffusion64_batching  the continuous-batching multiplier: the same
+                        closed-loop same-spec storm against the single-
+                        executor baseline AND a `--batch` daemon whose
+                        micro-batches coalesce it — requests/s, p50/p95
+                        both modes, the speedup (>= 1.5x acceptance),
+                        and the batch occupancy stats.
 
 Methodology: one fresh daemon per problem with an EMPTY private
 assembly-cache directory, so the first request is a true cold
@@ -335,6 +341,131 @@ def run_overload(config="diffusion64_overload", queue_depth=1,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_batching(config="diffusion64_batching", clients=8, rounds=4,
+                 steps=400):
+    """Continuous-batching throughput: a CLOSED-LOOP storm of `clients`
+    concurrent same-spec workers (each re-submitting the moment its
+    previous request resolves, with per-worker ICs — the batched
+    operands) against (a) the single-executor baseline daemon and (b) a
+    `--batch` daemon whose micro-batches coalesce the storm. The queue
+    is deep enough that nothing sheds — this measures throughput and
+    accepted latency, not admission control (run_overload covers that).
+    Records requests/s and p50/p95 for both modes plus the multiplier,
+    and the batch daemon's occupancy stats (batches formed, late joins,
+    peak seats). Exits nonzero when batching is not at least 1.5x the
+    single-executor requests/s — the multiplier IS the feature."""
+    import threading
+
+    spec = {"problem": "diffusion", "params": {"size": 64}}
+    x = np.linspace(0, 2 * np.pi, 64, endpoint=False)
+    worker_ics = [{"u": ("g", np.sin((1 + i % 4) * x)),
+                   "a": ("g", 0.05 * (1 + i) * np.cos(x))}
+                  for i in range(clients)]
+
+    def storm(port):
+        lat, errors = [], []
+        lock = threading.Lock()
+
+        def one_worker(i):
+            wclient = ServiceClient(port=port, timeout=1200)
+            for _ in range(rounds):
+                t_req = time.perf_counter()
+                try:
+                    wclient.run(spec, ics=worker_ics[i], dt=1e-3,
+                                stop_iteration=steps)
+                    with lock:
+                        lat.append(time.perf_counter() - t_req)
+                except Exception as exc:
+                    with lock:
+                        errors.append(str(exc))
+        threads = [threading.Thread(target=one_worker, args=(i,),
+                                    daemon=True) for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1200)
+        wall = time.perf_counter() - t0
+        assert not any(t.is_alive() for t in threads), "storm worker hung"
+        lats = sorted(lat)
+        return {
+            "requests": len(lat),
+            "errors": len(errors),
+            "wall_sec": round(wall, 3),
+            "requests_per_sec": round(len(lat) / wall, 3) if wall else 0,
+            "p50_sec": round(lats[len(lats) // 2], 4) if lats else None,
+            "p95_sec": round(lats[min(int(len(lats) * 0.95),
+                                      len(lats) - 1)], 4)
+            if lats else None,
+        }
+
+    out = {}
+    for mode, extra in (("baseline", ()),
+                        ("batched", ("--batch",
+                                     "--batch-max", str(clients),
+                                     "--batch-window", "0.02"))):
+        workdir = tempfile.mkdtemp(prefix=f"dedalus_batching_{mode}_")
+        proc, client, sink, stderr = start_daemon(
+            workdir, "--queue-depth", str(2 * clients), *extra)
+        try:
+            # warm the pool (and, batched, the fleet programs) before
+            # the measured storm
+            for _ in range(2):
+                client.run(spec, ics=worker_ics[0], dt=1e-3,
+                           stop_iteration=steps)
+            # occupancy is recorded as a STORM-ONLY delta: the daemon's
+            # counters are cumulative and the two warmup requests formed
+            # their own one-member batches
+            pre = (client.stats()["serving"]["batching"]
+                   if mode == "batched" else {})
+            mark(f"{config}: {mode} storm ({clients} workers x {rounds} "
+                 f"rounds x {steps} steps)")
+            out[mode] = storm(client.port)
+            if mode == "batched":
+                post = client.stats()["serving"]["batching"]
+                out["batch_stats"] = {
+                    "batches": post["batches"] - pre["batches"],
+                    "members": post["members"] - pre["members"],
+                    "late_joins": post["late_joins"] - pre["late_joins"],
+                    "peak_members": post["peak_members"],
+                }
+            out[mode]["daemon_crashed"] = proc.poll() is not None
+            mark(f"{config}: {mode} {out[mode]['requests_per_sec']} "
+                 f"requests/s (p50 {out[mode]['p50_sec']}s, p95 "
+                 f"{out[mode]['p95_sec']}s, {out[mode]['errors']} errors)")
+        finally:
+            stop_daemon(proc, client, stderr)
+            shutil.rmtree(workdir, ignore_errors=True)
+    base_rps = out["baseline"]["requests_per_sec"] or 1e-9
+    speedup = round(out["batched"]["requests_per_sec"] / base_rps, 2)
+    batch_stats = out.get("batch_stats") or {}
+    row = {
+        "config": config,
+        "backend": os.environ.get("JAX_PLATFORMS", "cpu").split(",")[0],
+        "clients": clients,
+        "rounds": rounds,
+        "steps_per_request": steps,
+        "baseline_requests_per_sec": out["baseline"]["requests_per_sec"],
+        "baseline_p50_sec": out["baseline"]["p50_sec"],
+        "baseline_p95_sec": out["baseline"]["p95_sec"],
+        "batched_requests_per_sec": out["batched"]["requests_per_sec"],
+        "batched_p50_sec": out["batched"]["p50_sec"],
+        "batched_p95_sec": out["batched"]["p95_sec"],
+        "requests_speedup": speedup,
+        "errors": out["baseline"]["errors"] + out["batched"]["errors"],
+        "batches": batch_stats.get("batches"),
+        "late_joins": batch_stats.get("late_joins"),
+        "peak_batch_members": batch_stats.get("peak_members"),
+        "meets_1p5x": speedup >= 1.5
+        and not out["batched"]["daemon_crashed"],
+    }
+    mark(f"{config}: batching {row['batched_requests_per_sec']} vs "
+         f"baseline {row['baseline_requests_per_sec']} requests/s = "
+         f"{speedup}x ({row['batches']} batches, {row['late_joins']} "
+         f"late joins, peak {row['peak_batch_members']} seats)")
+    return row
+
+
 def diffusion_ics(size=64):
     x = np.linspace(0, 2 * np.pi, size, endpoint=False)
     return {"u": ("g", np.sin(3 * x)), "a": ("g", 0.1 * np.cos(x))}
@@ -390,6 +521,17 @@ def main():
         and overload["shed"] > 0 and overload["daemon_alive_after"])
     _append_result(overload)
     print(json.dumps(overload), flush=True)
+    # the continuous-batching multiplier: same-spec closed-loop storm,
+    # single-executor baseline vs `--batch` micro-batching
+    batching_row = run_batching(clients=4 if quick else 8,
+                                rounds=2 if quick else 4,
+                                steps=200 if quick else 400)
+    _append_result(batching_row)
+    print(json.dumps(batching_row), flush=True)
+    if not quick and not batching_row["meets_1p5x"]:
+        mark("FAIL: batched serving is not >= 1.5x single-executor "
+             "requests/s under the same-spec storm")
+        sys.exit(1)
     if not quick and not ok:
         mark("FAIL: RB warm pool-hit ttfs is not >= 10x faster than the "
              "cold fresh-process request (or results drifted)")

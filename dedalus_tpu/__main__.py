@@ -220,6 +220,13 @@ def report(args):
                     parts.append(f"deadline={serving['deadline_sec']}s")
                 if serving.get("request_id"):
                     parts.append(f"request={serving['request_id']}")
+                batch = serving.get("batch")
+                if isinstance(batch, dict):
+                    parts.append(
+                        f"batch={batch.get('id', '?')}"
+                        f"#{batch.get('seat', '?')}"
+                        + (" (late join)" if batch.get("late_join")
+                           else ""))
                 print(f"    serving: {', '.join(parts)}")
         elif kind == "health_postmortem":
             n_post += 1
@@ -262,6 +269,35 @@ def report(args):
                 if breaker.get("open"):
                     line += f", OPEN circuits: {breaker['open']}"
                 print(line)
+            batching = (record.get("serving") or {}).get("batching") or {}
+            if batching.get("enabled"):
+                # continuous-batching occupancy (service/batching.py):
+                # how full the micro-batches actually ran, and why
+                # members left them
+                det = batching.get("detached") or {}
+                det_txt = ", ".join(f"{v} {k}"
+                                    for k, v in sorted(det.items())) \
+                    or "none"
+                print(f"    batching: {batching.get('batches', 0)} "
+                      f"batches, {batching.get('members', 0)} members "
+                      f"({batching.get('late_joins', 0)} late joins), "
+                      f"peak {batching.get('peak_members', 0)}"
+                      f"/{batching.get('batch_max', '?')} seats, "
+                      f"{batching.get('blocks', 0)} blocks, "
+                      f"detached: {det_txt}")
+                for ev in batching.get("recent_batches") or []:
+                    det = ev.get("detached") or {}
+                    det_txt = ", ".join(
+                        f"{v} {k}" for k, v in sorted(det.items())) \
+                        or "none"
+                    print(f"      {ev.get('batch_id', '?')} "
+                          f"[{ev.get('spec', '?')}]: "
+                          f"{ev.get('members', 0)} members "
+                          f"({ev.get('late_joins', 0)} late), peak "
+                          f"{ev.get('peak_active', 0)} active, "
+                          f"{ev.get('blocks', 0)} blocks, {det_txt}"
+                          + (" [ABANDONED]" if ev.get("abandoned")
+                             else ""))
         elif kind == "watchdog_postmortem":
             n_post += 1
             stacks = record.get("stacks") or []
@@ -342,6 +378,18 @@ def report(args):
                     line += (f", restore-after-fault "
                              f"{record['restore_after_fault_sec']}s")
                 print(line)
+            # continuous-batching benchmark rows (benchmarks/serving.py
+            # run_batching): the requests/s multiplier in one line
+            if record.get("requests_speedup") is not None:
+                print(f"    batching: "
+                      f"{record.get('batched_requests_per_sec', '?')} "
+                      f"vs {record.get('baseline_requests_per_sec', '?')}"
+                      f" requests/s ({record['requests_speedup']}x, "
+                      f"{record.get('clients', '?')} clients, "
+                      f"{record.get('batches', '?')} batches, "
+                      f"{record.get('late_joins', '?')} late joins, "
+                      f"peak {record.get('peak_batch_members', '?')} "
+                      "seats)")
             # overload benchmark rows (benchmarks/serving.py storm): the
             # shed-rate and bounded-latency story in one line
             if record.get("shed_rate") is not None:

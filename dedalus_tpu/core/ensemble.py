@@ -70,6 +70,19 @@ rewinds, health_checks, reshards, checkpoints_written) plus an
 `ensemble` summary block (members / active / dropped / reshards /
 ensemble-steps-per-s) in every flushed record — `python -m dedalus_tpu
 report` renders it as its own column set.
+
+Serving (continuous batching, service/batching.py): a fleet can also be
+driven as a **micro-batch of independent served requests** — members
+attach (`attach_member`) and detach (`detach_member`) at block
+boundaries as value operands (never a retrace), each carries its own
+steps-remaining budget (`R`, carried through the scan so a finished
+member freezes mid-block without leaving the compiled program), a
+multistep member joining a running fleet replays its own order build-up
+with everyone else frozen (`ramp_members` — bit-identical to a solo
+run's ramp), per-member Hermitian-projection phases follow each
+member's OWN iteration count (`project_members`), and `step_fleet`
+dispatches steady blocks without the fleet-global cadence/ramp logic
+the serving driver owns.
 """
 
 import functools
@@ -95,6 +108,11 @@ logger = logging.getLogger(__name__)
 __all__ = ["EnsembleSolver", "FleetSnapshot"]
 
 MEMBER_AXIS = "batch"
+
+# default per-member steps-remaining budget: effectively unbounded (the
+# classic evolve/step_many drivers stop the whole fleet, so members never
+# exhaust it); the serving driver sets true per-request budgets
+UNBOUNDED_STEPS = 1 << 30
 
 
 def _repad(a, members, n_pad, pad_value=None):
@@ -230,12 +248,19 @@ class EnsembleSolver:
         X0 = solver.gather_fields()
         self.X = self._put(jnp.broadcast_to(X0, (self.n_pad, G, S)))
         self.sim_times = np.full(self.n_pad, float(solver.sim_time))
-        self.T = self._put(jnp.asarray(self.sim_times, dtype=self.rd))
+        self.T = self._put_host(self.sim_times, dtype=self.rd)
         self.dts = np.zeros(self.n_pad)
         self.DT = self._put(jnp.zeros(self.n_pad, dtype=self.rd))
         self.active_host = np.zeros(self.n_pad, dtype=bool)
         self.active_host[:self.members] = True
-        self._active_dev = self._put(jnp.asarray(self.active_host))
+        self._active_dev = self._put_host(self.active_host)
+        # per-member steps-remaining budget (host mirror + device value
+        # operand carried through the fleet scan): a member whose budget
+        # hits zero freezes mid-block — per-member stop without leaving
+        # the compiled program. Unbounded by default.
+        self.steps_left = np.full(self.n_pad, UNBOUNDED_STEPS,
+                                  dtype=np.int64)
+        self.R = self._put_host(self.steps_left, dtype=jnp.int32)
         if self._multistep:
             s = ts.steps
             zeros = jnp.zeros((self.n_pad, s, G, S),
@@ -326,6 +351,17 @@ class EnsembleSolver:
         if self.mesh is None:
             return jnp.asarray(arr)
         return jax.device_put(arr, NamedSharding(self.mesh, P(MEMBER_AXIS)))
+
+    def _put_host(self, arr, dtype=None):
+        """Place a HOST mirror (active mask, dts, clocks, step budgets)
+        on device BY COPY. `jnp.asarray` zero-copies aligned numpy
+        buffers on CPU, so placing a mirror without the copy aliases the
+        device operand to the very buffer later in-place mutations
+        (`active_host[m] = ...`, `sim_times += ...`) rewrite — which
+        retroactively changes the operand of dispatches still queued on
+        the async stream (observed: members silently freezing for the
+        tail of a batch when a detach flipped the aliased mask)."""
+        return self._put(jnp.array(arr, dtype=dtype))
 
     @property
     def layout(self):
@@ -432,13 +468,18 @@ class EnsembleSolver:
             return jnp.where(keep, a, b)
         return jax.tree.map(one, new, old)
 
-    def _fleet_multistep(self, n, M, L, X, T, DT, act, extras,
+    def _fleet_multistep(self, n, M, L, X, T, DT, act, R, extras,
                          Fh, MXh, LXh, a, b, c, aux):
         body_fn = self.timestepper.advance_body
-        af = act.astype(self.rd)
 
         def body(carry, _):
-            X, T, Fh, MXh, LXh = carry
+            X, T, R, Fh, MXh, LXh = carry
+            # per-step liveness: the active mask AND a positive steps-
+            # remaining budget — a member that finishes inside the block
+            # freezes for the rest of the scan (computed-then-discarded,
+            # same as a dropped member)
+            live = act & (R > 0)
+            af = live.astype(self.rd)
             with jax.named_scope("dedalus/ensemble/step"):
                 Xn, Fhn, MXhn, LXhn = jax.vmap(
                     body_fn,
@@ -446,28 +487,30 @@ class EnsembleSolver:
                              None, None, None, None))(
                     M, L, X, T, extras, Fh, MXh, LXh, a, b, c, aux)
             Xn, Fhn, MXhn, LXhn = self._freeze(
-                (Xn, Fhn, MXhn, LXhn), (X, Fh, MXh, LXh), act)
-            return (Xn, T + DT * af, Fhn, MXhn, LXhn), None
+                (Xn, Fhn, MXhn, LXhn), (X, Fh, MXh, LXh), live)
+            return (Xn, T + DT * af, R - live, Fhn, MXhn, LXhn), None
 
-        carry, _ = jax.lax.scan(body, (X, T, Fh, MXh, LXh), None, length=n)
+        carry, _ = jax.lax.scan(body, (X, T, R, Fh, MXh, LXh), None,
+                                length=n)
         return carry
 
-    def _fleet_rk(self, n, M, L, X, T, DT, act, extras, auxs):
+    def _fleet_rk(self, n, M, L, X, T, DT, act, R, extras, auxs):
         body_fn = self.timestepper.step_body
         aux_ax = 0 if self.per_member_dt else None
-        af = act.astype(self.rd)
 
         def body(carry, _):
-            X, T = carry
+            X, T, R = carry
+            live = act & (R > 0)
+            af = live.astype(self.rd)
             with jax.named_scope("dedalus/ensemble/step"):
                 Xn = jax.vmap(
                     body_fn,
                     in_axes=(None, None, 0, 0, 0, 0, aux_ax))(
                     M, L, X, T, DT, extras, auxs)
-            Xn = self._freeze(Xn, X, act)
-            return (Xn, T + DT * af), None
+            Xn = self._freeze(Xn, X, live)
+            return (Xn, T + DT * af, R - live), None
 
-        carry, _ = jax.lax.scan(body, (X, T), None, length=n)
+        carry, _ = jax.lax.scan(body, (X, T, R), None, length=n)
         return carry
 
     def _program(self, n, args, batched_flags):
@@ -483,10 +526,7 @@ class EnsembleSolver:
                 raw, f"ensemble/fleet_step[{n}]", args, batched_flags)
         return prog
 
-    def _project_fleet(self):
-        """Vmapped Hermitian/valid-mode re-projection of active members
-        (mirrors solver.enforce_hermitian_symmetry; inactive members are
-        frozen through it)."""
+    def _ensure_project_prog(self):
         if self._project_prog is None:
             self.solver._ensure_project()
             proj = self.solver._project_body
@@ -498,7 +538,13 @@ class EnsembleSolver:
             self._project_prog = self._wrap(
                 raw, "ensemble/project", (self.X, self._active_dev),
                 (True, True))
-        self.X = self._project_prog(self.X, self._active_dev)
+        return self._project_prog
+
+    def _project_fleet(self):
+        """Vmapped Hermitian/valid-mode re-projection of active members
+        (mirrors solver.enforce_hermitian_symmetry; inactive members are
+        frozen through it)."""
+        self.X = self._ensure_project_prog()(self.X, self._active_dev)
 
     def _probe(self, X=None):
         """Per-member health reduction: (nonfinite count, max |coeff|) —
@@ -577,32 +623,48 @@ class EnsembleSolver:
         live = self.active_host | (self.dts == 0.0)
         if not np.all(self.dts[live] == target[live]):
             self.dts = target
-            self.DT = self._put(jnp.asarray(target, dtype=self.rd))
+            self.DT = self._put_host(target, dtype=self.rd)
 
-    def _dispatch(self, n, a=None, b=None, c=None):
+    def _dispatch(self, n, a=None, b=None, c=None, act_dev=None,
+                  act_host=None):
+        """One scanned fleet dispatch of n steps. `act_dev`/`act_host`
+        override the activity mask for this dispatch only (the cohort-
+        ramp path freezes everyone but the ramping members); both must
+        describe the same membership. Returns the per-member steps
+        actually taken (host array, padding rows included)."""
         solver = self.solver
+        if act_dev is None:
+            act_dev = self._active_dev
+        if act_host is None:
+            act_host = self.active_host
         if self._multistep:
             args = (solver.M_mat, solver.L_mat, self.X, self.T, self.DT,
-                    self._active_dev, self._extras, self.F_hist,
+                    act_dev, self.R, self._extras, self.F_hist,
                     self.MX_hist, self.LX_hist, a, b, c, self._lhs_aux)
             flags = (False, False, True, True, True, True, True, True,
-                     True, True, False, False, False, False)
+                     True, True, True, False, False, False, False)
             prog = self._program(n, args, flags)
-            self.X, self.T, self.F_hist, self.MX_hist, self.LX_hist = \
-                prog(*args)
+            self.X, self.T, self.R, self.F_hist, self.MX_hist, \
+                self.LX_hist = prog(*args)
         else:
             args = (solver.M_mat, solver.L_mat, self.X, self.T, self.DT,
-                    self._active_dev, self._extras, self._lhs_aux)
-            flags = (False, False, True, True, True, True, True,
+                    act_dev, self.R, self._extras, self._lhs_aux)
+            flags = (False, False, True, True, True, True, True, True,
                      self.per_member_dt)
             prog = self._program(n, args, flags)
-            self.X, self.T = prog(*args)
+            self.X, self.T, self.R = prog(*args)
         self.iteration += n
-        self.sim_times += n * self.dts * self.active_host
+        # host mirror of the in-scan liveness rule: an active member
+        # takes min(n, budget) steps, everyone else none
+        taken = np.where(act_host,
+                         np.minimum(n, np.maximum(self.steps_left, 0)), 0)
+        self.steps_left = self.steps_left - taken
+        self.sim_times += taken * self.dts
         self.metrics.inc("ensemble/fleet_steps", n)
-        member_steps = n * int(self.active_host[:self.members].sum())
+        member_steps = int(taken[:self.members].sum())
         self.metrics.inc("ensemble/member_steps", member_steps)
         self.metrics.observe_steps(member_steps)
+        return taken
 
     def _ms_single(self, dt):
         """One fleet multistep step with the ramp's order build-up
@@ -692,7 +754,7 @@ class EnsembleSolver:
                                             dts[0] if len(dts) else 0.0)])
         if not np.array_equal(full, self.dts):
             self.dts = full
-            self.DT = self._put(jnp.asarray(full, dtype=self.rd))
+            self.DT = self._put_host(full, dtype=self.rd)
 
     def _end_warmup(self):
         """Warmup boundary: compile-bearing first dispatches stay out of
@@ -702,6 +764,186 @@ class EnsembleSolver:
         jax.block_until_ready(self.X)
         self.metrics.reset_loop()
         retrace_mod.sentinel.arm()
+
+    # --------------------------------------- serving attach/detach/stepping
+    #
+    # The continuous-batching driver (service/batching.py) treats the
+    # fleet as seats: requests attach and detach at block boundaries,
+    # each with its own steps budget and projection phase. Everything
+    # here is a VALUE-operand mutation of the already-compiled fleet
+    # programs — zero post-warmup retraces across join/detach is the
+    # serving acceptance bar.
+
+    def _seat_mask(self, ms):
+        mask = np.zeros(self.n_pad, dtype=bool)
+        for m in np.atleast_1d(np.asarray(ms, dtype=int)):
+            if not 0 <= m < self.members:
+                raise IndexError(
+                    f"member {m} out of range [0, {self.members})")
+            mask[m] = True
+        return mask
+
+    def _masked_write(self, arr, mask_dev, row):
+        """Seat write as a value-operand `where` (an `.at[m]` update
+        would bake the seat index into the compiled scatter — one XLA
+        program per seat; the mask form is one program per array
+        shape)."""
+        keep = mask_dev.reshape((-1,) + (1,) * (arr.ndim - 1))
+        return jnp.where(keep, jnp.asarray(row, dtype=arr.dtype)[None],
+                         arr)
+
+    def attach_member(self, m, X_row, extras_rows=None, sim_time=0.0,
+                      steps=None):
+        """Seat a new member at index `m` (a serving join): install its
+        state (and, when given, per-member RHS extra operand) rows, zero
+        its multistep history, reset its clock/retry accounting, set its
+        steps-remaining budget, and activate it. Multistep members
+        seated into a running fleet still need their order build-up —
+        call `ramp_members([m])` before steady stepping."""
+        m = int(m)
+        mask = self._seat_mask([m])
+        if self.active_host[m]:
+            raise ValueError(f"seat {m} is already active")
+        mask_dev = self._put(jnp.asarray(mask))
+        self.X = self._masked_write(self.X, mask_dev, X_row)
+        if extras_rows is not None:
+            if len(extras_rows) != len(self._extras):
+                raise ValueError(
+                    f"expected {len(self._extras)} extra operand row(s), "
+                    f"got {len(extras_rows)}")
+            self._extras = [self._masked_write(e, mask_dev, row)
+                            for e, row in zip(self._extras, extras_rows)]
+        if self._multistep:
+            zeros = jnp.zeros((self.timestepper.steps,)
+                              + tuple(self.solver.pencil_shape),
+                              dtype=self.solver.pencil_dtype)
+            self.F_hist = self._masked_write(self.F_hist, mask_dev, zeros)
+            self.MX_hist = self._masked_write(self.MX_hist, mask_dev, zeros)
+            self.LX_hist = self._masked_write(self.LX_hist, mask_dev, zeros)
+        self.sim_times[m] = float(sim_time)
+        # the member's device clock is seat-written (NOT rebuilt from the
+        # host mirror: running members' device clocks are per-step
+        # accumulations whose bits the per-dispatch host mirror does not
+        # reproduce — clobbering them would perturb t-dependent RHSs)
+        self.T = self._masked_write(
+            self.T, mask_dev, jnp.asarray(float(sim_time), dtype=self.rd))
+        self.steps_left[m] = int(steps) if steps is not None \
+            else UNBOUNDED_STEPS
+        self.R = self._put_host(self.steps_left, dtype=jnp.int32)
+        self._retries[m] = 0
+        self.active_host[m] = True
+        self._active_dev = self._put_host(self.active_host)
+        return m
+
+    def detach_member(self, m):
+        """Release seat `m` (completion, deadline, divergence, or a gone
+        client): mask it out and zero its budget. Its row stays frozen —
+        extract results BEFORE detaching."""
+        m = int(m)
+        self._seat_mask([m])   # range check
+        self.active_host[m] = False
+        self.steps_left[m] = 0
+        self._active_dev = self._put_host(self.active_host)
+        self.R = self._put_host(self.steps_left, dtype=jnp.int32)
+
+    def set_fleet_dt(self, dt):
+        """Serving: one uniform dt for every seat, unconditionally (the
+        step-path `_set_common_dt` preserves per-member rewind backoffs
+        a serving fleet never carries, and skips the update entirely
+        when no seat is live — wrong for a fleet being re-armed between
+        batches)."""
+        dt = float(dt)
+        if not np.isfinite(dt) or dt <= 0:
+            raise ValueError(f"invalid fleet dt {dt!r}")
+        self.dts = np.full(self.n_pad, dt)
+        self.DT = self._put_host(self.dts, dtype=self.rd)
+
+    def project_members(self, ms):
+        """Masked Hermitian/valid-mode re-projection of a member subset:
+        under serving, each member's projection cadence follows its OWN
+        iteration count, not the fleet's (bit-identity with a solo run
+        requires projecting exactly where the solo loop would). Same
+        compiled program as the fleet-wide projection — the mask is a
+        value operand."""
+        mask = self._seat_mask(ms) & self.active_host
+        if not mask.any():
+            return
+        self.X = self._ensure_project_prog()(
+            self.X, self._put(jnp.asarray(mask)))
+
+    def ramp_members(self, ms, project=False):
+        """Multistep order build-up for newly attached members: `steps`
+        single fleet dispatches with every OTHER member frozen, each
+        using the ramp-order coefficients a fresh solo solver would use
+        at that iteration — a member joining a running fleet bit-matches
+        its own solo run. Requires the (uniform) fleet dt to be set.
+        `project=True` re-projects the ramping cohort before each ramp
+        step (solo projects on every iteration of the ramp window
+        whenever a cadence is enabled). No-op for RK schemes. Returns
+        the number of ramp dispatches."""
+        if not self._multistep:
+            return 0
+        ts = self.timestepper
+        s = ts.steps
+        mask = self._seat_mask(ms) & self.active_host
+        if not mask.any():
+            return 0
+        dts = self.dts[mask]
+        dt = float(dts[0])
+        if not np.all(dts == dt) or dt <= 0 or not np.isfinite(dt):
+            raise ValueError(
+                f"ramp_members requires one positive uniform dt for the "
+                f"cohort, got {sorted(set(dts.tolist()))}")
+        mask_dev = self._put(jnp.asarray(mask))
+        for k in range(1, s + 1):
+            if project:
+                self.project_members(np.flatnonzero(mask))
+            order = min(k, s)
+            a, b, c = ts.compute_coefficients([dt] * order, order)
+            a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+            b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+            c = np.concatenate([c, np.zeros(s - len(c))])
+            self._ensure_factor_ms(a[0], b[0])
+            self._dispatch(1, jnp.asarray(a, dtype=self.rd),
+                           jnp.asarray(b, dtype=self.rd),
+                           jnp.asarray(c, dtype=self.rd),
+                           act_dev=mask_dev, act_host=mask)
+        return s
+
+    def step_fleet(self, n):
+        """Serving steady dispatch: advance every active member by up to
+        `n` steps, honoring each member's steps-remaining budget (a
+        finished member freezes mid-scan — per-member stop without
+        leaving the compiled program). Unlike `step_many` this never
+        applies the fleet-global projection cadence or the multistep
+        ramp — the serving driver owns per-member projection phases
+        (`project_members`) and cohort ramps (`ramp_members`). Returns
+        the per-member steps actually taken."""
+        n = int(n)
+        if n <= 0:
+            return np.zeros(self.n_pad, dtype=np.int64)
+        if self._lost_devices:
+            self._handle_device_loss()
+        ts = self.timestepper
+        dt = float(self.dts[0])
+        if not np.isfinite(dt) or dt <= 0:
+            raise ValueError(f"invalid fleet dt {dt!r}")
+        if self._multistep:
+            s = ts.steps
+            a, b, c = ts.compute_coefficients([dt] * s, s)
+            a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+            b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+            c = np.concatenate([c, np.zeros(s - len(c))])
+            self._ensure_factor_ms(a[0], b[0])
+            taken = self._dispatch(n, jnp.asarray(a, dtype=self.rd),
+                                   jnp.asarray(b, dtype=self.rd),
+                                   jnp.asarray(c, dtype=self.rd))
+        else:
+            self._ensure_factor_rk(dt)
+            taken = self._dispatch(n)
+        if not self._warmed and self.iteration >= self.warmup_iterations:
+            self._end_warmup()
+        return taken
 
     # ------------------------------------------------- health and recovery
 
@@ -789,9 +1031,9 @@ class EnsembleSolver:
             mask = np.zeros(self.n_pad, dtype=bool)
             mask[ms] = True
             self._restore_members(mask, snap)
-        self._active_dev = self._put(jnp.asarray(self.active_host))
+        self._active_dev = self._put_host(self.active_host)
         if self.per_member_dt:
-            self.DT = self._put(jnp.asarray(self.dts, dtype=self.rd))
+            self.DT = self._put_host(self.dts, dtype=self.rd)
             self._lhs_key = None   # refactor with the backed-off dts
 
     def snapshot(self):
@@ -1108,10 +1350,12 @@ class EnsembleSolver:
                         for e in host_extras]
         self.sim_times = repad(self.sim_times)
         self.dts = repad(self.dts)
-        self.DT = self._put(jnp.asarray(self.dts, dtype=self.rd))
+        self.DT = self._put_host(self.dts, dtype=self.rd)
         self.active_host = repad(self.active_host, pad_value=False)
         self._retries = repad(self._retries, pad_value=0)
-        self._active_dev = self._put(jnp.asarray(self.active_host))
+        self._active_dev = self._put_host(self.active_host)
+        self.steps_left = repad(self.steps_left, pad_value=0)
+        self.R = self._put_host(self.steps_left, dtype=jnp.int32)
         # the compiled fleet programs are layout-specific: rebuild (fresh
         # wrappers trace once each — a compile, not a retrace)
         self._programs = {}
@@ -1257,12 +1501,12 @@ class EnsembleSolver:
         self.iteration = int(meta["iteration"])
         self.sim_times = repad(np.asarray(meta["sim_times"], dtype=float))
         self.dts = repad(np.asarray(meta["dts"], dtype=float))
-        self.DT = self._put(jnp.asarray(self.dts, dtype=self.rd))
+        self.DT = self._put_host(self.dts, dtype=self.rd)
         self.active_host = repad(
             np.asarray(meta["active"], dtype=bool), pad_value=False)
         self._retries = repad(
             np.asarray(meta["retries"], dtype=int), pad_value=0)
-        self._active_dev = self._put(jnp.asarray(self.active_host))
+        self._active_dev = self._put_host(self.active_host)
         self._lhs_key = None
         self._lhs_aux = None
         self.ring = []

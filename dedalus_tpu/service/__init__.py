@@ -15,6 +15,9 @@ Modules:
                 jittered retries, idempotent request ids)
   faults.py   — request-path fault tolerance: per-spec circuit breaker,
                 idempotent result cache, hung-dispatch watchdog
+  batching.py — continuous batching: concurrent same-spec requests
+                coalesced into one vmapped ensemble micro-batch with
+                member-level fault isolation (`serve --batch`)
 
 See docs/serving.md for the protocol reference, the failure-modes
 runbook, and the operations guide.

@@ -1,0 +1,1070 @@
+"""
+Fault-isolated continuous batching: coalesce concurrent same-spec run
+requests into ONE EnsembleSolver micro-batch.
+
+The daemon's single executor and the ensemble fleet (core/ensemble.py)
+finally meet: requests whose specs canonicalize to the same pool key
+(members differ only in ICs / parameter fields / run length — all
+batched operands) are seated as members of one vmapped fleet, advanced
+by ONE compiled block dispatch, and streamed per-member ack / progress /
+telemetry / result frames. This is what LLM inference servers do with
+token streams, applied to PDE solves — the largest served-throughput
+multiplier available when traffic repeats a spec shape.
+
+The robustness contract is **blast-radius zero**, riding the per-member
+machinery the fleet already has:
+
+  * late arrivals join at the next block boundary (`attach_member` —
+    membership is a value operand, zero post-warmup retraces; multistep
+    joiners replay their own order build-up via `ramp_members` with the
+    rest of the batch frozen, so a late join is bit-identical to a solo
+    run);
+  * a member hitting its per-request deadline stops gracefully at the
+    boundary — durable per-member checkpoint when the request configured
+    one, result frame `stopped_by: "deadline-exceeded"` — while the
+    batch keeps stepping;
+  * a diverging member (per-member NaN/growth probe each boundary) gets
+    a structured `health` error and detaches; survivors never see its
+    bits (vmap guarantees no cross-member reduction, and the freeze mask
+    discards its lanes);
+  * a dropped client detaches (ON_CLIENT_DROP=abort) or runs to
+    completion with its result cached for idempotent replay (=complete);
+  * the watchdog treats a wedged batch like a wedged solo run — the
+    batch is abandoned, the pool entry (and its fleet) quarantined, and
+    every SURVIVING member's request is REQUEUED for the replacement
+    executor to re-run (idempotent ids make the replay safe);
+  * admission control, per-spec circuit breakers, and idempotent replay
+    all run per member at seat time, exactly as the solo path runs them
+    at queue pop.
+
+Bit-identity is the acceptance bar: every surviving member's served
+result is bit-identical to a solo served run of the same request, under
+every injected fault (tests/test_service_batching.py). The guarantee is
+COMPOSITION INVARIANCE: a solo request on a batching daemon runs as a
+batch of one through the SAME compiled fleet program, vmap lanes never
+mix members, and membership/budgets are value operands — so a member's
+trajectory cannot depend on who else rides the batch. Three mechanisms
+make it exact rather than approximate: the per-member steps-remaining
+operand (a member stops after exactly its requested number of steps,
+mid-block, without leaving the compiled program), the multistep cohort
+ramp (a joiner replays its own order build-up with the batch frozen),
+and per-member Hermitian-projection phases — each member is re-projected
+exactly where ITS OWN iteration count says a solo loop would, which
+forces single-step dispatches around projection windows (block sizes
+stay in {block, 1}, so exactly two compiled fleet programs exist).
+Against a DIRECT in-process solve the diffusion-class problems are also
+bit-exact; 2-D problems can differ at the ulp level because the vmapped
+fleet program and the solo step program are different XLA executables
+with different FMA contractions (~1e-12 over tens of steps on RB).
+
+Scope: a request is batchable when it has a `stop_iteration` (not
+`stop_sim_time` — fixed-dt step counts are exact; sim-time stops are
+float-boundary-dependent), no `resume`, and at most the batch-safe
+chaos keys. Everything else falls through to the solo executor path
+unchanged. A batch shares one dt; a same-spec request with a different
+dt waits for the next batch. Periodic mid-run checkpoints are a solo
+feature — batched members write their durable checkpoint at graceful
+stops (completion, deadline, drain) only.
+"""
+
+import collections
+import logging
+import threading
+import time
+
+import numpy as np
+
+from . import faults, protocol
+from ..tools.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BatchContext", "BatchDispatcher"]
+
+# chaos keys a batched member may carry (aimed at ITSELF: `nan_field` +
+# `nan_iteration` poison the member's own slice at ITS iteration N;
+# `hang_*` stall the boundary — the watchdog drill). Anything else is a
+# solo-only fault and routes the request to the solo executor path.
+BATCH_CHAOS_KEYS = frozenset({"seed", "nan_field", "nan_iteration",
+                              "hang_iteration", "hang_sec"})
+
+
+class BatchContext:
+    """The watchdog-visible context of one running micro-batch (the
+    batch-shaped sibling of faults.RunContext). `last_progress` is
+    stamped at every block boundary after the per-member health probe's
+    device sync — a wedged fleet dispatch blocks that sync, the stamp
+    goes stale, and the watchdog fires. `loop` is self: the server's
+    drain path calls `ctx.loop.request_stop(why)` on whatever run is
+    active, and a batch honors it at the next boundary for every
+    member."""
+
+    is_batch = True
+
+    __slots__ = ("request_id", "digest", "abandoned", "last_progress",
+                 "started_ts", "stop_why", "seats", "client_gone",
+                 "pending_item", "seated", "late", "blocks", "peak",
+                 "detached")
+
+    def __init__(self, batch_id, digest):
+        self.request_id = batch_id
+        self.digest = digest
+        self.abandoned = threading.Event()
+        self.last_progress = time.monotonic()
+        self.started_ts = time.monotonic()
+        self.stop_why = None
+        self.seats = {}            # seat index -> _Seat
+        self.client_gone = False   # solo-path compat (never all-gone)
+        # the anchor item while the batch-level build runs (the watchdog
+        # must cover a hung build/compile, same as solo — a fire in that
+        # window answers THIS client instead of requeuing seats)
+        self.pending_item = None
+        # occupancy bookkeeping (read by run_batch's batch event)
+        self.seated = 0
+        self.late = 0
+        self.blocks = 0
+        self.peak = 0
+        self.detached = collections.Counter()
+
+    @property
+    def loop(self):
+        return self
+
+    def request_stop(self, why="requested"):
+        if self.stop_why is None:
+            self.stop_why = str(why)
+
+
+class _Seat:
+    """One served request riding the batch."""
+
+    __slots__ = ("item", "header", "conn", "wfile", "request_id",
+                 "client_id", "seat", "params", "deadline_mono", "probe",
+                 "queue_sec", "t_dispatch", "steps_total", "steps_done",
+                 "progress_next", "ttfs", "client_gone", "active",
+                 "released", "chaos", "chaos_fired", "late", "verdict",
+                 "build_sec", "joined_iteration")
+
+    def __init__(self, item, seat, request_id, params, verdict, build_sec,
+                 late, joined_iteration):
+        self.item = item
+        self.header = item["header"]
+        self.conn = item["conn"]
+        self.wfile = item["wfile"]
+        self.request_id = request_id
+        self.client_id = self.header.get("id")
+        self.seat = seat
+        self.params = params
+        self.deadline_mono = item.get("deadline_mono")
+        self.probe = bool(item.get("probe"))
+        self.t_dispatch = time.perf_counter()
+        self.queue_sec = self.t_dispatch - item["t_accept"]
+        self.steps_total = int(params["stop_iteration"])
+        self.steps_done = 0
+        self.progress_next = params["progress_every"] or 0
+        self.ttfs = None
+        self.client_gone = False
+        self.active = True
+        self.released = False
+        self.chaos = self.header.get("chaos") or None
+        self.chaos_fired = set()
+        self.late = late
+        self.verdict = verdict
+        self.build_sec = build_sec
+        self.joined_iteration = joined_iteration
+
+
+class BatchDispatcher:
+    """
+    The continuous micro-batch scheduler. Owned by the SolverService and
+    driven ON the executor thread (JAX dispatch stays single-threaded);
+    only `on_watchdog` and `stats` run on other threads.
+
+    Knobs ([service] section; None pulls the config default):
+      batch_max     BATCH_MAX_MEMBERS  seats per fleet (default 8)
+      batch_window  BATCH_WINDOW_SEC   coalescing wait after the first
+                                       member seats (default 0.05 s;
+                                       boundary joins make long windows
+                                       unnecessary)
+      batch_block   BATCH_BLOCK_ITERS  steady dispatch block (default 8)
+    """
+
+    def __init__(self, service, batch_max=None, batch_window=None,
+                 batch_block=None):
+        self.service = service
+        self.batch_max = max(int(
+            batch_max if batch_max is not None
+            else cfg_get("service", "BATCH_MAX_MEMBERS", "8")), 1)
+        self.batch_window = float(
+            batch_window if batch_window is not None
+            else cfg_get("service", "BATCH_WINDOW_SEC", "0.05"))
+        self.batch_block = max(int(
+            batch_block if batch_block is not None
+            else cfg_get("service", "BATCH_BLOCK_ITERS", "8")), 1)
+        self._batch_seq = 0
+        self._lock = threading.Lock()     # stats vs executor mutation
+        self.batches = 0
+        self.members_seated = 0
+        self.late_joins = 0
+        self.blocks = 0
+        self.detached = collections.Counter()
+        self.peak_members = 0
+        self.batch_events = collections.deque(maxlen=8)
+
+    # ------------------------------------------------------------ routing
+
+    @staticmethod
+    def batchable(header):
+        """Whether a run request may ride a micro-batch (solo otherwise):
+        iteration-bounded, no resume, at most batch-safe chaos keys."""
+        if header.get("resume"):
+            return False
+        if header.get("stop_iteration") is None \
+                or header.get("stop_sim_time") is not None:
+            return False
+        chaos = header.get("chaos")
+        if chaos is not None and (not isinstance(chaos, dict)
+                                  or set(chaos) - BATCH_CHAOS_KEYS):
+            return False
+        return True
+
+    def _matches(self, item, digest, dt):
+        """Whether a queued item can join the running batch: same spec
+        digest, same dt, batchable."""
+        header = item.get("header") or {}
+        if item.get("force_solo") or not self.batchable(header):
+            return False
+        if header.get("dt") != dt:
+            return False
+        return self.service._spec_digest(header) == digest
+
+    # ------------------------------------------------------- fleet cache
+
+    def _fleet_for(self, entry):
+        """The (cached) EnsembleSolver riding one pool entry, or None
+        when the template cannot fleet (unsupported scheme, dd runner) —
+        the verdict is cached so the fallback is decided once. The fleet
+        dies with its pool entry (eviction / watchdog quarantine), which
+        is exactly the lifetime its compiled programs are valid for."""
+        fleet = entry.fleet
+        if fleet is False:
+            return None
+        if fleet is None:
+            from ..core.ensemble import EnsembleSolver
+            try:
+                fleet = EnsembleSolver(entry.solver, self.batch_max,
+                                       mesh=None, policy="drop")
+            except Exception as exc:
+                logger.warning(
+                    f"batching: spec {protocol.spec_name(entry.spec)} "
+                    f"cannot fleet ({exc}); serving it solo")
+                entry.fleet = False
+                return None
+            for m in range(fleet.members):
+                fleet.detach_member(m)
+            entry.fleet = fleet
+        return fleet
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self):
+        with self._lock:
+            return {
+                "enabled": True,
+                "batch_max": self.batch_max,
+                "block_iters": self.batch_block,
+                "batches": self.batches,
+                "members": self.members_seated,
+                "late_joins": self.late_joins,
+                "blocks": self.blocks,
+                "peak_members": self.peak_members,
+                "detached": dict(self.detached),
+                "recent_batches": list(self.batch_events),
+            }
+
+    # ------------------------------------------------------ the dispatch
+
+    def run_batch(self, first_item):
+        """Form and drive one micro-batch starting from `first_item`
+        (already popped by the executor). Returns the list of queue
+        items popped at boundaries that could NOT join (different spec /
+        dt / not batchable) — the executor handles them next, in order.
+        Raises faults.AbandonedRun when the watchdog declared this batch
+        dead (the surviving members were already requeued by the
+        fire)."""
+        svc = self.service
+        deferred = []
+        with self._lock:
+            self._batch_seq += 1
+            batch_id = f"batch-{self._batch_seq}"
+        header = first_item["header"]
+        digest = svc._spec_digest(header)
+        dt = header.get("dt")
+        try:
+            spec = protocol.normalize_spec(header.get("spec"))
+        except protocol.SpecError as exc:
+            svc._count_error()
+            svc._send_error(first_item["wfile"], "bad-spec", str(exc))
+            self._close(first_item)
+            return deferred
+        ctx = BatchContext(batch_id, digest)
+        # the anchor runs the SAME pre-build gauntlet as the solo pop
+        # (replay re-check, params validation, breaker re-admit,
+        # queued-deadline) BEFORE any solver work — an open circuit must
+        # fast-fail without re-running an expensive failing build
+        admitted = self._admit_member(ctx, first_item)
+        if admitted is None:
+            return deferred
+        # registered BEFORE the build so the watchdog also covers a hung
+        # build/compile, exactly like the solo path
+        ctx.pending_item = first_item
+        with svc._active_lock:
+            svc._active_run = ctx
+        t0 = time.perf_counter()
+        try:
+            # RSS watermark first, like the solo pop: a fleet build is
+            # the largest allocation the request path makes
+            svc._shed_memory()
+            try:
+                entry, verdict, build_sec = svc.pool.acquire(spec)
+            except protocol.SpecError as exc:
+                svc._count_error()
+                svc._send_error(first_item["wfile"], "bad-spec", str(exc))
+                if first_item.get("probe"):
+                    svc.breaker.abandon_probe(digest)
+                self._close(first_item)
+                return deferred
+            except Exception as exc:
+                if ctx.abandoned.is_set():
+                    raise faults.AbandonedRun(ctx.request_id)
+                svc._count_error()
+                logger.exception(f"batching: build for {batch_id} failed")
+                svc.breaker.record_failure(digest)
+                svc._send_error(first_item["wfile"], "build-failed",
+                                f"{type(exc).__name__}: {exc}")
+                self._close(first_item)
+                return deferred
+            if ctx.abandoned.is_set():
+                # the watchdog fired during OUR build and already
+                # answered the anchor client
+                raise faults.AbandonedRun(ctx.request_id)
+            fleet = self._fleet_for(entry)
+            if fleet is None:
+                # back to the executor as deferred work — which holds an
+                # admission reservation, so the anchor's (consumed at
+                # the worker's queue pop) must be re-taken or the
+                # counter drifts negative and admission over-admits
+                with svc._counters_lock:
+                    svc._queued_runs += 1
+                first_item["force_solo"] = True
+                deferred.append(first_item)
+                return deferred
+            self._drive(ctx, entry, fleet, spec, digest, dt, first_item,
+                        admitted, verdict, build_sec, deferred)
+        except faults.AbandonedRun:
+            # the watchdog already requeued the surviving members (or
+            # answered the pending anchor) and quarantined the entry;
+            # the deferred items still hold their admission reservations
+            # — hand them straight back to the queue before unwinding
+            for item in deferred:
+                svc._queue.put(item)
+            deferred = []
+            raise
+        except Exception as exc:
+            # a batch-level blowup must not drop member connections
+            # silently: every still-seated member gets a structured
+            # `internal` reply, and the entry is discarded (its fleet
+            # state is suspect)
+            svc._count_error()
+            logger.exception(f"batching: {batch_id} failed")
+            svc.breaker.record_failure(digest)
+            if ctx.pending_item is not None:
+                svc._send_error(ctx.pending_item["wfile"], "internal",
+                                f"{type(exc).__name__}: {exc}")
+                self._close(ctx.pending_item)
+                ctx.pending_item = None
+            # seats exist only once _drive ran, so `fleet` is bound here
+            for seat in list(ctx.seats.values()):
+                if seat.active:
+                    svc._send_error(seat.wfile, "internal",
+                                    f"{type(exc).__name__}: {exc}")
+                    self._release(ctx, fleet, seat, "internal")
+            svc.pool.discard(digest)
+        finally:
+            with svc._active_lock:
+                if svc._active_run is ctx:
+                    svc._active_run = None
+            with self._lock:
+                self.batches += 1
+                self.blocks += ctx.blocks
+                event = {
+                    "batch_id": batch_id,
+                    "spec": protocol.spec_name(spec),
+                    "members": ctx.seated,
+                    "late_joins": ctx.late,
+                    "blocks": ctx.blocks,
+                    "peak_active": ctx.peak,
+                    "detached": dict(ctx.detached),
+                    "wall_sec": round(time.perf_counter() - t0, 4),
+                    "abandoned": ctx.abandoned.is_set(),
+                }
+                self.batch_events.append(event)
+        return deferred
+
+    # ---------------------------------------------------------- the loop
+
+    def _drive(self, ctx, entry, fleet, spec, digest, dt, first_item,
+               admitted, verdict, build_sec, deferred):
+        svc = self.service
+        import jax
+        template = entry.solver
+        cadence = int(template.enforce_real_cadence or 0)
+        sK = int(template.timestepper.steps)
+        # any straggler seats from an abandoned predecessor batch on this
+        # fleet are released (value operands only)
+        for m in range(fleet.members):
+            if fleet.active_host[m]:
+                fleet.detach_member(m)
+        fleet.set_fleet_dt(float(dt))
+        # _seat itself manages ctx.pending_item: the item stays watchdog-
+        # answerable through reset/IC-install/gather/attach, then
+        # graduates to a requeue-able seat
+        self._seat(ctx, entry, fleet, first_item, verdict, build_sec,
+                   cadence, late=False, admitted=admitted)
+        # opening coalescing window: requests that arrived together
+        # batch together from block one (later arrivals still join at
+        # boundaries)
+        self._poll_joins(ctx, entry, fleet, digest, dt, cadence, deferred)
+        if self.batch_window > 0 and len(ctx.seats) == 1 \
+                and svc._queued_runs == 0:
+            time.sleep(self.batch_window)
+            self._poll_joins(ctx, entry, fleet, digest, dt, cadence,
+                             deferred)
+
+        def live_seats():
+            return [s for s in ctx.seats.values() if s.active]
+
+        def due(s):
+            return cadence and s.steps_done % cadence < sK
+
+        def window_dist(s):
+            if not cadence:
+                return 1 << 30
+            r = s.steps_done % cadence
+            return 1 if (r + 1) % cadence < sK else cadence - r
+
+        while True:
+            if ctx.abandoned.is_set():
+                raise faults.AbandonedRun(ctx.request_id)
+            live = live_seats()
+            if not live:
+                break
+            if ctx.stop_why is not None:
+                for s in live:
+                    self._finish_member(ctx, entry, fleet, s,
+                                        stopped_by=ctx.stop_why)
+                break
+            self._apply_chaos(ctx, entry, fleet, template, live)
+            if ctx.abandoned.is_set():
+                # a hang fault can out-sleep the watchdog: the batch was
+                # declared dead mid-boundary
+                raise faults.AbandonedRun(ctx.request_id)
+            # per-member projection phase: exactly where each member's
+            # own solo loop would project (block collapses to single
+            # steps around projection windows — sizes stay {block, 1})
+            project = [s.seat for s in live if due(s)]
+            if project:
+                fleet.project_members(project)
+            n = self.batch_block if all(
+                window_dist(s) >= self.batch_block for s in live) else 1
+            taken = fleet.step_fleet(n)
+            ctx.blocks += 1
+            ctx.peak = max(ctx.peak, len(live))
+            # boundary sync doubles as the health probe AND the watchdog
+            # progress stamp: a wedged dispatch blocks here
+            nonfinite, max_abs = jax.device_get(fleet._probe())
+            if ctx.abandoned.is_set():
+                # the watchdog fired while we were stuck in the sync and
+                # already requeued these members' sockets for the
+                # replacement — touching them now would race it
+                raise faults.AbandonedRun(ctx.request_id)
+            ctx.last_progress = time.monotonic()
+            now = time.monotonic()
+            for s in live:
+                s.steps_done += int(taken[s.seat])
+                if s.ttfs is None and s.steps_done > 0:
+                    s.ttfs = time.perf_counter() - s.t_dispatch
+            for s in live:
+                if ctx.abandoned.is_set():
+                    raise faults.AbandonedRun(ctx.request_id)
+                if not s.active:
+                    continue
+                bad = int(nonfinite[s.seat])
+                grown = (np.isfinite(fleet.max_abs_limit)
+                         and max_abs[s.seat] > fleet.max_abs_limit)
+                if bad or grown:
+                    reason = (f"non-finite state ({bad} entries)" if bad
+                              else f"growth bound exceeded: max|coeff| = "
+                                   f"{max_abs[s.seat]:.3e} > "
+                                   f"{fleet.max_abs_limit:.3e}")
+                    self._fail_member(ctx, entry, fleet, s, "health",
+                                      f"run halted unrecoverably: {reason} "
+                                      f"at iteration {s.steps_done}")
+                elif s.steps_done >= s.steps_total:
+                    self._finish_member(ctx, entry, fleet, s,
+                                        stopped_by="completed")
+                elif s.deadline_mono is not None \
+                        and now >= s.deadline_mono:
+                    svc._count("deadline_exceeded")
+                    logger.warning(
+                        f"batching: request {s.request_id} exceeded its "
+                        f"{s.params['deadline_sec']}s deadline at "
+                        f"iteration {s.steps_done}; stopping gracefully")
+                    self._finish_member(ctx, entry, fleet, s,
+                                        stopped_by="deadline-exceeded")
+                elif s.progress_next and s.steps_done >= s.progress_next:
+                    s.progress_next = (s.steps_done
+                                       + s.params["progress_every"])
+                    self._send_member(ctx, fleet, s, {
+                        "kind": "progress", "id": s.request_id,
+                        "iteration": s.steps_done,
+                        "sim_time": float(fleet.sim_times[s.seat])})
+            if ctx.stop_why is None and not ctx.abandoned.is_set():
+                self._poll_joins(ctx, entry, fleet, digest, dt, cadence,
+                                 deferred)
+
+    # ---------------------------------------------------------- seating
+
+    def _admit_member(self, ctx, item):
+        """The pre-execution gauntlet one request passes before any
+        solver work — the same sequence, in the same order, as the solo
+        executor's queue pop: replay re-check, run-params (+ chaos
+        gating) validation, circuit-breaker re-admit, queued-deadline.
+        Returns {"request_id", "params", "probe"} on admission, or None
+        when the request resolved here (replayed / refused / rejected —
+        connection closed either way)."""
+        svc = self.service
+        header = item["header"]
+        conn, wfile = item["conn"], item["wfile"]
+        with svc._counters_lock:
+            svc._request_seq += 1
+            seq = svc._request_seq
+        client_id = header.get("id")
+        request_id = str(client_id or f"r{seq}")
+        probe = bool(item.get("probe"))
+        if client_id is not None and svc._send_replay(conn, wfile, header,
+                                                      str(client_id)):
+            if probe:
+                svc.breaker.abandon_probe(ctx.digest)
+            self._close(item)
+            return None
+        try:
+            params = svc._run_params(header)
+            chaos = header.get("chaos")
+            if chaos is not None:
+                if not svc.chaos_enabled:
+                    raise protocol.SpecError(
+                        "run: chaos injection is disabled on this daemon "
+                        "(start it with --chaos; test deployments only)")
+                self._validate_chaos(chaos)
+        except protocol.SpecError as exc:
+            svc._count_error()
+            svc._send_error(wfile, "bad-spec", str(exc))
+            if probe:
+                svc.breaker.abandon_probe(ctx.digest)
+            self._close(item)
+            return None
+        if not probe:
+            allowed, retry_after, state = svc.breaker.admit(ctx.digest)
+            if not allowed:
+                svc._count_error()
+                svc._send_error(
+                    wfile, "circuit-open",
+                    f"spec {ctx.digest[:12]} is cooling off after repeated "
+                    f"failures; retry in ~{retry_after}s",
+                    retry_after_sec=retry_after)
+                self._close(item)
+                return None
+            probe = state == "probe"
+            item["probe"] = probe
+        deadline_mono = item.get("deadline_mono")
+        if deadline_mono is not None and time.monotonic() >= deadline_mono:
+            svc._count("deadline_exceeded")
+            svc._count_error()
+            svc._send_error(
+                wfile, "deadline-exceeded",
+                f"run: deadline_sec={params['deadline_sec']} elapsed "
+                f"while queued")
+            if probe:
+                svc.breaker.abandon_probe(ctx.digest)
+            self._close(item)
+            return None
+        return {"request_id": request_id, "params": params, "probe": probe}
+
+    @staticmethod
+    def _validate_chaos(chaos):
+        """Structural validation of a batch chaos block at ADMISSION (the
+        solo path's _build_chaos pre-coercion, for the batch keys): a
+        malformed block must be a bad-spec reply to ITS request — never
+        a mid-batch blowup that takes co-tenants down."""
+        try:
+            if "nan_field" in chaos:
+                if not isinstance(chaos["nan_field"], str):
+                    raise protocol.SpecError(
+                        f"run: chaos nan_field must be a field name, got "
+                        f"{chaos['nan_field']!r}")
+                int(chaos.get("nan_iteration", 0))
+            if "hang_iteration" in chaos:
+                if "hang_sec" not in chaos:
+                    raise protocol.SpecError(
+                        "run: chaos hang_iteration requires hang_sec")
+                int(chaos["hang_iteration"])
+                float(chaos["hang_sec"])
+        except (TypeError, ValueError) as exc:
+            raise protocol.SpecError(f"run: bad chaos block: {exc}")
+
+    def _seat(self, ctx, entry, fleet, item, verdict, build_sec,
+              cadence, late, admitted=None):
+        """Seat one request as a batch member: the admission gauntlet
+        (unless the caller already ran it — the anchor admits BEFORE the
+        batch-level build), then IC install on the (reset) template, row
+        gather, attach, the multistep cohort ramp, and the ack. Returns
+        the seat, or None when the request resolved without seating
+        (connection closed either way)."""
+        svc = self.service
+        header = item["header"]
+        wfile = item["wfile"]
+        if admitted is None:
+            admitted = self._admit_member(ctx, item)
+            if admitted is None:
+                return None
+        request_id = admitted["request_id"]
+        params = admitted["params"]
+        probe = admitted["probe"]
+        # from here until the seat registers in ctx.seats, the request
+        # is covered as the PENDING item: a watchdog fire mid-seating
+        # (wedged reset/gather/attach) answers this client instead of
+        # leaving it neither requeued nor closed
+        ctx.pending_item = item
+        # ---- IC install on the reset template, then row gather
+        template = entry.solver
+        try:
+            ics = (protocol.decode_fields(item["payload"])
+                   if item["payload"] else {})
+            svc.pool.reset_entry(entry)
+            svc._install_ics(template, ics)
+            svc._output_fields(template, params["outputs"])  # validate
+        except protocol.SpecError as exc:
+            svc._count_error()
+            svc._send_error(wfile, "bad-spec", str(exc))
+            if probe:
+                svc.breaker.abandon_probe(ctx.digest)
+            self._close(item)
+            ctx.pending_item = None
+            return None
+        # seats are reusable: a detached member's seat frees up for the
+        # next join (attach overwrites every per-seat row), so a long-
+        # lived batch with churn never runs out
+        seat_idx = next(m for m in range(fleet.members)
+                        if not fleet.active_host[m])
+        X_row = template.gather_fields()
+        extras_rows = template.rhs_extra()
+        fleet.attach_member(seat_idx, X_row, extras_rows=extras_rows,
+                            sim_time=0.0, steps=params["stop_iteration"])
+        seat = _Seat(item, seat_idx, request_id, params, verdict,
+                     build_sec, late, fleet.iteration)
+        # register the seat, THEN drop pending coverage: a fire landing
+        # in between sees both and must not serve the request twice —
+        # on_watchdog skips a seat whose item IS the answered pending
+        ctx.seats[seat_idx] = seat
+        ctx.pending_item = None
+        ctx.seated += 1
+        with self._lock:
+            self.members_seated += 1
+            if late:
+                self.late_joins += 1
+            self.peak_members = max(self.peak_members,
+                                    sum(1 for s in ctx.seats.values()
+                                        if s.active))
+        if late:
+            ctx.late += 1
+        # seating IS progress: a join-heavy boundary (several resets +
+        # IC installs + ramps back to back) must not read as a hung
+        # dispatch to the watchdog
+        ctx.last_progress = time.monotonic()
+        # multistep cohort ramp: the joiner's own order build-up, solo-
+        # projected, with everyone else frozen (bit-identity with solo)
+        ramped = fleet.ramp_members([seat_idx], project=bool(cadence))
+        seat.steps_done += min(ramped, seat.steps_total)
+        try:
+            protocol.send_frame(wfile, {
+                "kind": "ack", "id": request_id,
+                "pool_verdict": seat.verdict,
+                "queue_sec": round(seat.queue_sec, 6),
+                "build_sec": round(seat.build_sec, 4),
+                "batch": {"id": ctx.request_id, "seat": seat_idx,
+                          "members": sum(1 for s in ctx.seats.values()
+                                         if s.active),
+                          "late_join": late}})
+        except OSError:
+            svc._count("client_drops")
+            logger.warning(f"batching: client for {request_id} vanished "
+                           "before the ack; member released")
+            if probe:
+                svc.breaker.abandon_probe(ctx.digest)
+            self._release(ctx, fleet, seat, "client-drop")
+        return seat
+
+    def _poll_joins(self, ctx, entry, fleet, digest, dt, cadence,
+                    deferred):
+        """Boundary join point: drain currently-queued items; same-batch
+        requests seat while seats remain, everything else defers to the
+        executor (processed, in order, after this batch). FAIRNESS: once
+        anything has been deferred, the batch stops coalescing entirely
+        — continuous same-spec traffic could otherwise keep the batch
+        alive forever while the deferred work starves. The batch then
+        drains at the pace of its current members (bounded by their stop
+        iterations/deadlines) and the executor serves the deferred items
+        next."""
+        svc = self.service
+        import queue as queue_mod
+        if deferred:
+            return
+        while fleet.n_active < fleet.members and svc._draining is None \
+                and not ctx.abandoned.is_set():
+            try:
+                item = svc._queue.get_nowait()
+            except queue_mod.Empty:
+                return
+            if item is None:
+                # the drain sentinel: not ours to consume
+                svc._queue.put(None)
+                return
+            if self._matches(item, digest, dt):
+                # seated (or answered) right now: its admission
+                # reservation is consumed here
+                with svc._counters_lock:
+                    svc._queued_runs -= 1
+                self._seat(ctx, entry, fleet, item, "hit", 0.0,
+                           cadence, late=True)
+            else:
+                # deferred work KEEPS its reservation — it is still in
+                # the system, and admission control must keep counting
+                # it against QUEUE_DEPTH until an executor handles it
+                deferred.append(item)
+
+    # --------------------------------------------------------- detaching
+
+    def _apply_chaos(self, ctx, entry, fleet, template, live):
+        """Per-member boundary faults (only reachable on a --chaos
+        daemon; keys AND content validated at admission): each fires
+        once, against the requesting member only. A fault body that
+        still blows up (e.g. nan_field naming no state variable of THIS
+        template — unknowable until the template exists) fails ONLY its
+        member: blast radius zero applies to the chaos machinery too."""
+        for s in live:
+            ch = s.chaos
+            if not ch or not s.active:
+                continue
+            try:
+                if "nan_field" in ch and "nan" not in s.chaos_fired \
+                        and s.steps_done >= int(ch.get("nan_iteration", 0)):
+                    s.chaos_fired.add("nan")
+                    from ..tools import chaos as chaos_mod
+                    chaos_mod.poison_fleet_member(fleet, template, s.seat,
+                                                  ch["nan_field"])
+                    logger.warning(f"batching chaos: poisoned member "
+                                   f"{s.request_id} (seat {s.seat}) at "
+                                   f"iteration {s.steps_done}")
+                if "hang_iteration" in ch and "hang" not in s.chaos_fired \
+                        and s.steps_done >= int(ch["hang_iteration"]):
+                    s.chaos_fired.add("hang")
+                    logger.warning(f"batching chaos: hanging the batch "
+                                   f"boundary for {ch['hang_sec']}s "
+                                   f"(member {s.request_id})")
+                    time.sleep(float(ch["hang_sec"]))
+            except Exception as exc:
+                logger.exception(f"batching chaos: fault body for "
+                                 f"{s.request_id} failed")
+                self._fail_member(ctx, entry, fleet, s, "bad-spec",
+                                  f"run: chaos block failed to apply: "
+                                  f"{type(exc).__name__}: {exc}")
+
+    def _send_member(self, ctx, fleet, s, frame, payload=None):
+        """One frame to one member's client; a dead socket marks the
+        member ONCE and applies ON_CLIENT_DROP (abort detaches at this
+        boundary, complete keeps stepping for the replay cache)."""
+        svc = self.service
+        if s.client_gone:
+            return False
+        try:
+            protocol.send_frame(s.wfile, frame, payload=payload)
+            return True
+        except OSError:
+            s.client_gone = True
+            svc._count("client_drops")
+            if svc.on_client_drop == "abort" and s.active:
+                logger.warning(
+                    f"batching: client for {s.request_id} disconnected; "
+                    "detaching the member at this boundary "
+                    "(ON_CLIENT_DROP = abort)")
+                if s.probe:
+                    # an aborted probe carries no verdict on the spec
+                    svc.breaker.abandon_probe(ctx.digest)
+                self._release(ctx, fleet, s, "client-drop")
+            elif s.active:
+                logger.warning(
+                    f"batching: client for {s.request_id} disconnected; "
+                    "member completes for the replay cache "
+                    "(ON_CLIENT_DROP = complete)")
+            return False
+
+    def _member_record(self, ctx, fleet, s, entry):
+        """The member's telemetry record (the step_metrics wire/sink
+        format with the serving + batch occupancy fields)."""
+        template = entry.solver
+        wall = time.perf_counter() - s.t_dispatch
+        serving = {
+            "queue_sec": round(s.queue_sec, 6),
+            "pool_verdict": s.verdict,
+            "time_to_first_step_sec": (round(s.ttfs, 6)
+                                       if s.ttfs is not None else None),
+            "build_sec": round(s.build_sec, 4),
+            "request_id": s.request_id,
+            "batch": {
+                "id": ctx.request_id,
+                "seat": s.seat,
+                "late_join": s.late,
+                "members_active": sum(1 for x in ctx.seats.values()
+                                      if x.active),
+                "joined_iteration": s.joined_iteration,
+            },
+        }
+        if s.params["deadline_sec"] is not None:
+            serving["deadline_sec"] = s.params["deadline_sec"]
+        from ..tools import retrace as retrace_mod
+        record = {
+            "kind": "step_metrics",
+            "ts": round(time.time(), 1),
+            "config": f"{protocol.spec_name(entry.spec)}_served",
+            "backend": fleet.metrics.meta.get("backend"),
+            "dtype": str(np.dtype(template.pencil_dtype)),
+            "pencil_shape": list(template.pencil_shape),
+            "iterations": s.steps_done,
+            "loop_wall_sec": round(wall, 6),
+            "steps_per_sec": round(s.steps_done / wall, 4)
+            if wall > 0 else 0.0,
+            "retraces_post_warmup": retrace_mod.sentinel.post_arm_retraces,
+            "serving": serving,
+        }
+        return record, serving
+
+    def _member_fields(self, fleet, entry, s):
+        """Extract one member's final fields in the requested layout —
+        the same field reads the solo reply path performs, against the
+        member's rows (state scattered into the template; parameter
+        operands re-presented from the member's extras rows)."""
+        svc = self.service
+        template = entry.solver
+        fleet.load_member(s.seat)
+        for k, field in enumerate(template.eval_F.extra_fields):
+            field.preset_coeff(np.asarray(fleet._extras[k][s.seat]))
+            field.mark_modified()
+        targets = svc._output_fields(template, s.params["outputs"])
+        out_fields = {}
+        for var in targets:
+            if s.params["layout"] == "c":
+                out_fields[var.name] = ("c", np.asarray(var.coeff_data()))
+            else:
+                out_fields[var.name] = ("g", np.array(var["g"]))
+        return out_fields
+
+    def _member_checkpoint(self, fleet, entry, s):
+        """Durable per-member checkpoint at a graceful stop: the
+        member's state is scattered into the template and written
+        through the same evaluator FileHandler path a solo served run
+        uses, so `resume: true` on a solo re-submission restores it
+        (validated by resume_latest)."""
+        checkpoint = s.params["checkpoint"]
+        if checkpoint is None:
+            return
+        template = entry.solver
+        fleet.load_member(s.seat)
+        template.sim_time = float(fleet.sim_times[s.seat])
+        template.iteration = s.steps_done
+        handler = template.evaluator.add_file_handler(
+            checkpoint["dir"], max_writes=1, mode="append")
+        try:
+            for var in template.state:
+                handler.add_task(var, layout="c", name=var.name)
+            handler.process(iteration=s.steps_done,
+                            wall_time=time.perf_counter() - s.t_dispatch,
+                            sim_time=float(fleet.sim_times[s.seat]),
+                            timestep=float(fleet.dts[s.seat]))
+        finally:
+            try:
+                template.evaluator.handlers.remove(handler)
+            except ValueError:
+                pass
+
+    def _finish_member(self, ctx, entry, fleet, s, stopped_by):
+        """Graceful member exit (completion, deadline, drain): durable
+        checkpoint when configured, telemetry record, result frame
+        (cached first for idempotent replay), detach."""
+        svc = self.service
+        try:
+            self._member_checkpoint(fleet, entry, s)
+        except Exception as exc:
+            logger.warning(f"batching: member checkpoint for "
+                           f"{s.request_id} failed: {exc}")
+        record, serving = self._member_record(ctx, fleet, s, entry)
+        svc._emit(record)
+        try:
+            out_fields = self._member_fields(fleet, entry, s)
+            payload = protocol.encode_fields(out_fields)
+        except Exception as exc:
+            svc._count_error()
+            logger.exception(f"batching: result extraction for "
+                             f"{s.request_id} failed")
+            svc._send_error(s.wfile, "internal",
+                            f"{type(exc).__name__}: {exc}")
+            self._release(ctx, fleet, s, "internal")
+            return
+        result = {
+            "kind": "result", "id": s.request_id,
+            "iteration": s.steps_done,
+            "sim_time": float(fleet.sim_times[s.seat]),
+            "stopped_by": stopped_by,
+            "rewinds": 0,
+            "serving": serving,
+        }
+        if s.client_id is not None:
+            svc.results.put(str(s.client_id), record, result, payload,
+                            fingerprint=svc._run_fingerprint(s.header))
+        # a graceful finish judges the spec healthy (the solo rule); the
+        # run completed even when the client stopped listening
+        svc.breaker.record_success(ctx.digest)
+        self._send_member(ctx, fleet, s, record)
+        self._send_member(ctx, fleet, s, result, payload=payload)
+        svc._count("requests_served")
+        svc._observe_run_wall(s.t_dispatch)
+        self._release(ctx, fleet, s, "deadline"
+                      if stopped_by == "deadline-exceeded"
+                      else ("completed" if stopped_by == "completed"
+                            else "drain"))
+
+    def _fail_member(self, ctx, entry, fleet, s, code, message):
+        """Structured member failure (divergence): telemetry, error
+        frame, breaker accounting, detach — the batch keeps stepping."""
+        svc = self.service
+        svc._count_error()
+        if s.client_gone and s.probe:
+            # a dead client says nothing about the SPEC: release the
+            # half-open probe slot instead of judging it
+            svc.breaker.abandon_probe(ctx.digest)
+        else:
+            svc.breaker.record_failure(ctx.digest)
+        record, _serving = self._member_record(ctx, fleet, s, entry)
+        svc._emit(record)
+        svc._send_error(s.wfile, code, message)
+        logger.warning(f"batching: member {s.request_id} failed "
+                       f"({code}): {message}")
+        self._release(ctx, fleet, s, "health" if code == "health"
+                      else code)
+
+    def _release(self, ctx, fleet, s, cause):
+        """Detach a seat and close its connection — the single seat-
+        bookkeeping point, idempotent: a client that drops INSIDE its
+        own finish path (the record send fails, the abort branch fires)
+        must not be counted or closed twice."""
+        if s.released:
+            return
+        s.released = True
+        if s.active:
+            s.active = False
+            fleet.detach_member(s.seat)
+        ctx.detached[cause] += 1
+        with self._lock:
+            self.detached[cause] += 1
+        self._close(s.item)
+
+    @staticmethod
+    def _close(item):
+        try:
+            item["conn"].close()
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------- watchdog
+
+    def on_watchdog(self, ctx, stuck_sec):
+        """The watchdog declared this batch hung (no boundary progress
+        within WATCHDOG_SEC): abandon it, postmortem it, quarantine the
+        pool entry (and with it the fleet the wedged executor may still
+        be dispatching on), and REQUEUE every surviving member's request
+        so the replacement executor re-runs them — member requests are
+        the unit of replay, not the batch. Runs on the watchdog
+        thread."""
+        svc = self.service
+        ctx.abandoned.set()
+        svc._count("watchdog_fires")
+        svc._count_error()
+        pending = ctx.pending_item
+        if pending is not None:
+            # the batch never got past its build: the anchor's client is
+            # answered like a solo watchdog fire (re-running a hung
+            # build would just hang the replacement too)
+            ctx.pending_item = None
+            svc._send_error(
+                pending["wfile"], "watchdog-timeout",
+                f"no progress within {svc.watchdog_sec}s during the "
+                f"batch build ({ctx.request_id}); postmortem recorded")
+            self._close(pending)
+        survivors, gone = [], 0
+        # snapshot: the executor may be inserting a seat concurrently
+        # (list() of the view is C-atomic under the GIL; iterating the
+        # live dict would race a resize)
+        for s in list(ctx.seats.values()):
+            if not s.active:
+                continue
+            if s.item is pending:
+                # the fire raced the seat registration: this request was
+                # already answered through the pending branch above
+                continue
+            if s.client_gone:
+                gone += 1
+                self._close(s.item)
+            else:
+                survivors.append(s)
+        record = {
+            "kind": "watchdog_postmortem",
+            "request_id": ctx.request_id,
+            "batch": True,
+            "member_requests": [s.request_id
+                                for s in list(ctx.seats.values())],
+            "requeued": [s.request_id for s in survivors],
+            "stuck_sec": round(stuck_sec, 3),
+            "watchdog_sec": svc.watchdog_sec,
+            "request_age_sec": round(time.monotonic() - ctx.started_ts, 3),
+            "stacks": faults.thread_stacks(),
+        }
+        logger.error(
+            f"batching: WATCHDOG — {ctx.request_id} made no boundary "
+            f"progress for {stuck_sec:.1f}s (> {svc.watchdog_sec}s); "
+            f"abandoning the batch, requeuing {len(survivors)} surviving "
+            f"member(s) on the replacement executor")
+        svc._emit(record)
+        if ctx.digest is not None:
+            svc.breaker.record_failure(ctx.digest)
+            svc.pool.discard(ctx.digest)
+        with self._lock:
+            self.detached["watchdog"] += len(survivors) + gone
+        for s in survivors:
+            # the member's original item re-enters the queue intact
+            # (connection open, payload kept, absolute deadline kept);
+            # idempotent ids make a doubled execution safe. Any chaos
+            # block is STRIPPED — each armed fault fires once per
+            # request (the chaos contract), so the replay runs clean
+            # instead of re-wedging every replacement executor.
+            s.header.pop("chaos", None)
+            svc.requeue_item(s.item)

@@ -40,16 +40,32 @@ __all__ = ["PoolEntry", "SolverPool"]
 
 
 class PoolEntry:
-    """One warm solver plus the build-time snapshot reset restores."""
+    """One warm solver plus the build-time snapshot reset restores.
+    `fleet` caches the entry's serving EnsembleSolver (service/
+    batching.py): None until the first batch, False when the template
+    cannot fleet, else the live fleet whose compiled programs ride this
+    entry's lifetime — eviction or quarantine drops both together."""
 
     __slots__ = ("key", "spec", "solver", "build_sec", "base_handlers",
-                 "base_schedule", "created_ts", "last_used_ts", "uses")
+                 "base_schedule", "base_extras", "created_ts",
+                 "last_used_ts", "uses", "fleet")
 
     def __init__(self, key, spec, solver, build_sec):
         self.key = key
         self.spec = spec
         self.solver = solver
         self.build_sec = build_sec
+        self.fleet = None
+        # build-time data of every RHS extra operand. Reset RESTORES
+        # these rather than zeroing: user parameter fields the builder
+        # left empty still start at zero (the documented contract), but
+        # equation-internal operands — BC constants, backgrounds — keep
+        # their built values. Zeroing them changed the PROBLEM: a served
+        # Rayleigh-Benard run lost its b(z=0)=Lz boundary constant and
+        # silently solved different physics than the same spec solved
+        # in-process.
+        self.base_extras = [np.asarray(f.coeff_data()).copy()
+                            for f in solver.eval_F.extra_fields]
         # the handler set present at registration (usually empty): per-
         # request additions (the resilient loop's checkpoint FileHandler)
         # are dropped by reset so one request's checkpoint cadence can
@@ -226,12 +242,17 @@ class SolverPool:
         the surviving timestepper/ops instances) are untouched, so the
         next request never retraces."""
         solver = entry.solver
-        # state + RHS-parameter fields: zero in coefficient layout (exact;
-        # the request's IC payload overwrites the fields it names)
+        # state: zero in coefficient layout (exact; the request's IC
+        # payload overwrites the fields it names). RHS extra operands:
+        # restored to their BUILD-time data (entry.base_extras) — zero
+        # for parameter fields the builder left empty, the built values
+        # for equation constants/backgrounds a request must never lose.
         for var in solver.state:
             var["c"] = 0
-        for field in solver.eval_F.extra_fields:
-            field["c"] = 0
+        for field, base in zip(solver.eval_F.extra_fields,
+                               entry.base_extras):
+            field.preset_coeff(base)
+            field.mark_modified()
         # clocks and stop criteria
         solver.sim_time = solver.initial_sim_time = 0.0
         solver.iteration = solver.initial_iteration = 0

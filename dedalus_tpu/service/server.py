@@ -45,6 +45,16 @@ layer sibling of the PR-4 solve-loop resilience):
     aborts it at the next step boundary ([service] ON_CLIENT_DROP),
     counted once, with the run's single telemetry flush intact.
 
+Continuous batching (`serve --batch`, service/batching.py): concurrent
+run requests whose specs canonicalize to the same pool key coalesce into
+ONE vmapped EnsembleSolver micro-batch on the executor — late arrivals
+join at block boundaries, per-member deadlines/divergence/client-drops
+detach members without perturbing the rest (blast-radius zero, results
+bit-identical to solo serving), and a wedged batch is abandoned with its
+surviving members requeued for the replacement executor. Requests that
+cannot batch (resume, sim-time stops, solo-only chaos) take the solo
+path below unchanged.
+
 Graceful drain: SIGTERM/SIGINT (or a `shutdown` request) stop the accept
 loop, request a cooperative stop on the in-flight loop via the PR-4
 stop-request machinery — the current step completes, a final durable
@@ -64,6 +74,7 @@ counters ride the `stats` reply and the final `service_stats` record.
 """
 
 import argparse
+import collections
 import contextlib
 import json
 import logging
@@ -76,7 +87,7 @@ import time
 
 import numpy as np
 
-from . import faults, protocol
+from . import batching, faults, protocol
 from .pool import SolverPool
 from ..tools import metrics as metrics_mod
 from ..tools.config import cfg_get
@@ -135,7 +146,8 @@ class SolverService:
                  idle_timeout=None, watchdog_sec=None, breaker_failures=None,
                  breaker_cooloff=None, result_cache=None,
                  mem_watermark_mb=None, on_client_drop=None,
-                 chaos_enabled=False):
+                 chaos_enabled=False, batching_enabled=None,
+                 batch_max=None, batch_window=None, batch_block=None):
         self.host = host
         self.port = int(port)
         self.pool = SolverPool(size=pool_size, allow_imports=allow_imports)
@@ -170,6 +182,15 @@ class SolverService:
             size=int(result_cache if result_cache is not None
                      else cfg_get("service", "RESULT_CACHE", "16")))
         self.chaos_enabled = bool(chaos_enabled)
+        # ---- continuous batching (service/batching.py): opt-in — the
+        # solo executor path stays the default dispatch mode
+        if batching_enabled is None:
+            batching_enabled = str(cfg_get(
+                "service", "BATCH", "False")).strip().lower() in (
+                    "1", "true", "yes", "on")
+        self.batcher = batching.BatchDispatcher(
+            self, batch_max=batch_max, batch_window=batch_window,
+            batch_block=batch_block) if batching_enabled else None
         # ---- request accounting
         self.requests_served = 0
         self.errors = 0
@@ -314,6 +335,13 @@ class SolverService:
             "uptime_sec": round(time.time() - self.started_ts, 1)
             if self.started_ts else 0.0,
             "pool": self.pool.stats(),
+            # per-batch occupancy (members seated / joined / detached by
+            # cause, per-block peaks) — executor-level counters alone
+            # cannot show how full the fleets ran
+            "serving": {
+                "batching": (self.batcher.stats() if self.batcher
+                             else {"enabled": False}),
+            },
             "faults": {
                 "queue_depth": self.queue_depth,
                 "queued": self._queued_runs,
@@ -529,14 +557,24 @@ class SolverService:
     def _worker(self, gen=None):
         if gen is None:
             gen = self._worker_gen
+        # items a running batch popped at a boundary but could not seat
+        # (different spec/dt, not batchable): processed FIRST, in order,
+        # before new queue pops — deferral must not become starvation.
+        # Deferred items keep their admission reservation (_queued_runs)
+        # until handled here, so QUEUE_DEPTH keeps counting them.
+        pending = collections.deque()
         while gen == self._worker_gen:
-            item = self._queue.get()
-            if item is None:
-                return
-            conn, wfile = item["conn"], item["wfile"]
+            if pending:
+                item = pending.popleft()
+            else:
+                item = self._queue.get()
+                if item is None:
+                    return
             with self._counters_lock:
                 self._queued_runs -= 1
+            conn, wfile = item["conn"], item["wfile"]
             abandoned = False
+            batch_owned = False
             try:
                 if self._draining is not None:
                     # drain began while this run sat in the queue
@@ -544,11 +582,20 @@ class SolverService:
                     self._send_error(
                         wfile, "draining",
                         f"daemon is draining ({self._draining})")
+                elif self.batcher is not None \
+                        and not item.get("force_solo") \
+                        and self.batcher.batchable(item["header"]):
+                    # continuous batching: this item anchors a micro-
+                    # batch; compatible queued/arriving requests join at
+                    # block boundaries. The batcher owns every member
+                    # connection (including this one).
+                    batch_owned = True
+                    pending.extend(self.batcher.run_batch(item))
                 else:
                     self._handle_run(item)
             except faults.AbandonedRun:
                 # the watchdog failed this run and is replacing this
-                # worker; the reply and the close already happened there
+                # worker; replies/requeues already happened there
                 logger.warning("service: abandoned run unwound; stale "
                                "executor exiting")
                 abandoned = True
@@ -556,20 +603,35 @@ class SolverService:
                 self._count_error()
                 logger.exception("service: connection handler failed")
             finally:
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                if not batch_owned:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
             if abandoned:
                 # exit UNCONDITIONALLY, not via the generation check: the
                 # fire sets ctx.abandoned BEFORE it bumps the generation,
                 # so an unwinding worker can observe itself still
                 # "current" — looping back here would leave TWO live
                 # executors racing the queue (and wedge the drain
-                # sentinel, which only one of them can consume)
+                # sentinel, which only one of them can consume). Work
+                # this worker still held locally goes back on the queue
+                # for the replacement — reservations still held, so no
+                # re-increment.
+                while pending:
+                    self._queue.put(pending.popleft())
                 return
         # generation mismatch: this worker was declared dead mid-run and
         # a replacement owns the queue now — exit without touching it
+
+    def requeue_item(self, item):
+        """Return an already-admitted run item to the queue (batch
+        watchdog replay; deferred work orphaned by an abandoned
+        executor): re-reserves its admission slot so the drain sweep and
+        the stats stay consistent."""
+        with self._counters_lock:
+            self._queued_runs += 1
+        self._queue.put(item)
 
     def _refuse_queued(self):
         """After the worker exits, answer any run a reader enqueued in
@@ -631,6 +693,21 @@ class SolverService:
                 # fire: it was never hung — leave the reply alone
                 return
             self._active_run = None
+        if getattr(ctx, "is_batch", False):
+            # a wedged BATCH: member requests are the unit of replay —
+            # the dispatcher abandons the batch, quarantines the pool
+            # entry (and its fleet), and requeues every surviving
+            # member's request for the replacement executor. The
+            # replacement starts UNCONDITIONALLY: a fire that blows up
+            # mid-bookkeeping must never leave the daemon executor-less
+            # (the stale worker exits on AbandonedRun either way).
+            try:
+                self.batcher.on_watchdog(ctx, stuck_sec)
+            except Exception:
+                logger.exception("service: batch watchdog fire failed")
+            finally:
+                self._start_worker()
+            return
         # abandon FIRST: a slow-but-alive executor must stop writing to
         # this socket (its next step hook raises AbandonedRun) before we
         # put the structured error frame on it
@@ -898,6 +975,10 @@ class SolverService:
             seq = self._request_seq
         client_id = header.get("id")
         request_id = str(client_id or f"r{seq}")
+        # NOTE: the replay -> params -> breaker -> deadline sequence
+        # below is mirrored by service/batching.BatchDispatcher.
+        # _admit_member for batched members; a change to the ordering or
+        # the bookkeeping here must be applied there too.
         # replay re-check: the original of an idempotent retry may have
         # completed while the retry sat in the queue
         if client_id is not None and self._send_replay(conn, wfile, header,
@@ -1310,6 +1391,22 @@ def build_parser():
                         help="accept per-run 'chaos' fault-injection "
                              "blocks (tools/chaos.py; TEST DEPLOYMENTS "
                              "ONLY)")
+    parser.add_argument("--batch", action="store_true", default=None,
+                        help="continuous batching: coalesce concurrent "
+                             "same-spec requests into one vmapped "
+                             "ensemble micro-batch (default: [service] "
+                             "BATCH; docs/serving.md)")
+    parser.add_argument("--batch-max", type=int, default=None,
+                        help="seats per micro-batch (default: [service] "
+                             "BATCH_MAX_MEMBERS)")
+    parser.add_argument("--batch-window", type=float, default=None,
+                        help="coalescing wait in seconds after the first "
+                             "member seats (default: [service] "
+                             "BATCH_WINDOW_SEC)")
+    parser.add_argument("--batch-block", type=int, default=None,
+                        help="fleet block size in iterations between "
+                             "join/detach boundaries (default: [service] "
+                             "BATCH_BLOCK_ITERS)")
     return parser
 
 
@@ -1327,6 +1424,8 @@ def main(argv=None):
         breaker_cooloff=args.breaker_cooloff,
         result_cache=args.result_cache,
         mem_watermark_mb=args.mem_watermark_mb,
-        on_client_drop=args.on_client_drop, chaos_enabled=args.chaos)
+        on_client_drop=args.on_client_drop, chaos_enabled=args.chaos,
+        batching_enabled=args.batch, batch_max=args.batch_max,
+        batch_window=args.batch_window, batch_block=args.batch_block)
     service.serve_forever()
     return 0

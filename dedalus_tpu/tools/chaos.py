@@ -47,7 +47,26 @@ the daemon's reaction deterministically):
   * `sigkill_client` — spawn a real `submit` subprocess and SIGKILL it
     once its run is in flight (the OS-level version of vanishing),
   * `queue_storm` — a burst of concurrent run requests sized to
-    overflow the bounded admission queue (drives load shedding).
+    overflow the bounded admission queue (drives load shedding),
+  * `late_join_storm` — staggered concurrent run requests against a
+    `--batch` daemon: the first anchors a micro-batch, later ones must
+    JOIN it at block boundaries. Each request carries its own header,
+    so per-member deadline skew (different `deadline_sec` per member)
+    and member-targeted faults (a `chaos` block with
+    `nan_field`/`nan_iteration` poisons that REQUEST's own member;
+    `hang_iteration`/`hang_sec` stalls the batch boundary for the
+    watchdog drill) ride the same helper. Deterministic: returns every
+    member's terminal outcome, in submission order.
+
+Batch-targeted member faults (service/batching.py applies them for
+run-header chaos blocks on a `--chaos --batch` daemon):
+
+  * `poison_fleet_member` — NaN ONE seat's slice of a serving fleet's
+    (N, G, S) state (the served `nan_member`): the per-member health
+    probe at the next boundary must detach exactly that member,
+  * `vanish_client` / `sigkill_client` aimed at a batched run — the
+    daemon detaches (abort) or completes-for-replay (complete) that
+    member only, mid-batch.
 
 Each armed ChaosInjector fault fires ONCE (rewind replays the
 triggering iteration; a re-firing fault would deadlock the recovery it
@@ -71,7 +90,8 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 __all__ = ["ChaosInjector", "corrupt_checkpoint", "corrupt_shard",
-           "half_frame", "queue_storm", "sigkill_client", "slow_loris",
+           "half_frame", "late_join_storm", "poison_fleet_member",
+           "queue_storm", "sigkill_client", "slow_loris",
            "vanish_client"]
 
 
@@ -413,6 +433,28 @@ class ChaosInjector:
         return members
 
 
+def poison_fleet_member(fleet, template, seat, field_name):
+    """Overwrite ONE seat's slice of a serving fleet's (N, G, S) state
+    with NaN — the batch-targeted `nan_member`: a served request's own
+    chaos block poisons its own member, and the per-member health probe
+    at the next block boundary must detach it without perturbing any
+    other member's bits (service/batching.py applies this for run-header
+    chaos on a `--chaos` daemon). Value-operand masked write: no seat
+    index is baked into a compiled program, and no retrace."""
+    import jax.numpy as jnp
+    offset, size = _field_slice(template, field_name)
+    n_pad, _G, S = fleet.X.shape
+    seat_mask = np.zeros(n_pad, dtype=bool)
+    seat_mask[int(seat)] = True
+    col_mask = np.zeros(S, dtype=bool)
+    col_mask[offset:offset + size] = True
+    fleet.X = jnp.where(jnp.asarray(seat_mask)[:, None, None]
+                        & jnp.asarray(col_mask)[None, None, :],
+                        jnp.nan, fleet.X)
+    logger.warning(f"chaos: poisoned fleet seat {seat} field "
+                   f"{field_name!r} (cols {offset}:{offset + size})")
+
+
 # --------------------------------------------------------- service faults
 #
 # Misbehaving clients aimed at a live `dedalus_tpu serve` daemon. Each
@@ -515,6 +557,81 @@ def sigkill_client(port, spec, dt, stop_iteration, host="127.0.0.1",
     logger.warning(f"chaos: SIGKILLed submit client pid {proc.pid} after "
                    f"{seen} progress frame(s)")
     return proc
+
+
+def late_join_storm(port, headers, payloads=None, stagger_sec=0.15,
+                    host="127.0.0.1", timeout=300.0):
+    """Staggered concurrent run requests against a `--batch` daemon: the
+    first request anchors a micro-batch, each later one is submitted
+    `stagger_sec` after the previous — landing mid-run, so it must JOIN
+    the live batch at a block boundary (its ack's `batch.late_join`
+    says whether it did). Each request carries its OWN header, so
+    per-member deadline skew (`deadline_sec` varying across headers)
+    and member-targeted chaos blocks ride the same storm. Returns one
+    outcome dict per request, in submission order: {"ok", "code",
+    "ack", "result", "fields", "records", "retry_after_sec",
+    "wall_sec"}."""
+    from ..service import protocol
+    results = [None] * len(headers)
+    payloads = payloads or [None] * len(headers)
+
+    def one(i):
+        t0 = time.perf_counter()
+        out = {"ok": False, "code": None, "ack": None, "result": None,
+               "fields": {}, "records": [], "retry_after_sec": None,
+               "wall_sec": None}
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout) as c:
+                wfile = c.makefile("wb")
+                rfile = c.makefile("rb")
+                protocol.send_frame(wfile, dict(headers[i]),
+                                    payload=payloads[i])
+                while True:
+                    frame, frame_payload = protocol.recv_frame(rfile)
+                    if frame is None:
+                        out["code"] = out["code"] or "closed"
+                        break
+                    kind = frame.get("kind")
+                    if kind == "ack":
+                        out["ack"] = frame
+                    elif kind == "progress":
+                        pass
+                    elif kind == "error":
+                        out["code"] = frame.get("code")
+                        out["retry_after_sec"] = frame.get(
+                            "retry_after_sec")
+                        break
+                    elif kind == "result":
+                        out["ok"] = True
+                        out["result"] = frame
+                        if frame_payload:
+                            out["fields"] = protocol.decode_fields(
+                                frame_payload)
+                        break
+                    else:
+                        out["records"].append(frame)
+        except OSError as exc:
+            out["code"] = f"oserror:{exc.errno}"
+        out["wall_sec"] = round(time.perf_counter() - t0, 4)
+        results[i] = out
+
+    threads = []
+    for i in range(len(headers)):
+        thread = threading.Thread(target=one, args=(i,), daemon=True)
+        threads.append(thread)
+        thread.start()
+        if i + 1 < len(headers) and stagger_sec:
+            time.sleep(float(stagger_sec))
+    for thread in threads:
+        thread.join(timeout=timeout)
+    late = sum(1 for r in results
+               if r and ((r.get("ack") or {}).get("batch") or {})
+               .get("late_join"))
+    logger.warning(f"chaos: late-join storm of {len(headers)} requests "
+                   f"-> {sum(1 for r in results if r and r['ok'])} "
+                   f"served, {late} late joins")
+    return results
 
 
 def queue_storm(port, header, payload=None, n=8, host="127.0.0.1",

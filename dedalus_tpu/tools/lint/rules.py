@@ -28,6 +28,11 @@ HOT_PATH_MODULES = (
     # shipped case: Snapshot.is_finite gathering the full state per
     # capture validation) stalls the same pipeline the step modules do
     "tools/resilience.py",
+    # the continuous-batching dispatcher brackets every fleet block: a
+    # stray host sync between boundaries serializes the whole batch's
+    # dispatch pipeline (member IO belongs in core/ensemble seat APIs,
+    # reply-phase IO after the boundary probe)
+    "service/batching.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its

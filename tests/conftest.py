@@ -97,7 +97,8 @@ def _service_test_watchdog(request):
     daemon."""
     marked = (request.node.get_closest_marker("service") is not None
               or request.node.get_closest_marker("chaos") is not None
-              or request.node.get_closest_marker("ensemble") is not None)
+              or request.node.get_closest_marker("ensemble") is not None
+              or request.node.get_closest_marker("batching") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
         yield
         return
@@ -156,6 +157,14 @@ def pytest_configure(config):
         "markers",
         "ensemble: fleet execution tests (core/ensemble.py: vmapped/"
         "sharded stepping, device-loss resharding); tier-1 by default")
+    # batching: continuous micro-batch serving tests (service/
+    # batching.py), covered by the same hard watchdog — a wedged batch
+    # boundary stalls exactly like a hung daemon.
+    config.addinivalue_line(
+        "markers",
+        "batching: continuous-batching service tests (service/"
+        "batching.py: micro-batch dispatch, member fault isolation); "
+        "tier-1 by default")
 
 
 @pytest.fixture

@@ -1,0 +1,508 @@
+"""
+Continuous batching (service/batching.py): concurrent same-spec run
+requests coalesced into one vmapped ensemble micro-batch, with
+member-level fault isolation proven BITWISE — every surviving member's
+served result must equal a direct in-process solve of the same request,
+under every injected fault:
+
+  * the batched-vs-solo bit-identity matrix (SBDF2 + RK222, diffusion +
+    Rayleigh-Benard), batch sizes > 1, with zero post-warmup retraces;
+  * late join at a block boundary (deterministic: the joiner is
+    submitted only after the anchor's first progress frame proves the
+    batch is in flight);
+  * per-member deadline skew: one member deadline-stops at a boundary
+    with a durable validated checkpoint while its batchmate completes;
+  * a NaN-poisoned member (the request's own chaos block) detaching
+    with a structured `health` error, blast radius zero;
+  * a mid-batch vanished client detaching under ON_CLIENT_DROP=abort;
+  * a wedged batch (hang chaos) abandoned by the watchdog with its
+    surviving members REQUEUED and re-served by the replacement
+    executor — the rolling-batch replay;
+  * occupancy telemetry: per-batch member/join/detach accounting in the
+    `serving` stats block, and the `report` CLI rendering of it.
+
+Each fault is followed by a healthy request asserted bit-identical to a
+direct solve (the daemon survives). In-process daemons throughout (no
+subprocess JAX import tax); covered by the conftest hard watchdog via
+the `batching` marker.
+"""
+
+import contextlib
+import io
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dedalus_tpu.service import protocol
+from dedalus_tpu.service.client import ServiceClient
+from dedalus_tpu.service.server import SolverService
+from dedalus_tpu.service.protocol import ServiceError
+from dedalus_tpu.tools import chaos as chaos_mod
+from dedalus_tpu.tools import resilience as res_mod
+from dedalus_tpu.tools import retrace as retrace_mod
+
+REPO = pathlib.Path(__file__).parent.parent
+
+pytestmark = [pytest.mark.batching, pytest.mark.service, pytest.mark.chaos]
+
+SIZE = 32
+DT = 1e-3
+STEPS = 40
+DIFF = {"problem": "diffusion", "params": {"size": SIZE}}
+DIFF_RK = {"problem": "diffusion", "params": {"size": SIZE,
+                                              "scheme": "RK222"}}
+RB = {"problem": "rayleigh_benard", "params": {"Nx": 32, "Nz": 8}}
+
+_x = np.linspace(0, 2 * np.pi, SIZE, endpoint=False)
+
+
+def diff_ics(k=3, amp=0.2):
+    return {"u": ("g", np.sin(k * _x)), "a": ("g", amp * np.cos(_x))}
+
+
+def rb_ics(seed=1):
+    rng = np.random.default_rng(seed)
+    return {"b": ("g", 1e-3 * rng.standard_normal((32, 8)))}
+
+
+_references = {}
+
+
+def direct_reference(spec, ics, dt, steps):
+    """The direct in-process solve a served member must bit-match:
+    builder + IC install + `steps` x solver.step — exactly the solo
+    served execution (test_service.py established served == direct)."""
+    key = json.dumps([spec, sorted(ics), dt, steps], sort_keys=True,
+                     default=str)
+    ics_key = (key, tuple(np.asarray(v[1]).tobytes() for v in
+                          ics.values()))
+    if ics_key not in _references:
+        solver = protocol.resolve_builder(spec)()
+        SolverService._install_ics(solver, ics)
+        for _ in range(steps):
+            solver.step(dt)
+        _references[ics_key] = {
+            v.name: np.asarray(v.coeff_data()).copy()
+            for v in solver.state}
+    return _references[ics_key]
+
+
+@contextlib.contextmanager
+def batch_service(**kw):
+    """In-process batching daemon: serve_forever on a thread with real
+    sockets, reader threads, the batching executor, and the watchdog."""
+    kw.setdefault("batching_enabled", True)
+    kw.setdefault("batch_max", 4)
+    kw.setdefault("batch_window", 0.1)
+    kw.setdefault("chaos_enabled", True)
+    # the retrace sentinel is process-global and accumulates across the
+    # whole pytest run; the zero-retraces-across-join/detach assertions
+    # below are about THIS daemon's fleet programs (same reset
+    # discipline as tests/test_ensemble.py)
+    retrace_mod.sentinel.reset()
+    svc = SolverService(port=0, **kw)
+    thread = threading.Thread(target=svc.serve_forever,
+                              kwargs={"ready_stream": io.StringIO()},
+                              daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 30
+    while svc.started_ts is None:
+        if time.monotonic() > deadline:
+            raise RuntimeError("in-process batch daemon did not come up")
+        time.sleep(0.01)
+    try:
+        yield svc
+    finally:
+        svc.request_drain("test teardown")
+        thread.join(timeout=60)
+        assert not thread.is_alive(), "batch daemon failed to drain"
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    """The shared batching daemon most tests aim at (sequential faults
+    against one long-lived process IS the survival claim)."""
+    sink = str(tmp_path_factory.mktemp("batching") / "served.jsonl")
+    with batch_service(sink=sink) as svc:
+        svc.sink_path = sink
+        yield svc
+
+
+def concurrent_runs(svc, requests, stagger=0.0):
+    """Fire len(requests) client runs concurrently (optionally
+    staggered); returns results/errors in submission order. Each request
+    is a kwargs dict for ServiceClient.run."""
+    out = [None] * len(requests)
+
+    def one(i, kw):
+        client = ServiceClient(port=svc.port, timeout=300)
+        try:
+            out[i] = client.run(**kw)
+        except (ServiceError, OSError) as exc:
+            out[i] = exc
+
+    threads = []
+    for i, kw in enumerate(requests):
+        thread = threading.Thread(target=one, args=(i, kw), daemon=True)
+        threads.append(thread)
+        thread.start()
+        if stagger and i + 1 < len(requests):
+            time.sleep(stagger)
+    for thread in threads:
+        thread.join(timeout=300)
+    assert all(r is not None for r in out), "a storm client hung"
+    return out
+
+
+def assert_healthy(svc, tag):
+    """Post-fault acceptance bar: a fresh request served bit-identically
+    to the direct in-process solve."""
+    client = ServiceClient(port=svc.port, timeout=300)
+    result = client.run(DIFF, ics=diff_ics(), dt=DT, stop_iteration=STEPS)
+    ref = direct_reference(DIFF, diff_ics(), DT, STEPS)
+    assert result.result["stopped_by"] == "completed"
+    assert np.array_equal(result.fields["u"][1], ref["u"]), \
+        f"post-{tag} served result differs from the direct solve"
+
+
+# -------------------------------------------------- bit-identity matrix
+
+@pytest.mark.parametrize("spec,make_ics,dt,steps,direct_exact", [
+    (DIFF, lambda i: diff_ics(k=2 + i, amp=0.1 * (i + 1)), DT, STEPS,
+     True),
+    (DIFF_RK, lambda i: diff_ics(k=2 + i, amp=0.1 * (i + 1)), DT, STEPS,
+     True),
+    # the 2-D flagship: the vmapped fleet program and the solo step
+    # program are DIFFERENT XLA executables whose FMA contraction can
+    # differ at the ulp level, so batched-vs-direct is tolerance-checked;
+    # batched-vs-solo-SERVED (same daemon, same compiled fleet program,
+    # batch of one) is still exact below
+    (RB, lambda i: rb_ics(seed=i + 1), 1e-3, 12, False),
+], ids=["diffusion-SBDF2", "diffusion-RK222", "rb-RK222"])
+def test_batched_vs_solo_bit_identity(daemon, spec, make_ics, dt, steps,
+                                      direct_exact):
+    """The acceptance bar, per member: a request served in a batch of N
+    is BIT-identical to the same request served ALONE on the daemon
+    (member trajectories are invariant to batch composition — vmap lanes
+    never mix, membership is a value operand, and solo serving runs the
+    same compiled fleet program as a batch of one). Both scheme families
+    (the multistep path exercises the cohort ramp), the 2-D flagship
+    included; the diffusion cases additionally bit-match a DIRECT
+    in-process solve, with zero post-warmup retraces."""
+    members = 3
+    requests = [dict(spec=spec, ics=make_ics(i), dt=dt,
+                     stop_iteration=steps) for i in range(members)]
+    # solo-served references: each request alone = a batch of one
+    solo = []
+    client = ServiceClient(port=daemon.port, timeout=300)
+    for kw in requests:
+        r = client.run(**kw)
+        assert (r.ack or {}).get("batch"), "solo request not fleet-served"
+        solo.append({name: arr for name, (_l, arr) in r.fields.items()})
+    results = concurrent_runs(daemon, requests)
+    batch_ids = set()
+    for i, r in enumerate(results):
+        assert not isinstance(r, Exception), r
+        assert r.result["stopped_by"] == "completed"
+        assert r.result["iteration"] == steps
+        batch = (r.ack or {}).get("batch")
+        assert batch, "request was not served batched"
+        batch_ids.add(batch["id"])
+        ref = direct_reference(spec, requests[i]["ics"], dt, steps)
+        for name, (layout, arr) in r.fields.items():
+            assert layout == "c"
+            assert np.array_equal(arr, solo[i][name]), \
+                ("batched != solo served", spec, i, name,
+                 np.max(np.abs(arr - solo[i][name])))
+            if direct_exact:
+                assert np.array_equal(arr, ref[name]), \
+                    (spec, i, name, np.max(np.abs(arr - ref[name])))
+            else:
+                assert np.allclose(arr, ref[name], atol=1e-10), \
+                    (spec, i, name, np.max(np.abs(arr - ref[name])))
+        record = r.record
+        assert record is not None
+        assert record["serving"]["batch"]["seat"] == batch["seat"]
+        assert record["retraces_post_warmup"] == 0
+    # the three concurrent requests shared at most two batches (the
+    # anchor's batch plus, in the worst submission race, one follow-up)
+    assert len(batch_ids) <= 2, batch_ids
+
+
+# -------------------------------------------------------- late join
+
+def test_late_join_at_block_boundary(daemon):
+    """A request submitted while a batch is mid-flight joins it at a
+    block boundary (ack says late_join) and both members bit-match their
+    solo runs — the joiner's multistep ramp replays with the anchor
+    frozen."""
+    anchor_steps = 600
+    in_flight = threading.Event()
+    anchor_out = {}
+
+    def anchor():
+        client = ServiceClient(port=daemon.port, timeout=300)
+        anchor_out["r"] = client.run(
+            DIFF, ics=diff_ics(k=3, amp=0.2), dt=DT,
+            stop_iteration=anchor_steps, progress_every=5,
+            on_progress=lambda f: in_flight.set())
+
+    thread = threading.Thread(target=anchor, daemon=True)
+    thread.start()
+    assert in_flight.wait(60), "anchor produced no progress frame"
+    client = ServiceClient(port=daemon.port, timeout=300)
+    joiner = client.run(DIFF, ics=diff_ics(k=5, amp=0.7), dt=DT,
+                        stop_iteration=STEPS)
+    thread.join(timeout=300)
+    anchor_r = anchor_out["r"]
+    jbatch = (joiner.ack or {}).get("batch")
+    abatch = (anchor_r.ack or {}).get("batch")
+    assert jbatch and jbatch["late_join"], jbatch
+    assert jbatch["id"] == abatch["id"]
+    ref_a = direct_reference(DIFF, diff_ics(k=3, amp=0.2), DT,
+                             anchor_steps)
+    ref_j = direct_reference(DIFF, diff_ics(k=5, amp=0.7), DT, STEPS)
+    assert np.array_equal(anchor_r.fields["u"][1], ref_a["u"])
+    assert np.array_equal(joiner.fields["u"][1], ref_j["u"])
+    assert joiner.record["retraces_post_warmup"] == 0
+    assert anchor_r.result["iteration"] == anchor_steps
+    assert joiner.result["iteration"] == STEPS
+
+
+# ------------------------------------------------- per-member deadlines
+
+def test_member_deadline_stops_at_boundary_with_checkpoint(
+        daemon, tmp_path):
+    """Deadline skew across one batch: the short-deadline member stops
+    gracefully at a block boundary (stopped_by=deadline-exceeded) with a
+    durable validated checkpoint, while its batchmate completes
+    bit-identically — blast radius zero."""
+    ckpt = tmp_path / "member_ckpt"
+    survivor_ics = diff_ics(k=4, amp=0.3)
+    doomed = dict(spec=DIFF, ics=diff_ics(k=2, amp=0.1), dt=DT,
+                  stop_iteration=500000, deadline_sec=1.5,
+                  checkpoint=str(ckpt))
+    survivor = dict(spec=DIFF, ics=survivor_ics, dt=DT,
+                    stop_iteration=STEPS)
+    results = concurrent_runs(daemon, [doomed, survivor], stagger=0.02)
+    doomed_r, survivor_r = results
+    assert not isinstance(doomed_r, Exception), doomed_r
+    assert doomed_r.result["stopped_by"] == "deadline-exceeded"
+    assert 0 < doomed_r.result["iteration"] < 500000
+    assert doomed_r.serving["deadline_sec"] == 1.5
+    # the durable per-member checkpoint validates (solo resume format)
+    sets = sorted(ckpt.glob("*.h5"))
+    assert sets, "deadline stop wrote no durable checkpoint"
+    n_valid, reason = res_mod.validate_checkpoint(sets[-1])
+    assert n_valid >= 1, reason
+    assert not isinstance(survivor_r, Exception), survivor_r
+    assert survivor_r.result["stopped_by"] == "completed"
+    ref = direct_reference(DIFF, survivor_ics, DT, STEPS)
+    assert np.array_equal(survivor_r.fields["u"][1], ref["u"])
+    assert daemon.deadline_exceeded >= 1
+    assert_healthy(daemon, "member-deadline")
+
+
+# ---------------------------------------------------- divergent member
+
+def test_nan_member_detaches_blast_radius_zero(daemon):
+    """The batch-targeted nan_member: one request's own chaos block
+    poisons ITS member mid-batch; the per-member probe detaches it with
+    a structured `health` error at the next boundary while the clean
+    member's result stays bit-identical."""
+    before = daemon.batcher.detached.get("health", 0)
+    poisoned = dict(spec=DIFF, ics=diff_ics(k=2, amp=0.1), dt=DT,
+                    stop_iteration=400,
+                    chaos={"nan_field": "u", "nan_iteration": 16})
+    clean_ics = diff_ics(k=5, amp=0.5)
+    clean = dict(spec=DIFF, ics=clean_ics, dt=DT, stop_iteration=STEPS)
+    results = concurrent_runs(daemon, [poisoned, clean], stagger=0.02)
+    poisoned_r, clean_r = results
+    assert isinstance(poisoned_r, ServiceError), poisoned_r
+    assert poisoned_r.code == "health"
+    assert not isinstance(clean_r, Exception), clean_r
+    ref = direct_reference(DIFF, clean_ics, DT, STEPS)
+    assert np.array_equal(clean_r.fields["u"][1], ref["u"])
+    assert clean_r.record["retraces_post_warmup"] == 0
+    assert daemon.batcher.detached.get("health", 0) == before + 1
+    # a malformed chaos block is a structured bad-spec at admission —
+    # never a mid-batch blowup that could take co-tenants down
+    with pytest.raises(ServiceError) as err:
+        ServiceClient(port=daemon.port, timeout=60).run(
+            DIFF, ics=diff_ics(), dt=DT, stop_iteration=STEPS,
+            chaos={"hang_iteration": 5})
+    assert err.value.code == "bad-spec"
+    assert_healthy(daemon, "nan-member")
+
+
+# ---------------------------------------------------- client vanishes
+
+def test_vanished_client_detaches_member_mid_batch():
+    """ON_CLIENT_DROP=abort: a member whose client vanished mid-stream
+    detaches at the next boundary; the rest of the batch is
+    unperturbed."""
+    with batch_service(on_client_drop="abort") as svc:
+        anchor_steps = 800
+        in_flight = threading.Event()
+        anchor_out = {}
+
+        def anchor():
+            client = ServiceClient(port=svc.port, timeout=300)
+            anchor_out["r"] = client.run(
+                DIFF, ics=diff_ics(k=3, amp=0.2), dt=DT,
+                stop_iteration=anchor_steps, progress_every=5,
+                on_progress=lambda f: in_flight.set())
+
+        thread = threading.Thread(target=anchor, daemon=True)
+        thread.start()
+        assert in_flight.wait(60), "anchor produced no progress frame"
+        # a real socket client that joins the batch, reads its ack, then
+        # disappears without a word — mid-batch
+        header = {"kind": "run", "spec": DIFF, "dt": DT,
+                  "stop_iteration": 400, "progress_every": 5}
+        payload = protocol.encode_fields(
+            {k: v for k, v in diff_ics(k=5, amp=0.7).items()})
+        frames = chaos_mod.vanish_client(svc.port, header,
+                                         payload=payload, read_frames=1)
+        assert frames and frames[0]["kind"] == "ack"
+        assert frames[0]["batch"]["late_join"]
+        thread.join(timeout=300)
+        anchor_r = anchor_out["r"]
+        ref = direct_reference(DIFF, diff_ics(k=3, amp=0.2), DT,
+                               anchor_steps)
+        assert np.array_equal(anchor_r.fields["u"][1], ref["u"])
+        deadline = time.monotonic() + 30
+        while daemon_drops(svc) < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert daemon_drops(svc) >= 1
+        assert svc.batcher.detached.get("client-drop", 0) >= 1
+        assert_healthy(svc, "vanished-client")
+
+
+def daemon_drops(svc):
+    return svc.client_drops
+
+
+def test_sigkilled_client_mid_batch():
+    """The OS-level client vanish: a real `submit` subprocess joins a
+    live batch, streams a progress frame, and is SIGKILLed — the daemon
+    detaches that member (abort) while the anchor keeps stepping, a
+    healthy request joins the STILL-RUNNING batch bit-identically, and
+    the drain then stops the anchor gracefully."""
+    with batch_service(on_client_drop="abort") as svc:
+        in_flight = threading.Event()
+        anchor_out = {}
+
+        def anchor():
+            client = ServiceClient(port=svc.port, timeout=600)
+            try:
+                anchor_out["r"] = client.run(
+                    DIFF, ics=diff_ics(k=3, amp=0.2), dt=DT,
+                    stop_iteration=2_000_000, progress_every=50,
+                    on_progress=lambda f: in_flight.set())
+            except (ServiceError, OSError) as exc:
+                anchor_out["r"] = exc
+
+        thread = threading.Thread(target=anchor, daemon=True)
+        thread.start()
+        assert in_flight.wait(60), "anchor produced no progress frame"
+        proc = chaos_mod.sigkill_client(svc.port, DIFF, DT, 400,
+                                        after_progress_frames=1)
+        assert proc.returncode is not None
+        deadline = time.monotonic() + 60
+        while svc.batcher.detached.get("client-drop", 0) < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc.batcher.detached.get("client-drop", 0) >= 1
+        assert svc.client_drops >= 1
+        # the batch survived the kill: a fresh request joins it live and
+        # still bit-matches the direct solve
+        assert_healthy(svc, "sigkilled-client")
+        # stop the anchor through the drain path: a batched member's
+        # graceful drain stop, result frame included
+        svc.request_drain("test stop")
+        thread.join(timeout=120)
+        anchor_r = anchor_out["r"]
+        assert not isinstance(anchor_r, Exception), anchor_r
+        assert anchor_r.result["stopped_by"] == "test stop"
+        assert 0 < anchor_r.result["iteration"] < 2_000_000
+
+
+# ------------------------------------------------- watchdog batch replay
+
+def test_watchdog_abandons_batch_and_replays_survivors(tmp_path):
+    """A wedged batch (hang chaos out-sleeping WATCHDOG_SEC at a block
+    boundary) is abandoned: postmortem recorded, pool entry + fleet
+    quarantined, executor replaced — and every surviving member's
+    request is REQUEUED and served to completion by the replacement,
+    bit-identical to solo. The clients never see the fault."""
+    sink = tmp_path / "watchdog.jsonl"
+    with batch_service(watchdog_sec=6.0, sink=str(sink)) as svc:
+        # prewarm: the first batched request pays the fleet build +
+        # compile under the (generous) watchdog, so the test's hang is
+        # the only stall in the measured window
+        client = ServiceClient(port=svc.port, timeout=300)
+        client.run(DIFF, ics=diff_ics(), dt=DT, stop_iteration=STEPS)
+        hang_ics = diff_ics(k=2, amp=0.1)
+        mate_ics = diff_ics(k=5, amp=0.6)
+        hanging = dict(spec=DIFF, ics=hang_ics, dt=DT,
+                       stop_iteration=200,
+                       chaos={"hang_iteration": 50, "hang_sec": 25})
+        mate = dict(spec=DIFF, ics=mate_ics, dt=DT, stop_iteration=200)
+        t0 = time.monotonic()
+        results = concurrent_runs(svc, [hanging, mate], stagger=0.02)
+        wall = time.monotonic() - t0
+        for kw, r in zip((hanging, mate), results):
+            assert not isinstance(r, Exception), r
+            assert r.result["stopped_by"] == "completed"
+            ref = direct_reference(DIFF, kw["ics"], DT, 200)
+            assert np.array_equal(r.fields["u"][1], ref["u"]), \
+                "replayed member differs from solo"
+        # served by the replacement BEFORE the 25 s hang released the
+        # stale executor: the fire + requeue is what finished the runs
+        assert wall < 25, wall
+        assert svc.watchdog_fires == 1
+        assert svc.batcher.detached.get("watchdog", 0) >= 2
+        records = [json.loads(line) for line in
+                   sink.read_text().splitlines()]
+        posts = [r for r in records
+                 if r.get("kind") == "watchdog_postmortem"]
+        assert len(posts) == 1 and posts[0]["batch"] is True
+        assert len(posts[0]["requeued"]) == 2
+        assert_healthy(svc, "batch-watchdog")
+
+
+# ----------------------------------------------- occupancy + report CLI
+
+def test_occupancy_telemetry_and_report(daemon):
+    """The `serving.batching` stats block carries per-batch occupancy
+    (members/joins/detaches per batch), and the `report` CLI renders the
+    batching lines plus the per-record batch column."""
+    stats = ServiceClient(port=daemon.port, timeout=60).stats()
+    batching = stats["serving"]["batching"]
+    assert batching["enabled"] and batching["batches"] >= 1
+    assert batching["members"] >= 2
+    assert batching["recent_batches"]
+    event = batching["recent_batches"][-1]
+    assert {"batch_id", "members", "late_joins", "blocks", "peak_active",
+            "detached"} <= set(event)
+    # the sink's member records carry the batch column; report renders
+    # both them and a service_stats line with the occupancy block
+    stats_record = dict(stats, kind="service_stats")
+    with open(daemon.sink_path, "a") as f:
+        f.write(json.dumps(stats_record) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dedalus_tpu", "report",
+         str(daemon.sink_path)],
+        capture_output=True, text=True, cwd=REPO,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "HOME": str(REPO)}, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "batching:" in proc.stdout
+    assert "batch=batch-" in proc.stdout
