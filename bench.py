@@ -314,6 +314,7 @@ def _attach_progression(record):
     _attach_serving(record)
     _attach_adjoint(record)
     _attach_checkpoint(record)
+    _attach_fusion(record)
     return record
 
 
@@ -511,6 +512,38 @@ def _attach_checkpoint(record):
         "age_s": round(time.time() - row["ts"], 1)
         if row.get("ts") else None,
     }
+    return record
+
+
+def _attach_fusion(record):
+    """Attach the newest in-window fusion benchmark headlines (fused vs
+    unfused steps/s + per-phase breakdown, benchmarks/fusion.py) to the
+    official bench line. Same provenance discipline as the ensemble/
+    serving/adjoint rows: a CACHED prior measurement, stamped stale with
+    its original measured_ts and age, dropped once outside the 48h
+    window. Fusion rows are CPU-measured by design (ROADMAP platform
+    note), so no backend filter."""
+    for key, config in (("fusion_rb256x64", "rb256x64_fusion"),
+                        ("fusion_diffusion64", "diffusion64_fusion")):
+        row = _recent_row(
+            lambda r, c=config: (r.get("config") == c
+                                 and r.get("fusion_speedup") is not None
+                                 and r.get("finite")))
+        if row is None:
+            continue
+        record[key] = {
+            "steps_per_sec_unfused": row.get("steps_per_sec_unfused"),
+            "steps_per_sec_fused": row.get("steps_per_sec_fused"),
+            "fusion_speedup": row.get("fusion_speedup"),
+            "meets_1p15x": row.get("meets_1p15x"),
+            "state_rel_diff": row.get("state_rel_diff"),
+            "fusion": row.get("fusion"),
+            "backend": row.get("backend"),
+            "stale": True,
+            "measured_ts": row.get("ts"),
+            "age_s": round(time.time() - row["ts"], 1)
+            if row.get("ts") else None,
+        }
     return record
 
 

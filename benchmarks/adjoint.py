@@ -46,29 +46,16 @@ def mark(msg):
 
 
 def build_diffusion(size=64):
-    """1-D forced heat IVP with parameter field `a` and forcing `f` —
-    all three differentiable operand classes present."""
-    import dedalus_tpu.public as d3
-    xc = d3.Coordinate("x")
-    dist = d3.Distributor(xc, dtype=np.float64)
-    xb = d3.RealFourier(xc, size=size, bounds=(0, 2 * np.pi))
-    u = dist.Field(name="u", bases=xb)
-    a = dist.Field(name="a", bases=xb)
-    f = dist.Field(name="f", bases=xb)
-    dx = lambda A: d3.Differentiate(A, xc)
-    problem = d3.IVP([u], namespace={"u": u, "a": a, "f": f,
-                                     "lap": d3.lap, "dx": dx})
-    # the Burgers term matters twice over: it exercises the dealiased
-    # transform chain under the adjoint, and it is what makes the
-    # backward pass STORE per-step residuals — a linear RHS needs none,
-    # and the checkpoint_segments memory sweep would show nothing
-    problem.add_equation("dt(u) - lap(u) = a*u + f - u*dx(u)")
-    x = dist.local_grid(xb)
-    u["g"] = np.sin(3 * x)
-    a["g"] = 0.1 * np.cos(x)
-    f["g"] = 0.05 * np.sin(2 * x)
-    return problem.build_solver(d3.SBDF2, warmup_iterations=2,
-                                enforce_real_cadence=0)
+    """The shared adjoint/fusion benchmark diffusion problem — all three
+    differentiable operand classes present (`u` IC, parameter `a`,
+    forcing `f`), and the Burgers term matters twice over: it exercises
+    the dealiased transform chain under the adjoint, and it is what
+    makes the backward pass STORE per-step residuals — a linear RHS
+    needs none, and the checkpoint_segments memory sweep would show
+    nothing. ONE definition in extras so cross-benchmark rows stay
+    comparable."""
+    from dedalus_tpu.extras.bench_problems import build_diffusion_solver
+    return build_diffusion_solver(size)
 
 
 def build_div(segments):

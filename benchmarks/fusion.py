@@ -1,0 +1,214 @@
+"""
+Fusion benchmark: fused vs unfused steps/s and per-phase breakdown on
+diffusion64 + rb256x64, in ONE process (ISSUE-12 acceptance: >= 1.15x on
+the rb256x64 CPU headline, recorded in results.jsonl).
+
+For each problem the solver is built twice from identical initial
+conditions — once with every [fusion] flag forced off (the exact legacy
+step path), once at the shipped defaults (core/fusedstep.py resolve) —
+and each build measures post-compile steps/s over scanned step_many
+blocks (medians; this box's CPU timings wobble ~20%) plus the sampled
+phase-probe breakdown. The two trajectories are compared after the same
+number of steps: FUSED_MATVEC is bitwise, the precomposed-substitution
+solve moves results at the eps*cond(block) level and the refinement
+sweep polishes it back, so the recorded `state_rel_diff` documents the
+fused-vs-unfused tolerance class alongside the speedup.
+
+Appends `diffusion64_fusion` + `rb256x64_fusion` rows to
+benchmarks/results.jsonl; bench.py `_attach_fusion` re-reports the
+newest in-window row stale-stamped like the ensemble/serving/adjoint
+rows. Exits nonzero when the rb256x64 speedup misses the 1.15x bar.
+
+Run: python benchmarks/fusion.py [--quick]
+  --quick   shortens windows (CI smoke; no rows appended, so a smoke
+            run never shadows the full measurement).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-measured by design while the chip is unclaimable (ROADMAP platform
+# note); an explicit JAX_PLATFORMS wins.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[fusion {time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def set_fusion(mode):
+    """Force every [fusion] flag ('off') or restore shipped defaults."""
+    from dedalus_tpu.tools.config import config
+    if not config.has_section("fusion"):
+        config.add_section("fusion")
+    if mode == "off":
+        for key in ("FUSED_SOLVE", "FUSED_MATVEC", "FUSED_TRANSFORMS",
+                    "DONATE_STEP", "PALLAS"):
+            config["fusion"][key] = "off"
+    else:
+        for key in ("FUSED_SOLVE", "FUSED_MATVEC", "FUSED_TRANSFORMS",
+                    "DONATE_STEP"):
+            config["fusion"][key] = "auto"
+        config["fusion"]["PALLAS"] = "off"
+
+
+def build_diffusion(size=64, dtype=np.float64):
+    """The shared adjoint/fusion benchmark diffusion problem (ONE
+    definition in extras so the cross-benchmark rows stay comparable)."""
+    from dedalus_tpu.extras.bench_problems import build_diffusion_solver
+    return build_diffusion_solver(size, dtype), 1e-3
+
+
+def build_rb(dtype):
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    solver, _b = build_rb_solver(256, 64, dtype, matsolver="banded")
+    return solver, 0.01
+
+
+def probe_phases(solver, reps=12):
+    """Median wall ms of each compiled phase probe (rhs_eval / matsolve /
+    fused_step when present), compile excluded."""
+    import jax
+    probes = solver.timestepper.phase_probes()
+    if probes is None:
+        return {}
+    out = {}
+    for name, (thunk, scale) in probes.items():
+        jax.block_until_ready(thunk())
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(thunk())
+            times.append(time.perf_counter() - t0)
+        out[f"{name}_ms"] = round(1e3 * float(np.median(times))
+                                  * float(scale), 3)
+    return out
+
+
+def measure(build, n_steps, block, blocks):
+    """Build, advance n_steps (trajectory checkpointing), then measure
+    median steps/s over `blocks` scanned step_many blocks."""
+    import jax
+    solver, dt = build()
+    # trajectory steps run singly so only ONE scanned block size
+    # compiles below — the retrace sentinel stays quiet post-warmup
+    for _ in range(n_steps):
+        solver.step(dt)
+    jax.block_until_ready(solver.X)
+    state = np.asarray(solver.X).copy()
+    solver.step_many(block, dt)               # compile the block program
+    jax.block_until_ready(solver.X)
+    rates = []
+    for _ in range(blocks):
+        t0 = time.perf_counter()
+        solver.step_many(block, dt)
+        jax.block_until_ready(solver.X)
+        rates.append(block / (time.perf_counter() - t0))
+    phases = probe_phases(solver)
+    finite = bool(np.isfinite(np.asarray(solver.X)).all())
+    return {
+        "steps_per_sec": round(float(np.median(rates)), 3),
+        "steps_per_sec_iqr": round(float(np.percentile(rates, 75)
+                                         - np.percentile(rates, 25)), 3),
+        "phases_ms": phases,
+        "finite": finite,
+    }, state
+
+
+def run_case(name, build, dtype, n_steps, block, blocks):
+    import jax
+    from dedalus_tpu.core.fusedstep import resolve_fusion
+    mark(f"{name}: building UNFUSED (all [fusion] flags off)")
+    set_fusion("off")
+    unfused, state_u = measure(build, n_steps, block, blocks)
+    mark(f"{name}: unfused {unfused['steps_per_sec']} steps/s "
+         f"(IQR {unfused['steps_per_sec_iqr']})")
+    mark(f"{name}: building FUSED (shipped defaults)")
+    set_fusion("auto")
+    plan = resolve_fusion()
+    fused, state_f = measure(build, n_steps, block, blocks)
+    mark(f"{name}: fused {fused['steps_per_sec']} steps/s "
+         f"(IQR {fused['steps_per_sec_iqr']})")
+    scale = float(np.max(np.abs(state_u))) or 1.0
+    rel = float(np.max(np.abs(state_f - state_u)) / scale)
+    speedup = (fused["steps_per_sec"] / unfused["steps_per_sec"]
+               if unfused["steps_per_sec"] else 0.0)
+    row = {
+        "config": f"{name}_fusion",
+        "backend": jax.default_backend(),
+        # the dtype actually passed to the builds, not re-derived — row
+        # provenance must track a future sweep/flag changing main()'s pick
+        "dtype": str(np.dtype(dtype)),
+        "steps_per_sec_unfused": unfused["steps_per_sec"],
+        "steps_per_sec_fused": fused["steps_per_sec"],
+        "steps_per_sec_iqr_unfused": unfused["steps_per_sec_iqr"],
+        "steps_per_sec_iqr_fused": fused["steps_per_sec_iqr"],
+        "fusion_speedup": round(speedup, 3),
+        "meets_1p15x": bool(speedup >= 1.15),
+        "phases_ms_unfused": unfused["phases_ms"],
+        "phases_ms_fused": fused["phases_ms"],
+        # fused-vs-unfused trajectory agreement after the same steps:
+        # the documented tolerance class of the precomposed substitution
+        # (FUSED_MATVEC alone is bitwise; see tests/test_fusion.py)
+        "state_rel_diff": rel,
+        "trajectory_steps": n_steps,
+        "finite": bool(unfused["finite"] and fused["finite"]),
+        "fusion": {"solve": plan.solve, "matvec": plan.matvec,
+                   "transforms": plan.transforms, "donate": plan.donate,
+                   "pallas": plan.pallas},
+        "ts": round(time.time(), 1),
+    }
+    mark(f"{name}: speedup {row['fusion_speedup']}x "
+         f"(state rel diff {rel:.3e})")
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    quick = "--quick" in sys.argv
+    from __graft_entry__ import _append_result
+    if quick:
+        _append_result = lambda record: None  # noqa: E731, F841
+    import numpy as np  # noqa: F401,F811
+    import jax
+    dtype = np.float64 if jax.default_backend() == "cpu" else np.float32
+    n_steps = 8 if quick else 20
+    rows = [
+        run_case("diffusion64",
+                 lambda: build_diffusion(64, dtype),
+                 dtype, n_steps, block=32 if quick else 200,
+                 blocks=3 if quick else 7),
+        run_case("rb256x64",
+                 lambda: build_rb(dtype),
+                 dtype, n_steps, block=8 if quick else 30,
+                 blocks=3 if quick else 7),
+    ]
+    ok = True
+    for row in rows:
+        if not row["finite"] or row["state_rel_diff"] > 1e-6:
+            mark(f"FAIL: {row['config']} non-finite or fused trajectory "
+                 f"off ({row['state_rel_diff']:.3e}); rows not recorded")
+            ok = False
+    if ok:
+        for row in rows:
+            _append_result(row)
+    rb = rows[1]
+    if not ok:
+        sys.exit(1)
+    if not rb["meets_1p15x"]:
+        mark(f"FAIL: rb256x64 fusion speedup {rb['fusion_speedup']}x "
+             "< 1.15x bar")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -330,6 +330,19 @@ def report(args):
                           f"member-steps/s "
                           f"({point.get('speedup_vs_serial', '?')}x serial,"
                           f" {point.get('devices', '?')} device(s))")
+            # fusion benchmark rows (benchmarks/fusion.py): fused vs
+            # unfused steps/s and the documented trajectory tolerance
+            if record.get("fusion_speedup") is not None:
+                plan = record.get("fusion") or {}
+                on = "+".join(k for k in ("solve", "matvec", "transforms",
+                                          "donate", "pallas")
+                              if plan.get(k)) or "off"
+                print(f"    fusion: "
+                      f"{record.get('steps_per_sec_unfused', '?')} -> "
+                      f"{record.get('steps_per_sec_fused', '?')} steps/s "
+                      f"({record.get('fusion_speedup', '?')}x, {on}; "
+                      f"state rel diff "
+                      f"{record.get('state_rel_diff', '?')})")
             # serving benchmark rows (benchmarks/serving.py): the cold-
             # miss vs warm-hit time-to-first-step comparison in one line
             if record.get("ttfs_cold_sec") is not None \

@@ -52,7 +52,12 @@ class CompiledWithFallback:
 
 
 class EvalContext:
-    """Carries substitutions (Field -> traced coeff array) and the memo."""
+    """Carries substitutions (Field -> traced coeff array) and the memo.
+    `fusion` (set by the IVP's RHS evaluator) carries the solver's
+    FusedEvalPlan so LinearOperator grid evaluations can route through
+    precomposed composite GEMMs (core/fusedstep.py); None = generic."""
+
+    fusion = None
 
     def __init__(self, subs=None):
         self.subs = subs or {}

@@ -267,6 +267,22 @@ class LinearOperator(Future):
             total = term if total is None else total + term
         return total
 
+    def ev(self, ctx, layout):
+        # fused grid evaluation (core/fusedstep.py FUSED_TRANSFORMS): a
+        # registered node's coupled-axis operator chain + dealiased
+        # backward transform run as one precomposed composite GEMM,
+        # skipping the intermediate coefficient layout. Nodes outside
+        # the plan (or contexts without one) take the generic path.
+        if layout == "g" and ctx.fusion is not None:
+            key = (id(self), layout)
+            if key in ctx.memo:
+                return ctx.memo[key]
+            out = ctx.fusion.grid_eval(self, ctx)
+            if out is not None:
+                ctx.memo[key] = out
+                return out
+        return super().ev(ctx, layout)
+
 
 # ----------------------------------------------------------------------
 # Differentiate

@@ -88,6 +88,14 @@ class SolverBase:
         per-group scipy walk otherwise.
         """
         names = self.matrices
+        # resolve the [fusion] composition ONCE, before anything keys on
+        # or compiles under it: solver_key's fusion token, BandedOps'
+        # switches, the timestepper's donation contract and the eval plan
+        # all read THIS plan, so a config mutation mid-build (tests and
+        # benchmarks flip flags in-process) can never split one solver
+        # across two compositions
+        from . import fusedstep
+        self._fusion_plan = fusedstep.resolve_fusion()
         G, S = self.pencil_shape
         dense_bytes = G * S * S * np.dtype(self.pencil_dtype).itemsize
         lazy_bytes = int(config["linear algebra"].get(
@@ -357,7 +365,8 @@ class SolverBase:
             self._banded_reason = str(exc)
             return (coo_store, masks)
         self.structure = structure
-        self.ops = pencilops.BandedOps(structure)
+        self.ops = pencilops.BandedOps(
+            structure, fusion=getattr(self, "_fusion_plan", None))
         logger.info(
             f"Pencil system: banded path (S={structure.S}, "
             f"pins={structure.t_pins}, kl={structure.kl}, "
@@ -542,6 +551,10 @@ class SolverBase:
             if extra_arrays is not None:
                 subs.update(zip(extra_fields, extra_arrays))
             ctx = EvalContext(subs)
+            # fused operator-chain composites ride into the traced
+            # evaluator (read per trace: the plan is built after this
+            # evaluator, at solver construction)
+            ctx.fusion = getattr(self, "_fused_eval_plan", None)
             parts = []
             for eq, masks in zip(equations, member_masks):
                 size = layout.slot_size(eq["domain"], eq["tensorsig"])
@@ -587,6 +600,13 @@ class InitialValueSolver(SolverBase):
             self.L_mat = self.ops.to_device(self._matrices["L"],
                                             self.pencil_dtype)
         self.eval_F = self.build_rhs_evaluator("F", time_field=problem.time)
+        # fused RHS operator chains (core/fusedstep.py FUSED_TRANSFORMS):
+        # foldable linear-operator nodes get host-precomposed
+        # backward-MMT @ operator composite GEMMs, persisted through the
+        # assembly cache; None when transform fusion is off or nothing
+        # folds. Read at trace time via EvalContext.fusion.
+        from . import fusedstep
+        self._fused_eval_plan = fusedstep.build_eval_plan(self)
         # timestepping state
         self.sim_time = 0.0
         self.initial_sim_time = 0.0
@@ -977,12 +997,19 @@ class InitialValueSolver(SolverBase):
             trans = m.time_thunk("transform", lambda: proj(self.X)) * scale
             rhs = times.get("rhs_eval", 0.0)
             trans = min(trans, rhs) if rhs else trans
-            m.add_phase_sample({
+            sample = {
                 "transform": trans,
                 "evaluator": max(rhs - trans, 0.0),
                 "matsolve": times.get("matsolve", 0.0),
                 "transpose": times.get("transpose", 0.0),
-            })
+            }
+            if "fused_step" in times:
+                # the whole fused step program re-measured as its own row:
+                # an ALTERNATIVE whole-step attribution that OVERLAPS the
+                # split rows above, so metrics excludes it from the phase
+                # sum (SUM_PHASES) — fused < sum(split) is the fusion win
+                sample["fused"] = times["fused_step"]
+            m.add_phase_sample(sample)
         return True
 
     def flush_metrics(self, extra=None):

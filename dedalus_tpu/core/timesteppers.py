@@ -127,10 +127,21 @@ class MultistepIMEX:
         self.solver = solver
         G, S = solver.pencil_shape
         s = self.steps
-        zeros = jnp.zeros((s, G, S), dtype=solver.pencil_dtype)
-        self.F_hist = zeros
-        self.MX_hist = zeros
-        self.LX_hist = zeros
+        # fused-step plan: the one the SOLVER resolved at build start
+        # (core/solvers.py), so a mid-build/mid-run config edit can
+        # never split one scheme across two compositions; donation
+        # applies to the fused (non-split) step programs only
+        from .fusedstep import resolve_fusion
+        self._fusion = getattr(solver, "_fusion_plan", None) \
+            or resolve_fusion()
+        self._split = _use_split_step(solver)
+        self.donates_histories = self._fusion.donate and not self._split
+        # three DISTINCT zero buffers: the donating step program aliases
+        # each history input to its output, so sharing one interned zeros
+        # array across the three would alias two donated params
+        self.F_hist = jnp.zeros((s, G, S), dtype=solver.pencil_dtype)
+        self.MX_hist = jnp.zeros((s, G, S), dtype=solver.pencil_dtype)
+        self.LX_hist = jnp.zeros((s, G, S), dtype=solver.pencil_dtype)
         self.dt_hist = []
         self._lhs_key = None
         self._lhs_aux = None
@@ -170,10 +181,17 @@ class MultistepIMEX:
 
         # the fused step body composes the same two pieces the split mode
         # dispatches separately, so the numerics cannot drift between modes
+        pair = (self._fusion.matvec and hasattr(ops, "matvec_pair"))
+
         def eval_parts(M, L, X, t, extra):
             pin = _mesh_pin(solver)
-            return pin((eval_F(X, t, extra) * mask(), ops.matvec(M, X),
-                        ops.matvec(L, X)))
+            if pair:
+                # one-pass M/L pair (bitwise-identical components;
+                # core/fusedstep.py FUSED_MATVEC)
+                MXn, LXn = ops.matvec_pair(M, L, X)
+            else:
+                MXn, LXn = ops.matvec(M, X), ops.matvec(L, X)
+            return pin((eval_F(X, t, extra) * mask(), MXn, LXn))
 
         def update_solve(Fn, MXn, LXn, F_hist, MX_hist, LX_hist, a, b, c,
                          lhs_aux, M, L):
@@ -210,8 +228,20 @@ class MultistepIMEX:
             return Xn, F_hist, MX_hist, LX_hist
 
         self._factor = _factor
-        self._advance = lifted_jit(advance_body)
-        self._advance_n = lifted_jit(_advance_n, static_argnums=(11,))
+        # the fused whole-step programs donate the history buffers
+        # (args 5-7: F/MX/LX) when DONATE_STEP is on, so XLA rolls the
+        # histories in place instead of allocating fresh ones each step;
+        # cross-step reference holders (snapshot ring, async checkpoint
+        # capture, the probe cache below) copy under donates_histories
+        donate = (5, 6, 7) if self.donates_histories else ()
+        self._advance = lifted_jit(advance_body, donate_argnums=donate)
+        self._advance_n = lifted_jit(_advance_n, static_argnums=(11,),
+                                     donate_argnums=donate)
+        # non-donating twin for the fused-phase probe: a donating program
+        # would consume the probe cache's snapshot inputs on first use
+        # (compiled once at warmup end, outside measured windows)
+        self._advance_probe = self._advance if not donate \
+            else lifted_jit(advance_body)
         # ensemble hook (core/ensemble.py): the raw, un-jitted step body,
         # vmapped over a leading member axis by EnsembleSolver — the same
         # composition the fused program compiles, so fleet numerics cannot
@@ -220,8 +250,8 @@ class MultistepIMEX:
 
         # split-step pieces: the SAME bodies the fused program composes,
         # compiled as separate (smaller) device programs for very large
-        # systems (see _use_split_step)
-        self._split = _use_split_step(solver)
+        # systems (see _use_split_step; self._split set in __init__ ahead
+        # of the donation wiring)
         self._eval_parts = lifted_jit(eval_parts)
         self._update_solve = lifted_jit(update_solve)
 
@@ -269,10 +299,13 @@ class MultistepIMEX:
         solver performs."""
         solver = self.solver
         G, S = solver.pencil_shape
-        zeros = jnp.zeros((self.steps, G, S), dtype=solver.pencil_dtype)
-        self.F_hist = zeros
-        self.MX_hist = zeros
-        self.LX_hist = zeros
+        # distinct buffers: see __init__ (donated inputs must not alias)
+        self.F_hist = jnp.zeros((self.steps, G, S),
+                                dtype=solver.pencil_dtype)
+        self.MX_hist = jnp.zeros((self.steps, G, S),
+                                 dtype=solver.pencil_dtype)
+        self.LX_hist = jnp.zeros((self.steps, G, S),
+                                 dtype=solver.pencil_dtype)
         self.dt_hist = []
         self.iteration = 0
 
@@ -383,7 +416,10 @@ class MultistepIMEX:
             # probe-input warm: runs once per LHS key under the metrics
             # cadence gate, never in the measured step path
             jax.block_until_ready((Fn, MXn, LXn))  # dedalus-lint: disable=DTL001
-            hists = (self.F_hist, self.MX_hist, self.LX_hist)
+            # the probe cache holds cross-step references: copy under
+            # donation (the shared contract lives in guard_histories)
+            from .fusedstep import guard_histories
+            hists = guard_histories(self)
             lhs_aux = self._lhs_aux
 
             def eval_thunk():
@@ -393,9 +429,18 @@ class MultistepIMEX:
                 return self._update_solve(Fn, MXn, LXn, *hists,
                                           aj, bj, cj, lhs_aux, M, L)
 
-            cache = self._probe_cache = (
-                self._lhs_key, {"rhs_eval": (eval_thunk, 1.0),
-                                "matsolve": (solve_thunk, 1.0)})
+            probes = {"rhs_eval": (eval_thunk, 1.0),
+                      "matsolve": (solve_thunk, 1.0)}
+            if not self._split:
+                # the whole fused step program (transform -> solve in one
+                # dispatch), probed via the non-donating twin: the
+                # `fused` row of the sampled phase table (tools/metrics)
+                def fused_thunk():
+                    return self._advance_probe(M, L, X, t, extra, *hists,
+                                               aj, bj, cj, lhs_aux)
+
+                probes["fused_step"] = (fused_thunk, 1.0)
+            cache = self._probe_cache = (self._lhs_key, probes)
         return cache[1]
 
 
@@ -513,6 +558,14 @@ class RungeKuttaIMEX:
         self.iteration = 0
         self._lhs_key = None
         self._lhs_aux = None
+        # RK stages carry no cross-step history buffers: nothing to
+        # donate (the fused-solve/matvec layers of core/fusedstep.py
+        # apply through solver.ops regardless; plan kept for
+        # introspection parity with MultistepIMEX)
+        from .fusedstep import resolve_fusion
+        self._fusion = getattr(solver, "_fusion_plan", None) \
+            or resolve_fusion()
+        self.donates_histories = False
 
         eval_F = solver.eval_F  # (reset_run mirrors the per-run state)
         rd = solver.real_dtype
@@ -720,9 +773,18 @@ class RungeKuttaIMEX:
                 return self._stage_solve(1, MX0, [F1], [LX1], dtj, aux0,
                                          M, L)
 
-            cache = self._probe_cache = (
-                self._lhs_key, {"rhs_eval": (eval_thunk, s),
-                                "matsolve": (solve_thunk, s)})
+            probes = {"rhs_eval": (eval_thunk, s),
+                      "matsolve": (solve_thunk, s)}
+            if not self._split:
+                # the whole fused step program (all stages in one
+                # dispatch); non-mutating — step_body returns a fresh X
+                lhs_auxs = self._lhs_aux
+
+                def fused_thunk():
+                    return self._step(M, L, X, t, dtj, extra, lhs_auxs)
+
+                probes["fused_step"] = (fused_thunk, 1.0)
+            cache = self._probe_cache = (self._lhs_key, probes)
         return cache[1]
 
 

@@ -8,6 +8,32 @@ benchmarks, and the emulated-f64 regression tests.
 import numpy as np
 
 
+def build_diffusion_solver(size=64, dtype=np.float64):
+    """1-D forced nonlinear heat IVP (SBDF2, dense pencil path): the
+    shared small problem behind the adjoint and fusion benchmark rows —
+    parameter field `a`, forcing `f`, and a Burgers term so the dealiased
+    transform chain and per-step residual storage are both exercised.
+    ONE definition so the cross-benchmark results.jsonl comparisons stay
+    on the same physics."""
+    import dedalus_tpu.public as d3
+    xc = d3.Coordinate("x")
+    dist = d3.Distributor(xc, dtype=dtype)
+    xb = d3.RealFourier(xc, size=size, bounds=(0, 2 * np.pi))
+    u = dist.Field(name="u", bases=xb)
+    a = dist.Field(name="a", bases=xb)
+    f = dist.Field(name="f", bases=xb)
+    dx = lambda A: d3.Differentiate(A, xc)  # noqa: E731
+    problem = d3.IVP([u], namespace={"u": u, "a": a, "f": f,
+                                     "lap": d3.lap, "dx": dx})
+    problem.add_equation("dt(u) - lap(u) = a*u + f - u*dx(u)")
+    x = dist.local_grid(xb)
+    u["g"] = np.sin(3 * x)
+    a["g"] = 0.1 * np.cos(x)
+    f["g"] = 0.05 * np.sin(2 * x)
+    return problem.build_solver(d3.SBDF2, warmup_iterations=2,
+                                enforce_real_cadence=0)
+
+
 def build_rb_solver(Nx, Nz, dtype, mesh=None, matsolver=None):
     import dedalus_tpu.public as d3
     Lx, Lz = 4.0, 1.0

@@ -150,12 +150,22 @@ class AdjointSolveOps:
         rule; linearizing first marks them. The linearization point is
         zeros, so every primal-side value is a DCE-able constant and the
         compiled backward contains just the transposed solve."""
-        with jax.named_scope(f"dedalus/matsolve/{self.kind}.solve_T"):
-            _, f_vjp = jax.vjp(
-                lambda r: self._solve_impl(aux, r, mats),
-                jnp.zeros_like(rhs))
-            (out,) = f_vjp(rhs)
-            return out
+        # the experimental Pallas substitution is not differentiable
+        # (jax.vjp cannot trace through pallas_call): transpose against
+        # the XLA-scan fused path instead — identical linear algebra on
+        # the same precomposed operators, so the adjoint contract holds
+        # under every [fusion] composition
+        pallas = getattr(self, "_pallas", False)
+        self._pallas = False
+        try:
+            with jax.named_scope(f"dedalus/matsolve/{self.kind}.solve_T"):
+                _, f_vjp = jax.vjp(
+                    lambda r: self._solve_impl(aux, r, mats),
+                    jnp.zeros_like(rhs))
+                (out,) = f_vjp(rhs)
+                return out
+        finally:
+            self._pallas = pallas
 
 
 def shard_groups(fn, G, *args):
@@ -203,6 +213,14 @@ class DenseOps(AdjointSolveOps):
     def matvec(self, A, X):
         with jax.named_scope("dedalus/matsolve/dense.matvec"):
             return jnp.einsum("gij,gj->gi", A, X)
+
+    def matvec_pair(self, M, L, X):
+        """(M @ X, L @ X) — the fused-step pair surface (core/fusedstep).
+        Dense matvecs share nothing to factor out, so this is the two
+        einsums (bitwise identical to separate calls by construction)."""
+        with jax.named_scope("dedalus/matsolve/dense.matvec_pair"):
+            return (jnp.einsum("gij,gj->gi", M, X),
+                    jnp.einsum("gij,gj->gi", L, X))
 
     def lincomb(self, a, A, b, B):
         return a * A + b * B
@@ -273,7 +291,7 @@ class BandedOps(AdjointSolveOps):
 
     kind = "banded"
 
-    def __init__(self, structure, refine=1):
+    def __init__(self, structure, refine=1, fusion=None):
         st = structure
         # Structures arrive either freshly finalized or rehydrated from
         # the persistent assembly cache (MatrixStructure.from_state);
@@ -290,6 +308,21 @@ class BandedOps(AdjointSolveOps):
                 f"stale assembly-cache payload?)")
         self.st = st
         self.refine = int(refine)
+        # fused-step switches: callers in a solver build pass the plan
+        # the solver resolved ONCE (solver._fusion_plan) so mid-build
+        # config edits can never split one solver across two
+        # compositions; standalone constructions resolve fresh.
+        # FUSED_SOLVE engages on the factor_lincomb paths only (the IVP
+        # step loop, where the factor-time inversion cost is amortized);
+        # plain factor() keeps the backward-stable pivoted substitution
+        # for the one-factor-one-solve solver classes.
+        if fusion is None:
+            from ..core.fusedstep import resolve_fusion
+            fusion = resolve_fusion()
+        plan = fusion
+        self._fused_solve = plan.solve
+        self._fused_matvec = plan.matvec
+        self._pallas = plan.pallas
         # pencil-batch chunking (lax.map over G-chunks): bounds the
         # factorization's HLO temp footprint AND forces the scan-stacked
         # factor outputs into flat (Gc, 2q*q) layouts that tile (8, 128)
@@ -444,6 +477,26 @@ class BandedOps(AdjointSolveOps):
             out = jnp.zeros_like(X)
             return out.at[:, self.row_perm].set(yp[:, :self.n])
 
+    def matvec_pair(self, M, L, X):
+        """(M @ X, L @ X) in ONE pass over the operand: the fused-step
+        pair surface (core/fusedstep.py). The column permutation, pad,
+        pin einsums and row scatter run once over a shared padded X; each
+        matrix keeps its own trimmed diagonal loop, so both outputs are
+        BITWISE identical to separate `matvec` calls."""
+        with jax.named_scope("dedalus/matsolve/banded.matvec_pair"):
+            width = M.bands.shape[-1]
+            xp = X[:, self.col_perm]
+            xp = jnp.pad(xp, ((0, 0), (0, width - self.n)))
+            outs = []
+            for A in (M, L):
+                yp = self._band_mv(A.bands, A.dsel, xp)
+                if self.t and A.Vt is not None:
+                    pin_vals = jnp.einsum("gtn,gn->gt", A.Vt, xp)
+                    yp = yp.at[:, self.pin_pos].add(pin_vals)
+                out = jnp.zeros_like(X)
+                outs.append(out.at[:, self.row_perm].set(yp[:, :self.n]))
+            return tuple(outs)
+
     def _chunk_blocks(self, chunk):
         """One block-row's (G, D, q) band chunk -> (diag, left, right) blocks
         ((i, i), (i, i-1), (i, i+1)); avoids materializing the full block
@@ -522,8 +575,104 @@ class BandedOps(AdjointSolveOps):
         lu, _, lastP = jax.lax.linalg.lu(A11_f)
         return (perms, panelLU, U12, lastP, lu)
 
-    def _solve_interior(self, interior_aux, f):
+    def _precompose_subst(self, interior):
+        """Precomposed matmul-substitution operators (FUSED_SOLVE,
+        core/fusedstep.py). At factor time each panel's unit-lower and
+        upper blocks are inverted (one batched triangular solve against
+        the identity over every block row at once) and FOLDED with the
+        window permutation and the elimination update into per-step
+        GEMM operators:
+
+            fwd:  [y_i; w_next] = FwdOp_i @ [w; f_{i+1}]
+                  FwdOp_i = [[L1inv P_top], [P_bot - L2 L1inv P_top]]
+            bwd:  x_i = BwdOp_i @ [y_i; x_{i+1}; x_{i+2}]
+                  BwdOp_i = [U11inv | -U11inv U12]
+            last: x = lastOp @ w,  lastOp = U^-1 L^-1 P
+
+        so every substitution scan step is ONE batched (2q, 2q)-class
+        matmul — no triangular-solve custom calls, no gathers, no
+        separate elimination update (measured ~19x per triangular solve
+        and ~2x per scan step in op overhead on CPU; the TPU dense
+        path's BatchedInverse principle applied to the banded factors).
+        The substitution result moves off the backward-stable sweep by
+        ~eps*cond(block); the refinement polish (refine >= 1) drives the
+        final residual back to the unfused level — the documented
+        fused-vs-unfused tolerance (tests/test_fusion.py)."""
+        perms, panelLU, U12, lastP, lastLU = interior
+        q = self.q
+        dtype = lastLU.dtype
+        eye = jnp.eye(q, dtype=dtype)
+
+        def inv_lower(lu):
+            L1 = jnp.tril(lu, -1) + eye
+            return jsl.solve_triangular(
+                L1, jnp.broadcast_to(eye, L1.shape), lower=True,
+                unit_diagonal=True)
+
+        def inv_upper(lu):
+            return jsl.solve_triangular(
+                jnp.triu(lu), jnp.broadcast_to(eye, lu.shape), lower=False)
+
+        # last block: A^-1 P = U^-1 L^-1 P composed once (perm folded)
+        lastPmat = jax.nn.one_hot(lastP, q, dtype=dtype, axis=-1)
+        fsub = {"lastOp": inv_upper(lastLU) @ inv_lower(lastLU) @ lastPmat}
+        if panelLU is not None:
+            steps, G = panelLU.shape[:2]
+            lu = panelLU.reshape(steps * G, 2 * q, q)
+            L1inv = inv_lower(lu[:, :q, :])
+            U11inv = inv_upper(lu[:, :q, :])
+            Pmat = jax.nn.one_hot(perms.reshape(steps * G, 2 * q), 2 * q,
+                                  dtype=dtype, axis=-1)
+            top = L1inv @ Pmat[:, :q, :]                      # (., q, 2q)
+            bot = Pmat[:, q:, :] - lu[:, q:, :] @ top
+            fwd_op = jnp.concatenate([top, bot], axis=1)      # (., 2q, 2q)
+            bwd_op = jnp.concatenate(
+                [U11inv, -(U11inv @ U12.reshape(steps * G, q, 2 * q))],
+                axis=2)                                       # (., q, 3q)
+            fsub["FwdOp"] = fwd_op.reshape(steps, G, 4 * q * q)
+            fsub["BwdOp"] = bwd_op.reshape(steps, G, 3 * q * q)
+        return fsub
+
+    def _solve_interior_fused(self, interior_aux, f, fsub):
+        """Solve B~ x = f via the precomposed substitution operators: the
+        same blocked sweeps as `_solve_interior`, each scan step one
+        batched GEMM against the factor-time FwdOp/BwdOp."""
+        G, _, k = f.shape
+        q, NB = self.q, self.NB
+        lastOp = fsub["lastOp"]
+        fb = jnp.moveaxis(f.reshape(G, NB, q, k), 1, 0).reshape(NB, G, q * k)
+        if NB == 1:
+            x = lastOp @ fb[0].reshape(G, q, k)
+            return jnp.moveaxis(x[None], 0, 1).reshape(G, self.n_pad, k)
+
+        def fwd(w_cur, xs):
+            f_next, op_flat = xs
+            wf = jnp.concatenate([w_cur, f_next.reshape(G, q, k)], axis=1)
+            yw = op_flat.reshape(G, 2 * q, 2 * q) @ wf
+            return yw[:, q:], yw[:, :q].reshape(G, q * k)
+
+        w_f, ys = jax.lax.scan(fwd, fb[0].reshape(G, q, k),
+                               (fb[1:], fsub["FwdOp"]))
+        x_last = lastOp @ w_f
+        zero = jnp.zeros_like(x_last)
+
+        def bwd(carry, xs):
+            x1, x2 = carry
+            y_flat, op_flat = xs
+            z = jnp.concatenate([y_flat.reshape(G, q, k), x1, x2], axis=1)
+            x = op_flat.reshape(G, q, 3 * q) @ z
+            return (x, x1), x.reshape(G, q * k)
+
+        _, xs_rev = jax.lax.scan(bwd, (x_last, zero),
+                                 (ys, fsub["BwdOp"]), reverse=True)
+        x = jnp.concatenate([xs_rev.reshape(NB - 1, G, q, k),
+                             x_last[None]], axis=0)
+        return jnp.moveaxis(x, 0, 1).reshape(G, self.n_pad, k)
+
+    def _solve_interior(self, interior_aux, f, fsub=None):
         """Solve B~ x = f for f (G, n_pad, k) via the pivoted block factors."""
+        if fsub is not None:
+            return self._solve_interior_fused(interior_aux, f, fsub)
         perms, panelLU, U12, lastP, lastLU = interior_aux
         G, _, k = f.shape
         q, NB = self.q, self.NB
@@ -609,9 +758,12 @@ class BandedOps(AdjointSolveOps):
         widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
         return jnp.pad(arr, widths, mode="edge")
 
-    def _factor_core(self, bands, Vt):
+    def _factor_core(self, bands, Vt, fused=False):
         """Factor one full-lattice band slab (any leading batch size).
-        Returns (interior, Vt, YbT, CapLU) — a pytree safe to lax.map."""
+        Returns (interior, Vt, YbT, CapLU, fsub) — a pytree safe to
+        lax.map. `fused` additionally precomposes the matmul-substitution
+        inverses (FUSED_SOLVE; the Woodbury E-solve below already runs on
+        them, so fused factors are cheaper too)."""
         G = bands.shape[0]
         dtype = bands.dtype
         # identity pins at the pinned rows + padded diagonal
@@ -621,12 +773,19 @@ class BandedOps(AdjointSolveOps):
             tail = jnp.ones((G, self.n_pad - self.n), dtype=dtype)
             bands = bands.at[:, self.kl, self.n:].set(tail)
         interior = self._factor_interior(bands)
+        fsub = self._precompose_subst(interior) if fused else None
+        if fused:
+            # the fused solve consumes only fsub — dropping the pivoted
+            # factors here (not just from the host-side aux) keeps the
+            # incremental path's donated stores from materializing ~5q^2
+            # of dead factors per step next to the ~7q^2 live operators
+            interior = None
         YbT = CapLU = None
         if self.t:
             # Y = B~^-1 E  (E = one-hot columns at the pin positions)
             E = jnp.zeros((G, self.n_pad, self.t), dtype=dtype)
             E = E.at[:, self.pin_pos, jnp.arange(self.t)].set(1.0)
-            Yb = self._solve_interior(interior, E)                # (G, n_pad, t)
+            Yb = self._solve_interior(interior, E, fsub=fsub)     # (G, n_pad, t)
             # capacitance: I + (Vt - E^T) Y
             Cap = (jnp.eye(self.t, dtype=dtype)
                    + jnp.einsum("gtn,gnk->gtk", Vt, Yb)
@@ -634,14 +793,26 @@ class BandedOps(AdjointSolveOps):
             # stored (G, t, n_pad): a trailing dim of t ~ 16 pads 8x under
             # TPU (8, 128) tiling; n_pad-minor tiles cleanly
             YbT = jnp.swapaxes(Yb, 1, 2)
-            CapLU = jsl.lu_factor(Cap)
-        return (interior, Vt, YbT, CapLU)
+            if fused:
+                # the t x t capacitance solve becomes one GEMM too
+                fsub["CapInv"] = jnp.linalg.inv(Cap)
+            else:
+                CapLU = jsl.lu_factor(Cap)
+        return (interior, Vt, YbT, CapLU, fsub)
 
     def _aux_from_core(self, core, refine_aux):
-        interior, Vt, YbT, CapLU = core
-        aux = {"interior": interior, "Vt": Vt}
+        interior, Vt, YbT, CapLU, fsub = core
+        # fused solves consume only the precomposed operators — dropping
+        # the pivoted factors from the persistent aux frees ~4q^2 of the
+        # 7q^2 per-step factor storage (they were transients of fsub)
+        aux = {"Vt": Vt}
+        if fsub is None:
+            aux["interior"] = interior
+        else:
+            aux["fsub"] = fsub
         if YbT is not None:
             aux["YbT"] = YbT
+        if CapLU is not None:
             aux["Cap"] = CapLU
         aux.update(refine_aux)
         return aux
@@ -707,9 +878,10 @@ class BandedOps(AdjointSolveOps):
         # M and L themselves are NOT stored in the aux: the jitted factor
         # would return copies of both full band stores; the refinement
         # matvec receives them via solve(..., mats=(M, L))
+        fused = self._fused_solve
         if C == 1:
             bands, Vt = combine(M.bands, L.bands, M.Vt, L.Vt, G)
-            core = self._factor_core(bands, Vt)
+            core = self._factor_core(bands, Vt, fused=fused)
         else:
             G_pad = C * Gc
             has_mv = M.Vt is not None
@@ -730,7 +902,7 @@ class BandedOps(AdjointSolveOps):
                 i += has_mv
                 lv = xs[i] if has_lv else None
                 bands, Vt = combine(mb, lb, mv, lv, Gc)
-                return self._factor_core(bands, Vt)
+                return self._factor_core(bands, Vt, fused=fused)
 
             core = jax.lax.map(one, tuple(xs))
         return self._aux_from_core(core, {"ab": (a, b)})
@@ -788,7 +960,7 @@ class BandedOps(AdjointSolveOps):
         def chunk_core(mb, lb, mv, lv, a, b):
             bands, Vt = self._combine_ml(mb, lb, mv, lv, Gc, a, b,
                                          dM, dL, dtype)
-            return self._factor_core(bands, Vt)
+            return self._factor_core(bands, Vt, fused=self._fused_solve)
 
         shapes = jax.eval_shape(
             chunk_core,
@@ -832,14 +1004,30 @@ class BandedOps(AdjointSolveOps):
             return self.matvec(aux["A"], x)
         a, b = aux["ab"]
         M, L = mats
+        if self._fused_matvec:
+            # one-pass pair (bitwise-identical components): the
+            # refinement residual's two matvecs share permute/pad/scatter
+            MX, LX = self.matvec_pair(M, L, x)
+            return a * MX + b * LX
         return a * self.matvec(M, x) + b * self.matvec(L, x)
 
     def _solve_core(self, auxc, fp):
-        y = self._solve_interior(auxc["interior"], fp[..., None])[..., 0]
+        fsub = auxc.get("fsub")
+        if fsub is not None and "FwdOp" in fsub and self._pallas:
+            # experimental: the whole substitution as one Pallas kernel
+            # per group (no block-row round-trips; core/fusedstep.py)
+            from ..core.fusedstep import pallas_substitution
+            y = pallas_substitution(fsub, fp, self.q)
+        else:
+            y = self._solve_interior(auxc.get("interior"), fp[..., None],
+                                     fsub=fsub)[..., 0]
         if self.t:
             Vy = (jnp.einsum("gtn,gn->gt", auxc["Vt"], y)
                   - y[:, self.pin_pos])
-            z = jsl.lu_solve(auxc["Cap"], Vy)
+            if fsub is not None and "CapInv" in fsub:
+                z = jnp.einsum("gij,gj->gi", fsub["CapInv"], Vy)
+            else:
+                z = jsl.lu_solve(auxc["Cap"], Vy)
             y = y - jnp.einsum("gtn,gt->gn", auxc["YbT"], z)
         return y
 
@@ -847,17 +1035,19 @@ class BandedOps(AdjointSolveOps):
         G = rhs.shape[0]
         fp = rhs[:, self.row_perm]
         fp = jnp.pad(fp, ((0, 0), (0, self.n_pad - self.n)))
-        # chunking is read off the aux's own stacked shapes (lastLU is
-        # (G, q, q) unchunked, (C, Gc, q, q) chunked) — instance state
-        # would go stale across auxes factored under different configs
-        lastLU = aux["interior"][-1]
-        C = lastLU.shape[0] if lastLU.ndim == 4 else 1
+        # chunking is read off the aux's own stacked shapes ((G, q, q)
+        # unchunked, (C, Gc, q, q) chunked) — instance state would go
+        # stale across auxes factored under different configs
+        probe = (aux["fsub"]["lastOp"] if "fsub" in aux
+                 else aux["interior"][-1])
+        C = probe.shape[0] if probe.ndim == 4 else 1
         if C == 1:
             y = self._solve_core(aux, fp)
         else:
-            Gc = lastLU.shape[1]
+            Gc = probe.shape[1]
             fp = self._pad_groups(fp, C * Gc)   # match factor-time padding
-            auxc = {k: aux[k] for k in ("interior", "Vt", "YbT", "Cap")
+            auxc = {k: aux[k] for k in ("interior", "Vt", "YbT", "Cap",
+                                        "fsub")
                     if k in aux}
             y = jax.lax.map(lambda xs: self._solve_core(xs[0], xs[1]),
                             (auxc, fp.reshape(C, Gc, self.n_pad)))

@@ -228,6 +228,24 @@ def solver_key(solver, names):
                    "solver", type(solver).__name__, "names", tuple(names))
         for section, key in _KEYED_CONFIG:
             _fp_update(h, key, config[section].get(key, ""))
+        # fused-step composition (core/fusedstep.py): the RESOLVED fusion
+        # token rides into the key so a [fusion] flag flip (or an `auto`
+        # landing differently on another backend) can never serve a
+        # payload whose precomposed fused matrices were built under
+        # another composition. The host-assembly matrices themselves are
+        # fusion-independent, but this key seeds pool_key — the serving
+        # warm pool holds COMPILED step programs, which do depend on the
+        # composition — and the fused-composite entries, so a flip
+        # invalidates all three together. Cost: a rare flag flip re-runs
+        # host assembly once; the safe direction. The solver's
+        # build-start plan is preferred so the key always tokens the
+        # composition the build actually compiles under.
+        plan = getattr(solver, "_fusion_plan", None)
+        if plan is None:
+            from ..core.fusedstep import cache_token
+            _fp_update(h, "fusion", cache_token())
+        else:
+            _fp_update(h, "fusion", plan.token())
         spec = solver.matsolver
         _fp_update(h, "matsolver",
                    spec if isinstance(spec, str) else getattr(
@@ -538,7 +556,8 @@ def install_payload(solver, names, payload):
         solver._batched = None
         solver._matrices = mats
         solver.structure = st
-        solver.ops = pencilops.BandedOps(st)
+        solver.ops = pencilops.BandedOps(
+            st, fusion=getattr(solver, "_fusion_plan", None))
         return True
     if kind == "coo":
         vals = {name: arrays[f"vals_{name}"] for name in names}

@@ -224,17 +224,36 @@ def _signature(tree):
 
 
 class lifted_jit:
-    """jax.jit with device-constant lifting; supports static_argnums."""
+    """jax.jit with device-constant lifting; supports static_argnums and
+    donate_argnums (original-fn positions; the fused step programs donate
+    their history buffers so XLA rolls them in place — callers own the
+    invalidation contract for outstanding references, see
+    core/fusedstep.py DONATE_STEP)."""
 
-    def __init__(self, fn, static_argnums=()):
+    def __init__(self, fn, static_argnums=(), donate_argnums=()):
         self.fn = fn
         self.static_argnums = tuple(static_argnums)
+        self.donate_argnums = tuple(donate_argnums)
+        overlap = set(self.static_argnums) & set(self.donate_argnums)
+        if overlap:
+            raise ValueError(f"cannot donate static argnums {overlap}")
         self._cache = {}
         # retrace sentinel: the jit bodies below note every trace of THIS
         # wrapper, so post-warmup recompiles surface as structured
         # warnings + the dedalus/retrace metric (tools/retrace.py)
         self._retrace_state = retrace_mod.TraceCount(
             getattr(fn, "__qualname__", None) or repr(fn))
+
+    def _donate_positions(self, n_args):
+        """Donated original positions -> wrapped positions (the consts
+        list occupies wrapped slot 0; dynamic arg j sits at 1 + j)."""
+        dyn_index = {}
+        j = 0
+        for i in range(n_args):
+            if i not in self.static_argnums:
+                dyn_index[i] = j
+                j += 1
+        return tuple(1 + dyn_index[i] for i in self.donate_argnums)
 
     def __call__(self, *args):
         static = tuple(args[i] for i in self.static_argnums)
@@ -253,7 +272,10 @@ class lifted_jit:
                 with _Mode("substitute", dict(zip(idxs, consts))):
                     return self._call_fn(static, d)
 
-            entry = self._cache[key] = (idxs, jax.jit(wrapped))
+            donate = self._donate_positions(len(args)) \
+                if self.donate_argnums else ()
+            entry = self._cache[key] = (
+                idxs, jax.jit(wrapped, donate_argnums=donate))
         idxs, jfn = entry
         return jfn([_registry.device_value(i) for i in idxs], *dynamic)
 

@@ -33,6 +33,11 @@ HOT_PATH_MODULES = (
     # dispatch pipeline (member IO belongs in core/ensemble seat APIs,
     # reply-phase IO after the boundary probe)
     "service/batching.py",
+    # the fused-step module's grid_eval / pallas kernels compile into the
+    # step program through the evaluator call graph (no in-module jit
+    # wrapper for the structural pass to see) — a stray sync here lands
+    # inside every fused step
+    "core/fusedstep.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its

@@ -74,13 +74,22 @@ import jax
 
 from .config import config
 
-__all__ = ["PHASES", "BUILD_PHASES", "CadenceGate", "Counter", "PhaseTimer",
+__all__ = ["PHASES", "SUM_PHASES", "BUILD_PHASES", "CadenceGate", "Counter",
+           "PhaseTimer",
            "MemoryWatermark", "Metrics", "BuildPhases", "trace_scope",
            "annotate", "scoped", "resolve", "format_phase_table",
            "register_exit_flush", "flush_pending", "process_rss_bytes"]
 
 # The hot-path phase vocabulary (shared with trace annotations).
-PHASES = ("transform", "matsolve", "transpose", "evaluator")
+# SUM_PHASES is the step DECOMPOSITION: rows that partition one step and
+# should sum to ~the loop wall. The `fused` row (present when the fused
+# step path is active, core/fusedstep.py) is an ALTERNATIVE whole-step
+# attribution — the one-dispatch fused program re-measured end-to-end —
+# that OVERLAPS the decomposition rows, so it is excluded from phase
+# sums: `fused` below the decomposition sum is the fusion win (separate
+# dispatches pay per-phase boundaries the fused program elides).
+SUM_PHASES = ("transform", "matsolve", "transpose", "evaluator")
+PHASES = SUM_PHASES + ("fused",)
 
 # The cold-start (build) phase vocabulary: host-side symbolic assembly,
 # banded structural analysis, device transfer + factorization, and the
@@ -439,7 +448,9 @@ class Metrics:
         iters = self.iterations
         phase_mean = {p: self.timer.mean(p) for p in PHASES}
         phase_total = {p: phase_mean[p] * iters for p in PHASES}
-        phase_sum = sum(phase_total.values())
+        # the fused whole-step row overlaps the decomposition rows (see
+        # the PHASES note): only the decomposition enters the sum
+        phase_sum = sum(phase_total[p] for p in SUM_PHASES)
         record = {
             "kind": "step_metrics",
             "ts": round(time.time(), 1),
@@ -590,15 +601,22 @@ def format_phase_table(record, indent="  "):
     mean = record.get("phase_mean_sec") or {}
     lines = [f"Per-phase wall time ({record.get('phase_samples', 0)} samples,"
              f" cadence {record.get('sample_cadence', '?')}):"]
-    for phase in PHASES:
+    for phase in SUM_PHASES:
         t = total.get(phase, 0.0)
         frac = 100.0 * t / wall if wall > 0 else 0.0
         lines.append(f"{indent}{phase:<10} {mean.get(phase, 0.0):#.4g} s/step"
                      f"  {t:#.4g} s total  {frac:5.1f}%")
-    psum = sum(total.get(p, 0.0) for p in PHASES)
+    psum = sum(total.get(p, 0.0) for p in SUM_PHASES)
     frac = 100.0 * psum / wall if wall > 0 else 0.0
     lines.append(f"{indent}{'sum':<10} {psum:#.4g} s of {wall:#.4g} s loop"
                  f" wall ({frac:.1f}%), {iters} iterations")
+    if total.get("fused"):
+        # whole-step fused-program re-measurement (overlaps the rows
+        # above; core/fusedstep.py) — below the sum when fusion wins
+        lines.append(
+            f"{indent}{'fused':<10} {mean.get('fused', 0.0):#.4g} s/step"
+            f"  (whole fused step program; overlaps the split rows, "
+            f"excluded from sum)")
     mem = record.get("device_mem_peak_bytes")
     if mem:
         lines.append(f"{indent}device memory peak: {mem / 1e9:.3f} GB"

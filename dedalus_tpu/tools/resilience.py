@@ -223,8 +223,12 @@ def capture_snapshot(solver):
     ts = solver.timestepper
     ts_state = {"iteration": int(ts.iteration)}
     if hasattr(ts, "F_hist"):
+        # the ring holds cross-step references: copy under donation
+        # (core/fusedstep.py guard_histories owns the contract)
+        from ..core.fusedstep import guard_histories
+        hists = guard_histories(ts)
         ts_state.update(
-            F_hist=ts.F_hist, MX_hist=ts.MX_hist, LX_hist=ts.LX_hist,
+            F_hist=hists[0], MX_hist=hists[1], LX_hist=hists[2],
             dt_hist=list(ts.dt_hist))
     ev_state = [h.schedule_state() for h in solver.evaluator.handlers]
     dd = getattr(solver, "_dd", None)
@@ -254,9 +258,12 @@ def restore_snapshot(solver, snap):
     st = snap.timestepper_state
     ts.iteration = st["iteration"]
     if "F_hist" in st:
-        ts.F_hist = st["F_hist"]
-        ts.MX_hist = st["MX_hist"]
-        ts.LX_hist = st["LX_hist"]
+        # install COPIES under donation: the next (donating) step
+        # consumes its history inputs, and a second rewind to this same
+        # ring slot must still find live arrays
+        from ..core.fusedstep import guard_histories
+        ts.F_hist, ts.MX_hist, ts.LX_hist = guard_histories(
+            ts, (st["F_hist"], st["MX_hist"], st["LX_hist"]))
         ts.dt_hist = list(st["dt_hist"])
     # drop the (possibly poisoned-era) factorization; the next step
     # refactors for its own dt
@@ -616,8 +623,13 @@ class ResilientLoop:
             "pencil_shape": [int(s) for s in solver.pencil_shape],
         }
         if hasattr(ts, "F_hist"):
-            arrays.update(F_hist=ts.F_hist, MX_hist=ts.MX_hist,
-                          LX_hist=ts.LX_hist)
+            # async writers copy shards out AFTER submit; a donating
+            # step between submit and copy-out would consume these
+            # buffers, so the capture owns copies (guard_histories)
+            from ..core.fusedstep import guard_histories
+            hists = guard_histories(ts)
+            arrays.update(F_hist=hists[0], MX_hist=hists[1],
+                          LX_hist=hists[2])
             meta["dt_hist"] = [float(v) for v in ts.dt_hist]
         return arrays, meta
 

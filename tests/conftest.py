@@ -98,7 +98,8 @@ def _service_test_watchdog(request):
     marked = (request.node.get_closest_marker("service") is not None
               or request.node.get_closest_marker("chaos") is not None
               or request.node.get_closest_marker("ensemble") is not None
-              or request.node.get_closest_marker("batching") is not None)
+              or request.node.get_closest_marker("batching") is not None
+              or request.node.get_closest_marker("fusion") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
         yield
         return
@@ -164,6 +165,15 @@ def pytest_configure(config):
         "markers",
         "batching: continuous-batching service tests (service/"
         "batching.py: micro-batch dispatch, member fault isolation); "
+        "tier-1 by default")
+    # fusion: fused spectral step tests (core/fusedstep.py +
+    # libraries/pencilops.py fused paths). Tier-1 by default; rides the
+    # same hard watchdog — a wedged fused-vs-unfused fleet comparison or
+    # pallas interpret loop must not eat the tier-1 budget silently.
+    config.addinivalue_line(
+        "markers",
+        "fusion: fused spectral step tests (core/fusedstep.py: "
+        "precomposed solve/matvec/transform fusion, donation, pallas); "
         "tier-1 by default")
 
 

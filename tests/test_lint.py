@@ -128,6 +128,28 @@ def to_host(solver):
     assert result.findings == []
 
 
+def test_dtl001_covers_fusedstep_module(tmp_path):
+    """core/fusedstep.py is a declared hot module (its grid_eval/pallas
+    bodies compile into the step program through the evaluator call
+    graph): a stray sync there fires whole-file, and host-side
+    precomposition stays quiet."""
+    bad = _lint_src(tmp_path, "core/fusedstep.py", """
+import jax
+
+def grid_eval(plan, node, data):
+    jax.block_until_ready(data)
+    return data
+""")
+    assert _rules_fired(bad) == ["DTL001"]
+    good = _lint_src(tmp_path, "core/fusedstep.py", """
+import numpy as np
+
+def composite(backward, term):
+    return np.ascontiguousarray(np.asarray(backward) @ term)
+""")
+    assert good.findings == []
+
+
 def test_dtl001_traced_concretization_any_module(tmp_path):
     bad = _lint_src(tmp_path, "anywhere.py", """
 import numpy as np
