@@ -315,6 +315,7 @@ def _attach_progression(record):
     _attach_adjoint(record)
     _attach_checkpoint(record)
     _attach_fusion(record)
+    _attach_scaling(record)
     return record
 
 
@@ -544,6 +545,41 @@ def _attach_fusion(record):
             "age_s": round(time.time() - row["ts"], 1)
             if row.get("ts") else None,
         }
+    return record
+
+
+def _attach_scaling(record):
+    """Attach the newest in-window weak-scaling headline (steps/s per
+    device count + transpose overlap split + chunked-vs-monolithic
+    guard + 2048x1024 north-star shape, benchmarks/scaling.py) to the
+    official bench line. Same provenance discipline as the other
+    attached rows: a CACHED prior measurement, stamped stale with its
+    original measured_ts and age, dropped once outside the 48h window.
+    Scaling rows are measured on the virtual CPU mesh by design (ROADMAP
+    platform note: the curve must survive TPU chip outages)."""
+    row = _recent_row(
+        lambda r: (r.get("config") == "weak_scaling"
+                   and isinstance(r.get("sweep"), list)
+                   and r["sweep"]
+                   and isinstance(r.get("chunked_vs_mono"), dict)))
+    if row is None:
+        return record
+    record["weak_scaling"] = {
+        "sweep": [{k: p.get(k) for k in
+                   ("devices", "shape", "steps_per_sec",
+                    "all_to_alls", "all_gathers",
+                    "transpose_exposed_sec", "transpose_overlapped_sec")}
+                  for p in row["sweep"]],
+        "chunks": row.get("chunks"),
+        "chunked_vs_mono": row.get("chunked_vs_mono"),
+        "northstar": row.get("northstar"),
+        "fleet2d": row.get("fleet2d"),
+        "backend": row.get("backend"),
+        "stale": True,
+        "measured_ts": row.get("ts"),
+        "age_s": round(time.time() - row["ts"], 1)
+        if row.get("ts") else None,
+    }
     return record
 
 

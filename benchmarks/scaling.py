@@ -1,0 +1,368 @@
+"""
+Weak-scaling benchmark for the overlapped distributed transpose pipeline.
+
+Records the multi-chip scaling trajectory ROADMAP item 3 asked for, on
+the 8-device virtual CPU mesh so the curve survives TPU chip outages
+(bench.py `_attach_scaling` re-reports the newest row stale-stamped
+every round; a claimed chip re-measures it for real). Per device count
+d in {1, 2, 4, 8}:
+
+  * a weak-scaled 2-D nonlinear diffusion IVP (Fourier x Chebyshev,
+    Nx = 64*d so per-device work is constant) is built, distributed
+    over a d-device pencil mesh, and stepped — steps/s recorded;
+  * the compiled step's HLO is scanned: ZERO full-state all-gathers
+    (the collective-placement assertion of tests/test_collectives.py,
+    promoted to the chunked walk) and the all-to-all count recorded;
+  * the transpose phase split is measured at the pipeline level
+    (DistributedPencilPipeline round-trips): `transpose_exposed_sec` =
+    communication the chunked walk still waits on,
+    `transpose_overlapped_sec` = communication hidden under the
+    interleaved chunk transforms (tools/metrics.py phase vocabulary).
+
+Then, on the full 8-device mesh:
+
+  * chunked-vs-monolithic guard: [distributed] TRANSPOSE_CHUNKS=auto vs
+    =1 solvers must produce BIT-IDENTICAL states, and the chunked walk
+    must hold >= 0.95x the monolithic steps/s (the overlap is upside,
+    never a tax);
+  * the 2048 x 1024 NORTH-STAR shape steps on the 8-device mesh
+    (banded pencil solve), steps/s recorded;
+  * a 2-D batch x pencil fleet (EnsembleSolver on Mesh(2, 4)) must
+    bit-match the 1-D member-mesh fleet.
+
+Appends ONE `weak_scaling` row to benchmarks/results.jsonl; exits
+nonzero when any guard fails (gather found, bit-identity broken, ratio
+< 0.95, non-finite north star, fleet mismatch).
+
+Run: python benchmarks/scaling.py [--quick] [--skip-northstar]
+  --quick          devices {1, 8}, shorter windows (CI smoke)
+  --skip-northstar skip the 2048x1024 build (memory-constrained hosts)
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The virtual pencil mesh must exist before jax initializes (conftest.py
+# does the same for the test suite).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[scaling {time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def build_diffusion2d(Nx, Nz, matsolver=None):
+    """2-D nonlinear diffusion IVP (the tests/test_collectives.py step
+    problem, resolution-parameterized): one variable + two tau lines, so
+    the weak-scaled builds stay cheap while the step exercises the full
+    transform walk + pencil solve."""
+    import dedalus_tpu.public as d3
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=Nx, bounds=(0, 4.0), dealias=3 / 2)
+    zb = d3.ChebyshevT(coords["z"], size=Nz, bounds=(0, 1.0), dealias=3 / 2)
+    u = dist.Field(name="u", bases=(xb, zb))
+    t1 = dist.Field(name="t1", bases=xb)
+    t2 = dist.Field(name="t2", bases=xb)
+    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
+    problem = d3.IVP([u, t1, t2], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("u(z=1) = 0")
+    kw = {"matsolver": matsolver} if matsolver else {}
+    solver = problem.build_solver(d3.SBDF2, **kw)
+    x, z = dist.local_grids(xb, zb)
+    u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+    return solver, u
+
+
+def collective_counts(txt):
+    return {op: len(re.findall(rf"\s{op}\(", txt))
+            for op in ("all-to-all", "all-gather")}
+
+
+def step_hlo(solver):
+    """Compiled-HLO text of the solver's advance program (the
+    tests/test_collectives.py probe)."""
+    import jax.numpy as jnp
+    ts = solver.timestepper
+    rd = solver.real_dtype
+    s = ts.steps + 1
+    a = b = jnp.zeros(s, dtype=rd)
+    c = jnp.zeros(ts.steps, dtype=rd)
+    args = (solver.M_mat, solver.L_mat, solver.X,
+            jnp.asarray(0.0, dtype=rd), solver.rhs_extra(),
+            ts.F_hist, ts.MX_hist, ts.LX_hist, a, b, c, ts._lhs_aux)
+    return ts._advance.lower(*args).compile().as_text()
+
+
+def measure_steps(solver, dt, warmup, steps, reps=3):
+    """Median steps/s over `reps` measured windows of `steps` steps."""
+    import jax
+    solver.step_many(warmup, dt)
+    jax.block_until_ready(solver.X)
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        solver.step_many(steps, dt)
+        jax.block_until_ready(solver.X)
+        walls.append(time.perf_counter() - t0)
+    return steps / float(np.median(walls))
+
+
+def median_wall(fn, reps=5):
+    fn()  # compile
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        walls.append(time.perf_counter() - t0)
+    return float(np.median(walls))
+
+
+def transpose_split(domain, mesh, chunks):
+    """Pipeline-level transpose phase split on `mesh`:
+      t_chunk  chunked to_grid/to_coeff round-trip wall
+      t_mono   monolithic (chunks=1) round-trip wall
+      t_a2a    the bare transposes (all_to_all_transpose both ways)
+    exposed = t_chunk - (t_mono - t_a2a)   [chunked wall minus compute]
+    overlapped = t_a2a - exposed           [comm hidden under compute]
+    both clamped at 0."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from dedalus_tpu.parallel import (DistributedPencilPipeline,
+                                      all_to_all_transpose)
+    name = mesh.axis_names[0]
+    pipe_c = DistributedPencilPipeline(domain, mesh, name, chunks=chunks)
+    pipe_m = DistributedPencilPipeline(domain, mesh, name, chunks=1)
+    shape = tuple(b.size for b in domain.bases)
+    rng = np.random.default_rng(7)
+    cdata = jax.device_put(rng.standard_normal(shape),
+                           NamedSharding(mesh, P(name)))
+
+    def roundtrip(pipe):
+        prog_g = jax.jit(pipe.to_grid)
+        prog_c = jax.jit(pipe.to_coeff)
+
+        def run():
+            jax.block_until_ready(prog_c(prog_g(cdata)))
+        return run
+
+    gdata = jax.jit(pipe_m.to_grid)(cdata)
+    a2a_g = jax.jit(lambda d: all_to_all_transpose(d, 0, 1, mesh, name))
+    a2a_c = jax.jit(lambda d: all_to_all_transpose(d, 1, 0, mesh, name))
+
+    def bare_transposes():
+        jax.block_until_ready(a2a_c(a2a_g(cdata)))
+
+    t_chunk = median_wall(roundtrip(pipe_c))
+    t_mono = median_wall(roundtrip(pipe_m))
+    t_a2a = median_wall(bare_transposes)
+    exposed = max(0.0, t_chunk - max(0.0, t_mono - t_a2a))
+    overlapped = max(0.0, t_a2a - exposed)
+    return {"transpose_total_sec": round(t_a2a, 6),
+            "transpose_exposed_sec": round(exposed, 6),
+            "transpose_overlapped_sec": round(overlapped, 6),
+            "walk_chunked_sec": round(t_chunk, 6),
+            "walk_monolithic_sec": round(t_mono, 6)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--skip-northstar", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+    from jax.sharding import Mesh
+    from dedalus_tpu.parallel import distribute_solver
+    from dedalus_tpu.tools.config import config
+    from dedalus_tpu.parallel.transposes import resolve_transpose_chunks
+    from __graft_entry__ import _append_result
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        mark(f"only {n_dev} devices visible; need 8")
+        return 1
+    chunks = resolve_transpose_chunks()
+    device_counts = (1, 8) if args.quick else (1, 2, 4, 8)
+    base_nx, nz = 64, 64
+    warmup, steps = (3, 6) if args.quick else (4, 16)
+    dt = 1e-4
+    failures = []
+
+    # ---------------------------------------------------- weak-scaling sweep
+    sweep = []
+    for d in device_counts:
+        Nx = base_nx * d
+        mark(f"weak point d={d}: {Nx}x{nz}")
+        solver, _ = build_diffusion2d(Nx, nz)
+        mesh = None
+        if d > 1:
+            mesh = Mesh(np.array(jax.devices()[:d]), ("x",))
+            distribute_solver(solver, mesh)
+        sps = measure_steps(solver, dt, warmup, steps)
+        point = {"devices": d, "shape": [Nx, nz],
+                 "steps_per_sec": round(sps, 4)}
+        if d > 1:
+            counts = collective_counts(step_hlo(solver))
+            point.update(all_to_alls=counts["all-to-all"],
+                         all_gathers=counts["all-gather"])
+            if counts["all-gather"]:
+                failures.append(
+                    f"d={d}: {counts['all-gather']} full-state "
+                    f"all-gathers in the sharded step")
+            if counts["all-to-all"] < 2:
+                failures.append(f"d={d}: transform transposes missing "
+                                f"({counts})")
+            point.update(transpose_split(solver.problem.variables[0].domain,
+                                         mesh, chunks))
+        sweep.append(point)
+        mark(f"  {sps:.2f} steps/s")
+
+    # -------------------------------------- chunked vs monolithic (8 devices)
+    mark("chunked vs monolithic guard (8 devices)")
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("x",))
+    old = config["distributed"]["TRANSPOSE_CHUNKS"]
+    Nx8 = base_nx * 8
+    try:
+        config["distributed"]["TRANSPOSE_CHUNKS"] = "1"
+        mono, _ = build_diffusion2d(Nx8, nz)
+        distribute_solver(mono, mesh8)
+        config["distributed"]["TRANSPOSE_CHUNKS"] = old
+        chunked, _ = build_diffusion2d(Nx8, nz)
+        distribute_solver(chunked, mesh8)
+    finally:
+        config["distributed"]["TRANSPOSE_CHUNKS"] = old
+    # interleaved windows: alternating the two walks inside one sweep
+    # cancels host load drift that a sequential A-then-B comparison
+    # would read as a regression
+    import jax as _jax
+    for s in (mono, chunked):
+        s.step_many(warmup, dt)
+        _jax.block_until_ready(s.X)
+    walls = {"mono": [], "chunk": []}
+    for _ in range(5):
+        for key, s in (("mono", mono), ("chunk", chunked)):
+            t0 = time.perf_counter()
+            s.step_many(steps, dt)
+            _jax.block_until_ready(s.X)
+            walls[key].append(time.perf_counter() - t0)
+    sps_mono = steps / float(np.median(walls["mono"]))
+    sps_chunk = steps / float(np.median(walls["chunk"]))
+    bit_identical = bool(
+        (np.asarray(mono.X) == np.asarray(chunked.X)).all())
+    ratio = sps_chunk / sps_mono if sps_mono else 0.0
+    if not bit_identical:
+        diff = np.abs(np.asarray(mono.X) - np.asarray(chunked.X)).max()
+        failures.append(f"chunked walk not bit-identical to monolithic "
+                        f"(max diff {diff:.3e})")
+    if ratio < 0.95:
+        failures.append(f"chunked walk regressed: {ratio:.3f}x < 0.95x "
+                        f"monolithic steps/s")
+    guard = {"chunks": chunks,
+             "mono_steps_per_sec": round(sps_mono, 4),
+             "chunked_steps_per_sec": round(sps_chunk, 4),
+             "ratio": round(ratio, 4),
+             "bit_identical": bit_identical}
+    mark(f"  mono {sps_mono:.2f} vs chunked {sps_chunk:.2f} steps/s "
+         f"({ratio:.3f}x), bit_identical={bit_identical}")
+
+    # ------------------------------------------------- 2048x1024 north star
+    northstar = None
+    if not args.skip_northstar:
+        mark("north-star shape 2048x1024 on 8 devices (banded)")
+        try:
+            ns, _ = build_diffusion2d(2048, 1024, matsolver="banded")
+            distribute_solver(ns, mesh8)
+            ns_steps = 2 if args.quick else 4
+            t_build = time.time() - T0
+            ns.step_many(2, 1e-5)   # compile + ramp
+            jax.block_until_ready(ns.X)
+            t0 = time.perf_counter()
+            ns.step_many(ns_steps, 1e-5)
+            jax.block_until_ready(ns.X)
+            wall = time.perf_counter() - t0
+            finite = bool(np.isfinite(np.asarray(ns.X)).all())
+            northstar = {"shape": [2048, 1024], "devices": 8,
+                         "steps_per_sec": round(ns_steps / wall, 4),
+                         "finite": finite,
+                         "build_sec": round(t_build, 1)}
+            if not finite:
+                failures.append("north-star state non-finite")
+            mark(f"  {northstar['steps_per_sec']} steps/s, "
+                 f"finite={finite}")
+            del ns
+        except MemoryError as exc:
+            mark(f"  north-star skipped: {exc}")
+            northstar = {"shape": [2048, 1024], "skipped": str(exc)}
+
+    # ------------------------------------ 2-D batch x pencil fleet bit-match
+    mark("2-D batch x pencil fleet vs 1-D fleet")
+    members, fleet_steps = 4, 8
+
+    def fleet_state(mesh):
+        solver, u = build_diffusion2d(64, 16)
+        x, z = solver.dist.local_grids(*u.domain.bases)
+        fleet = solver.ensemble(members, mesh=mesh)
+
+        def ics(i):
+            u["g"] = np.sin(np.pi * z) * (
+                1 + 0.1 * (i + 1) * np.cos(np.pi * x / 2))
+        fleet.init_members(ics)
+        fleet.step_many(fleet_steps, 1e-3)
+        return np.asarray(fleet.X)[:members]
+
+    X1 = fleet_state(Mesh(np.array(jax.devices()[:2]), ("batch",)))
+    X2 = fleet_state(Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                          ("batch", "pencil")))
+    fleet_match = bool((X1 == X2).all())
+    if not fleet_match:
+        failures.append(f"2-D fleet diverged from 1-D fleet "
+                        f"(max diff {np.abs(X1 - X2).max():.3e})")
+    mark(f"  bit_match={fleet_match}")
+
+    row = {
+        "config": "weak_scaling",
+        "benchmark": "scaling",
+        "backend": jax.default_backend(),
+        "dtype": "float64",
+        "chunks": chunks,
+        "sweep": sweep,
+        "chunked_vs_mono": guard,
+        "fleet2d": {"members": members,
+                    "mesh": [2, 4],
+                    "bit_match_1d": fleet_match},
+        "finite": not failures,
+        "quick": bool(args.quick),
+    }
+    if northstar is not None:
+        row["northstar"] = northstar
+    if failures:
+        row["errors"] = failures
+    _append_result(row)
+    print(json.dumps(row, indent=2))
+    if failures:
+        mark("FAILURES: " + "; ".join(failures))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -330,6 +330,47 @@ def report(args):
                           f"member-steps/s "
                           f"({point.get('speedup_vs_serial', '?')}x serial,"
                           f" {point.get('devices', '?')} device(s))")
+            # weak-scaling rows (benchmarks/scaling.py): steps/s per
+            # device count with the transpose overlap phase split, the
+            # chunked-vs-monolithic guard, north star, and the 2-D
+            # batch x pencil fleet bit-match
+            if record.get("benchmark") == "scaling" \
+                    and isinstance(record.get("sweep"), list):
+                for point in record["sweep"]:
+                    line = (f"    d={point.get('devices', '?')} "
+                            f"{'x'.join(str(s) for s in point.get('shape', []))}: "
+                            f"{point.get('steps_per_sec', '?')} steps/s")
+                    if point.get("transpose_exposed_sec") is not None:
+                        line += (f", transpose exposed "
+                                 f"{point['transpose_exposed_sec']}s / "
+                                 f"overlapped "
+                                 f"{point.get('transpose_overlapped_sec', '?')}s")
+                    if point.get("all_gathers") is not None:
+                        line += (f", {point.get('all_to_alls', '?')} a2a / "
+                                 f"{point['all_gathers']} gathers")
+                    print(line)
+                guard = record.get("chunked_vs_mono")
+                if isinstance(guard, dict):
+                    print(f"    chunked({record.get('chunks', '?')}) vs "
+                          f"mono: {guard.get('chunked_steps_per_sec', '?')} "
+                          f"vs {guard.get('mono_steps_per_sec', '?')} "
+                          f"steps/s ({guard.get('ratio', '?')}x, "
+                          f"bit_identical="
+                          f"{guard.get('bit_identical', '?')})")
+                ns = record.get("northstar")
+                if isinstance(ns, dict) and ns.get("steps_per_sec"):
+                    print(f"    north star "
+                          f"{'x'.join(str(s) for s in ns.get('shape', []))}"
+                          f" on {ns.get('devices', '?')} devices: "
+                          f"{ns['steps_per_sec']} steps/s "
+                          f"(finite={ns.get('finite', '?')})")
+                fleet = record.get("fleet2d")
+                if isinstance(fleet, dict):
+                    print(f"    2-D fleet {fleet.get('members', '?')} "
+                          f"members on "
+                          f"{'x'.join(str(s) for s in fleet.get('mesh', []))}"
+                          f" batch x pencil: bit_match_1d="
+                          f"{fleet.get('bit_match_1d', '?')}")
             # fusion benchmark rows (benchmarks/fusion.py): fused vs
             # unfused steps/s and the documented trajectory tolerance
             if record.get("fusion_speedup") is not None:

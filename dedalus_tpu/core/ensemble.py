@@ -240,13 +240,17 @@ class EnsembleSolver:
         self.per_member_dt = bool(per_member_dt)
         self.policy = policy
         self.rd = solver.real_dtype
+        # pencil axis of a 2-D batch x pencil mesh (None on 1-D meshes):
+        # set by _resolve_mesh when the composition is active
+        self.pencil_axis = None
         self.mesh = self._resolve_mesh(mesh)
         D = self.mesh.shape[MEMBER_AXIS] if self.mesh is not None else 1
         self.n_pad = -(-self.members // D) * D
         # ---------------------------------------------------- fleet state
         G, S = solver.pencil_shape
         X0 = solver.gather_fields()
-        self.X = self._put(jnp.broadcast_to(X0, (self.n_pad, G, S)))
+        self.X = self._put(jnp.broadcast_to(X0, (self.n_pad, G, S)),
+                           pencil_dim=1)
         self.sim_times = np.full(self.n_pad, float(solver.sim_time))
         self.T = self._put_host(self.sim_times, dtype=self.rd)
         self.dts = np.zeros(self.n_pad)
@@ -265,9 +269,9 @@ class EnsembleSolver:
             s = ts.steps
             zeros = jnp.zeros((self.n_pad, s, G, S),
                               dtype=solver.pencil_dtype)
-            self.F_hist = self._put(zeros)
-            self.MX_hist = self._put(zeros)
-            self.LX_hist = self._put(zeros)
+            self.F_hist = self._put(zeros, pencil_dim=2)
+            self.MX_hist = self._put(zeros, pencil_dim=2)
+            self.LX_hist = self._put(zeros, pencil_dim=2)
             self._ms_iter = 0
             self._dt_hist = []
         # per-member RHS operands: every extra field batched (N, ...)
@@ -322,9 +326,11 @@ class EnsembleSolver:
                   "pencil_shape": list(solver.pencil_shape),
                   "members": self.members})
         self.metrics.inc("ensemble/members", self.members)
+        pencil_txt = (f" x {self.mesh.shape[self.pencil_axis]} pencil "
+                      f"device(s)" if self.pencil_axis is not None else "")
         logger.info(
             f"EnsembleSolver: {self.members} members (padded {self.n_pad}) "
-            f"on {D} device(s), "
+            f"on {D} batch device(s){pencil_txt}, "
             f"{'per-member' if self.per_member_dt else 'common'} dt, "
             f"policy={self.policy}")
 
@@ -338,19 +344,53 @@ class EnsembleSolver:
             if len(devices) < 2:
                 return None
             return Mesh(np.array(devices), (MEMBER_AXIS,))
-        if len(mesh.axis_names) != 1:
-            raise ValueError("EnsembleSolver requires a 1-D member mesh.")
+        if len(mesh.axis_names) not in (1, 2):
+            raise ValueError(
+                "EnsembleSolver requires a 1-D member mesh or a 2-D "
+                "batch x pencil mesh.")
         if mesh.axis_names[0] != MEMBER_AXIS:
             raise ValueError(
-                f"member mesh axis must be named {MEMBER_AXIS!r}")
+                f"member mesh axis must be named {MEMBER_AXIS!r} and "
+                f"come first")
+        if len(mesh.axis_names) == 2:
+            # 2-D composition: members vmap over `batch` while every
+            # member's pencil state distributes over the second axis —
+            # the fleet programs run manual over batch with the pencil
+            # axis in GSPMD auto mode, and the per-member transform
+            # walks/solves route through meshctx/pencilops over the
+            # pencil axis (the same discipline as distribute_solver's
+            # 1-D pencil mesh, composed under the member axis)
+            pencil = mesh.axis_names[1]
+            if pencil == MEMBER_AXIS:
+                raise ValueError("the pencil mesh axis must not be "
+                                 f"named {MEMBER_AXIS!r}")
+            if self.per_member_dt:
+                raise ValueError(
+                    "per_member_dt is not supported on a 2-D batch x "
+                    "pencil mesh (the vmapped per-member factorization "
+                    "is member-manual); use a 1-D member mesh.")
+            G = self.solver.pencil_shape[0]
+            n = mesh.shape[pencil]
+            if G % n:
+                raise ValueError(
+                    f"pencil mesh axis {pencil!r} (size {n}) does not "
+                    f"divide the pencil-group count {G}; choose "
+                    f"resolutions with G % n == 0.")
+            self.pencil_axis = pencil
         return mesh
 
-    def _put(self, arr):
+    def _put(self, arr, pencil_dim=None):
         """One device_put onto the member sharding (SNIPPETS §[2]
-        get_naive_sharding: lead axis on the batch mesh axis)."""
+        get_naive_sharding: lead axis on the batch mesh axis). On a 2-D
+        batch x pencil mesh, `pencil_dim` names the array dim carrying
+        the pencil-group axis (1 for the (N, G, S) state, 2 for the
+        (N, steps, G, S) histories), sharded over the pencil axis."""
         if self.mesh is None:
             return jnp.asarray(arr)
-        return jax.device_put(arr, NamedSharding(self.mesh, P(MEMBER_AXIS)))
+        spec = [MEMBER_AXIS]
+        if self.pencil_axis is not None and pencil_dim is not None:
+            spec += [None] * (pencil_dim - 1) + [self.pencil_axis]
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
     def _put_host(self, arr, dtype=None):
         """Place a HOST mirror (active mask, dts, clocks, step budgets)
@@ -400,7 +440,7 @@ class EnsembleSolver:
         pad = self.n_pad - self.members
         X_rows += [X_rows[0]] * pad
         extra_rows += [extra_rows[0]] * pad
-        self.X = self._put(jnp.stack(X_rows))
+        self.X = self._put(jnp.stack(X_rows), pencil_dim=1)
         self._extras = [self._put(jnp.stack([row[k] for row in extra_rows]))
                         for k in range(len(extra_rows[0]))]
         return self
@@ -416,7 +456,7 @@ class EnsembleSolver:
         if pad:
             X = jnp.concatenate([X, jnp.broadcast_to(
                 X[:1], (pad,) + X.shape[1:])])
-        self.X = self._put(X)
+        self.X = self._put(X, pencil_dim=1)
         return self
 
     def member_arrays(self, m):
@@ -442,16 +482,50 @@ class EnsembleSolver:
         spec = P(MEMBER_AXIS) if batched else P()
         return jax.tree.map(lambda _: spec, tree)
 
+    def _pencil_contexts(self, fn):
+        """Wrap a fleet body so its TRACE runs under the pencil routing
+        contexts of the 2-D batch x pencil composition: factor/solve
+        funnels shard over the pencil axis (pencilops.pencil_mesh) and
+        the per-member transform walks publish the mesh
+        (field.mesh_transforms; meshctx.walk_axis_names filters the
+        batch axis out, so the walks transpose over the pencil axes
+        only). Identity on 1-D member meshes."""
+        if self.pencil_axis is None:
+            return fn
+        from . import field as field_mod
+        from ..libraries import pencilops
+
+        def with_contexts(*args):
+            with pencilops.pencil_mesh(self.mesh, self.pencil_axis), \
+                    field_mod.mesh_transforms(
+                        self.mesh,
+                        chunks=getattr(self.solver, "_transpose_chunks",
+                                       None)):
+                return fn(*args)
+
+        return with_contexts
+
     def _wrap(self, raw, label, args, batched_flags):
         """jit (and shard_map, when a mesh is active) one fleet program.
         `batched_flags` marks which top-level args carry the member axis;
-        specs are built per-leaf from the actual argument tree."""
-        fn = retrace_mod.noted(raw, label)
+        specs are built per-leaf from the actual argument tree. On a 2-D
+        batch x pencil mesh the shard_map is MANUAL over the member axis
+        only, with the pencil axis in GSPMD auto mode — inside, the
+        vmapped bodies route their ffts/solves through nested shard_maps
+        over the pencil axis (core/meshctx.local_fft,
+        libraries/pencilops.shard_groups), the same targeted routing the
+        1-D distributed solver uses, composed under the member axis."""
+        fn = retrace_mod.noted(self._pencil_contexts(raw), label)
         if self.mesh is not None:
             in_specs = tuple(self._specs(a, b)
                              for a, b in zip(args, batched_flags))
-            fn = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                           out_specs=P(MEMBER_AXIS))
+            if self.pencil_axis is not None:
+                fn = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                               out_specs=P(MEMBER_AXIS), check_rep=False,
+                               auto=frozenset({self.pencil_axis}))
+            else:
+                fn = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                               out_specs=P(MEMBER_AXIS))
         # every call site memoizes the wrapper (self._programs[n] /
         # self._project_prog / self._vfactor_prog), so each fleet program
         # is built and traced exactly once
@@ -526,10 +600,56 @@ class EnsembleSolver:
                 raw, f"ensemble/fleet_step[{n}]", args, batched_flags)
         return prog
 
+    def _pencil_project_body(self):
+        """Per-member dealias-roundtrip projection for the 2-D batch x
+        pencil composition. The solver's own projection body is reused
+        where a layout walk exists; variables too low-dimensional to
+        walk (1-D tau fields: their only axis IS the pencil-sharded one)
+        route their whole roundtrip through meshctx.gathered_apply —
+        gather over the pencil axis, transform locally, slice the block
+        back — instead of leaving an unrouted fft in the GSPMD-auto
+        region (which the SPMD partitioner cannot place)."""
+        from . import meshctx
+        from .field import (transform_to_grid, transform_to_coeff,
+                            _walk_divisible)
+        from .subsystems import gather_state, scatter_state, state_key
+        solver = self.solver
+        layout, variables = solver.layout, solver.variables
+        mesh, pencil = self.mesh, self.pencil_axis
+
+        def project(X):
+            arrays = scatter_state(layout, variables, X)
+            out = {}
+            for v in variables:
+                scales = tuple(v.domain.dealias)
+                tdim = len(v.tensorsig)
+                data = arrays[state_key(v)]
+
+                def roundtrip(a, v=v, scales=scales, tdim=tdim):
+                    g = transform_to_grid(a, v.domain, scales, tdim,
+                                          tensorsig=v.tensorsig)
+                    return transform_to_coeff(g, v.domain, scales, tdim,
+                                              tensorsig=v.tensorsig)
+
+                walkable = (v.domain.dim > 1
+                            and _walk_divisible(data, v.domain, scales,
+                                                tdim, mesh, (pencil,)))
+                if walkable:
+                    out[state_key(v)] = roundtrip(data)
+                else:
+                    out[state_key(v)] = meshctx.gathered_apply(
+                        roundtrip, data, mesh, pencil, dim=tdim)
+            return gather_state(layout, variables, out)
+
+        return project
+
     def _ensure_project_prog(self):
         if self._project_prog is None:
-            self.solver._ensure_project()
-            proj = self.solver._project_body
+            if self.pencil_axis is None:
+                self.solver._ensure_project()
+                proj = self.solver._project_body
+            else:
+                proj = self._pencil_project_body()
 
             def raw(X, act):
                 Xp = jax.vmap(proj)(X)
@@ -564,6 +684,19 @@ class EnsembleSolver:
 
     # ------------------------------------------------------ factorization
 
+    def _factor_context(self):
+        """Pencil routing for the (host-driven) LHS factorization of a
+        2-D batch x pencil fleet: the factor program traces with the
+        pencil mesh active, so the factors come out sharded over the
+        pencil axis like the fleet state they solve against (the
+        timestepper's own pencil_mesh(None) wrapper inherits this outer
+        context). Null context on 1-D member meshes."""
+        import contextlib
+        if self.pencil_axis is None:
+            return contextlib.nullcontext()
+        from ..libraries import pencilops
+        return pencilops.pencil_mesh(self.mesh, self.pencil_axis)
+
     def _ensure_factor_rk(self, dt):
         ts = self.timestepper
         solver = self.solver
@@ -571,9 +704,10 @@ class EnsembleSolver:
             key = round(float(dt), 14)
             if key != self._lhs_key:
                 self._lhs_key = key
-                self._lhs_aux = ts._factor(
-                    solver.M_mat, solver.L_mat,
-                    jnp.asarray(float(dt), dtype=self.rd))
+                with self._factor_context():
+                    self._lhs_aux = ts._factor(
+                        solver.M_mat, solver.L_mat,
+                        jnp.asarray(float(dt), dtype=self.rd))
             return
         key = tuple(np.round(self.dts, 14))
         if key == self._lhs_key:
@@ -603,10 +737,11 @@ class EnsembleSolver:
         key = (round(float(a0), 14), round(float(b0), 14))
         if key != self._lhs_key:
             self._lhs_key = key
-            self._lhs_aux = self.timestepper._factor(
-                self.solver.M_mat, self.solver.L_mat,
-                jnp.asarray(a0, dtype=self.rd),
-                jnp.asarray(b0, dtype=self.rd))
+            with self._factor_context():
+                self._lhs_aux = self.timestepper._factor(
+                    self.solver.M_mat, self.solver.L_mat,
+                    jnp.asarray(a0, dtype=self.rd),
+                    jnp.asarray(b0, dtype=self.rd))
 
     # ------------------------------------------------------------ stepping
 
@@ -1180,6 +1315,13 @@ class EnsembleSolver:
         the new layout (fresh wrappers — a compile, not a retrace)."""
         pending = sorted(set(self._lost_devices))
         self._lost_devices = []
+        if self.pencil_axis is not None:
+            raise RuntimeError(
+                "device-loss recovery supports 1-D member meshes only: a "
+                "2-D batch x pencil fleet loses a SLICE of every member's "
+                "pencil state with a device, so restore onto survivors "
+                "must come from a durable sharded checkpoint "
+                "(restore_checkpoint) on a rebuilt fleet.")
         if self.mesh is None:
             if pending:
                 raise RuntimeError(
@@ -1475,12 +1617,15 @@ class EnsembleSolver:
         self._validate_fleet_meta(meta, event["path"])
         repad = functools.partial(_repad, members=self.members,
                                   n_pad=self.n_pad)
-        self.X = self._put(jnp.asarray(repad(arrays["X"])))
+        self.X = self._put(jnp.asarray(repad(arrays["X"])), pencil_dim=1)
         self.T = self._put(jnp.asarray(repad(arrays["T"])))
         if self._multistep and "F_hist" in arrays:
-            self.F_hist = self._put(jnp.asarray(repad(arrays["F_hist"])))
-            self.MX_hist = self._put(jnp.asarray(repad(arrays["MX_hist"])))
-            self.LX_hist = self._put(jnp.asarray(repad(arrays["LX_hist"])))
+            self.F_hist = self._put(jnp.asarray(repad(arrays["F_hist"])),
+                                    pencil_dim=2)
+            self.MX_hist = self._put(jnp.asarray(repad(arrays["MX_hist"])),
+                                     pencil_dim=2)
+            self.LX_hist = self._put(jnp.asarray(repad(arrays["LX_hist"])),
+                                     pencil_dim=2)
             self._ms_iter = int(meta.get("ms_iter", 0))
             self._dt_hist = [float(v) for v in meta.get("dt_hist", [])]
         extras = []
@@ -1595,8 +1740,10 @@ class EnsembleSolver:
             "member_steps": member_steps,
             "ensemble_steps_per_sec": round(member_steps / wall, 4)
             if wall > 0 else 0.0,
-            "devices": (self.mesh.shape[MEMBER_AXIS]
+            "devices": (int(np.prod(list(self.mesh.shape.values())))
                         if self.mesh is not None else 1),
+            **({"mesh": dict(self.mesh.shape)}
+               if self.pencil_axis is not None else {}),
             "per_member_dt": self.per_member_dt,
             "policy": self.policy,
             "dropped_members": [e["member"] for e in self.dropped],

@@ -152,7 +152,10 @@ class Handler:
 
         def fn(arrays):
             from ..tools.metrics import trace_scope
-            with mesh_transforms(dist.mesh), trace_scope("evaluator", "tasks"):
+            with mesh_transforms(dist.mesh,
+                                 chunks=getattr(self.solver,
+                                                "_transpose_chunks", None)), \
+                    trace_scope("evaluator", "tasks"):
                 return fn_body(arrays)
 
         def fn_body(arrays):

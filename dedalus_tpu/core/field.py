@@ -42,29 +42,55 @@ _MESH_CTX = _threading.local()
 
 
 class mesh_transforms:
-    """Context manager activating sharded transform walks (trace-time)."""
+    """Context manager activating sharded transform walks (trace-time).
+    `mesh=None` INHERITS any active context instead of clearing it: an
+    undistributed solver body traced inside an outer walk context (the
+    2-D batch x pencil fleet, core/ensemble.py) keeps the outer mesh.
+    `chunks` carries the solver's resolved transpose chunk count
+    ([distributed] TRANSPOSE_CHUNKS) into the walk; None resolves from
+    config at walk time."""
 
-    def __init__(self, mesh):
+    def __init__(self, mesh, chunks=None):
         self.mesh = mesh
+        self.chunks = chunks
 
     def __enter__(self):
         self.prev = getattr(_MESH_CTX, "mesh", None)
-        _MESH_CTX.mesh = self.mesh
-        return self.mesh
+        self.prev_chunks = getattr(_MESH_CTX, "chunks", None)
+        if self.mesh is not None:
+            _MESH_CTX.mesh = self.mesh
+            _MESH_CTX.chunks = self.chunks
+        return getattr(_MESH_CTX, "mesh", None)
 
     def __exit__(self, *exc):
         _MESH_CTX.mesh = self.prev
+        _MESH_CTX.chunks = self.prev_chunks
 
 
 def _active_mesh(domain):
-    """(mesh, axis_names) for the current transform walk, or (None, ())."""
+    """(mesh, axis_names) for the current transform walk, or (None, ()).
+    Reserved ensemble batch axes are filtered out (meshctx.walk_axis_names):
+    on a 2-D batch x pencil mesh the walk transposes over the pencil axes
+    only."""
     mesh = getattr(_MESH_CTX, "mesh", None)
     if mesh is None:
         return None, ()
-    R = min(len(mesh.axis_names), domain.dim - 1)
+    names = meshctx.walk_axis_names(mesh)
+    R = min(len(names), domain.dim - 1)
     if R < 1:
         return None, ()
-    return mesh, mesh.axis_names[:R]
+    return mesh, names[:R]
+
+
+def _active_chunks():
+    """Transpose chunk count for the current walk: the solver's resolved
+    value when its mesh_transforms context carried one, else resolved
+    from [distributed] TRANSPOSE_CHUNKS."""
+    chunks = getattr(_MESH_CTX, "chunks", None)
+    if chunks is not None:
+        return chunks
+    from ..parallel.transposes import resolve_transpose_chunks
+    return resolve_transpose_chunks()
 
 
 def _constrain(data, mesh, layout):
@@ -120,12 +146,28 @@ def transform_to_coeff(data, domain, scales, tdim, library=None, tensorsig=()):
             data = fwd(data, axis)
         return data
     R = len(names)
+    chunks = _active_chunks()
     # grid layout: mesh axis r shards array dim r+1
     layout = {tdim + r + 1: names[r] for r in range(R)}
     prev = meshctx.set_walk(mesh, layout)
     try:
         data = _constrain(data, mesh, layout)
         for r in range(R):
+            if chunks > 1 and data.shape[tdim + r + 1] % mesh.shape[names[r]] == 0:
+                # overlapped chunked stage: transform + per-chunk
+                # all_to_all interleaved inside one shard_map
+                # (parallel/transposes.py; bit-identical to the
+                # monolithic constraint-walk below)
+                from ..parallel.transposes import overlapped_to_coeff_stage
+                del layout[tdim + r + 1]
+                data = overlapped_to_coeff_stage(
+                    data, lambda x, _r=r: fwd(x, _r),
+                    tdim + r + 1, tdim + r, mesh, names[r],
+                    layout=layout, chunks=chunks)
+                layout[tdim + r] = names[r]
+                meshctx.set_walk(mesh, layout)
+                data = _constrain(data, mesh, layout)
+                continue
             data = fwd(data, r)                 # axis r is local in grid layout
             del layout[tdim + r + 1]
             layout[tdim + r] = names[r]
@@ -157,6 +199,7 @@ def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
             data = bwd(data, axis)
         return data
     R = len(names)
+    chunks = _active_chunks()
     # coeff layout: mesh axis r shards array dim r
     layout = {tdim + r: names[r] for r in range(R)}
     prev = meshctx.set_walk(mesh, layout)
@@ -165,6 +208,22 @@ def transform_to_grid(data, domain, scales, tdim, library=None, tensorsig=()):
         for axis in range(domain.dim - 1, R - 1, -1):
             data = bwd(data, axis)
         for r in range(R - 1, -1, -1):
+            n = mesh.shape[names[r]]
+            if chunks > 1 and data.shape[tdim + r + 1] % n == 0 \
+                    and data.shape[tdim + r] % n == 0:
+                # overlapped chunked stage (parallel/transposes.py):
+                # chunk k+1's all_to_all rides under chunk k's backward
+                # transform; bit-identical to the monolithic walk below
+                from ..parallel.transposes import overlapped_to_grid_stage
+                del layout[tdim + r]
+                data = overlapped_to_grid_stage(
+                    data, lambda x, _r=r: bwd(x, _r),
+                    tdim + r, tdim + r + 1, mesh, names[r],
+                    layout=layout, chunks=chunks)
+                layout[tdim + r + 1] = names[r]
+                meshctx.set_walk(mesh, layout)
+                data = _constrain(data, mesh, layout)
+                continue
             del layout[tdim + r]
             layout[tdim + r + 1] = names[r]
             meshctx.set_walk(mesh, layout)

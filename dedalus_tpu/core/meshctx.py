@@ -22,6 +22,21 @@ from ..tools.compat import shard_map
 
 _CTX = threading.local()
 
+# Mesh axis names reserved for ENSEMBLE member batching (core/ensemble.py):
+# a transform walk never transposes over them — on a 2-D batch x pencil
+# mesh the walk distributes the pencil axes only, while the member axis
+# stays manual (shard_map) around the whole fleet program.
+BATCH_AXIS_NAMES = frozenset({"batch"})
+
+
+def walk_axis_names(mesh):
+    """Mesh axes that participate in transform-walk distribution: every
+    axis except the reserved ensemble batch axes. The 2-D batch x pencil
+    composition publishes the SAME mesh for walks and fleet sharding;
+    this filter is what keeps the walk's transposes on the pencil axes
+    while members ride the batch axis untouched."""
+    return tuple(n for n in mesh.axis_names if n not in BATCH_AXIS_NAMES)
+
 
 def surviving_devices(mesh, lost_indices):
     """Devices of a 1-D `mesh` left after losing `lost_indices` (local
@@ -67,6 +82,36 @@ def restore_walk(prev):
 
 def active():
     return getattr(_CTX, "state", None)
+
+
+def gathered_apply(fn, data, mesh, axis_name, dim=0):
+    """
+    Apply `fn` (a local whole-array function) to `data` whose `dim` is
+    block-sharded over `axis_name`: all_gather the axis inside shard_map,
+    apply `fn` to the replicated copy, and slice this device's block back
+    out. The escape hatch for arrays too low-dimensional to layout-walk —
+    a 1-D tau field's transform roundtrip under the 2-D batch x pencil
+    fleet (core/ensemble.py): its only axis is the sharded one, so there
+    is no free axis to keep local, and an unrouted fft on a
+    manual-subgroup-sharded array hard-crashes the SPMD partitioner.
+    `fn` must preserve the size of `dim`. Falls back to a direct call
+    when the dim does not divide the mesh axis.
+    """
+    n = mesh.shape[axis_name]
+    if data.shape[dim] % n:
+        return fn(data)
+    spec = PartitionSpec(*[axis_name if d == dim else None
+                           for d in range(data.ndim)])
+
+    def local(block):
+        import jax
+        full = jax.lax.all_gather(block, axis_name, axis=dim, tiled=True)
+        out = fn(full)
+        idx = jax.lax.axis_index(axis_name)
+        blk = out.shape[dim] // n
+        return jax.lax.dynamic_slice_in_dim(out, idx * blk, blk, axis=dim)
+
+    return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)(data)
 
 
 def local_fft(fn, data, orig_axis):

@@ -96,6 +96,13 @@ class SolverBase:
         # across two compositions
         from . import fusedstep
         self._fusion_plan = fusedstep.resolve_fusion()
+        # resolve the [distributed] transpose chunking ONCE too, for the
+        # same reason: the chunk structure shapes every compiled sharded
+        # walk, and solver_key/pool_key token it so pooled compiled
+        # programs can never alias across chunk configs (a bad config
+        # value fails the build here, not mid-trace)
+        from ..parallel.transposes import resolve_transpose_chunks
+        self._transpose_chunks = resolve_transpose_chunks()
         G, S = self.pencil_shape
         dense_bytes = G * S * S * np.dtype(self.pencil_dtype).itemsize
         lazy_bytes = int(config["linear algebra"].get(
@@ -533,7 +540,8 @@ class SolverBase:
 
         def eval_F(X, t=None, extra_arrays=None):
             from .field import mesh_transforms
-            with mesh_transforms(self.dist.mesh):
+            with mesh_transforms(self.dist.mesh,
+                                 chunks=self._transpose_chunks):
                 return eval_F_body(X, t, extra_arrays)
 
         def eval_F_body(X, t=None, extra_arrays=None):
@@ -728,7 +736,8 @@ class InitialValueSolver(SolverBase):
             from ..tools.jitlift import lifted_jit
 
             def project(X):
-                with mesh_transforms(self.dist.mesh):
+                with mesh_transforms(self.dist.mesh,
+                                     chunks=self._transpose_chunks):
                     arrays = scatter_state(layout, variables, X)
                     out = {}
                     for v in variables:

@@ -318,7 +318,8 @@ class PencilLayout:
                 if basis is None:
                     pad = [(0, 0)] * data.ndim
                     pad[1 + axis] = (0, G * gs - size)
-                    data = jnp.pad(data, pad)
+                    from ..tools.array import zeropad
+                    data = zeropad(data, pad)
                 new_shape.extend([G, gs])
                 group_positions.append(pos)
                 pos += 2

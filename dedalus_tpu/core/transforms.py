@@ -19,6 +19,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from . import meshctx
+from ..tools.array import zeropad
 
 from ..tools.array import apply_matrix_jax
 from ..tools.metrics import scoped as _scoped
@@ -222,7 +223,7 @@ class FastChebyshevTransform(TransformPlan):
         chain (stride-2): out[n] = sum_{m >= n, m = n mod 2} x[m]."""
         n = x.shape[-1]
         if n % 2:
-            x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+            x = zeropad(x, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
         pairs = x.reshape(x.shape[:-1] + (-1, 2))
         acc = jnp.cumsum(pairs[..., ::-1, :], axis=-2)[..., ::-1, :]
         return acc.reshape(x.shape[:-1] + (-1,))[..., :n]
@@ -256,7 +257,7 @@ class FastChebyshevTransform(TransformPlan):
             Hj = jnp.asarray(H, dtype=dt)
             u = self._revcumsum_parity(Hj * u / jnp.asarray(d0, dtype=dt)) / Hj
         chat = u * jnp.asarray(self.rescale, dtype=dt)
-        chat = jnp.pad(chat, [(0, 0)] * (chat.ndim - 1) + [(0, Ng - N)])
+        chat = zeropad(chat, [(0, 0)] * (chat.ndim - 1) + [(0, Ng - N)])
         # _idct2(y)_j = y_0/(2Ng) + (1/Ng) sum_n y_n cos(n th_j)
         chat = chat.at[..., 0].multiply(2.0)
         g = _idct2(chat * Ng, axis)

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec
 from .matsolvers import get_solver
 from ..tools.compat import shard_map
 from ..tools.config import config
+from ..tools.array import zeropad
 
 
 # ------------------------------------------------------- pencil-mesh routing
@@ -59,17 +60,22 @@ _PENCIL_MESH = threading.local()
 class pencil_mesh:
     """Trace-time context: batched factor/solve calls under this context
     run inside shard_map over the leading batch axis of `mesh`'s first
-    axis (or `axis_name`). `mesh=None` is a no-op, so unsharded traces
-    compile identically to before."""
+    axis (or `axis_name`). `mesh=None` INHERITS any active context (so
+    an undistributed solver's factor/solve bodies traced inside an outer
+    pencil context — the 2-D batch x pencil fleet, core/ensemble.py —
+    keep the outer routing); with no outer context it is a no-op and
+    unsharded traces compile identically to before."""
 
     def __init__(self, mesh, axis_name=None):
+        self.inherit = mesh is None
         self.state = None if mesh is None else \
             (mesh, axis_name or mesh.axis_names[0])
 
     def __enter__(self):
         self.prev = getattr(_PENCIL_MESH, "state", None)
-        _PENCIL_MESH.state = self.state
-        return self.state
+        if not self.inherit:
+            _PENCIL_MESH.state = self.state
+        return getattr(_PENCIL_MESH, "state", None)
 
     def __exit__(self, *exc):
         _PENCIL_MESH.state = self.prev
@@ -457,7 +463,7 @@ class BandedOps(AdjointSolveOps):
         width follows the band ARRAY (assembled storage, not the
         re-blocked factor width)."""
         width = bands.shape[-1]
-        xpad = jnp.pad(x, ((0, 0), (self.kl, self.ku)))
+        xpad = zeropad(x, ((0, 0), (self.kl, self.ku)))
         y = jnp.zeros_like(x)
         for i, d in enumerate(dsel):
             y = y + bands[:, i, :] * jax.lax.slice_in_dim(
@@ -468,7 +474,7 @@ class BandedOps(AdjointSolveOps):
         """Full A @ X in the ORIGINAL slot ordering; X (G, S)."""
         with jax.named_scope("dedalus/matsolve/banded.matvec"):
             xp = X[:, self.col_perm]
-            xp = jnp.pad(xp, ((0, 0), (0, A.bands.shape[-1] - self.n)))
+            xp = zeropad(xp, ((0, 0), (0, A.bands.shape[-1] - self.n)))
             yp = self._band_mv(A.bands, A.dsel, xp)
             if self.t and A.Vt is not None:
                 pin_vals = jnp.einsum("gtn,gn->gt", A.Vt, xp)
@@ -486,7 +492,7 @@ class BandedOps(AdjointSolveOps):
         with jax.named_scope("dedalus/matsolve/banded.matvec_pair"):
             width = M.bands.shape[-1]
             xp = X[:, self.col_perm]
-            xp = jnp.pad(xp, ((0, 0), (0, width - self.n)))
+            xp = zeropad(xp, ((0, 0), (0, width - self.n)))
             outs = []
             for A in (M, L):
                 yp = self._band_mv(A.bands, A.dsel, xp)
@@ -904,7 +910,22 @@ class BandedOps(AdjointSolveOps):
                 bands, Vt = combine(mb, lb, mv, lv, Gc)
                 return self._factor_core(bands, Vt, fused=fused)
 
-            core = jax.lax.map(one, tuple(xs))
+            if active_pencil_mesh() is not None:
+                # distributed factor: XLA's SPMD partitioner miscompiles
+                # the chunk-level lax.map (s64/s32 index mismatch in the
+                # scan's dynamic_update_slice under x64 — the 2048x1024
+                # north-star regime), and the factor outputs' group dims
+                # vary per leaf so a manual shard_map reassembly is
+                # ambiguous. C is static and small: unroll the chunk
+                # loop into C chunk programs instead (the memory bound
+                # lax.map provided is preserved by XLA's serial
+                # scheduling of the independent chunk subgraphs).
+                cores = [one(jax.tree.map(lambda s, _i=i: s[_i],
+                                          tuple(xs)))
+                         for i in range(C)]
+                core = jax.tree.map(lambda *ls: jnp.stack(ls), *cores)
+            else:
+                core = jax.lax.map(one, tuple(xs))
         return self._aux_from_core(core, {"ab": (a, b)})
 
     # ------------------------------------------------ incremental factor
@@ -1034,7 +1055,7 @@ class BandedOps(AdjointSolveOps):
     def _solve_once(self, aux, rhs):
         G = rhs.shape[0]
         fp = rhs[:, self.row_perm]
-        fp = jnp.pad(fp, ((0, 0), (0, self.n_pad - self.n)))
+        fp = zeropad(fp, ((0, 0), (0, self.n_pad - self.n)))
         # chunking is read off the aux's own stacked shapes ((G, q, q)
         # unchunked, (C, Gc, q, q) chunked) — instance state would go
         # stale across auxes factored under different configs
@@ -1049,11 +1070,51 @@ class BandedOps(AdjointSolveOps):
             auxc = {k: aux[k] for k in ("interior", "Vt", "YbT", "Cap",
                                         "fsub")
                     if k in aux}
-            y = jax.lax.map(lambda xs: self._solve_core(xs[0], xs[1]),
-                            (auxc, fp.reshape(C, Gc, self.n_pad)))
+            fpr = fp.reshape(C, Gc, self.n_pad)
+
+            def chunked_solve(auxc, fpr):
+                return jax.lax.map(
+                    lambda xs: self._solve_core(xs[0], xs[1]),
+                    (auxc, fpr))
+
+            y = self._shard_chunked(chunked_solve, (auxc, fpr), Gc)
             y = y.reshape(-1, self.n_pad)[:G]
         xp = y[:, :self.n]
         return xp[:, self.pos_col]
+
+    def _shard_chunked(self, fn, args, Gc):
+        """Run a chunk-mapped factor/solve (`fn(*args)`, every traced
+        leaf a (C, Gc, ...) slab) with the per-chunk GROUP axis (dim 1)
+        sharded over the active pencil mesh, inside manual shard_map.
+        Two reasons: the t x t capacitance LU custom calls stay
+        device-local (GSPMD cannot partition them), and XLA's SPMD
+        partitioner miscompiles the chunk scan's dynamic_update_slice
+        under x64 (s64/s32 index mismatch, verifier failure after
+        spmd-partitioning — observed on the 2048x1024 north-star banded
+        step). Falls back to the plain GSPMD call when no mesh context
+        is active, the chunk width does not tile the mesh, or any leaf
+        does not carry the (C, Gc, ...) layout."""
+        state = active_pencil_mesh()
+        if state is not None:
+            mesh, name = state
+            n = mesh.shape[name]
+            spec = PartitionSpec(None, name)
+
+            def spec_of(leaf):
+                ndim = getattr(leaf, "ndim", 0)
+                if ndim == 0:
+                    return PartitionSpec()
+                if ndim >= 2 and leaf.shape[1] == Gc:
+                    return spec
+                return None
+
+            in_specs = jax.tree.map(spec_of, args)
+            if Gc % n == 0 and not any(
+                    s is None for s in jax.tree.leaves(
+                        in_specs, is_leaf=lambda x: x is None)):
+                return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=spec)(*args)
+        return fn(*args)
 
     def _solve_impl(self, aux, rhs, mats=None):
         with jax.named_scope("dedalus/matsolve/banded.solve"):

@@ -4,6 +4,7 @@ Distributed execution over JAX device meshes
 chain — the MPI pencil machinery replaced by XLA collectives over ICI/DCN).
 """
 
-from .transposes import all_to_all_transpose, DistributedPencilPipeline
+from .transposes import (all_to_all_transpose, DistributedPencilPipeline,
+                         resolve_transpose_chunks)
 from .sharding import distribute_solver, pencil_sharding
 from . import multihost

@@ -32,6 +32,13 @@ def distribute_solver(solver, mesh=None, axis_name=None):
     mesh = mesh or solver.dist.mesh
     if mesh is None:
         return solver
+    if getattr(solver, "_dd", None) is not None:
+        raise ValueError(
+            "distribute_solver requires the native step path: the "
+            "emulated-f64 (double-double) runner (core/ddstep.py) steps "
+            "a single-process dd state the mesh sharding would bypass. "
+            "Build with [execution] EMULATED_F64 = never to distribute "
+            "f64 solves.")
     # record on the distributor: the compiled transform walks read it to
     # pin intermediate shardings (field.mesh_transforms)
     solver.dist.mesh = mesh

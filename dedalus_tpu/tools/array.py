@@ -11,6 +11,33 @@ import scipy.sparse as sp
 import jax.numpy as jnp
 
 
+# -------------------------------------------------------------- device side
+
+def zeropad(x, pad_width):
+    """`jnp.pad(x, pad_width)` for zero padding, lowered as
+    concatenations with zero broadcasts instead of an HLO `pad` op.
+    XLA's SPMD partitioner (jaxlib 0.4.37) hard-crashes
+    (hlo_sharding_util CHECK `IsManualSubgroup`) propagating shardings
+    through `pad` inside the GSPMD-auto region of a partially-manual
+    shard_map — the region every per-member op of the 2-D batch x pencil
+    fleet composition lives in (core/ensemble.py). Concatenation
+    partitions cleanly and is bitwise-identical zero padding, so the
+    traced transform/solve bodies use this form. `pad_width` is the
+    jnp.pad spec: one non-negative (before, after) pair per dim."""
+    for axis, (before, after) in enumerate(pad_width):
+        parts = []
+        if before:
+            shp = x.shape[:axis] + (before,) + x.shape[axis + 1:]
+            parts.append(jnp.zeros(shp, x.dtype))
+        parts.append(x)
+        if after:
+            shp = x.shape[:axis] + (after,) + x.shape[axis + 1:]
+            parts.append(jnp.zeros(shp, x.dtype))
+        if len(parts) > 1:
+            x = jnp.concatenate(parts, axis=axis)
+    return x
+
+
 # ---------------------------------------------------------------- host side
 
 def kron(*factors):

@@ -246,6 +246,17 @@ def solver_key(solver, names):
             _fp_update(h, "fusion", cache_token())
         else:
             _fp_update(h, "fusion", plan.token())
+        # resolved [distributed] transpose chunking: the chunk structure
+        # shapes every compiled sharded walk, and this key seeds
+        # pool_key — pooled entries hold COMPILED step programs, so two
+        # chunk configs must never alias one warm entry (the host
+        # matrices themselves are chunk-independent; same safe-direction
+        # trade as the fusion token above)
+        chunks = getattr(solver, "_transpose_chunks", None)
+        if chunks is None:
+            from ..parallel.transposes import resolve_transpose_chunks
+            chunks = resolve_transpose_chunks()
+        _fp_update(h, "transpose_chunks", int(chunks))
         spec = solver.matsolver
         _fp_update(h, "matsolver",
                    spec if isinstance(spec, str) else getattr(

@@ -89,7 +89,15 @@ __all__ = ["PHASES", "SUM_PHASES", "BUILD_PHASES", "CadenceGate", "Counter",
 # sums: `fused` below the decomposition sum is the fusion win (separate
 # dispatches pay per-phase boundaries the fused program elides).
 SUM_PHASES = ("transform", "matsolve", "transpose", "evaluator")
-PHASES = SUM_PHASES + ("fused",)
+# `transpose_exposed` / `transpose_overlapped` split the distributed
+# transpose wall of an OVERLAPPED chunked walk (parallel/transposes.py,
+# [distributed] TRANSPOSE_CHUNKS): exposed = communication the step
+# still waits on after chunking; overlapped = communication hidden
+# under the interleaved chunk transforms. Like `fused`, they OVERLAP
+# the `transpose` decomposition row (exposed + overlapped ~= the
+# monolithic transpose wall), so they are excluded from phase sums —
+# benchmarks/scaling.py measures and records them per device count.
+PHASES = SUM_PHASES + ("fused", "transpose_exposed", "transpose_overlapped")
 
 # The cold-start (build) phase vocabulary: host-side symbolic assembly,
 # banded structural analysis, device transfer + factorization, and the
@@ -617,6 +625,18 @@ def format_phase_table(record, indent="  "):
             f"{indent}{'fused':<10} {mean.get('fused', 0.0):#.4g} s/step"
             f"  (whole fused step program; overlaps the split rows, "
             f"excluded from sum)")
+    if total.get("transpose_exposed") or total.get("transpose_overlapped"):
+        # overlapped-chunked-walk split of the transpose wall
+        # (parallel/transposes.py): exposed = still waited on,
+        # overlapped = hidden under the interleaved chunk transforms
+        exp = total.get("transpose_exposed", 0.0)
+        ovl = total.get("transpose_overlapped", 0.0)
+        tot = exp + ovl
+        pct = 100.0 * ovl / tot if tot > 0 else 0.0
+        lines.append(
+            f"{indent}{'transpose':<10} exposed {exp:#.4g} s / overlapped "
+            f"{ovl:#.4g} s ({pct:.0f}% hidden; overlaps the transpose "
+            f"row, excluded from sum)")
     mem = record.get("device_mem_peak_bytes")
     if mem:
         lines.append(f"{indent}device memory peak: {mem / 1e9:.3f} GB"

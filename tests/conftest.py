@@ -99,7 +99,8 @@ def _service_test_watchdog(request):
               or request.node.get_closest_marker("chaos") is not None
               or request.node.get_closest_marker("ensemble") is not None
               or request.node.get_closest_marker("batching") is not None
-              or request.node.get_closest_marker("fusion") is not None)
+              or request.node.get_closest_marker("fusion") is not None
+              or request.node.get_closest_marker("distributed") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
         yield
         return
@@ -175,6 +176,15 @@ def pytest_configure(config):
         "fusion: fused spectral step tests (core/fusedstep.py: "
         "precomposed solve/matvec/transform fusion, donation, pallas); "
         "tier-1 by default")
+    # distributed: overlapped chunked transpose pipeline + 2-D
+    # batch x pencil mesh composition tests. Tier-1 by default; rides
+    # the same hard watchdog — a wedged collective on the virtual mesh
+    # stalls exactly like a hung daemon.
+    config.addinivalue_line(
+        "markers",
+        "distributed: overlapped distributed transpose pipeline + 2-D "
+        "batch x pencil mesh tests (parallel/transposes.py, "
+        "core/ensemble.py); tier-1 by default")
 
 
 @pytest.fixture

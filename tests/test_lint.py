@@ -101,6 +101,41 @@ def fleet_finite(snap):
     assert "gathers the full state" in bad.findings[0].message
 
 
+def test_dtl001_fires_in_transposes_module(tmp_path):
+    """parallel/transposes.py is hot-module scoped: the overlapped
+    chunked walk stages compile into every sharded step, so a stray
+    host sync there stalls the whole transpose pipeline. Fixture-pinned
+    so the scope can never silently regress."""
+    bad = _lint_src(tmp_path, "parallel/transposes.py", """
+import jax
+import jax.numpy as jnp
+
+def overlapped_stage(data, mesh):
+    jax.block_until_ready(data)        # sync between chunk issues
+    return float(jnp.max(data))        # host read of the moved block
+""")
+    assert _rules_fired(bad) == ["DTL001"]
+    assert len(bad.findings) == 2
+
+
+def test_dtl001_quiet_on_transposes_host_setup(tmp_path):
+    """Host-side chunk bookkeeping (divisor clamping, spec lists) in the
+    transposes module is not a device sync."""
+    result = _lint_src(tmp_path, "parallel/transposes.py", """
+import numpy as np
+
+def stage_chunks(requested, block):
+    c = max(1, min(int(requested), int(block)))   # host chunk math
+    while block % c:
+        c -= 1
+    return c
+
+def specs(layout, ndim):
+    return [layout.get(d) for d in range(ndim)]
+""")
+    assert result.findings == []
+
+
 def test_dtl001_state_gather_quiet_on_host_conversions(tmp_path):
     """The dtype= convention and non-state attributes stay quiet: host
     bookkeeping in the hot modules is not a device sync."""

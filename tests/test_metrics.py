@@ -227,7 +227,11 @@ def test_log_stats_phase_table(tmp_path, caplog):
         solver.log_stats()
     text = caplog.text
     assert "Per-phase wall time" in text
-    for phase in PHASES:
+    # the transpose_exposed/transpose_overlapped split renders only when
+    # measured (benchmarks/scaling.py feeds it); the in-loop sampler
+    # table always carries the decomposition rows + the fused overlay
+    from dedalus_tpu.tools.metrics import SUM_PHASES
+    for phase in SUM_PHASES + ("fused",):
         assert phase in text
 
 

@@ -195,9 +195,12 @@ def test_shell_coriolis_ivp_banded_matches_dense(dtype):
     # f64 pins representation agreement; the f32 bound only guards
     # against gross blowup — at 1/Ekman = 1e3 the Coriolis-scaled system
     # amplifies f32 assembly roundoff, and the partial-batched assembly's
-    # summation order legitimately moves the error within a ~2x band
-    # (measured 2.0e-4 per-group vs 3.5e-4 partial-batched; f64 5.7e-13)
-    rtol = 1e-10 if dtype == np.float64 else 5e-4
+    # summation order legitimately moves the error with thread count and
+    # reduction order (measured 2.0e-4 per-group vs 3.5e-4
+    # partial-batched originally, 7.6e-4 on the round-13 2-core host AT
+    # UNMODIFIED HEAD — the old 5e-4 bar sat inside the environmental
+    # band; f64 5.7e-13)
+    rtol = 1e-10 if dtype == np.float64 else 2e-3
     assert np.abs(sol - ref).max() < rtol * max(np.abs(ref).max(), 1.0)
 
 
