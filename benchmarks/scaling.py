@@ -42,7 +42,6 @@ Run: python benchmarks/scaling.py [--quick] [--skip-northstar]
 import argparse
 import json
 import os
-import re
 import sys
 import time
 
@@ -91,24 +90,17 @@ def build_diffusion2d(Nx, Nz, matsolver=None):
     return solver, u
 
 
-def collective_counts(txt):
-    return {op: len(re.findall(rf"\s{op}\(", txt))
-            for op in ("all-to-all", "all-gather")}
+# shared with tests/test_collectives.py and the lint --programs census:
+# ONE parser and ONE program handle behind every gather assertion
+from dedalus_tpu.tools.lint.progcheck import collective_counts  # noqa: E402
 
 
 def step_hlo(solver):
     """Compiled-HLO text of the solver's advance program (the
     tests/test_collectives.py probe)."""
-    import jax.numpy as jnp
-    ts = solver.timestepper
-    rd = solver.real_dtype
-    s = ts.steps + 1
-    a = b = jnp.zeros(s, dtype=rd)
-    c = jnp.zeros(ts.steps, dtype=rd)
-    args = (solver.M_mat, solver.L_mat, solver.X,
-            jnp.asarray(0.0, dtype=rd), solver.rhs_extra(),
-            ts.F_hist, ts.MX_hist, ts.LX_hist, a, b, c, ts._lhs_aux)
-    return ts._advance.lower(*args).compile().as_text()
+    from dedalus_tpu.core.timesteppers import step_program_handle
+    prog, args = step_program_handle(solver)
+    return prog.lower(*args).compile().as_text()
 
 
 def measure_steps(solver, dt, warmup, steps, reps=3):

@@ -9,7 +9,8 @@ subcommands — `python -m dedalus_tpu <command> --help` documents each:
     get_examples  print the examples directory
     report        summarize a metrics/results JSONL file
     postmortem    summarize a health post-mortem directory
-    lint          jit-hygiene static analysis (own arg surface)
+    lint          static analysis: AST jit-hygiene rules, and the
+                  compiled-program contract census under --programs
     serve         warm-pool solver daemon (dedalus_tpu/service/)
     submit        submit one run to a serve daemon
 """
@@ -481,8 +482,12 @@ def postmortem(args):
 
 
 def lint(argv):
-    """Jit-hygiene static analysis (tools/lint): DTL rule set, baseline,
-    suppressions. Nonzero exit on findings not covered by the baseline."""
+    """Static analysis (tools/lint): the DTL AST rule set plus, under
+    --programs, the DTP compiled-program contract census
+    (tools/lint/progcheck.py — collective placement, donation aliasing,
+    forbidden primitives, manual-region integrity over the lowered
+    step/fleet/grad programs; CPU-only). Nonzero exit on findings not
+    covered by the per-tier baseline."""
     from .tools.lint.cli import main as lint_main
     sys.exit(lint_main(argv))
 
@@ -539,8 +544,8 @@ def build_parser():
     # pass-through subcommands: listed here so the top-level --help names
     # them, but main() dispatches them before this parser ever runs
     for name, helptext in (
-            ("lint", "jit-hygiene static analysis (DTL rule set); "
-                     "see `lint --help`"),
+            ("lint", "static analysis (DTL AST rules; DTP program "
+                     "contracts via --programs); see `lint --help`"),
             ("serve", "warm-pool solver daemon (docs/serving.md); "
                       "see `serve --help`"),
             ("submit", "submit one run to a serve daemon; "

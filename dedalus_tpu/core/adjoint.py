@@ -450,6 +450,21 @@ class DifferentiableIVP:
                         f"wrt={list(self.wrt)}, dt={float(dt):.3e}")
         return float(val), grads
 
+    def grad_program_handle(self, n_steps, dt):
+        """(program, args) of the compiled value_and_grad program over
+        n constant-dt steps from the solver's current state — the
+        inspection handle the program contract checker
+        (tools/lint/progcheck.py) lowers. `program` is the same
+        lifted_jit wrapper value_and_grad dispatches (memoized per
+        (kind, n, K)), so `program.jaxpr(*args)` exposes the primitive
+        structure the adjoint actually backpropagates through — the
+        no-host-callback / gradient-integrity contracts read it here."""
+        n = int(n_steps)
+        if n < 1:
+            raise ValueError("n_steps must be >= 1")
+        args = self._args(n, dt, *self._operands(None, None))
+        return self._program("grad", n), args
+
     # ----------------------------------------------------------- telemetry
 
     def _compiled_keys(self):

@@ -801,6 +801,54 @@ class EnsembleSolver:
         self.metrics.observe_steps(member_steps)
         return taken
 
+    def step_program_handle(self, n=None):
+        """(program, args) of a compiled fleet step program — the
+        inspection handle the program contract checker
+        (tools/lint/progcheck.py) lowers: `program.lower(*args)` is the
+        same jitted shard_map program `_dispatch` runs for a block of n
+        steps, so collective placement (zero full-state gathers, the
+        all-to-all census) and the manual/auto shard_map structure are
+        checked on the EXECUTING program, not a reconstruction. Requires
+        a warmed fleet (step_many has run at least one scanned block so
+        factors and — for multistep schemes — the coefficient ramp
+        exist). `n` defaults to the largest block already traced."""
+        ts = self.timestepper
+        if n is None:
+            if not self._programs:
+                raise RuntimeError(
+                    "step_program_handle needs a stepped fleet: run "
+                    "step_many first so a block program exists")
+            n = max(self._programs)
+        n = int(n)
+        if self._multistep:
+            s = ts.steps
+            if len(self._dt_hist) < s:
+                raise RuntimeError(
+                    "step_program_handle needs the multistep ramp "
+                    "complete: run step_many past the first "
+                    f"{s} steps first")
+            a, b, c = ts.compute_coefficients(self._dt_hist, s)
+            a = np.concatenate([a, np.zeros(s + 1 - len(a))])
+            b = np.concatenate([b, np.zeros(s + 1 - len(b))])
+            c = np.concatenate([c, np.zeros(s - len(c))])
+            self._ensure_factor_ms(a[0], b[0])
+            args = (self.solver.M_mat, self.solver.L_mat, self.X, self.T,
+                    self.DT, self._active_dev, self.R, self._extras,
+                    self.F_hist, self.MX_hist, self.LX_hist,
+                    jnp.asarray(a, dtype=self.rd),
+                    jnp.asarray(b, dtype=self.rd),
+                    jnp.asarray(c, dtype=self.rd), self._lhs_aux)
+            flags = (False, False, True, True, True, True, True, True,
+                     True, True, True, False, False, False, False)
+        else:
+            self._ensure_factor_rk(self.dts[0])
+            args = (self.solver.M_mat, self.solver.L_mat, self.X, self.T,
+                    self.DT, self._active_dev, self.R, self._extras,
+                    self._lhs_aux)
+            flags = (False, False, True, True, True, True, True, True,
+                     self.per_member_dt)
+        return self._program(n, args, flags), args
+
     def _ms_single(self, dt):
         """One fleet multistep step with the ramp's order build-up
         (mirrors MultistepIMEX.step coefficient handling)."""

@@ -863,3 +863,36 @@ class RKGFY(RungeKuttaIMEX):
                   [0.5, 0.5, 0.],
                   [0.5, 0., 0.5]])
     c = np.array([0., 1., 1.])
+
+
+def step_program_handle(solver, dt=1e-3):
+    """(program, args) of the solver's compiled single-step program — the
+    shared inspection handle behind the compiled-program contract checker
+    (tools/lint/progcheck.py), the collective-placement tests
+    (tests/test_collectives.py) and benchmarks/scaling.py. `program` is
+    the lifted_jit wrapper the step loop actually dispatches (multistep
+    `_advance` / RK `_step`), so `program.lower(*args)` reproduces the
+    executing program text — including the donate_argnums aliasing
+    contract — and `program.jaxpr(*args)` its primitive structure.
+    Requires a factored solver (one `solver.step(dt)` builds the LHS
+    factorization); raises RuntimeError otherwise rather than lowering a
+    program the step loop would never run.
+    """
+    ts = solver.timestepper
+    if getattr(ts, "_lhs_aux", None) is None:
+        raise RuntimeError(
+            "step_program_handle needs a factored solver: call "
+            "solver.step(dt) once before lowering the step program")
+    rd = solver.real_dtype
+    if isinstance(ts, MultistepIMEX):
+        s = ts.steps + 1
+        a = b = jnp.zeros(s, dtype=rd)
+        c = jnp.zeros(ts.steps, dtype=rd)
+        args = (solver.M_mat, solver.L_mat, solver.X,
+                jnp.asarray(0.0, dtype=rd), solver.rhs_extra(),
+                ts.F_hist, ts.MX_hist, ts.LX_hist, a, b, c, ts._lhs_aux)
+        return ts._advance, args
+    args = (solver.M_mat, solver.L_mat, solver.X,
+            jnp.asarray(0.0, dtype=rd), jnp.asarray(float(dt), dtype=rd),
+            solver.rhs_extra(), ts._lhs_aux)
+    return ts._step, args

@@ -73,3 +73,34 @@ def build_rb_solver(Nx, Nz, dtype, mesh=None, matsolver=None):
     b.fill_random("g", seed=42, distribution="normal", scale=1e-3)
     b["g"] += (Lz - z)
     return solver, b
+
+
+def build_tau_ivp(Nx=16, Nz=8, cadence=100, matsolver=None,
+                  timestepper=None):
+    """2-D nonlinear heat IVP with tau lines (Fourier x Chebyshev): the
+    shared small sharded-stepping configuration behind the collective-
+    placement tests (tests/test_collectives.py, tests/test_distributed.py),
+    the weak-scaling benchmark and the compiled-program contract census
+    (tools/lint/progcheck.py). Returns (solver, u, x, z) undistributed;
+    callers shard it with parallel.distribute_solver or fleet it with
+    solver.ensemble. ONE definition so every gather/all-to-all assertion
+    runs against the same program shape."""
+    import dedalus_tpu.public as d3
+    coords = d3.CartesianCoordinates("x", "z")
+    dist = d3.Distributor(coords, dtype=np.float64)
+    xb = d3.RealFourier(coords["x"], size=Nx, bounds=(0, 4.0), dealias=3 / 2)
+    zb = d3.ChebyshevT(coords["z"], size=Nz, bounds=(0, 1.0), dealias=3 / 2)
+    u = dist.Field(name="u", bases=(xb, zb))
+    t1 = dist.Field(name="t1", bases=xb)
+    t2 = dist.Field(name="t2", bases=xb)
+    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)  # noqa: E731
+    problem = d3.IVP([u, t1, t2], namespace=locals())
+    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
+    problem.add_equation("u(z=0) = 0")
+    problem.add_equation("u(z=1) = 0")
+    kw = {"matsolver": matsolver} if matsolver else {}
+    solver = problem.build_solver(timestepper or d3.SBDF2,
+                                  enforce_real_cadence=cadence, **kw)
+    x, z = dist.local_grids(xb, zb)
+    u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+    return solver, u, x, z

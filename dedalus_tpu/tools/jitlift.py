@@ -285,8 +285,26 @@ class lifted_jit:
             args.insert(pos, val)
         return self.fn(*args)
 
+    def jaxpr(self, *args):
+        """ClosedJaxpr of the lifted program body (device constants
+        resolve to their interned device arrays, so they appear as jaxpr
+        constants). Inspection surface for the program contract checker
+        (tools/lint/progcheck.py): primitive-level contracts — forbidden
+        solve/callback primitives, pads inside partial-auto shard_map
+        regions — read the program from here."""
+        static = tuple(args[i] for i in self.static_argnums)
+        dynamic = [a for i, a in enumerate(args)
+                   if i not in self.static_argnums]
+        return jax.make_jaxpr(lambda *d: self._call_fn(static, d))(*dynamic)
+
     def lower(self, *args):
-        """Lower the lifted program (for inspection/testing)."""
+        """Lower the lifted program (for inspection/testing). The fresh
+        jit carries the wrapper's donate_argnums, so inspection sees the
+        SAME input_output_alias contract the executing program compiles
+        with — the donation-honored program contract
+        (tools/lint/progcheck.py) reads it from exactly this text, and a
+        lower() that silently dropped donation would report every
+        donating program as a regression (and, worse, hide a real one)."""
         static = tuple(args[i] for i in self.static_argnums)
         dynamic = [a for i, a in enumerate(args)
                    if i not in self.static_argnums]
@@ -299,6 +317,8 @@ class lifted_jit:
             with _Mode("substitute", dict(zip(idxs, consts))):
                 return self._call_fn(static, d)
 
+        donate = self._donate_positions(len(args)) \
+            if self.donate_argnums else ()
         # cold inspection path: a fresh jit per lower() is the point here
-        return jax.jit(wrapped).lower(  # dedalus-lint: disable=DTL003
+        return jax.jit(wrapped, donate_argnums=donate).lower(  # dedalus-lint: disable=DTL003
             [_registry.device_value(i) for i in idxs], *dynamic)

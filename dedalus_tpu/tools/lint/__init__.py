@@ -11,16 +11,24 @@ Components:
   framework.py — rule registry, findings, per-line `# dedalus-lint:
                  disable=RULE` suppressions, JSON baseline for
                  grandfathered findings, module context (import-alias
-                 canonicalization + traced-function detection).
+                 canonicalization + traced-function detection), parallel
+                 per-file scanning.
   rules.py     — the DTL rule set (see each rule's docstring).
+  progcheck.py — the SECOND tier: compiled-program contracts (DTP ids)
+                 over a census of lowered step/grad/fleet programs —
+                 collective placement, donation aliasing, forbidden
+                 primitives, manual-region integrity
+                 (`lint --programs`; baseline progcheck_baseline.json).
   cli.py       — `python -m dedalus_tpu lint [paths]`; exits nonzero on
                  findings not covered by the baseline.
 
-The pass is self-enforcing: tests/test_lint.py runs it over the package
-against the checked-in baseline (tools/lint/baseline.json), so tier-1
-fails on any new un-baselined violation. The runtime complements are the
-retrace sentinel (tools/retrace.py) and the opt-in `leak_check` pytest
-marker (tests/conftest.py).
+The pass is self-enforcing: tests/test_lint.py runs the AST tier over
+the package against the checked-in baseline (tools/lint/baseline.json)
+and tests/test_progcheck.py runs the fast census subset against
+progcheck_baseline.json, so tier-1 fails on any new un-baselined
+violation in either source or compiled programs. The runtime complements
+are the retrace sentinel (tools/retrace.py) and the opt-in `leak_check`
+pytest marker (tests/conftest.py).
 """
 
 from .framework import (DEFAULT_BASELINE, PACKAGE_DIR, Finding, LintResult,

@@ -1,5 +1,13 @@
 """
-`python -m dedalus_tpu lint [paths]` — run the jit-hygiene analyzer.
+`python -m dedalus_tpu lint [paths]` — the static-analysis CLI.
+
+Two tiers share the Finding/baseline machinery:
+
+  * default: the AST rule set (DTL0xx, rules.py) over Python source;
+  * `--programs`: the compiled-program contract checker (DTP1xx,
+    progcheck.py) — lowers the census of representative step/grad/fleet
+    programs on CPU and checks collective placement, donation aliasing,
+    forbidden primitives and manual-region integrity.
 
 Exit codes: 0 clean (every finding suppressed or baselined, baseline not
 stale), 1 new findings or stale baseline entries, 2 usage error.
@@ -7,27 +15,30 @@ stale), 1 new findings or stale baseline entries, 2 usage error.
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 
 from .framework import (all_rules, apply_baseline, load_baseline,
                         make_baseline, run_lint, DEFAULT_BASELINE,
-                        PACKAGE_DIR)
+                        PACKAGE_DIR, RULES)
 
 
 def build_parser():
     parser = argparse.ArgumentParser(
         prog="python -m dedalus_tpu lint",
-        description="Jit-hygiene static analysis (DTL rule set). "
-                    "Suppress single findings with a same-line "
-                    "'# dedalus-lint: disable=RULE' comment; grandfather "
-                    "existing ones into the baseline.")
+        description="Static analysis: the DTL AST rule set, plus the "
+                    "DTP compiled-program contract census under "
+                    "--programs. Suppress single AST findings with a "
+                    "same-line '# dedalus-lint: disable=RULE' comment; "
+                    "grandfather existing ones into the baseline.")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint "
-                             "(default: the dedalus_tpu package)")
-    parser.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                             "(default: the dedalus_tpu package; "
+                             "ignored under --programs)")
+    parser.add_argument("--baseline", default=None,
                         help="baseline JSON of grandfathered findings "
-                             "(default: %(default)s)")
+                             "(default: the checked-in per-tier baseline)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore the baseline (report every finding)")
     parser.add_argument("--update-baseline", action="store_true",
@@ -36,8 +47,134 @@ def build_parser():
     parser.add_argument("--format", choices=("text", "json"), default="text",
                         help="report format (default: text)")
     parser.add_argument("--list-rules", action="store_true",
-                        help="print the rule catalog and exit")
+                        help="print the rule + contract catalog and exit")
+    parser.add_argument("--rules", default=None, metavar="IDS",
+                        help="comma-separated AST rule ids to run "
+                             "(e.g. DTL001,DTL007; default: all)")
+    parser.add_argument("--jobs", type=int, default=None, metavar="N",
+                        help="parallel per-file AST scanning processes "
+                             "(0 = one per core; default: auto for "
+                             "package-sized scans)")
+    parser.add_argument("--programs", action="store_true",
+                        help="run the compiled-program contract census "
+                             "(tools/lint/progcheck.py) instead of the "
+                             "AST scan; CPU-only, no chip needed")
+    parser.add_argument("--select", default=None, metavar="NAMES",
+                        help="comma-separated census program names "
+                             "(--programs mode; default: the full census)")
+    parser.add_argument("--contracts", default=None, metavar="IDS",
+                        help="comma-separated contract ids to check "
+                             "(--programs mode; e.g. DTP101,DTP104)")
+    parser.add_argument("--fast", action="store_true",
+                        help="restrict the census to the fast subset "
+                             "(the tier-1 gate's selection)")
     return parser
+
+
+def _render_stale(stale):
+    """A stale entry means the grandfathered hazard was FIXED: print it
+    with its fixed-occurrence count on every run (not only under
+    --update-baseline) so the baseline visibly shrinks."""
+    for entry in stale:
+        n = entry.get("missing", 1)
+        print(f"stale baseline entry: {entry['rule']} {entry['path']} "
+              f"({entry['snippet']!r}) — {n} grandfathered "
+              f"occurrence{'s' if n != 1 else ''} no longer found "
+              "(fixed? run --update-baseline to drop it)")
+
+
+def _summary_line(summary, stale):
+    print(f"{summary['total']} finding(s): {summary['new']} new, "
+          f"{summary['baselined']} baselined, "
+          f"{summary['suppressed']} suppressed, "
+          f"{len(stale)} stale baseline entr"
+          f"{'y' if len(stale) == 1 else 'ies'}")
+
+
+def _split_ids(text):
+    return [t.strip() for t in text.split(",") if t.strip()]
+
+
+def _run_programs(args):
+    """The --programs tier. Imports (and thereby initializes) the solver
+    stack lazily — the AST tier must stay import-light."""
+    # the census needs a virtual device mesh; the flag only affects the
+    # host (cpu) platform and must land before the backend initializes
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from . import progcheck
+
+    names = _split_ids(args.select) if args.select else None
+    contracts = _split_ids(args.contracts) if args.contracts else None
+    baseline_path = pathlib.Path(args.baseline) if args.baseline \
+        else progcheck.PROGRAMS_BASELINE
+
+    if args.update_baseline:
+        if (names or contracts or args.fast) \
+                and baseline_path.resolve() \
+                == progcheck.PROGRAMS_BASELINE.resolve():
+            print("lint: refusing to regenerate the programs baseline "
+                  "from a census subset (it would drop entries outside "
+                  "the selection); drop --select/--contracts/--fast, or "
+                  "pass --baseline FILE for a scoped baseline",
+                  file=sys.stderr)
+            return 2
+        from .progcheck import check_records, run_census
+        records, _ = run_census(names, fast_only=args.fast)
+        findings, _, _ = check_records(
+            records, [progcheck.CONTRACTS[c] for c in contracts]
+            if contracts else None)
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        baseline_path.write_text(
+            json.dumps(make_baseline(findings), indent=1) + "\n")
+        print(f"baseline: {len(findings)} finding(s) grandfathered "
+              f"-> {baseline_path}")
+        return 0
+
+    try:
+        report = progcheck.run_programs(
+            names=names, contracts=contracts, fast_only=args.fast,
+            baseline_path=baseline_path, no_baseline=args.no_baseline)
+    except KeyError as exc:
+        print(f"lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+    except ValueError as exc:
+        print(f"lint: {exc}", file=sys.stderr)
+        return 2
+
+    summary = report["summary"]
+    stale = summary["stale"]
+    if args.format == "json":
+        print(json.dumps(report, indent=1))
+    else:
+        for row in report["programs"]:
+            if row.get("skipped"):
+                print(f"program {row['program']}: SKIPPED "
+                      f"({row['skipped']})")
+                continue
+            cols = [f"build {row['build_sec']}s"]
+            coll = row.get("collectives") or {}
+            cols.append(f"a2a {coll.get('all-to-all', 0)}")
+            cols.append(f"gathers {coll.get('all-gather', 0)}")
+            if row.get("donated") is not None:
+                cols.append(f"donated {row.get('donated_aliases', 0)}"
+                            f"/{row['donated']}")
+            if row.get("pads_in_auto_regions") is not None:
+                cols.append(f"auto-pads {row['pads_in_auto_regions']}")
+            print(f"program {row['program']}: {', '.join(cols)}")
+        for timing_kind in ("census", "contracts"):
+            budget = report["timings"][timing_kind]
+            total = round(sum(budget.values()), 3)
+            print(f"{timing_kind} timings ({total}s total): "
+                  + ", ".join(f"{k} {v}s" for k, v in budget.items()))
+        for f in report["findings"]:
+            print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} "
+                  f"[{f['severity']}] {f['message']}")
+        _render_stale(stale)
+        _summary_line(summary, stale)
+    return 1 if (summary["new"] or stale) else 0
 
 
 def main(argv=None):
@@ -52,7 +189,30 @@ def main(argv=None):
         for rule in all_rules():
             doc = (rule.__doc__ or "").strip().splitlines()[0]
             print(f"{rule.id} [{rule.severity}] {rule.title}: {doc}")
+        from .progcheck import all_contracts
+        for contract in all_contracts():
+            doc = (contract.__doc__ or "").strip().splitlines()[0]
+            print(f"{contract.id} [{contract.severity}] {contract.title}: "
+                  f"{doc} (--programs)")
         return 0
+
+    if args.programs:
+        if args.paths:
+            print("lint: --programs checks the compiled census, not "
+                  "source paths (drop the path arguments)",
+                  file=sys.stderr)
+            return 2
+        return _run_programs(args)
+
+    rules = None
+    if args.rules:
+        ids = _split_ids(args.rules)
+        unknown = [r for r in ids if r not in RULES]
+        if unknown:
+            print(f"lint: unknown rule id(s) {unknown}; known: "
+                  f"{sorted(RULES)}", file=sys.stderr)
+            return 2
+        rules = [RULES[r] for r in ids]
 
     for p in args.paths:
         path = pathlib.Path(p)
@@ -62,28 +222,39 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
     paths = args.paths or [str(PACKAGE_DIR)]
+    baseline_arg = args.baseline or str(DEFAULT_BASELINE)
     # staleness of the PACKAGE baseline is only meaningful when the scan
-    # covers the package: a subset scan leaves out-of-scope entries
-    # unmatched by construction, not because their findings were fixed.
-    # A custom --baseline is assumed scoped to the given paths.
-    check_stale = (pathlib.Path(args.baseline).resolve()
+    # covers the package AND every rule ran: a subset scan (or rule
+    # filter) leaves out-of-scope entries unmatched by construction, not
+    # because their findings were fixed. A custom --baseline is assumed
+    # scoped to the given paths.
+    check_stale = (pathlib.Path(baseline_arg).resolve()
                    != DEFAULT_BASELINE.resolve()
                    or not args.paths
                    or any(pathlib.Path(p).resolve() == PACKAGE_DIR
-                          for p in args.paths))
-    result = run_lint(paths)
+                          for p in args.paths)) and rules is None
+    jobs = args.jobs
+    if jobs is None:
+        # auto: fan out package-sized scans, stay serial for small ones
+        files_guess = sum(1 for p in paths
+                          for _ in pathlib.Path(p).rglob("*.py")) \
+            if all(pathlib.Path(p).is_dir() for p in paths) else 0
+        jobs = min(os.cpu_count() or 1, 8) if files_guess >= 16 else 1
+    elif jobs == 0:
+        jobs = os.cpu_count() or 1
+    result = run_lint(paths, rules=rules, jobs=jobs)
 
     if args.update_baseline:
-        baseline_path = pathlib.Path(args.baseline)
-        if args.paths \
+        baseline_path = pathlib.Path(baseline_arg)
+        if (args.paths or rules is not None) \
                 and baseline_path.resolve() == DEFAULT_BASELINE.resolve():
             # a subset scan would silently WIPE every grandfathered entry
-            # outside the given paths; the package baseline regenerates
-            # only from the full default scan
+            # outside the given paths (or outside the selected rules);
+            # the package baseline regenerates only from the full scan
             print("lint: refusing to regenerate the package baseline from "
-                  "a subset of paths (it would drop entries outside them); "
-                  "drop the paths, or pass --baseline FILE for a scoped "
-                  "baseline", file=sys.stderr)
+                  "a subset of paths or rules (it would drop entries "
+                  "outside them); drop the paths/--rules, or pass "
+                  "--baseline FILE for a scoped baseline", file=sys.stderr)
             return 2
         baseline_path.parent.mkdir(parents=True, exist_ok=True)
         baseline_path.write_text(
@@ -96,7 +267,7 @@ def main(argv=None):
         baseline = {}
     else:
         try:
-            baseline = load_baseline(args.baseline)
+            baseline = load_baseline(baseline_arg)
         except ValueError as exc:
             print(f"lint: {exc}", file=sys.stderr)
             return 2
@@ -117,12 +288,6 @@ def main(argv=None):
     else:
         for f in new:
             print(f.format())
-        for e in stale:
-            print(f"stale baseline entry: {e['rule']} {e['path']} "
-                  f"({e['snippet']!r}) — fixed? run --update-baseline")
-        print(f"{summary['total']} finding(s): {summary['new']} new, "
-              f"{summary['baselined']} baselined, "
-              f"{summary['suppressed']} suppressed, "
-              f"{len(stale)} stale baseline entr"
-              f"{'y' if len(stale) == 1 else 'ies'}")
+        _render_stale(stale)
+        _summary_line(summary, stale)
     return 1 if (new or stale) else 0

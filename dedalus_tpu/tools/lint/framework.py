@@ -226,7 +226,12 @@ class ModuleContext:
         traced_names = set()
         traced = set()
         for node in ast.walk(self.tree):
-            if isinstance(node, ast.Call) and self._jitish(node):
+            is_wrap_call = isinstance(node, ast.Call) and (
+                self._jitish(node)
+                # curried form: functools.partial(jax.jit, ...)(fn)
+                or (isinstance(node.func, ast.Call)
+                    and self._jitish(node.func)))
+            if is_wrap_call:
                 for arg in node.args:
                     if isinstance(arg, ast.Name):
                         traced_names.add(arg.id)
@@ -332,28 +337,82 @@ def collect_py_files(paths):
     return unique
 
 
-def run_lint(paths, rules=None):
-    """Run the rule set over .py files under `paths`. Unparsable files
-    surface as DTL000 findings (a lint pass that skips broken files hides
-    exactly the commit that needs review). Returns a LintResult."""
-    rules = all_rules() if rules is None else rules
+def _scan_file(path, rules):
+    """Lint one file: (findings, suppressed). Unparsable files surface
+    as DTL000 findings (a lint pass that skips broken files hides exactly
+    the commit that needs review)."""
     findings = []
     suppressed = []
-    for path in collect_py_files(paths):
+    try:
+        source = path.read_text()
+        ctx = ModuleContext(path, source)
+    except (OSError, SyntaxError, ValueError) as exc:
+        findings.append(Finding("DTL000", "error", path,
+                                getattr(exc, "lineno", 1) or 1, 0,
+                                f"unparsable module: {exc}", ""))
+        return findings, suppressed
+    for rule in rules:
+        for finding in rule.check(ctx):
+            if ctx.suppressed(finding):
+                suppressed.append(finding)
+            else:
+                findings.append(finding)
+    return findings, suppressed
+
+
+def _scan_file_by_ids(args):
+    """Process-pool worker: resolve rule ids against the registry in the
+    child (the rules module import registers them) and scan one file.
+    Finding objects pickle whole — plain slots of builtin types."""
+    path_str, rule_ids = args
+    from . import rules as _rules  # noqa: F401  (registers the rule set)
+    return _scan_file(pathlib.Path(path_str), [RULES[r] for r in rule_ids])
+
+
+def run_lint(paths, rules=None, jobs=None):
+    """Run the rule set over .py files under `paths`; returns a
+    LintResult. `jobs` > 1 fans the per-file AST scan over a fork-based
+    process pool (the serial pass is the longest part of a package lint
+    on this tree); results are identical and ordered as the serial scan.
+    Parallel scanning requires registry rules (resolved by id in the
+    children) and the fork start method — anything else silently runs
+    serial, which is always correct.
+    """
+    rules = all_rules() if rules is None else rules
+    files = collect_py_files(paths)
+    if jobs and jobs > 1 and len(files) > 1 \
+            and all(RULES.get(r.id) is r for r in rules):
         try:
-            source = path.read_text()
-            ctx = ModuleContext(path, source)
-        except (OSError, SyntaxError, ValueError) as exc:
-            findings.append(Finding("DTL000", "error", path,
-                                    getattr(exc, "lineno", 1) or 1, 0,
-                                    f"unparsable module: {exc}", ""))
-            continue
-        for rule in rules:
-            for finding in rule.check(ctx):
-                if ctx.suppressed(finding):
-                    suppressed.append(finding)
-                else:
-                    findings.append(finding)
+            import multiprocessing
+            import warnings
+            from concurrent.futures import ProcessPoolExecutor
+            mp_ctx = multiprocessing.get_context("fork")
+            rule_ids = [r.id for r in rules]
+            work = [(str(f), rule_ids) for f in files]
+            with warnings.catch_warnings():
+                # JAX warns that forking a multithreaded process risks
+                # deadlock; the children do pure-AST parsing and never
+                # enter the JAX runtime, and any pool failure falls back
+                # to the serial scan below
+                warnings.filterwarnings(
+                    "ignore", message=".*os.fork.*", category=RuntimeWarning)
+                with ProcessPoolExecutor(
+                        max_workers=min(int(jobs), len(files)),
+                        mp_context=mp_ctx) as pool:
+                    results = list(pool.map(_scan_file_by_ids, work))
+            findings, suppressed = [], []
+            for file_findings, file_suppressed in results:
+                findings.extend(file_findings)
+                suppressed.extend(file_suppressed)
+            return LintResult(findings, suppressed)
+        except (ImportError, ValueError, OSError):
+            pass  # no fork / restricted environment: serial fallback
+    findings = []
+    suppressed = []
+    for path in files:
+        file_findings, file_suppressed = _scan_file(path, rules)
+        findings.extend(file_findings)
+        suppressed.extend(file_suppressed)
     return LintResult(findings, suppressed)
 
 

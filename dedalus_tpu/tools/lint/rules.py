@@ -511,3 +511,260 @@ class NonDifferentiableOpInStepBody(Rule):
                     "donate_argnums-jitted step body aliases a donated "
                     "input the backward pass still needs; drop the "
                     "donation or write to a fresh buffer")
+
+
+# Modules whose traced bodies run inside (or compose into) shard_map
+# manual/partial-auto regions — the scope of DTL009. libraries/pencilops.py
+# is deliberately NOT listed: its lax.map chunk dispatches route through
+# BandedOps._shard_chunked manual shard_maps / static unrolls (the PR-13
+# fixes), and its one surviving jnp.pad is mode="edge" factor-time padding
+# that tools.array.zeropad cannot express — the compiled-program contract
+# DTP105 (tools/lint/progcheck.py) still guards the lowered result.
+MANUAL_REGION_MODULES = (
+    "core/transforms.py",
+    "core/subsystems.py",
+    "core/field.py",
+    "core/ensemble.py",
+    "core/fusedstep.py",
+    "core/timesteppers.py",
+    "core/meshctx.py",
+    "parallel/transposes.py",
+)
+
+# Function names that ARE the step/dispatch path in the hot modules: code
+# here runs per step (or per fleet block), strictly after the solver key
+# was sealed. Curated exact names, not substrings — build-time helpers
+# like timesteppers._use_split_step legitimately read config.
+STEP_PATH_FUNCTIONS = frozenset({
+    "step", "step_many", "step_fleet", "advance", "advance_body",
+    "step_body", "_step_split", "_dispatch", "_ms_single", "solve",
+    "solve_transpose", "matvec", "matvec_pair", "evolve",
+    "evolve_resilient",
+})
+
+
+def _dotted(node):
+    """Dotted source name of a Name/Attribute chain ('self.active_host',
+    'dts'); None when the base is not a plain name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return ".".join([node.id] + parts[::-1])
+    return None
+
+
+@register
+class HostMirrorAliasing(Rule):
+    """DTL007: zero-copy device placement of a mutated host mirror.
+
+    `jnp.asarray` of an aligned numpy buffer is ZERO-COPY on CPU: the
+    device array aliases the very memory later in-place writes mutate,
+    which retroactively rewrites the value operand of every dispatch
+    still queued on the async stream. The shipped case (PR 11): the
+    ensemble host mirrors (`active_host[m] = False`, `sim_times += ...`)
+    silently froze members for the tail of a served batch by rewriting
+    queued fleet operands. The sanctioned spellings copy:
+    `jnp.array(arr)` (copy=True by default — core/ensemble._put_host) or
+    an explicit `.copy()` on the source.
+
+    Heuristics: flags `jnp.asarray(x)` where x is
+      * an attribute chain (`self.active_host`, `snap.X`) that is
+        subscript-mutated (`x[...] = ...`, `x[...] += ...`) ANYWHERE in
+        the module — mirrors live on objects and the placement and the
+        mutation are typically in different methods; or
+      * a bare local name subscript-mutated LATER in the same function —
+        a buffer built in place and then placed (mutations before the
+        placement) is the legitimate construction pattern and stays
+        quiet.
+    `jnp.array(...)` never flags (it copies). The dotted-name match is
+    textual (no alias analysis): two objects sharing an attribute name in
+    one module can false-positive — carry a suppression naming why the
+    buffers are distinct.
+    """
+
+    id = "DTL007"
+    severity = "error"
+    title = "host-mirror-aliasing"
+
+    @staticmethod
+    def _mutations(ctx):
+        """{dotted name: [mutation nodes]} for subscript stores."""
+        out = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            else:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Subscript):
+                    name = _dotted(target.value)
+                    if name:
+                        out.setdefault(name, []).append(node)
+        return out
+
+    def check(self, ctx):
+        mutated = None
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = ctx.canon(node.func)
+            # only the zero-copy spelling; jnp.array copies by default
+            if name is None or not name_matches(name, "jax.numpy.asarray"):
+                continue
+            arg = node.args[0]
+            src = _dotted(arg)
+            if src is None:
+                continue
+            if mutated is None:
+                mutated = self._mutations(ctx)
+            writes = mutated.get(src)
+            if not writes:
+                continue
+            if isinstance(arg, ast.Name):
+                fn = ctx.enclosing_function(node)
+                later = [w for w in writes
+                         if ctx.enclosing_function(w) is fn
+                         and w.lineno > node.lineno]
+                if fn is None or not later:
+                    continue
+            yield self.finding(
+                ctx, node, f"jnp.asarray({src}) zero-copies a host "
+                "buffer that is mutated in place elsewhere "
+                f"(line {writes[0].lineno}): queued dispatches would see "
+                "the rewritten value; place mirrors by copy "
+                "(jnp.array, or .copy() the source)")
+
+
+@register
+class ConfigReadInStepPath(Rule):
+    """DTL008: config read on the step/dispatch path after solver-key
+    resolution.
+
+    The load-bearing invariant of PRs 12-13: every config knob a compiled
+    program depends on is resolved ONCE per solver build, stored on the
+    solver (`solver._fusion_plan`, `solver._transpose_chunks`) BEFORE
+    `assembly_cache.solver_key` seals it, and folded into the assembly
+    and serving pool keys — so two configs can never alias one compiled
+    program. A `cfg_get`/`config[...]` read inside the step path (or
+    inside traced code, where it bakes into one program variant at trace
+    time) reintroduces exactly the aliasing the keys exist to prevent:
+    the value read at step N is invisible to every cache key.
+
+    Heuristics: flags config reads (tools.config.cfg_get /
+    config[...] subscripts) inside traced functions ANYWHERE, and — in
+    the HOT_PATH_MODULES — inside functions named in STEP_PATH_FUNCTIONS
+    (exact names; walk-up through nested functions). Build/factor-time
+    reads (`__init__`, `_use_split_step`, `resolve_*`) are the sanctioned
+    pattern and stay quiet; a step-path function that must consult config
+    should take the resolved value as an argument instead.
+    """
+
+    id = "DTL008"
+    severity = "error"
+    title = "config-read-in-step-path"
+
+    @staticmethod
+    def _is_config_read(ctx, node):
+        if isinstance(node, ast.Call):
+            name = ctx.canon(node.func)
+            return name is not None and name_matches(name, "cfg_get")
+        if isinstance(node, ast.Subscript):
+            name = ctx.canon(node.value)
+            # exact forms only: the tools.config singleton (however
+            # imported) or a bare `config` name — `self.config`/other
+            # attributes named config are not the global read
+            return name == "config" \
+                or (name is not None
+                    and name.endswith("tools.config.config"))
+        return False
+
+    def _in_step_path(self, ctx, node):
+        cur = ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and cur.name in STEP_PATH_FUNCTIONS:
+                return True
+            cur = ctx.parent(cur)
+        return False
+
+    def check(self, ctx):
+        hot = module_matches(ctx.rel, HOT_PATH_MODULES)
+        for node in ast.walk(ctx.tree):
+            if not self._is_config_read(ctx, node):
+                continue
+            # Subscript STORES (config["x"]["Y"] = ...) are test/setup
+            # mutations, not reads
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(getattr(node, "ctx", None),
+                                   (ast.Store, ast.Del)):
+                continue
+            if ctx.in_traced(node):
+                yield self.finding(
+                    ctx, node, "config read inside traced code bakes the "
+                    "value into one program variant invisibly to the "
+                    "solver/pool keys; resolve it once per build and "
+                    "pass the resolved value in")
+            elif hot and self._in_step_path(ctx, node):
+                yield self.finding(
+                    ctx, node, "config read on the step/dispatch path "
+                    "(after solver-key resolution): the value is "
+                    "invisible to the assembly/pool keys, so two configs "
+                    "could alias one compiled program; resolve once per "
+                    "build (before solver_key) and store it on the "
+                    "solver")
+
+
+@register
+class GspmdFragileOp(Rule):
+    """DTL009: GSPMD-fragile op in a manual-region module.
+
+    jaxlib 0.4.37's SPMD partitioner hard-crashes on `pad` ops inside the
+    GSPMD-auto subregion of a partially-manual shard_map
+    (hlo_sharding_util CHECK IsManualSubgroup), and miscompiles
+    `lax.map`-style chunk scans under GSPMD (s64/s32
+    dynamic_update_slice mismatch) — the three crash classes PR 13 fixed.
+    The traced bodies of MANUAL_REGION_MODULES compose into exactly those
+    regions (the 2-D batch x pencil fleet wraps them all), so zero
+    padding there must lower through `tools.array.zeropad`
+    (concatenation, bitwise identical) and chunk maps must route through
+    an explicit manual shard_map or a static unroll
+    (libraries/pencilops.BandedOps._shard_chunked is the model).
+
+    Heuristic: flags any `jnp.pad` / `jax.lax.map` call in the scoped
+    modules, whole-file — these modules' functions are reached under the
+    fleet composition regardless of where in the file they sit. The
+    compiled-program contract DTP105 (tools/lint/progcheck.py) is the
+    backstop that checks the LOWERED programs, including modules outside
+    this scope.
+    """
+
+    id = "DTL009"
+    severity = "error"
+    title = "gspmd-fragile-op"
+
+    def check(self, ctx):
+        if not module_matches(ctx.rel, MANUAL_REGION_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.canon(node.func)
+            if name is None:
+                continue
+            if name_matches(name, "jax.numpy.pad"):
+                yield self.finding(
+                    ctx, node, "jnp.pad in a manual-region module: the "
+                    "SPMD partitioner crashes on pad inside partial-auto "
+                    "shard_map regions; use tools.array.zeropad for zero "
+                    "padding (non-zero modes need explicit manual "
+                    "shard_map routing)")
+            elif name_matches(name, "jax.lax.map"):
+                yield self.finding(
+                    ctx, node, "lax.map in a manual-region module "
+                    "miscompiles under GSPMD; route the chunk map "
+                    "through a manual shard_map or a static unroll "
+                    "(see pencilops.BandedOps._shard_chunked)")

@@ -100,7 +100,8 @@ def _service_test_watchdog(request):
               or request.node.get_closest_marker("ensemble") is not None
               or request.node.get_closest_marker("batching") is not None
               or request.node.get_closest_marker("fusion") is not None
-              or request.node.get_closest_marker("distributed") is not None)
+              or request.node.get_closest_marker("distributed") is not None
+              or request.node.get_closest_marker("progcheck") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
         yield
         return
@@ -185,6 +186,14 @@ def pytest_configure(config):
         "distributed: overlapped distributed transpose pipeline + 2-D "
         "batch x pencil mesh tests (parallel/transposes.py, "
         "core/ensemble.py); tier-1 by default")
+    # progcheck: compiled-program contract census tests (tools/lint/
+    # progcheck.py). Tier-1 by default; rides the same hard watchdog —
+    # a wedged census build (a hung collective on the virtual mesh)
+    # stalls exactly like a hung daemon.
+    config.addinivalue_line(
+        "markers",
+        "progcheck: compiled-program contract checker tests (tools/"
+        "lint/progcheck.py: census + DTP contracts); tier-1 by default")
 
 
 @pytest.fixture

@@ -9,69 +9,78 @@ XLA's SPMD partitioner cannot partition fft ops — without the
 meshctx.local_fft shard_map routing, every batched FFT in the step
 lowered as all-gather + replicated full-size FFT (observed in round 3 on
 the virtual 8-device mesh).
-"""
 
-import re
+The parsing machinery lives in the program contract checker
+(tools/lint/progcheck.collective_counts — this file's ad-hoc regex,
+promoted to shared, size-aware analysis), the program shape in
+extras/bench_problems.build_tau_ivp, and the program handle in
+core/timesteppers.step_program_handle: the assertions here are the SAME
+checks `python -m dedalus_tpu lint --programs` runs over the whole
+census, kept as tests so a regression names the exact program.
+"""
 
 import numpy as np
 import pytest
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh
 
-import dedalus_tpu.public as d3
+import dedalus_tpu.public as d3  # noqa: F401  (solver stack ready)
+from dedalus_tpu.core.timesteppers import step_program_handle
+from dedalus_tpu.extras.bench_problems import build_tau_ivp
 from dedalus_tpu.parallel import distribute_solver
+from dedalus_tpu.tools.lint.progcheck import collective_counts
 
 N_DEV = len(jax.devices())
 needs_devices = pytest.mark.skipif(N_DEV < 4, reason="needs >= 4 devices")
+needs_8 = pytest.mark.skipif(N_DEV < 8, reason="needs >= 8 devices")
 
 
 def build_sharded_step():
-    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
-    coords = d3.CartesianCoordinates("x", "z")
-    dist = d3.Distributor(coords, dtype=np.float64)
-    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 4.0), dealias=3 / 2)
-    zb = d3.ChebyshevT(coords["z"], size=8, bounds=(0, 1.0), dealias=3 / 2)
-    u = dist.Field(name="u", bases=(xb, zb))
-    t1 = dist.Field(name="t1", bases=xb)
-    t2 = dist.Field(name="t2", bases=xb)
-    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
-    problem = d3.IVP([u, t1, t2], namespace=locals())
-    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
-    problem.add_equation("u(z=0) = 0")
-    problem.add_equation("u(z=1) = 0")
-    solver = problem.build_solver(d3.SBDF2)
-    x, z = dist.local_grids(xb, zb)
-    u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
-    distribute_solver(solver, mesh)
+    solver, u, x, z = build_tau_ivp()
+    distribute_solver(solver, Mesh(np.array(jax.devices()[:4]), ("x",)))
     return solver
 
 
-def collective_counts(hlo_text):
-    out = {}
-    for op in ("all-to-all", "all-gather", "all-reduce", "reduce-scatter"):
-        out[op] = len(re.findall(rf"\s{op}\(", hlo_text))
-    return out
+def step_hlo(solver):
+    solver_prog, args = step_program_handle(solver)
+    return solver_prog.lower(*args).compile().as_text()
 
 
 @needs_devices
 def test_sharded_step_uses_all_to_all_not_gather():
     solver = build_sharded_step()
     solver.step(1e-3)  # builds factors; also catches runtime errors
-    ts = solver.timestepper
-    rd = solver.real_dtype
-    s = ts.steps + 1
-    a = b = jnp.zeros(s, dtype=rd)
-    c = jnp.zeros(ts.steps, dtype=rd)
-    args = (solver.M_mat, solver.L_mat, solver.X,
-            jnp.asarray(0.0, dtype=rd), solver.rhs_extra(),
-            ts.F_hist, ts.MX_hist, ts.LX_hist, a, b, c, ts._lhs_aux)
-    txt = ts._advance.lower(*args).compile().as_text()
-    counts = collective_counts(txt)
+    counts = collective_counts(step_hlo(solver))
     assert counts["all-to-all"] >= 2, f"transform transposes missing: {counts}"
     assert counts["all-gather"] == 0, (
         f"full-state gathers in the sharded step: {counts} — the fft "
         "shard_map routing (core/meshctx.local_fft) has regressed")
+
+
+@needs_8
+def test_fleet_2d_step_uses_no_gathers():
+    """The zero-full-state-gather assertion PROMOTED to the 2-D
+    batch x pencil fleet program (which previously had no gather
+    assertion at all): members shard_map MANUAL over batch with pencils
+    in GSPMD auto mode — exactly the regime where the partitioner
+    degrades an unrouted op to a gather silently."""
+    solver, u, x, z = build_tau_ivp()
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("batch", "pencil"))
+    fleet = solver.ensemble(2, mesh=mesh)
+
+    def ics(i):
+        u["g"] = np.sin(np.pi * z) * (1 + 0.1 * (i + 1)
+                                      * np.cos(np.pi * x / 2))
+
+    fleet.init_members(ics)
+    fleet.step_many(4, 1e-3)
+    prog, args = fleet.step_program_handle()
+    counts = collective_counts(prog.lower(*args).compile().as_text())
+    assert counts["all-to-all"] >= 2, counts   # pencil transposes live
+    assert counts["all-gather"] == 0, (
+        f"full-state gathers in the 2-D fleet step: {counts} — the "
+        "pencil routing of the batch x pencil composition has regressed")
 
 
 @needs_devices
@@ -82,8 +91,8 @@ def test_sharded_checkpoint_write_is_per_shard_copies_only():
     8-device fleet state host-copies ONE SHARD AT A TIME — the global
     array is never materialized on host. The spy wraps the module-level
     dcheckpoint._copy_out hook, which every shard copy funnels through."""
-    import dedalus_tpu.public as d3_pub  # noqa: F401 (solver stack ready)
     from dedalus_tpu.tools import dcheckpoint as dc
+    import jax.numpy as jnp
     import tempfile
 
     mesh = Mesh(np.array(jax.devices()), ("batch",))
@@ -136,23 +145,8 @@ def test_sharded_step_matches_unsharded_with_local_fft():
         solver.step(1e-3)
     X_sharded = np.asarray(solver.X)
 
-    # rebuild unsharded
-    mesh_backup = None
-    coords = d3.CartesianCoordinates("x", "z")
-    dist = d3.Distributor(coords, dtype=np.float64)
-    xb = d3.RealFourier(coords["x"], size=16, bounds=(0, 4.0), dealias=3 / 2)
-    zb = d3.ChebyshevT(coords["z"], size=8, bounds=(0, 1.0), dealias=3 / 2)
-    u = dist.Field(name="u", bases=(xb, zb))
-    t1 = dist.Field(name="t1", bases=xb)
-    t2 = dist.Field(name="t2", bases=xb)
-    lift = lambda A, n: d3.Lift(A, zb.derivative_basis(2), n)
-    problem = d3.IVP([u, t1, t2], namespace=locals())
-    problem.add_equation("dt(u) - lap(u) + lift(t1,-1) + lift(t2,-2) = - u*u")
-    problem.add_equation("u(z=0) = 0")
-    problem.add_equation("u(z=1) = 0")
-    ref = problem.build_solver(d3.SBDF2)
-    x, z = dist.local_grids(xb, zb)
-    u["g"] = np.sin(np.pi * z) * (1 + 0.3 * np.cos(np.pi * x / 2))
+    # rebuild unsharded (same builder, no mesh)
+    ref, u, x, z = build_tau_ivp()
     for _ in range(5):
         ref.step(1e-3)
     assert np.allclose(X_sharded, np.asarray(ref.X), atol=1e-13)

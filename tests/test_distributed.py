@@ -48,10 +48,9 @@ class chunk_config:
         config["distributed"]["TRANSPOSE_CHUNKS"] = self.old
 
 
-def collective_counts(hlo_text):
-    import re
-    return {op: len(re.findall(rf"\s{op}\(", hlo_text))
-            for op in ("all-to-all", "all-gather")}
+# shared collective parser (the ad-hoc per-test regexes migrated to the
+# program contract checker's size-aware machinery)
+from dedalus_tpu.tools.lint.progcheck import collective_counts  # noqa: E402
 
 
 def build_2d_field():
@@ -217,16 +216,9 @@ def test_chunked_sharded_step_zero_gathers_and_bit_identity():
             return solver
 
     chunked = run(2)
-    ts = chunked.timestepper
-    rd = chunked.real_dtype
-    s = ts.steps + 1
-    a = b = jnp.zeros(s, dtype=rd)
-    c = jnp.zeros(ts.steps, dtype=rd)
-    args = (chunked.M_mat, chunked.L_mat, chunked.X,
-            jnp.asarray(0.0, dtype=rd), chunked.rhs_extra(),
-            ts.F_hist, ts.MX_hist, ts.LX_hist, a, b, c, ts._lhs_aux)
-    counts = collective_counts(
-        ts._advance.lower(*args).compile().as_text())
+    from dedalus_tpu.core.timesteppers import step_program_handle
+    prog, args = step_program_handle(chunked)
+    counts = collective_counts(prog.lower(*args).compile().as_text())
     assert counts["all-gather"] == 0, (
         f"full-state gathers in the chunked sharded step: {counts}")
     assert counts["all-to-all"] >= 2, counts

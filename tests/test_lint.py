@@ -49,7 +49,8 @@ def _rules_fired(result):
 def test_rule_catalog():
     rules = all_rules()
     assert [r.id for r in rules] == ["DTL001", "DTL002", "DTL003",
-                                     "DTL004", "DTL005", "DTL006"]
+                                     "DTL004", "DTL005", "DTL006",
+                                     "DTL007", "DTL008", "DTL009"]
     for r in rules:
         assert r.severity in ("error", "warning")
         assert r.title
@@ -364,6 +365,157 @@ update_j = jax.jit(update)
     assert undonated.findings == []
 
 
+def test_dtl007_fires_on_aliased_host_mirror(tmp_path):
+    """The PR-11 race encoded: jnp.asarray zero-copies the host mirror,
+    a later in-place mutation rewrites the queued device operand. Both
+    the attribute-mirror form (placement and mutation in different
+    methods) and the same-function local form flag."""
+    result = _lint_src(tmp_path, "core/ensemble.py", """
+import jax.numpy as jnp
+
+class Fleet:
+    def place(self):
+        self._active_dev = jnp.asarray(self.active_host)   # zero-copy
+
+    def detach(self, m):
+        self.active_host[m] = False                        # rewrites it
+
+def budgets(steps_left):
+    dev = jnp.asarray(steps_left)
+    steps_left[0] = 0        # later in-place write, same function
+    return dev
+""")
+    assert _rules_fired(result) == ["DTL007"]
+    assert len(result.findings) == 2
+    assert "zero-copies" in result.findings[0].message
+
+
+def test_dtl007_quiet_on_copying_placements(tmp_path):
+    """The sanctioned spellings stay quiet: jnp.array copies by default
+    (the _put_host fix), build-then-place locals mutate BEFORE the
+    placement, and numpy-side asarray is host bookkeeping."""
+    result = _lint_src(tmp_path, "core/ensemble.py", """
+import numpy as np
+import jax.numpy as jnp
+
+class Fleet:
+    def place(self):
+        self._active_dev = jnp.array(self.active_host)     # copies
+
+    def detach(self, m):
+        self.active_host[m] = False
+
+def build_mask(n):
+    mask = np.zeros(n, dtype=bool)
+    mask[0] = True                 # mutation BEFORE placement: build
+    return jnp.asarray(mask)
+
+def host_only(snap):
+    snap.lineage[0] = "x"
+    return np.asarray(snap.lineage)
+""")
+    assert result.findings == []
+
+
+def test_dtl008_fires_on_step_path_config_reads(tmp_path):
+    """Config reads on the step/dispatch path of a hot module (and
+    inside traced code anywhere) violate the resolved-once-per-build
+    invariant the assembly/pool keys depend on."""
+    bad = _lint_src(tmp_path, "core/timesteppers.py", """
+from ..tools.config import config, cfg_get
+
+class Stepper:
+    def step(self, dt):
+        mode = config["fusion"].get("FUSED_SOLVE", "auto")   # per step!
+        return mode
+
+    def _dispatch(self, n):
+        return cfg_get("distributed", "TRANSPOSE_CHUNKS", "auto")
+""")
+    assert _rules_fired(bad) == ["DTL008"]
+    assert len(bad.findings) == 2
+    assert "solver-key" in bad.findings[0].message \
+        or "pool keys" in bad.findings[0].message
+    traced = _lint_src(tmp_path, "anymodule.py", """
+import jax
+from dedalus_tpu.tools.config import cfg_get
+
+def body(x):
+    chunks = int(cfg_get("distributed", "TRANSPOSE_CHUNKS", "2"))
+    return x * chunks
+
+jitted = jax.jit(body)
+""")
+    assert _rules_fired(traced) == ["DTL008"]
+    assert "traced" in traced.findings[0].message
+
+
+def test_dtl008_quiet_on_build_time_reads(tmp_path):
+    """Build/factor-time resolution is the sanctioned pattern: reads in
+    __init__, module-level helpers, and resolve_* functions stay quiet
+    (the resolved value is stored before solver_key seals it)."""
+    result = _lint_src(tmp_path, "core/timesteppers.py", """
+from ..tools.config import config, cfg_get
+
+def _use_split_step(solver):
+    return config["execution"].get("STEP_PROGRAM", "auto") == "split"
+
+def resolve_chunks():
+    return cfg_get("distributed", "TRANSPOSE_CHUNKS", "auto")
+
+class Stepper:
+    def __init__(self):
+        self._mode = config["fusion"].get("FUSED_SOLVE", "auto")
+
+    def step(self, dt):
+        return self._mode      # resolved once, read from self
+""")
+    assert result.findings == []
+    # step-path reads OUTSIDE the hot modules are out of scope (tools,
+    # analysis code) unless traced
+    cold = _lint_src(tmp_path, "tools/post.py", """
+from .config import cfg_get
+
+def step(data):
+    return cfg_get("analysis", "FORMAT", "h5")
+""")
+    assert cold.findings == []
+
+
+def test_dtl009_fires_on_gspmd_fragile_ops(tmp_path):
+    """jnp.pad / lax.map restored into a manual-region module — the
+    jaxlib SPMD-partitioner crash classes PR 13 fixed — flag whole-file;
+    the zeropad funnel and out-of-scope modules stay quiet."""
+    bad = _lint_src(tmp_path, "core/transforms.py", """
+import jax
+import jax.numpy as jnp
+
+def backward(data, n):
+    padded = jnp.pad(data, ((0, 0), (0, n)))
+    return jax.lax.map(lambda x: x * 2, padded)
+""")
+    assert _rules_fired(bad) == ["DTL009"]
+    assert len(bad.findings) == 2
+    messages = " ".join(f.message for f in bad.findings)
+    assert "zeropad" in messages and "_shard_chunked" in messages
+    good = _lint_src(tmp_path, "core/transforms.py", """
+from ..tools.array import zeropad
+
+def backward(data, n):
+    return zeropad(data, ((0, 0), (0, n)))
+""")
+    assert good.findings == []
+    # pencilops is deliberately out of scope (documented: its chunk maps
+    # route through _shard_chunked; DTP105 guards the lowered programs)
+    scoped = _lint_src(tmp_path, "libraries/pencilops.py", """
+import jax.numpy as jnp
+
+def pad_groups(arr, n):
+    return jnp.pad(arr, ((0, n),), mode="edge")
+""")
+    assert scoped.findings == []
+
+
 def test_dtl006_suppression_and_baseline_zero():
     """The shipped step bodies carry ZERO grandfathered DTL006 entries —
     the differentiable path depends on them staying gradient-clean."""
@@ -473,6 +625,159 @@ def warm(x):
     entry = data["entries"][0]
     assert entry["rule"] == "DTL001"
     assert entry["snippet"] == "jax.block_until_ready(x)"
+
+
+def test_multi_rule_same_line_suppression(tmp_path):
+    """One comment can disable several rules on its line; each finding
+    is counted separately (whitespace after commas tolerated)."""
+    result = _lint_src(tmp_path, "mymod.py", """
+import jax
+import jax.numpy as jnp
+
+def body(plan, data):
+    jax.block_until_ready(data)  # dedalus-lint: disable=DTL001,DTL002
+    return jnp.asarray(plan.matrix) @ data  # dedalus-lint: disable=DTL002, DTL001
+
+jitted = jax.jit(body)
+""")
+    assert result.findings == []
+    assert sorted(f.rule for f in result.suppressed) == ["DTL001", "DTL002"]
+
+
+def test_multi_rule_disable_file(tmp_path):
+    """disable-file accepts a rule list too, and leaves unnamed rules
+    active."""
+    result = _lint_src(tmp_path, "mymod.py", """
+# dedalus-lint: disable-file=DTL002,DTL004
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def body(plan, data):
+    a = jnp.asarray(plan.matrix)            # DTL002: file-suppressed
+    b = jnp.zeros(4, dtype=np.float64)      # DTL004: file-suppressed
+    jax.block_until_ready(data)             # DTL001: still active
+    return a @ data + b
+
+jitted = jax.jit(body)
+""")
+    assert _rules_fired(result) == ["DTL001"]
+    assert sorted({f.rule for f in result.suppressed}) == ["DTL002",
+                                                           "DTL004"]
+
+
+def test_traced_detection_partial_jit_decorator(tmp_path):
+    """functools.partial(jax.jit, ...) — decorator form AND call form —
+    marks the function traced, so in-trace hazards fire without a plain
+    jax.jit in sight."""
+    result = _lint_src(tmp_path, "mymod.py", """
+import functools
+import numpy as np
+import jax
+
+@functools.partial(jax.jit, static_argnums=0)
+def decorated(n, x):
+    return np.asarray(x) + n          # DTL001: concretizes a tracer
+
+def plain(x):
+    return np.asarray(x) * 2          # DTL001 via the call form below
+
+jitted = functools.partial(jax.jit, donate_argnums=())(plain)
+""")
+    dtl1 = [f for f in result.findings if f.rule == "DTL001"]
+    assert len(dtl1) == 2, [f.format() for f in result.findings]
+
+
+def test_traced_detection_noncall_contexts_stay_host(tmp_path):
+    """A function never handed to a trace wrapper stays host code even
+    when it LOOKS jit-adjacent (named like one, called next to one)."""
+    result = _lint_src(tmp_path, "mymod2.py", """
+import numpy as np
+import jax
+
+def jit_helper(x):
+    return np.asarray(x)      # host: never traced
+
+def run(x):
+    return jax.jit(lambda v: v + 1)(x) + jit_helper(x).sum()
+""")
+    assert "DTL001" not in _rules_fired(result)
+
+
+def test_dtl000_syntax_error_carries_location(tmp_path):
+    """Unparsable modules surface as DTL000 findings with the parse
+    error's line, participate in the baseline like any finding, and do
+    not abort the scan of other files."""
+    broken = tmp_path / "pkg" / "broken.py"
+    broken.parent.mkdir(parents=True)
+    broken.write_text("def f(:\n    pass\n")
+    fine = broken.parent / "fine.py"
+    fine.write_text("x = 1\n")
+    result = run_lint([broken.parent])
+    assert _rules_fired(result) == ["DTL000"]
+    f = result.findings[0]
+    assert f.line == 1 and "unparsable" in f.message
+    # baseline round-trip: DTL000 grandfathering works like any rule
+    new, stale = apply_baseline(result.findings, {f.key(): 1})
+    assert new == [] and stale == []
+
+
+def test_parallel_scan_matches_serial():
+    """jobs>1 fans the per-file scan over a process pool; findings and
+    suppressions must be IDENTICAL (content and order) to the serial
+    pass over the real package tree."""
+    serial = run_lint([PACKAGE_DIR])
+    parallel = run_lint([PACKAGE_DIR], jobs=2)
+    assert [f.to_dict() for f in parallel.findings] \
+        == [f.to_dict() for f in serial.findings]
+    assert [f.to_dict() for f in parallel.suppressed] \
+        == [f.to_dict() for f in serial.suppressed]
+
+
+def test_rules_filter_cli(tmp_path, capsys):
+    """--rules runs the named subset only (and never reports package-
+    baseline staleness, which a filtered run cannot judge); unknown ids
+    are a usage error."""
+    bad = tmp_path / "core" / "timesteppers.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def step(x):
+    jax.block_until_ready(x)                  # DTL001
+    return jnp.zeros(4, dtype=np.float64)     # DTL004
+""")
+    rc = lint_main([str(bad), "--no-baseline", "--rules", "DTL004"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "DTL004" in out and "DTL001" not in out
+    rc = lint_main([str(bad), "--rules", "DTL999"])
+    assert rc == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_stale_entries_render_with_fixed_count_by_default(tmp_path,
+                                                         capsys):
+    """The framework docstring promise, now rendered: a DEFAULT run
+    prints stale entries as warnings with the fixed-hazard count, so the
+    baseline visibly shrinks without anyone running --update-baseline."""
+    bad = tmp_path / "core" / "timesteppers.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import jax\n\ndef f(x):\n    jax.block_until_ready(x)"
+                   "\n\ndef g(x):\n    jax.block_until_ready(x)\n")
+    baseline = tmp_path / "baseline.json"
+    assert lint_main([str(bad), "--baseline", str(baseline),
+                      "--update-baseline"]) == 0
+    capsys.readouterr()
+    bad.write_text("import jax\n")   # both hazards fixed
+    rc = lint_main([str(bad), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale baseline entry" in out
+    assert "2 grandfathered occurrences no longer found" in out
+    assert "1 stale baseline entry" in out
 
 
 # --------------------------------------------------------- package hygiene
