@@ -315,6 +315,7 @@ def _attach_progression(record):
     _attach_adjoint(record)
     _attach_checkpoint(record)
     _attach_fusion(record)
+    _attach_solvecomp(record)
     _attach_scaling(record)
     return record
 
@@ -539,6 +540,43 @@ def _attach_fusion(record):
             "meets_1p15x": row.get("meets_1p15x"),
             "state_rel_diff": row.get("state_rel_diff"),
             "fusion": row.get("fusion"),
+            "backend": row.get("backend"),
+            "stale": True,
+            "measured_ts": row.get("ts"),
+            "age_s": round(time.time() - row["ts"], 1)
+            if row.get("ts") else None,
+        }
+    return record
+
+
+def _attach_solvecomp(record):
+    """Attach the newest in-window solve-composition sweep headlines
+    (sequential/ascan/spike x f64/f32+refine steps/s + accuracy,
+    benchmarks/fusion.py run_solve_sweep) to the official bench line.
+    Same provenance discipline as the fusion rows: a CACHED prior
+    measurement, stamped stale with its original measured_ts and age,
+    dropped once outside the 48h window. CPU-measured by design (ROADMAP
+    platform note), so no backend filter."""
+    for key, config in (("solvecomp_rb256x64", "rb256x64_solvecomp"),
+                        ("solvecomp_diffusion64", "diffusion64_solvecomp")):
+        row = _recent_row(
+            lambda r, c=config: (r.get("config") == c
+                                 and isinstance(r.get("sweep"), list)
+                                 and r.get("baseline_steps_per_sec")
+                                 is not None))
+        if row is None:
+            continue
+        record[key] = {
+            "baseline_steps_per_sec": row.get("baseline_steps_per_sec"),
+            "best_f64_accurate": row.get("best_f64_accurate"),
+            "meets_1p15x": row.get("meets_1p15x"),
+            "ladder": row.get("ladder"),
+            "ladder_meets_1e10": row.get("ladder_meets_1e10"),
+            "sweep": [{k: c.get(k) for k in
+                       ("composition", "solve_dtype", "steps_per_sec",
+                        "speedup", "state_rel_err", "refine_sweeps",
+                        "achieved_residual")}
+                      for c in row["sweep"]],
             "backend": row.get("backend"),
             "stale": True,
             "measured_ts": row.get("ts"),

@@ -59,6 +59,22 @@ def set_fusion(mode):
                     "DONATE_STEP"):
             config["fusion"][key] = "auto"
         config["fusion"]["PALLAS"] = "off"
+    set_solve()  # solve composition/precision back to shipped defaults
+
+
+def set_solve(composition="auto", solve_dtype="auto", sweeps="auto",
+              spike_chunks="auto"):
+    """Pin the solve composition + precision ladder for one build
+    (libraries/solvecomp.py; the [fusion]/[precision] knobs of the
+    solve-composition sweep)."""
+    from dedalus_tpu.tools.config import config
+    for section in ("fusion", "precision"):
+        if not config.has_section(section):
+            config.add_section(section)
+    config["fusion"]["SOLVE_COMPOSITION"] = composition
+    config["fusion"]["SPIKE_CHUNKS"] = spike_chunks
+    config["precision"]["SOLVE_DTYPE"] = solve_dtype
+    config["precision"]["REFINE_SWEEPS"] = sweeps
 
 
 def build_diffusion(size=64, dtype=np.float64):
@@ -94,11 +110,14 @@ def probe_phases(solver, reps=12):
     return out
 
 
-def measure(build, n_steps, block, blocks):
+def measure(build, n_steps, block, blocks, solver_out=None):
     """Build, advance n_steps (trajectory checkpointing), then measure
-    median steps/s over `blocks` scanned step_many blocks."""
+    median steps/s over `blocks` scanned step_many blocks. `solver_out`
+    (a list) receives the live solver for post-measurement probes."""
     import jax
     solver, dt = build()
+    if solver_out is not None:
+        solver_out.append(solver)
     # trajectory steps run singly so only ONE scanned block size
     # compiles below — the retrace sentinel stays quiet post-warmup
     for _ in range(n_steps):
@@ -173,6 +192,132 @@ def run_case(name, build, dtype, n_steps, block, blocks):
     return row
 
 
+def solve_residual(solver):
+    """Achieved relative residual of one probe solve against the live
+    LHS factorization (the ladder accuracy record), or None."""
+    import jax.numpy as jnp
+    import numpy as np
+    ts = solver.timestepper
+    aux = getattr(ts, "_lhs_aux", None)
+    if aux is None or not hasattr(solver.ops, "solve_report"):
+        return None
+    aux0 = aux[0] if isinstance(aux, list) else aux
+    try:
+        _, rel = solver.ops.solve_report(
+            aux0, jnp.asarray(solver.X),
+            mats=(solver.M_mat, solver.L_mat))
+    except Exception:
+        return None
+    return None if rel is None else float(np.asarray(rel))
+
+
+# The solve-composition x precision sweep (ISSUE-15): every cell builds
+# at the shipped fused defaults plus the pinned composition/dtype and is
+# compared against the sequential/f64 cell — the PR-12 fused baseline.
+SOLVE_CELLS = (
+    ("sequential", "f64"),
+    ("ascan", "f64"),
+    ("spike", "f64"),
+    ("sequential", "f32"),
+    ("ascan", "f32"),
+    ("spike", "f32"),
+)
+
+# f64-class accuracy bar for the "unchanged accuracy" speedup claim: the
+# PR-12 fused-vs-unfused tolerance class (tests/test_fusion.py)
+F64_CLASS = 1e-12
+
+
+def run_solve_sweep(name, build, dtype, n_steps, block, blocks):
+    """Measure every solve composition x precision cell, record one
+    `{name}_solvecomp` row: steps/s, state error vs the sequential-f64
+    fused baseline, refinement sweep counts, achieved residuals."""
+    import jax
+    set_fusion("auto")
+    sweep = []
+    base = None
+    base_state = None
+    for comp, sdtype in SOLVE_CELLS:
+        mark(f"{name}: solve composition {comp}/{sdtype}")
+        set_solve(composition=comp,
+                  solve_dtype="auto" if sdtype == "f64" else sdtype)
+        holder = []
+        res, state = measure(build, n_steps, block, blocks,
+                             solver_out=holder)
+        solver = holder[0]
+        plan = solver._solve_plan
+        cell = {
+            "composition": comp,
+            "solve_dtype": sdtype,
+            "steps_per_sec": res["steps_per_sec"],
+            "steps_per_sec_iqr": res["steps_per_sec_iqr"],
+            "refine_sweeps": plan.sweeps if plan.sweeps is not None
+            else getattr(solver.ops, "refine", None),
+            "achieved_residual": solve_residual(solver),
+            "finite": res["finite"],
+        }
+        if base is None:
+            base = cell
+            base_state = state
+            cell["baseline"] = True
+            cell["state_rel_err"] = 0.0
+        else:
+            scale = float(np.max(np.abs(base_state))) or 1.0
+            cell["state_rel_err"] = float(
+                np.max(np.abs(state - base_state)) / scale)
+            cell["speedup"] = round(
+                cell["steps_per_sec"] / base["steps_per_sec"], 3) \
+                if base["steps_per_sec"] else 0.0
+        sweep.append(cell)
+        mark(f"{name}: {comp}/{sdtype} {cell['steps_per_sec']} steps/s"
+             f" (err {cell['state_rel_err']:.1e},"
+             f" resid {cell['achieved_residual']})")
+    set_fusion("auto")
+    # best NEW cell at unchanged f64-class accuracy (the >=1.15x bar),
+    # and the best f32 refinement-ladder cell (the <=1e-10 bar)
+    accurate = [c for c in sweep if not c.get("baseline")
+                and c["finite"] and c["state_rel_err"] <= F64_CLASS]
+    best = max(accurate, key=lambda c: c["steps_per_sec"], default=None)
+    ladder_cells = [c for c in sweep if c["solve_dtype"] == "f32"
+                    and c["finite"]]
+    ladder = max(ladder_cells, key=lambda c: c["steps_per_sec"],
+                 default=None)
+    import jax as _jax
+    row = {
+        "config": f"{name}_solvecomp",
+        "benchmark": "solvecomp",
+        "backend": _jax.default_backend(),
+        "dtype": str(np.dtype(dtype)),
+        "baseline_steps_per_sec": base["steps_per_sec"],
+        "sweep": sweep,
+        "best_f64_accurate": None if best is None else {
+            "composition": best["composition"],
+            "solve_dtype": best["solve_dtype"],
+            "steps_per_sec": best["steps_per_sec"],
+            "speedup": best["speedup"],
+            "state_rel_err": best["state_rel_err"],
+        },
+        "meets_1p15x": bool(best is not None
+                            and best.get("speedup", 0.0) >= 1.15),
+        "ladder": None if ladder is None else {
+            "composition": ladder["composition"],
+            "solve_dtype": ladder["solve_dtype"],
+            "steps_per_sec": ladder["steps_per_sec"],
+            "speedup": ladder.get("speedup"),
+            "state_rel_err": ladder["state_rel_err"],
+            "refine_sweeps": ladder["refine_sweeps"],
+            "achieved_residual": ladder["achieved_residual"],
+        },
+        "ladder_meets_1e10": bool(ladder is not None
+                                  and ladder["state_rel_err"] <= 1e-10),
+        "trajectory_steps": n_steps,
+        "finite": all(c["finite"] for c in sweep),
+        "ts": round(time.time(), 1),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def main():
     quick = "--quick" in sys.argv
     from __graft_entry__ import _append_result
@@ -192,21 +337,46 @@ def main():
                  dtype, n_steps, block=8 if quick else 30,
                  blocks=3 if quick else 7),
     ]
+    solve_rows = [
+        run_solve_sweep("diffusion64",
+                        lambda: build_diffusion(64, dtype),
+                        dtype, n_steps, block=32 if quick else 200,
+                        blocks=3 if quick else 7),
+        run_solve_sweep("rb256x64",
+                        lambda: build_rb(dtype),
+                        dtype, n_steps, block=8 if quick else 20,
+                        blocks=3 if quick else 5),
+    ]
     ok = True
     for row in rows:
         if not row["finite"] or row["state_rel_diff"] > 1e-6:
             mark(f"FAIL: {row['config']} non-finite or fused trajectory "
                  f"off ({row['state_rel_diff']:.3e}); rows not recorded")
             ok = False
+    for row in solve_rows:
+        if not row["finite"]:
+            mark(f"FAIL: {row['config']} non-finite; rows not recorded")
+            ok = False
     if ok:
-        for row in rows:
+        for row in rows + solve_rows:
             _append_result(row)
     rb = rows[1]
+    rb_solve = solve_rows[1]
     if not ok:
         sys.exit(1)
     if not rb["meets_1p15x"]:
         mark(f"FAIL: rb256x64 fusion speedup {rb['fusion_speedup']}x "
              "< 1.15x bar")
+        sys.exit(1)
+    if not rb_solve["meets_1p15x"]:
+        best = rb_solve.get("best_f64_accurate")
+        mark(f"FAIL: rb256x64 best f64-accurate solve composition "
+             f"{best and best['speedup']}x < 1.15x bar")
+        sys.exit(1)
+    if not rb_solve["ladder_meets_1e10"]:
+        ladder = rb_solve.get("ladder")
+        mark(f"FAIL: rb256x64 f32 refinement ladder state error "
+             f"{ladder and ladder['state_rel_err']} > 1e-10 bar")
         sys.exit(1)
 
 

@@ -385,6 +385,38 @@ def report(args):
                       f"({record.get('fusion_speedup', '?')}x, {on}; "
                       f"state rel diff "
                       f"{record.get('state_rel_diff', '?')})")
+            # solve-composition sweep rows (benchmarks/fusion.py
+            # run_solve_sweep): per-cell steps/s + accuracy, and the
+            # two acceptance bars in one summary line
+            if record.get("benchmark") == "solvecomp" \
+                    and isinstance(record.get("sweep"), list):
+                for cell in record["sweep"]:
+                    line = (f"    {cell.get('composition', '?')}/"
+                            f"{cell.get('solve_dtype', '?')}: "
+                            f"{cell.get('steps_per_sec', '?')} steps/s")
+                    if cell.get("baseline"):
+                        line += " (baseline)"
+                    else:
+                        line += (f" ({cell.get('speedup', '?')}x, err "
+                                 f"{cell.get('state_rel_err', '?')})")
+                    if cell.get("achieved_residual") is not None:
+                        line += (f", resid {cell['achieved_residual']:.1e}"
+                                 f" @ {cell.get('refine_sweeps', '?')} "
+                                 "sweep(s)")
+                    print(line)
+                best = record.get("best_f64_accurate")
+                ladder = record.get("ladder")
+                if best:
+                    print(f"    best f64-accurate: {best['composition']}/"
+                          f"{best['solve_dtype']} {best.get('speedup', '?')}x"
+                          f" (meets_1p15x={record.get('meets_1p15x', '?')})")
+                if ladder:
+                    print(f"    ladder: {ladder['composition']}/"
+                          f"{ladder['solve_dtype']} "
+                          f"{ladder.get('speedup', '?')}x, state err "
+                          f"{ladder.get('state_rel_err', '?')} "
+                          f"(meets_1e10="
+                          f"{record.get('ladder_meets_1e10', '?')})")
             # serving benchmark rows (benchmarks/serving.py): the cold-
             # miss vs warm-hit time-to-first-step comparison in one line
             if record.get("ttfs_cold_sec") is not None \

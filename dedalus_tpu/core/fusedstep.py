@@ -251,6 +251,12 @@ class FusedEvalPlan:
 
     def __init__(self, solver, exprs):
         from .operators import LinearOperator
+        # optional low-precision composite GEMMs ([precision] MMT_DTYPE,
+        # libraries/solvecomp.py): resolved on the solver's build-start
+        # plan — grid_eval casts the operand around the contraction
+        # (apply_matrix_jax matches the matrix to the operand dtype)
+        splan = getattr(solver, "_solve_plan", None)
+        self._mmt_dtype = splan.mmt_dtype if splan is not None else "native"
         self.nodes = {}        # id(node) -> [(factor, blocks, axis, comp)]
         self._walk_order = []  # deterministic node order for cache payload
         # id(node) -> [(factor, blocks, axis, plan, fold_mat, shape)];
@@ -386,7 +392,17 @@ class FusedEvalPlan:
                         term = apply_axis_blocks(term, blk, tdim_in + bax)
                 # the composite GEMM: coupled-axis operator chain +
                 # dealiased backward transform in one contraction
-                term = apply_matrix_jax(comp, term, tdim_in + axis)
+                # (optionally in the [precision] MMT dtype — the matrix
+                # follows the operand via the match_precision funnel,
+                # the result is cast back to the working precision)
+                if self._mmt_dtype != "native":
+                    from ..libraries.solvecomp import low_dtype
+                    wide = term.dtype
+                    term = apply_matrix_jax(
+                        comp, term.astype(low_dtype(self._mmt_dtype, wide)),
+                        tdim_in + axis).astype(wide)
+                else:
+                    term = apply_matrix_jax(comp, term, tdim_in + axis)
                 if factor is not None:
                     term = apply_tensor_factor(
                         term, factor, node.operand.tshape, node.tshape)

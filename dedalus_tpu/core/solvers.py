@@ -103,6 +103,14 @@ class SolverBase:
         # value fails the build here, not mid-trace)
         from ..parallel.transposes import resolve_transpose_chunks
         self._transpose_chunks = resolve_transpose_chunks()
+        # resolve the solve composition + precision ladder ONCE as well
+        # ([fusion] SOLVE_COMPOSITION/SPIKE_CHUNKS + the [precision]
+        # section, libraries/solvecomp.py): the composition restructures
+        # the compiled substitution and the ladder changes the factor
+        # store dtype, so both token the assembly/pool keys; a bad
+        # config value fails the build here, not mid-trace
+        from ..libraries import solvecomp
+        self._solve_plan = solvecomp.resolve_solve_plan()
         G, S = self.pencil_shape
         dense_bytes = G * S * S * np.dtype(self.pencil_dtype).itemsize
         lazy_bytes = int(config["linear algebra"].get(
@@ -202,7 +210,9 @@ class SolverBase:
                 self._matrices = build_matrices(
                     self.subproblems, self.equations, self.variables,
                     names=names)
-        self.ops = pencilops.DenseOps(self._dense_matsolver())
+        self.ops = pencilops.DenseOps(
+            self._dense_matsolver(),
+            solve_plan=getattr(self, "_solve_plan", None))
         self._cache_store(cache, ckey, names)
 
     def _cache_store(self, cache, ckey, names):
@@ -373,7 +383,8 @@ class SolverBase:
             return (coo_store, masks)
         self.structure = structure
         self.ops = pencilops.BandedOps(
-            structure, fusion=getattr(self, "_fusion_plan", None))
+            structure, fusion=getattr(self, "_fusion_plan", None),
+            solve_plan=getattr(self, "_solve_plan", None))
         logger.info(
             f"Pencil system: banded path (S={structure.S}, "
             f"pins={structure.t_pins}, kl={structure.kl}, "
@@ -1045,7 +1056,41 @@ class InitialValueSolver(SolverBase):
         # cold-start phase split (host_assembly/structure/factor/compile
         # seconds + assembly-cache verdict)
         extra.setdefault("build_phases", self.build_phases.record())
+        # non-default solve composition / precision ladder: record the
+        # resolved plan + the achieved residual of one probe solve (a
+        # flush-time dispatch, off the step loop) so every telemetry
+        # record carries the accuracy its speedup was bought at
+        plan = getattr(self, "_solve_plan", None)
+        if plan is not None and (plan.dtype != "native"
+                                 or plan.composition != "sequential"):
+            extra.setdefault("precision", self._precision_summary())
         return self.metrics.flush(extra=extra)
+
+    def _precision_summary(self):
+        """The `precision` telemetry block: the resolved solve plan and
+        the achieved relative residual of a probe solve against the
+        current LHS factorization (None until the first factor)."""
+        plan = self._solve_plan
+        block = {
+            "solve_dtype": plan.dtype,
+            "composition": plan.composition,
+            "refine_sweeps": plan.sweeps if plan.sweeps is not None
+            else getattr(self.ops, "refine", None),
+            "refine_tol": plan.tol,
+        }
+        ts = getattr(self, "timestepper", None)
+        aux = getattr(ts, "_lhs_aux", None)
+        if aux is None or not hasattr(self.ops, "solve_report"):
+            return block
+        aux0 = aux[0] if isinstance(aux, list) else aux
+        try:
+            _, rel = self.ops.solve_report(
+                aux0, self.X, mats=(self.M_mat, self.L_mat))
+            if rel is not None:
+                block["achieved_residual"] = float(np.asarray(rel))
+        except Exception:
+            pass
+        return block
 
     def evolve_resilient(self, timestep_function=None, dt=None,
                          log_cadence=100, **kw):

@@ -11,6 +11,7 @@ Functional API so factorizations flow through jit as pytrees:
     x   = Solver.solve(aux, rhs)    # (G, S) -> (G, S)
 """
 
+import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
@@ -25,9 +26,11 @@ def add_solver(cls):
     entry points for profiler traces."""
     for meth in ("factor", "solve", "solve_multi"):
         raw = cls.__dict__.get(meth)
+        label = f"dedalus/matsolve/{cls.__name__}.{meth}"
         if isinstance(raw, staticmethod):
-            label = f"dedalus/matsolve/{cls.__name__}.{meth}"
             setattr(cls, meth, staticmethod(_scoped(raw.__func__, label)))
+        elif isinstance(raw, classmethod):
+            setattr(cls, meth, classmethod(_scoped(raw.__func__, label)))
     matsolvers[cls.__name__.lower()] = cls
     return cls
 
@@ -73,35 +76,77 @@ class BatchedInverse:
 class BatchedInverseRefined:
     """
     Mixed-precision solver for 64-bit problems on TPU: TPU LuDecomposition
-    only implements F32/C64, so the inverse is computed in 32-bit and each
-    solve is polished by iterative refinement with 64-bit residual matvecs
-    (supported via emulation). 3 refinement sweeps recover ~f64 accuracy for
-    condition numbers well past the f32 limit.
+    only implements F32/C64, so the inverse is computed in the low dtype
+    and each solve is polished by iterative refinement with 64-bit
+    residual matvecs (supported via emulation). The sweep count and the
+    residual tolerance are CLASS attributes bound per solver build
+    (`refined_ladder` below / `get_solver`) from the `[precision]` config
+    — resolved at build time, never read inside traced code — and the
+    refinement runs as a fixed-trip `lax.fori_loop` with
+    tolerance-masked updates, so programs stay retrace-free while
+    converged groups freeze. `residual()` is the telemetry probe
+    (achieved relative residual per group).
     """
 
-    iterations = 3
+    iterations = 3        # overridden per build via refined_ladder()
+    tol = 0.0             # 0: apply every sweep (the legacy behavior)
+    low_name = "f32"      # 'f32' or 'bf16' (libraries/solvecomp.py)
 
-    @staticmethod
-    def _low(dtype):
-        return jnp.complex64 if jnp.issubdtype(dtype, jnp.complexfloating) \
-            else jnp.float32
+    @classmethod
+    def _low(cls, dtype):
+        from .solvecomp import low_dtype
+        return low_dtype(cls.low_name, dtype)
 
-    @staticmethod
-    def factor(matrices):
-        inv32 = jnp.linalg.inv(matrices.astype(
-            BatchedInverseRefined._low(matrices.dtype)))
-        return (matrices, inv32)
+    @classmethod
+    def factor(cls, matrices):
+        inv_low = jnp.linalg.inv(matrices.astype(cls._low(matrices.dtype)))
+        return (matrices, inv_low)
 
-    @staticmethod
-    def solve(aux, rhs):
-        A, inv32 = aux
-        low = BatchedInverseRefined._low(rhs.dtype)
-        x = jnp.einsum("gij,gj->gi", inv32, rhs.astype(low)).astype(rhs.dtype)
-        for _ in range(BatchedInverseRefined.iterations):
+    @classmethod
+    def solve(cls, aux, rhs):
+        A, inv_low = aux
+        low = cls._low(rhs.dtype)
+        x = jnp.einsum("gij,gj->gi", inv_low,
+                       rhs.astype(low)).astype(rhs.dtype)
+        tol = cls.tol
+
+        def sweep(_, x):
             r = rhs - jnp.einsum("gij,gj->gi", A, x)
-            dx = jnp.einsum("gij,gj->gi", inv32, r.astype(low)).astype(rhs.dtype)
-            x = x + dx
+            dx = jnp.einsum("gij,gj->gi", inv_low,
+                            r.astype(low)).astype(rhs.dtype)
+            if tol > 0.0:
+                rn = jnp.max(jnp.abs(r), axis=-1, keepdims=True)
+                bn = jnp.max(jnp.abs(rhs), axis=-1, keepdims=True)
+                return jnp.where(rn > tol * bn, x + dx, x)
+            return x + dx
+
+        if cls.iterations > 0:
+            # static bounds: lowers as a fixed-length loop (retrace-free
+            # and reverse-mode differentiable through the adjoint funnel)
+            x = jax.lax.fori_loop(0, cls.iterations, sweep, x)
         return x
+
+    @classmethod
+    def residual(cls, aux, x, rhs):
+        """Achieved relative residual per group (device values; the
+        `precision` telemetry/benchmark probe — off the step path)."""
+        A, _ = aux
+        r = rhs - jnp.einsum("gij,gj->gi", A, x)
+        bn = jnp.max(jnp.abs(rhs), axis=-1)
+        return jnp.max(jnp.abs(r), axis=-1) / jnp.where(bn == 0, 1.0, bn)
+
+
+def refined_ladder(plan):
+    """A per-build BatchedInverseRefined subclass bound to the resolved
+    `[precision]` plan (libraries/solvecomp.SolvePlan): the dense arm of
+    the precision ladder. Class attributes carry the schedule so the
+    traced factor/solve bodies never read config (DTL008)."""
+    low = plan.dtype if plan.dtype != "native" else "f32"
+    sweeps = plan.sweeps if plan.sweeps is not None \
+        else BatchedInverseRefined.iterations
+    return type("BatchedInverseLadder", (BatchedInverseRefined,),
+                {"iterations": int(sweeps), "tol": float(plan.tol),
+                 "low_name": low})
 
 
 @add_solver
@@ -137,6 +182,11 @@ class DummySolver:
 def get_solver(spec):
     if spec is None:
         spec = "BatchedLUFactorized"
-    if isinstance(spec, str):
-        return matsolvers[spec.lower()]
-    return spec
+    cls = matsolvers[spec.lower()] if isinstance(spec, str) else spec
+    if cls is BatchedInverseRefined:
+        # bind the [precision] refinement schedule at build time (the
+        # sweep count used to be a hardcoded class attribute): get_solver
+        # runs in ops construction, before any program traces
+        from .solvecomp import resolve_solve_plan
+        return refined_ladder(resolve_solve_plan())
+    return cls

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 from jax.sharding import PartitionSpec
 
-from .matsolvers import get_solver
+from . import solvecomp
+from .matsolvers import BatchedInverseRefined, get_solver, refined_ladder
 from ..tools.compat import shard_map
 from ..tools.config import config
 from ..tools.array import zeropad
@@ -210,8 +211,23 @@ class DenseOps(AdjointSolveOps):
 
     kind = "dense"
 
-    def __init__(self, matsolver=None):
-        self.solver_cls = get_solver(matsolver)
+    def __init__(self, matsolver=None, solve_plan=None):
+        # solve-composition/precision plan: callers in a solver build
+        # pass the plan the solver resolved ONCE (solver._solve_plan);
+        # standalone constructions resolve fresh. The scan compositions
+        # are inert on the dense path (there is no substitution scan to
+        # restructure — accepted as no-ops so one [fusion] config drives
+        # mixed dense/banded fleets); the precision ladder routes the
+        # solve through the refined low-dtype inverse + f64 residual
+        # polish (matsolvers.refined_ladder).
+        if solve_plan is None:
+            solve_plan = solvecomp.resolve_solve_plan()
+        self._solve_plan = solve_plan
+        self._composition = "sequential"
+        if solve_plan.dtype != "native":
+            self.solver_cls = refined_ladder(solve_plan)
+        else:
+            self.solver_cls = get_solver(matsolver)
 
     def to_device(self, host_mat, dtype):
         return jnp.asarray(host_mat, dtype=dtype)
@@ -245,6 +261,17 @@ class DenseOps(AdjointSolveOps):
         with jax.named_scope("dedalus/matsolve/dense.solve"):
             return shard_groups(self.solver_cls.solve, rhs.shape[0],
                                 aux, rhs)
+
+    def solve_report(self, aux, rhs, mats=None):
+        """Diagnostic solve + achieved relative residual as a device
+        scalar (None when this aux carries no reconstructible matrix) —
+        the flush-time `precision` telemetry probe and the benchmark
+        accuracy rows. Never called on the step path."""
+        x = self.solve(aux, rhs, mats=mats)
+        if not (isinstance(self.solver_cls, type)
+                and issubclass(self.solver_cls, BatchedInverseRefined)):
+            return x, None
+        return x, jnp.max(self.solver_cls.residual(aux, x, rhs))
 
     def densify_host(self, host_mat, g):
         return np.asarray(host_mat[g])
@@ -297,7 +324,7 @@ class BandedOps(AdjointSolveOps):
 
     kind = "banded"
 
-    def __init__(self, structure, refine=1, fusion=None):
+    def __init__(self, structure, refine=1, fusion=None, solve_plan=None):
         st = structure
         # Structures arrive either freshly finalized or rehydrated from
         # the persistent assembly cache (MatrixStructure.from_state);
@@ -329,6 +356,33 @@ class BandedOps(AdjointSolveOps):
         self._fused_solve = plan.solve
         self._fused_matvec = plan.matvec
         self._pallas = plan.pallas
+        # solve-composition/precision plan (libraries/solvecomp.py):
+        # like `fusion`, resolved once per solver build and passed in so
+        # a mid-build config edit can never split one solver across two
+        # compositions; the plan token rides the assembly/pool keys.
+        if solve_plan is None:
+            solve_plan = solvecomp.resolve_solve_plan()
+        self._solve_plan = solve_plan
+        if solve_plan.composition != "sequential" and not plan.solve:
+            raise ValueError(
+                f"[fusion] SOLVE_COMPOSITION = {solve_plan.composition} "
+                "requires FUSED_SOLVE: the restructured sweeps run over "
+                "the precomposed FwdOp/BwdOp GEMM operators")
+        if self._pallas and solve_plan.composition != "sequential":
+            raise ValueError(
+                "[fusion] PALLAS covers the sequential substitution "
+                f"only; SOLVE_COMPOSITION = {solve_plan.composition} "
+                "already removes the per-block-row HBM round-trips the "
+                "kernel exists to avoid")
+        self._composition = solve_plan.composition if plan.solve \
+            else "sequential"
+        self._spike_chunks_cfg = solve_plan.spike_chunks
+        self._ladder = solve_plan.dtype != "native"
+        # refinement schedule: explicit [precision] sweeps win; None
+        # defers to the legacy `refine` count (the PR-12 fused tolerance
+        # class is calibrated against it)
+        self._refine_sweeps = solve_plan.sweeps
+        self._refine_tol = solve_plan.tol
         # pencil-batch chunking (lax.map over G-chunks): bounds the
         # factorization's HLO temp footprint AND forces the scan-stacked
         # factor outputs into flat (Gc, 2q*q) layouts that tile (8, 128)
@@ -639,6 +693,94 @@ class BandedOps(AdjointSolveOps):
             fsub["BwdOp"] = bwd_op.reshape(steps, G, 3 * q * q)
         return fsub
 
+    # ------------------------------- restructured substitutions (solvecomp)
+    #
+    # Both precomposed sweeps are affine recurrences over factor-time
+    # operators: forward w_{i+1} = A_i w_i + B_i f_{i+1} with outputs
+    # y_i = C_i w_i + D_i f_{i+1} ((A|B; C|D) = blocks of FwdOp), and
+    # backward z_i = A'_i z_{i+1} + B'_i y_i over the stacked pair
+    # z_i = [x_i; x_{i+1}] (A', B' built from BwdOp = [Y | P]:
+    # x_i = Y_i y_i + P_i z_{i+1}). The [fusion] SOLVE_COMPOSITION knob
+    # swaps the O(N)-depth lax.scan over these recurrences for the
+    # log-depth parallel prefix (ascan) or the chunk-partitioned SPIKE
+    # program (libraries/solvecomp.py has the depth/flops model).
+
+    def _subst_fwd_system(self, fsub):
+        """(A, B, C, D) of the forward sweep from the precomposed
+        FwdOp blocks; state/input/output widths all q."""
+        q = self.q
+        steps, G = fsub["FwdOp"].shape[:2]
+        op = fsub["FwdOp"].reshape(steps, G, 2 * q, 2 * q)
+        return (op[:, :, q:, :q], op[:, :, q:, q:],
+                op[:, :, :q, :q], op[:, :, :q, q:])
+
+    def _subst_bwd_system(self, fsub):
+        """(A', B', C', D') of the backward sweep, step-reversed into a
+        forward recurrence over v_j = z_{NB-2-j}; state width 2q,
+        input/output width q. The output row extracts x_i = z_i[:q]
+        (the post-step state's top block: C' = P, D' = Y)."""
+        q = self.q
+        steps, G = fsub["BwdOp"].shape[:2]
+        op = fsub["BwdOp"].reshape(steps, G, q, 3 * q)
+        Yb = op[..., :q]                                  # acts on y_i
+        Pb = op[..., q:]                                  # acts on z_{i+1}
+        shift = jnp.broadcast_to(
+            jnp.concatenate([jnp.eye(q, dtype=op.dtype),
+                             jnp.zeros((q, q), dtype=op.dtype)], axis=1),
+            (steps, G, q, 2 * q))                         # x_{i+1} carry row
+        A = jnp.concatenate([Pb, shift], axis=2)[::-1]
+        B = jnp.concatenate([Yb, jnp.zeros_like(Yb)], axis=2)[::-1]
+        return A, B, Pb[::-1], Yb[::-1]
+
+    def _attach_spike(self, fsub):
+        """Factor-time SPIKE precomposition: fold the within-chunk
+        transfer products of both sweeps into dense per-chunk GEMM
+        operators (solvecomp.spike_precompose) and DROP FwdOp/BwdOp —
+        the spike solve consumes only the chunk operators, so keeping
+        the step-stacked forms would double the persistent factor
+        store. Degenerate step counts (too few steps to chunk) keep the
+        sequential operators untouched."""
+        n_steps = fsub["FwdOp"].shape[0]
+        chunks = solvecomp.spike_chunk_count(n_steps, self._spike_chunks_cfg)
+        if chunks <= 1:
+            return
+        fsub["spikeF"] = solvecomp.spike_precompose(
+            *self._subst_fwd_system(fsub), chunks)
+        fsub["spikeB"] = solvecomp.spike_precompose(
+            *self._subst_bwd_system(fsub), chunks)
+        del fsub["FwdOp"], fsub["BwdOp"]
+
+    def _solve_interior_ascan(self, f, fsub):
+        """Solve B~ x = f with both substitution sweeps as parallel
+        prefixes over (A, b) pairs (lax.associative_scan, matmul
+        combine): O(log NB) depth, no sequential scan in the lowered
+        program (the DTP106 contract's ascan branch)."""
+        G, _, k = f.shape
+        q, NB = self.q, self.NB
+        fb = jnp.moveaxis(f.reshape(G, NB, q, k), 1, 0)   # (NB, G, q, k)
+        ys, w_f = solvecomp.ascan_apply(
+            *self._subst_fwd_system(fsub), fb[1:], fb[0])
+        x_last = fsub["lastOp"] @ w_f
+        z0 = jnp.concatenate([x_last, jnp.zeros_like(x_last)], axis=1)
+        outs, _ = solvecomp.ascan_apply(
+            *self._subst_bwd_system(fsub), ys[::-1], z0)
+        x = jnp.concatenate([outs[::-1], x_last[None]], axis=0)
+        return jnp.moveaxis(x, 0, 1).reshape(G, self.n_pad, k)
+
+    def _solve_interior_spike(self, f, fsub):
+        """Solve B~ x = f against the factor-time SPIKE operators: each
+        sweep is two batched GEMMs over all chunks plus the C-step
+        reduced coupling scan (the DTP106 contract's spike branch)."""
+        G, _, k = f.shape
+        q, NB = self.q, self.NB
+        fb = jnp.moveaxis(f.reshape(G, NB, q, k), 1, 0)
+        ys, w_f = solvecomp.spike_apply(fsub["spikeF"], fb[1:], fb[0])
+        x_last = fsub["lastOp"] @ w_f
+        z0 = jnp.concatenate([x_last, jnp.zeros_like(x_last)], axis=1)
+        outs, _ = solvecomp.spike_apply(fsub["spikeB"], ys[::-1], z0)
+        x = jnp.concatenate([outs[::-1], x_last[None]], axis=0)
+        return jnp.moveaxis(x, 0, 1).reshape(G, self.n_pad, k)
+
     def _solve_interior_fused(self, interior_aux, f, fsub):
         """Solve B~ x = f via the precomposed substitution operators: the
         same blocked sweeps as `_solve_interior`, each scan step one
@@ -650,6 +792,13 @@ class BandedOps(AdjointSolveOps):
         if NB == 1:
             x = lastOp @ fb[0].reshape(G, q, k)
             return jnp.moveaxis(x[None], 0, 1).reshape(G, self.n_pad, k)
+        # restructured compositions (resolved once per build): spike
+        # factors carry their chunk operators in the aux; ascan slices
+        # the step-stacked operators at solve time
+        if "spikeF" in fsub:
+            return self._solve_interior_spike(f, fsub)
+        if self._composition == "ascan":
+            return self._solve_interior_ascan(f, fsub)
 
         def fwd(w_cur, xs):
             f_next, op_flat = xs
@@ -786,6 +935,10 @@ class BandedOps(AdjointSolveOps):
             # incremental path's donated stores from materializing ~5q^2
             # of dead factors per step next to the ~7q^2 live operators
             interior = None
+            if self._composition == "spike" and "FwdOp" in fsub:
+                # BEFORE the Woodbury E-solve below: the E columns then
+                # solve through the same restructured program
+                self._attach_spike(fsub)
         YbT = CapLU = None
         if self.t:
             # Y = B~^-1 E  (E = one-hot columns at the pin positions)
@@ -804,6 +957,20 @@ class BandedOps(AdjointSolveOps):
                 fsub["CapInv"] = jnp.linalg.inv(Cap)
             else:
                 CapLU = jsl.lu_factor(Cap)
+        if fused and self._ladder:
+            # precision ladder (libraries/solvecomp.py): the whole
+            # A'-solve — substitution operators AND Woodbury correction
+            # — is stored and run in the low dtype (also halving the
+            # persistent factor store); everything above computed at
+            # native precision first so the low operators are rounded
+            # versions of well-conditioned f64 factors. The f64
+            # residual-matvec refinement in _solve_impl polishes each
+            # solve back (sweep count scaled to the dtype gap).
+            low = solvecomp.low_dtype(self._solve_plan.dtype, bands.dtype)
+            fsub = jax.tree.map(lambda a: a.astype(low), fsub)
+            Vt = Vt.astype(low)
+            if YbT is not None:
+                YbT = YbT.astype(low)
         return (interior, Vt, YbT, CapLU, fsub)
 
     def _aux_from_core(self, core, refine_aux):
@@ -1034,6 +1201,11 @@ class BandedOps(AdjointSolveOps):
 
     def _solve_core(self, auxc, fp):
         fsub = auxc.get("fsub")
+        if fsub is not None and fsub["lastOp"].dtype != fp.dtype:
+            # precision ladder: the factors are stored low — run the
+            # whole inner solve low; _solve_once casts the result back
+            # and _solve_impl refines against the f64 M/L matvec
+            fp = fp.astype(fsub["lastOp"].dtype)
         if fsub is not None and "FwdOp" in fsub and self._pallas:
             # experimental: the whole substitution as one Pallas kernel
             # per group (no block-row round-trips; core/fusedstep.py)
@@ -1080,7 +1252,10 @@ class BandedOps(AdjointSolveOps):
             y = self._shard_chunked(chunked_solve, (auxc, fpr), Gc)
             y = y.reshape(-1, self.n_pad)[:G]
         xp = y[:, :self.n]
-        return xp[:, self.pos_col]
+        out = xp[:, self.pos_col]
+        if out.dtype != rhs.dtype:
+            out = out.astype(rhs.dtype)   # ladder: back to the rhs dtype
+        return out
 
     def _shard_chunked(self, fn, args, Gc):
         """Run a chunk-mapped factor/solve (`fn(*args)`, every traced
@@ -1121,7 +1296,38 @@ class BandedOps(AdjointSolveOps):
             x = self._solve_once(aux, rhs)
             if mats is None and "A" not in aux:
                 return x  # lincomb factor without mats: no refinement possible
-            for _ in range(self.refine):
+            sweeps = self._refine_sweeps if self._refine_sweeps is not None \
+                else self.refine
+            if sweeps <= 0:
+                return x
+            tol = self._refine_tol
+
+            def sweep(x, _):
+                # f64 residual matvec against the assembled M/L (never
+                # the low-dtype factors) — the correction solve runs in
+                # the solve dtype, the polish at native precision
                 r = rhs - self._aux_matvec(aux, x, mats)
-                x = x + self._solve_once(aux, r)
+                dx = self._solve_once(aux, r)
+                if tol > 0.0:
+                    # tolerance-terminated: converged groups freeze
+                    # (masked update — fixed trip count, retrace-free)
+                    rn = jnp.max(jnp.abs(r), axis=1, keepdims=True)
+                    bn = jnp.max(jnp.abs(rhs), axis=1, keepdims=True)
+                    return jnp.where(rn > tol * bn, x + dx, x), None
+                return x + dx, None
+
+            x, _ = jax.lax.scan(sweep, x, None, length=sweeps)
             return x
+
+    def solve_report(self, aux, rhs, mats=None):
+        """Diagnostic solve + achieved relative residual as a device
+        scalar (None when the aux carries no residual matvec) — the
+        flush-time `precision` telemetry probe and the benchmark
+        accuracy rows. Never called on the step path."""
+        x = self.solve(aux, rhs, mats=mats)
+        if mats is None and "A" not in aux:
+            return x, None
+        r = rhs - self._aux_matvec(aux, x, mats)
+        scale = jnp.max(jnp.abs(rhs))
+        rel = jnp.max(jnp.abs(r)) / jnp.where(scale == 0, 1.0, scale)
+        return x, rel

@@ -257,6 +257,18 @@ def solver_key(solver, names):
             from ..parallel.transposes import resolve_transpose_chunks
             chunks = resolve_transpose_chunks()
         _fp_update(h, "transpose_chunks", int(chunks))
+        # resolved solve composition + precision ladder (libraries/
+        # solvecomp.py): the composition restructures the compiled
+        # substitution programs and the ladder changes the factor-store
+        # dtype — pooled compiled solvers and fused-composite payloads
+        # must never alias across either (same safe-direction trade as
+        # the fusion/chunk tokens above)
+        splan = getattr(solver, "_solve_plan", None)
+        if splan is None:
+            from ..libraries.solvecomp import solve_plan_token
+            _fp_update(h, "solve_plan", solve_plan_token())
+        else:
+            _fp_update(h, "solve_plan", splan.token())
         spec = solver.matsolver
         _fp_update(h, "matsolver",
                    spec if isinstance(spec, str) else getattr(
@@ -568,7 +580,8 @@ def install_payload(solver, names, payload):
         solver._matrices = mats
         solver.structure = st
         solver.ops = pencilops.BandedOps(
-            st, fusion=getattr(solver, "_fusion_plan", None))
+            st, fusion=getattr(solver, "_fusion_plan", None),
+            solve_plan=getattr(solver, "_solve_plan", None))
         return True
     if kind == "coo":
         vals = {name: arrays[f"vals_{name}"] for name in names}
@@ -576,12 +589,16 @@ def install_payload(solver, names, payload):
                            vals, arrays["row_valid"], arrays["col_valid"])
         solver._matrices = solver._dense_from_batched(names)
         solver.structure = None
-        solver.ops = pencilops.DenseOps(solver._dense_matsolver())
+        solver.ops = pencilops.DenseOps(
+            solver._dense_matsolver(),
+            solve_plan=getattr(solver, "_solve_plan", None))
         return True
     if kind == "dense":
         solver._batched = None
         solver._matrices = {name: arrays[f"dense_{name}"] for name in names}
         solver.structure = None
-        solver.ops = pencilops.DenseOps(solver._dense_matsolver())
+        solver.ops = pencilops.DenseOps(
+            solver._dense_matsolver(),
+            solve_plan=getattr(solver, "_solve_plan", None))
         return True
     return False

@@ -100,9 +100,10 @@ _DTYPE_BYTES = {
 
 __all__ = ["ProgramRecord", "Contract", "all_contracts", "collective_counts",
            "gather_buffers", "donated_alias_count", "jaxpr_primitives",
-           "pads_in_auto_regions", "record_from_jit", "register_contract",
-           "run_census", "check_records", "run_programs", "census_names",
-           "PROGRAMS_BASELINE", "GATHER_FRACTION"]
+           "pads_in_auto_regions", "scan_lengths", "record_from_jit",
+           "register_contract", "run_census", "check_records",
+           "run_programs", "census_names", "PROGRAMS_BASELINE",
+           "GATHER_FRACTION"]
 
 
 # ------------------------------------------------------- program analyses
@@ -190,6 +191,27 @@ def jaxpr_primitives(jaxpr):
     return counts
 
 
+def scan_lengths(jaxpr):
+    """(lengths, whiles): the trip count of every `scan` equation in the
+    program (sub-jaxprs included) and the number of `while` equations
+    (whose trip counts are unprovable from the program text). The DTP106
+    depth analysis: a restructured substitution's sequential depth IS
+    the longest scan left in its lowered program."""
+    lengths = []
+    whiles = [0]
+
+    def visit(eqn, _):
+        if eqn.primitive.name == "scan":
+            length = eqn.params.get("length")
+            if length is not None:
+                lengths.append(int(length))
+        elif eqn.primitive.name == "while":
+            whiles[0] += 1
+
+    _walk_jaxprs(jaxpr, visit)
+    return lengths, whiles[0]
+
+
 def pads_in_auto_regions(jaxpr):
     """Count of `pad` primitives lexically inside shard_map regions with
     a nonempty `auto` set. Pads inside FULLY manual regions are already
@@ -252,8 +274,12 @@ class ProgramRecord:
             row["donated_aliases"] = donated_alias_count(self.compiled_text)
         if self.jaxpr is not None:
             row["pads_in_auto_regions"] = pads_in_auto_regions(self.jaxpr)
+            if "max_scan_length" in self.meta:
+                lengths, whiles = scan_lengths(self.jaxpr)
+                row["scan_lengths"] = sorted(set(lengths), reverse=True)
+                row["while_loops"] = whiles
         for key in ("state_bytes", "expected_a2a_min", "donated",
-                    "fused_solve", "manual_auto"):
+                    "fused_solve", "manual_auto", "max_scan_length"):
             if key in self.meta:
                 row[key] = self.meta[key]
         return row
@@ -506,6 +532,48 @@ class ManualRegionIntegrity(Contract):
                 "or route the op through an explicit manual shard_map")
 
 
+@register_contract
+class ScanDepthBound(Contract):
+    """DTP106: the substitution depth claim, machine-checkable.
+
+    The restructured solve compositions (libraries/solvecomp.py) exist
+    to cut the banded substitution's sequential depth: ascan leaves NO
+    sequential scan over the block rows (ceil(log2(NB))+1 bounds the
+    residual-refinement loop and any bookkeeping scan), spike leaves
+    exactly the C-step reduced coupling scan. A refactor that silently
+    reintroduces an O(NB) lax.scan (or hides depth in a while loop,
+    whose trip count is unprovable from the program text) would keep
+    the numerics and lose the entire point — this contract fails it.
+    Programs declare their bound via meta["max_scan_length"].
+    """
+
+    id = "DTP106"
+    severity = "error"
+    title = "scan-depth-bound"
+
+    def check(self, record):
+        bound = record.meta.get("max_scan_length")
+        if bound is None or record.jaxpr is None:
+            return
+        lengths, whiles = scan_lengths(record.jaxpr)
+        worst = max(lengths, default=0)
+        if worst > int(bound):
+            yield self.finding(
+                record, f"scan length {worst} > {int(bound)}",
+                f"a lax.scan of length {worst} compiled where the "
+                f"declared substitution depth bound is {int(bound)}: "
+                "the restructured solve has regressed to a sequential "
+                "sweep (check SOLVE_COMPOSITION wiring and the "
+                "solvecomp chunk/prefix programs)")
+        if whiles:
+            yield self.finding(
+                record, f"while-loop x{whiles}",
+                f"{whiles} while loop(s) in a depth-bounded program: "
+                "trip counts are unprovable from the program text; use "
+                "fixed-length lax.scan/fori_loop so the depth contract "
+                "stays checkable")
+
+
 # ------------------------------------------------------------- the census
 
 CENSUS = {}
@@ -623,6 +691,76 @@ def _census_rb_unfused():
         rec = _solver_record(
             "rb_step_unfused", solver,
             "banded RB RK222 step, fusion off (legacy substitution)")
+    return [rec]
+
+
+@census("tau_step_ascan")
+def _census_tau_ascan():
+    """Banded tau-IVP step with the associative-scan substitution
+    (SOLVE_COMPOSITION=ascan): no triangular/pivot solves (DTP102) AND
+    no sequential scan over the block rows — the depth claim of the
+    log-depth composition, bounded at ceil(log2(NB))+1 (DTP106). The
+    small banded problem keeps this in the fast tier-1 subset."""
+    import math
+    from ...extras.bench_problems import build_tau_ivp
+    with _pinned_config("fusion", FUSED_SOLVE="on", SOLVE_COMPOSITION="ascan",
+                        PALLAS="off"):
+        solver, u, x, z = build_tau_ivp(8, 32, matsolver="banded")
+        solver.step(1e-3)
+        bound = math.ceil(math.log2(solver.ops.NB)) + 1
+        rec = _solver_record(
+            "tau_step_ascan", solver,
+            "banded tau-IVP SBDF2 step, associative-scan substitution "
+            f"(NB={solver.ops.NB}, depth bound {bound})",
+            extra_meta={"fused_solve": True, "max_scan_length": bound})
+    return [rec]
+
+
+@census("rb_step_spike", fast=False)
+def _census_rb_spike():
+    """Banded Rayleigh-Benard step with the SPIKE-chunked substitution:
+    the only sequential scan left is the C-step reduced coupling
+    (DTP106 bound = C), and the chunk GEMM program still carries no
+    triangular/pivot custom calls (DTP102)."""
+    from ...extras.bench_problems import build_rb_solver
+    from ...libraries import solvecomp
+    with _pinned_config("fusion", FUSED_SOLVE="on", SOLVE_COMPOSITION="spike",
+                        SPIKE_CHUNKS="auto", PALLAS="off"):
+        solver, _ = build_rb_solver(16, 32, np.float64, matsolver="banded")
+        solver.step(1e-3)
+        chunks = solvecomp.spike_chunk_count(
+            solver.ops.NB - 1, solver._solve_plan.spike_chunks)
+        rec = _solver_record(
+            "rb_step_spike", solver,
+            f"banded RB RK222 step, SPIKE substitution (C={chunks})",
+            extra_meta={"fused_solve": True, "max_scan_length": chunks})
+    return [rec]
+
+
+@census("rb_step_ladder", fast=False)
+def _census_rb_ladder():
+    """The precision-laddered banded RB step (SPIKE + f32 operators +
+    f64 residual refinement): the fused-solve and depth contracts must
+    survive the low-dtype factor store, and the fixed-trip refinement
+    loop must stay inside the depth bound (no while loops)."""
+    from ...extras.bench_problems import build_rb_solver
+    from ...libraries import solvecomp
+    with _pinned_config("fusion", FUSED_SOLVE="on", SOLVE_COMPOSITION="spike",
+                        SPIKE_CHUNKS="auto", PALLAS="off"):
+        with _pinned_config("precision", SOLVE_DTYPE="f32",
+                            REFINE_SWEEPS="auto"):
+            solver, _ = build_rb_solver(16, 32, np.float64,
+                                        matsolver="banded")
+            solver.step(1e-3)
+            chunks = solvecomp.spike_chunk_count(
+                solver.ops.NB - 1, solver._solve_plan.spike_chunks)
+            sweeps = solver._solve_plan.sweeps or 0
+            rec = _solver_record(
+                "rb_step_ladder", solver,
+                "banded RB RK222 step, f32 precision ladder over SPIKE "
+                f"(C={chunks}, {sweeps} refinement sweeps)",
+                extra_meta={"fused_solve": True,
+                            "max_scan_length": max(chunks, sweeps)})
     return [rec]
 
 

@@ -38,6 +38,11 @@ HOT_PATH_MODULES = (
     # wrapper for the structural pass to see) — a stray sync here lands
     # inside every fused step
     "core/fusedstep.py",
+    # the restructured-substitution programs (associative-scan prefix,
+    # SPIKE chunk solves, the precision-ladder refinement) trace into
+    # every fused solve through BandedOps/DenseOps — same exposure as
+    # pencilops itself
+    "libraries/solvecomp.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its
@@ -52,6 +57,7 @@ TRACED_CONTEXT_MODULES = (
     "core/weighted_jacobi.py",
     "libraries/pencilops.py",
     "libraries/matsolvers.py",
+    "libraries/solvecomp.py",
     "libraries/sphere.py",
     "libraries/zernike.py",
     "libraries/spin_intertwiners.py",

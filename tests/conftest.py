@@ -100,6 +100,7 @@ def _service_test_watchdog(request):
               or request.node.get_closest_marker("ensemble") is not None
               or request.node.get_closest_marker("batching") is not None
               or request.node.get_closest_marker("fusion") is not None
+              or request.node.get_closest_marker("solvecomp") is not None
               or request.node.get_closest_marker("distributed") is not None
               or request.node.get_closest_marker("progcheck") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
@@ -194,6 +195,15 @@ def pytest_configure(config):
         "markers",
         "progcheck: compiled-program contract checker tests (tools/"
         "lint/progcheck.py: census + DTP contracts); tier-1 by default")
+    # solvecomp: restructured-substitution + precision-ladder tests
+    # (libraries/solvecomp.py + the pencilops/matsolvers wiring). Tier-1
+    # by default; rides the same hard watchdog — a wedged banded build
+    # or a hung fleet comparison stalls exactly like a hung daemon.
+    config.addinivalue_line(
+        "markers",
+        "solvecomp: solve-composition + precision-ladder tests "
+        "(libraries/solvecomp.py: associative-scan/SPIKE substitution, "
+        "mixed-precision refinement); tier-1 by default")
 
 
 @pytest.fixture

@@ -186,6 +186,30 @@ def composite(backward, term):
     assert good.findings == []
 
 
+def test_dtl001_covers_solvecomp_module(tmp_path):
+    """libraries/solvecomp.py is a declared hot module (the restructured
+    substitution programs trace into every fused solve through
+    BandedOps/DenseOps): a stray sync there fires whole-file, and the
+    pure-jnp prefix/chunk builders stay quiet."""
+    bad = _lint_src(tmp_path, "libraries/solvecomp.py", """
+import jax
+
+def spike_apply(ops, u, v0):
+    jax.block_until_ready(u)
+    return u
+""")
+    assert _rules_fired(bad) == ["DTL001"]
+    good = _lint_src(tmp_path, "libraries/solvecomp.py", """
+import jax.numpy as jnp
+
+def ascan_combine(prev, nxt):
+    A1, b1 = prev
+    A2, b2 = nxt
+    return A2 @ A1, A2 @ b1 + b2
+""")
+    assert good.findings == []
+
+
 def test_dtl001_traced_concretization_any_module(tmp_path):
     bad = _lint_src(tmp_path, "anywhere.py", """
 import numpy as np

@@ -44,8 +44,10 @@ needs_8 = pytest.mark.skipif(N_DEV < 8, reason="needs >= 8 devices")
 
 # the tier-1 subset: every contract exercised on at least one REAL
 # program, the expensive banded-RB builds left to the full CLI census
+# (tau_step_ascan is the fast DTP106 anchor: a small banded build whose
+# lowered step must carry no sequential substitution scan)
 FAST_SUBSET = ["diffusion_step", "sharded_step_1d", "chunked_walk_1d",
-               "fleet_2d", "adjoint_grad", "pool_step"]
+               "fleet_2d", "adjoint_grad", "pool_step", "tau_step_ascan"]
 
 
 def _rules_fired(findings):
@@ -83,7 +85,8 @@ def test_census_breadth(fast_report):
     rows = {row["program"]: row for row in fast_report["programs"]}
     assert set(rows) == {"diffusion_step", "sharded_step_1d",
                          "chunked_walk_to_grid", "chunked_walk_to_coeff",
-                         "fleet_2d", "adjoint_grad", "pool_step"}
+                         "fleet_2d", "adjoint_grad", "pool_step",
+                         "tau_step_ascan"}
     # collective placement facts the weak-scaling/fusion claims rest on
     assert rows["sharded_step_1d"]["collectives"]["all-to-all"] >= 2
     assert rows["sharded_step_1d"]["collectives"]["all-gather"] == 0
@@ -93,6 +96,12 @@ def test_census_breadth(fast_report):
     # donation honored on the donating programs
     assert rows["diffusion_step"]["donated_aliases"] >= 3
     assert rows["pool_step"]["donated_aliases"] >= 3
+    # the depth contract's fast anchor: the associative-scan step's
+    # longest surviving scan sits under its declared log-depth bound
+    ascan = rows["tau_step_ascan"]
+    assert ascan["fused_solve"] is True
+    assert ascan["while_loops"] == 0
+    assert max(ascan["scan_lengths"], default=0) <= ascan["max_scan_length"]
     # per-contract timings recorded for every registered contract
     assert set(fast_report["timings"]["contracts"]) == set(CONTRACTS)
 
@@ -105,10 +114,13 @@ def test_full_census_names_cover_required_shapes():
     for required in ("rb_step_fused", "rb_step_unfused", "diffusion_step",
                      "sharded_step_1d", "chunked_walk_1d",
                      "chunked_walk_2dmesh", "fleet_2d",
-                     "ensemble_fleet_1d", "adjoint_grad", "pool_step"):
+                     "ensemble_fleet_1d", "adjoint_grad", "pool_step",
+                     "tau_step_ascan", "rb_step_spike", "rb_step_ladder"):
         assert required in names
     fast = progcheck.census_names(fast_only=True)
     assert "rb_step_fused" not in fast and "rb_step_unfused" not in fast
+    assert "rb_step_spike" not in fast and "rb_step_ladder" not in fast
+    assert "tau_step_ascan" in fast
 
 
 # ------------------------------------------------ seeded regressions
@@ -240,6 +252,46 @@ def test_seeded_host_callback_in_step_body():
     findings, _, _ = check_records([rec])
     assert "DTP102" in _rules_fired(findings)
     assert any("callback" in f.message for f in findings)
+
+
+def test_seeded_sequential_scan_regression():
+    """A lax.scan longer than the declared substitution depth bound
+    produces DTP106 (the depth claim made machine-checkable); the same
+    program without the declaration is legal, and a while loop inside a
+    depth-bounded program is flagged as unprovable."""
+
+    def seq_sweep(ops, x):
+        def body(c, op):
+            return op @ c, c
+        out, _ = jax.lax.scan(body, x, ops)
+        return out
+
+    ops = jnp.stack([jnp.eye(4)] * 64)
+    x = jnp.ones(4)
+    rec = record_from_jit("seed_seq_scan", seq_sweep, (ops, x),
+                          meta={"max_scan_length": 5})
+    findings, _, _ = check_records([rec])
+    assert _rules_fired(findings) == ["DTP106"]
+    assert "64" in findings[0].message
+    undeclared = record_from_jit("seed_seq_scan_free", seq_sweep, (ops, x))
+    findings, _, _ = check_records([undeclared])
+    assert findings == []
+    # an in-bound refinement loop passes
+    small = record_from_jit(
+        "seed_small_scan", seq_sweep, (jnp.stack([jnp.eye(4)] * 3), x),
+        meta={"max_scan_length": 5})
+    findings, _, _ = check_records([small])
+    assert findings == []
+
+    def while_sweep(x):
+        return jax.lax.while_loop(lambda v: jnp.sum(v) < 1e3,
+                                  lambda v: v * 2.0, x)
+
+    wrec = record_from_jit("seed_while", while_sweep, (jnp.ones(4),),
+                           meta={"max_scan_length": 5})
+    findings, _, _ = check_records([wrec])
+    assert _rules_fired(findings) == ["DTP106"]
+    assert "while" in findings[0].message
 
 
 # -------------------------------------- baseline/waiver discipline
