@@ -191,13 +191,30 @@ def _run_child(env, timeout, tag):
     return None, f"{tag} child rc={proc.returncode}"
 
 
-def _recent_row(predicate, max_age_hours=48):
+def _stale_window_sec():
+    """The ONE measurement window every attach/probe helper shares:
+    `[bench] STALE_WINDOW_SEC` (default 48h — wide enough to span a
+    round whose chip window opened early, or the previous round's sweep
+    when the chip stayed unclaimable throughout). Config-backed so
+    operators widen/narrow it in one place instead of chasing hardcoded
+    48s through each helper."""
+    try:
+        from dedalus_tpu.tools.config import config
+        return float(config.get("bench", "STALE_WINDOW_SEC",
+                                fallback=48 * 3600.0))
+    except Exception:
+        return 48.0 * 3600.0
+
+
+def _recent_row(predicate, max_age_sec=None):
     """Latest results.jsonl row satisfying `predicate` whose report ts
-    falls inside the measurement window (`max_age_hours=None` disables
-    the window). The ONE scan loop behind the TPU-headline, ensemble,
-    and serving probes, so the provenance-window rules can never drift
-    between them."""
+    falls inside the measurement window (default `_stale_window_sec()`;
+    `max_age_sec=0` disables the window). The ONE scan loop behind the
+    TPU-headline, ensemble, and serving probes, so the provenance-window
+    rules can never drift between them."""
     import time
+    if max_age_sec is None:
+        max_age_sec = _stale_window_sec()
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "results.jsonl")
     best = None
@@ -209,29 +226,27 @@ def _recent_row(predicate, max_age_hours=48):
                 except json.JSONDecodeError:
                     continue
                 if (predicate(row) and row.get("ts")
-                        and (max_age_hours is None
-                             or time.time() - row["ts"]
-                             < max_age_hours * 3600)):
+                        and (not max_age_sec
+                             or time.time() - row["ts"] < max_age_sec)):
                     best = row
     except OSError:
         return None
     return best
 
 
-def _recent_tpu_row(config=None, max_age_hours=48):
+def _recent_tpu_row(config=None, max_age_sec=None):
     """Latest finite backend=tpu row for `config` (default rb256x64) from
-    results.jsonl recorded within the recent measurement window (48h:
-    wide enough to span a round whose chip window opened early — or the
-    previous round's sweep when the chip stayed unclaimable throughout,
-    as rows carry their own measured_ts provenance). `max_age_hours=None`
-    disables the window (the stale-headline guard's unfiltered probe)."""
+    results.jsonl recorded within the shared measurement window
+    (`[bench] STALE_WINDOW_SEC` via _stale_window_sec(), as rows carry
+    their own measured_ts provenance). `max_age_sec=0` disables the
+    window (the stale-headline guard's unfiltered probe)."""
     config = config or f"rb{NX}x{NZ}"
     return _recent_row(
         lambda row: (row.get("config") == config
                      and row.get("backend") == "tpu"
                      and row.get("finite")
                      and row.get("steps_per_sec")),
-        max_age_hours)
+        max_age_sec)
 
 
 def _prior_headline_reuses(measured_ts, same_round_grace_hours=6.0):
@@ -320,17 +335,17 @@ def _attach_progression(record):
     return record
 
 
-def _recent_ensemble_row(config, max_age_hours=48):
+def _recent_ensemble_row(config, max_age_sec=None):
     """Latest benchmarks/ensemble.py sweep row for `config` within the
-    measurement window. Ensemble rows are CPU-measured by design (the
-    virtual member mesh; ROADMAP platform note), so unlike
+    shared measurement window. Ensemble rows are CPU-measured by design
+    (the virtual member mesh; ROADMAP platform note), so unlike
     _recent_tpu_row this does not filter on backend."""
     return _recent_row(
         lambda row: (row.get("config") == config
                      and isinstance(row.get("sweep"), list)
                      and row["sweep"]
                      and row.get("speedup_n64") is not None),
-        max_age_hours)
+        max_age_sec)
 
 
 def _attach_ensemble(record):
@@ -365,15 +380,15 @@ def _attach_ensemble(record):
     return record
 
 
-def _recent_serving_row(config, max_age_hours=48):
-    """Latest benchmarks/serving.py row for `config` within the
+def _recent_serving_row(config, max_age_sec=None):
+    """Latest benchmarks/serving.py row for `config` within the shared
     measurement window. Serving rows are CPU-measured by design (the
     daemon subprocess; ROADMAP platform note), so no backend filter."""
     return _recent_row(
         lambda row: (row.get("config") == config
                      and row.get("ttfs_speedup") is not None
                      and row.get("bit_identical_cold_warm")),
-        max_age_hours)
+        max_age_sec)
 
 
 def _attach_serving(record):
@@ -668,7 +683,7 @@ def main():
         # fall through silently: record the refusal loudly so the ancient
         # TPU number can never be mistaken for this round's result — and
         # the CPU fallback below never masks the staleness.
-        old = _recent_tpu_row(max_age_hours=None)
+        old = _recent_tpu_row(max_age_sec=0)
         if old is not None and old.get("ts"):
             age_hours = round((time.time() - old["ts"]) / 3600.0, 2)
             record = {

@@ -97,6 +97,7 @@ def measure_ratio(n, dt, repeats):
         "grad_forward_ratio": s["grad_forward_ratio"],
         "auto_segments": s["checkpoint_segments"],
         "fd_rel_err": round(fd_rel, 10),
+        "plan": div.solver.plan_provenance(),
         "finite": finite,
     }
 

@@ -124,6 +124,7 @@ def main():
         "nx": nx, "nz": nz,
         "checkpoints": N_CHECKPOINTS,
         "steps_between": STEPS_BETWEEN,
+        "plan": solver.plan_provenance(),
         "finite": True,
     }
 

@@ -161,6 +161,10 @@ def measure_fleet(builder, N, dt, block, blocks, warm=True):
         "member_steps": member_steps,
         "ensemble_steps_per_sec": round(member_steps / loop_sec, 2),
         "finite": bool(np.isfinite(np.asarray(ens.X)).all()),
+        # template solver's resolved plan == the whole fleet's (the
+        # members share one compiled program); hoisted to the row by
+        # run_problem
+        "plan": solver.plan_provenance(),
     }
 
 
@@ -180,6 +184,7 @@ def run_problem(config, builder, dt, block, blocks, sweep, append,
     }
     for N in sweep:
         fleet = measure_fleet(builder, N, dt, block, blocks, warm=warm)
+        row["plan"] = fleet.pop("plan")
         fleet["speedup_vs_serial"] = round(
             fleet["ensemble_steps_per_sec"] / serial["steps_per_sec"], 2)
         # setup amortization: one build+compile for the fleet vs N of them

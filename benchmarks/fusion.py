@@ -154,7 +154,9 @@ def run_case(name, build, dtype, n_steps, block, blocks):
     mark(f"{name}: building FUSED (shipped defaults)")
     set_fusion("auto")
     plan = resolve_fusion()
-    fused, state_f = measure(build, n_steps, block, blocks)
+    fused_solver = []
+    fused, state_f = measure(build, n_steps, block, blocks,
+                             solver_out=fused_solver)
     mark(f"{name}: fused {fused['steps_per_sec']} steps/s "
          f"(IQR {fused['steps_per_sec_iqr']})")
     scale = float(np.max(np.abs(state_u))) or 1.0
@@ -184,6 +186,9 @@ def run_case(name, build, dtype, n_steps, block, blocks):
         "fusion": {"solve": plan.solve, "matvec": plan.matvec,
                    "transforms": plan.transforms, "donate": plan.donate,
                    "pallas": plan.pallas},
+        # resolved-plan provenance for the FUSED build (the headline
+        # number's configuration, machine-readable: docs/observability.md)
+        "plan": fused_solver[0].plan_provenance(),
         "ts": round(time.time(), 1),
     }
     mark(f"{name}: speedup {row['fusion_speedup']}x "
@@ -259,6 +264,7 @@ def run_solve_sweep(name, build, dtype, n_steps, block, blocks):
         if base is None:
             base = cell
             base_state = state
+            base_plan = solver.plan_provenance()
             cell["baseline"] = True
             cell["state_rel_err"] = 0.0
         else:
@@ -312,6 +318,9 @@ def run_solve_sweep(name, build, dtype, n_steps, block, blocks):
                                   and ladder["state_rel_err"] <= 1e-10),
         "trajectory_steps": n_steps,
         "finite": all(c["finite"] for c in sweep),
+        # baseline cell's resolved plan (per-cell compositions live in
+        # the sweep itself)
+        "plan": base_plan,
         "ts": round(time.time(), 1),
     }
     print(json.dumps(row), flush=True)

@@ -201,6 +201,7 @@ def main():
 
     # ---------------------------------------------------- weak-scaling sweep
     sweep = []
+    sweep_plan = None
     for d in device_counts:
         Nx = base_nx * d
         mark(f"weak point d={d}: {Nx}x{nz}")
@@ -225,6 +226,8 @@ def main():
                                 f"({counts})")
             point.update(transpose_split(solver.problem.variables[0].domain,
                                          mesh, chunks))
+            # the widest sharded point's resolved plan stamps the row
+            sweep_plan = solver.plan_provenance()
         sweep.append(point)
         mark(f"  {sps:.2f} steps/s")
 
@@ -341,6 +344,7 @@ def main():
         "fleet2d": {"members": members,
                     "mesh": [2, 4],
                     "bit_match_1d": fleet_match},
+        "plan": sweep_plan,
         "finite": not failures,
         "quick": bool(args.quick),
     }

@@ -122,6 +122,8 @@ def one_request(client, spec, ics, dt, steps, tag):
         "request_wall_sec": round(wall, 4),
         "fields": result.fields,
         "steps_per_sec": (result.record or {}).get("steps_per_sec"),
+        # the daemon-resolved plan rides back in the flushed step record
+        "plan": (result.record or {}).get("plan"),
     }
 
 
@@ -167,6 +169,7 @@ def run_problem(config, spec, ics, dt, steps, warm_runs,
                 w["queue_sec"] for w in warm), 6),
             "bit_identical_cold_warm": bool(bit_identical),
             "steps_per_sec_warm": warm[-1]["steps_per_sec"],
+            "plan": warm[-1]["plan"] or cold["plan"],
         }
         if throughput_requests:
             mark(f"{config}: throughput sweep "

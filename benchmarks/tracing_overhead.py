@@ -1,0 +1,171 @@
+"""
+Tracing-overhead benchmark: the observability layer's <1% claim, measured.
+
+Request tracing (dedalus_tpu/tools/tracing.py) is host-side by contract —
+the compiled step program is byte-identical with tracing on or off
+(progcheck DTP107, `traced_step` census) — so the only costs it CAN have
+are (a) whatever the per-step host path pays for having tracing enabled
+and (b) span bookkeeping at the phase-sampling sites. This benchmark
+prices both on the rb256x64 CPU headline configuration (the banded
+Rayleigh-Benard step bench.py reports) and records their sum:
+
+  * loop A/B — steps/s over many SHORT interleaved step_many windows,
+    tracing disabled vs enabled, phase sampling quiesced so the probe
+    re-execution (a ~2 step-time measurement burst with its own
+    variance, identical in both modes) cannot drown a 1% signal. The
+    window order alternates each round (off-on, on-off, ...) and the
+    estimator is the MEDIAN OF PER-ROUND PAIRED fractions, so slow
+    host-load drift — which a sequential comparison or a pooled median
+    reads as overhead — cancels to common mode.
+  * span path — the per-sample cost of the span recording a traced
+    sample performs (one add_span per phase) is timed directly over
+    thousands of iterations, then expressed as a fraction of step time
+    at the PINNED cadence (every 5th step — 40x the shipped default of
+    200, so the recorded fraction is an upper bound, not a flattering
+    one).
+
+Appends one `rb256x64_tracing` row to benchmarks/results.jsonl
+(steps_per_sec off/on, loop + sampling + total overhead fractions,
+span cost per sample, meets_1pct, resolved plan provenance) and exits
+nonzero when the measured total reaches 1%. `--quick` shrinks the
+round count and appends nothing.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _append_result, _mark as mark  # noqa: E402
+
+PINNED_CADENCE = 5
+SPAN_PHASES = ("matsolve", "rhs_eval", "transform", "transpose", "other")
+
+
+def measure_interleaved(solver, dt, block, rounds):
+    """Loop A/B: median steps/s per mode plus the drift-cancelled paired
+    overhead fraction, over `rounds` alternating-order window pairs.
+    Tracing state and sampling flag are restored on exit."""
+    import jax
+    from dedalus_tpu.tools import tracing
+    was_on = tracing.enabled()
+    was_sampling = solver.metrics.sampling
+    solver.metrics.sampling = False
+    walls = {"off": [], "on": []}
+    try:
+        for r in range(rounds):
+            order = ("off", "on") if r % 2 == 0 else ("on", "off")
+            for mode in order:
+                (tracing.enable if mode == "on" else tracing.disable)()
+                t0 = time.perf_counter()
+                solver.step_many(block, dt)
+                jax.block_until_ready(solver.X)
+                walls[mode].append(time.perf_counter() - t0)
+    finally:
+        (tracing.enable if was_on else tracing.disable)()
+        solver.metrics.sampling = was_sampling
+    rates = {mode: round(block / float(np.median(w)), 3)
+             for mode, w in walls.items()}
+    paired = [(on - off) / off
+              for off, on in zip(walls["off"], walls["on"])]
+    return rates, float(np.median(paired))
+
+
+def measure_span_cost(repeats=5000):
+    """Per-sample cost of the span recording a traced phase sample
+    performs (metrics.add_phase_sample: one add_span per phase)."""
+    from dedalus_tpu.tools import tracing
+    was_on = tracing.enabled()
+    tracing.enable()
+    try:
+        for ph in SPAN_PHASES:                      # warm the path
+            tracing.add_span("phase/" + ph, 1e-4)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            for ph in SPAN_PHASES:
+                tracing.add_span("phase/" + ph, 1e-4)
+        cost = (time.perf_counter() - t0) / repeats
+    finally:
+        (tracing.enable if was_on else tracing.disable)()
+    return cost
+
+
+def main():
+    quick = "--quick" in sys.argv
+    append = _append_result
+    if quick:
+        # smoke mode appends nothing: a short-window quick fraction is
+        # noise, and would shadow the full measurement in report scans
+        append = lambda record: None  # noqa: E731
+
+    import jax
+    from dedalus_tpu.extras.bench_problems import build_rb_solver
+    from dedalus_tpu.tools import tracing
+
+    dt = 0.01
+    # short windows: slow host-load drift moves BETWEEN windows, not
+    # within one, so the paired estimator sees it as common mode
+    block = 5
+    rounds = 6 if quick else 24
+    mark("building rb256x64 (banded, CPU headline config)")
+    solver, _ = build_rb_solver(256, 64, np.float64, matsolver="banded")
+    solver.metrics.sample_cadence = PINNED_CADENCE
+    solver.metrics._gate.cadence = PINNED_CADENCE
+    solver.metrics._gate.reset(int(solver.iteration))
+    t0 = time.perf_counter()
+    # warm with the SAME block size: step_many specializes on n, and a
+    # different measurement block would recompile inside the first window
+    solver.step_many(block, dt)
+    jax.block_until_ready(solver.X)
+    # warm the phase-sampling probes OUTSIDE the measured windows: the
+    # first sample ever pays a one-time probe compile/warm (seconds on
+    # this config) that would otherwise masquerade as tracing overhead
+    solver._try_sample_phases()
+    mark(f"compiled in {time.perf_counter() - t0:.1f}s; measuring "
+         f"{rounds} interleaved round pairs x {block}-step windows")
+    rates, loop_frac = measure_interleaved(solver, dt, block, rounds)
+    span_cost = measure_span_cost(repeats=1000 if quick else 5000)
+    step_sec = 1.0 / rates["off"] if rates["off"] else 1.0
+    sampling_frac = span_cost / (PINNED_CADENCE * step_sec)
+    overhead = loop_frac + sampling_frac
+    finite = bool(np.isfinite(np.asarray(solver.X)).all())
+    row = {
+        "config": "rb256x64_tracing",
+        "backend": jax.default_backend(),
+        "dtype": "float64",
+        "block": block,
+        "rounds": rounds,
+        "sample_cadence": PINNED_CADENCE,
+        "steps_per_sec_untraced": rates["off"],
+        "steps_per_sec_traced": rates["on"],
+        "loop_overhead_frac": round(loop_frac, 5),
+        "span_cost_per_sample_usec": round(span_cost * 1e6, 3),
+        "sampling_overhead_frac": round(sampling_frac, 7),
+        "overhead_frac": round(overhead, 5),
+        "meets_1pct": bool(overhead < 0.01),
+        "plan": solver.plan_provenance(),
+        "finite": finite,
+        "quick": quick,
+        "ts": round(time.time(), 1),
+    }
+    mark(f"loop {loop_frac * 100:+.3f}% + sampling "
+         f"{sampling_frac * 100:.5f}% (span path "
+         f"{span_cost * 1e6:.1f} us/sample at cadence {PINNED_CADENCE}) "
+         f"-> total {overhead * 100:+.3f}% (bar: <1%)")
+    append(row)
+    print(json.dumps(row), flush=True)
+    if not finite:
+        mark("FAIL: state non-finite after measurement")
+        return 1
+    if not quick and not row["meets_1pct"]:
+        mark("FAIL: tracing overhead >= 1% on rb256x64")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,38 @@ def get_examples(args=None):
     print(root)
 
 
+def _format_plan(record):
+    """One-line resolved-plan provenance for a metrics/bench row. Rows
+    written before plan stamping existed (PR 16) carry no `plan` block
+    and must still render — as the literal `plan=unversioned` — rather
+    than crash or silently vanish."""
+    plan = record.get("plan")
+    if not isinstance(plan, dict):
+        return "plan=unversioned"
+    parts = []
+    fusion = plan.get("fusion")
+    if isinstance(fusion, dict):
+        on = "+".join(k for k in ("solve", "matvec", "transforms",
+                                  "donate", "pallas")
+                      if fusion.get(k)) or "off"
+        parts.append(f"fusion={on}")
+    if plan.get("solve_composition"):
+        solve = str(plan["solve_composition"])
+        if plan.get("solve_dtype"):
+            solve += f"/{plan['solve_dtype']}"
+        parts.append(f"solve={solve}")
+    if plan.get("refine_sweeps") is not None:
+        parts.append(f"sweeps={plan['refine_sweeps']}")
+    if plan.get("spike_chunks") is not None:
+        parts.append(f"spike={plan['spike_chunks']}")
+    if plan.get("transpose_chunks") is not None:
+        parts.append(f"chunks={plan['transpose_chunks']}")
+    if plan.get("solver_key"):
+        parts.append(f"key={plan['solver_key']}")
+    return (f"plan[v{plan.get('plan_version', '?')}]: "
+            + (", ".join(parts) or "(empty)"))
+
+
 def report(args):
     """Summarize a metrics JSONL file (tools/metrics.py records; bench rows
     from benchmarks/results.jsonl listed briefly; health post-mortem and
@@ -229,6 +261,7 @@ def report(args):
                         + (" (late join)" if batch.get("late_join")
                            else ""))
                 print(f"    serving: {', '.join(parts)}")
+            print(f"    {_format_plan(record)}")
         elif kind == "health_postmortem":
             n_post += 1
             resilience = record.get("resilience")
@@ -270,6 +303,13 @@ def report(args):
                 if breaker.get("open"):
                     line += f", OPEN circuits: {breaker['open']}"
                 print(line)
+                codes = faults.get("error_codes") or {}
+                if codes:
+                    # per-error-code refusal census (server._send_error):
+                    # which failure mode dominates, at a glance
+                    print("    error codes: "
+                          + ", ".join(f"{v} {k}"
+                                      for k, v in sorted(codes.items())))
             batching = (record.get("serving") or {}).get("batching") or {}
             if batching.get("enabled"):
                 # continuous-batching occupancy (service/batching.py):
@@ -299,6 +339,15 @@ def report(args):
                           f"{ev.get('blocks', 0)} blocks, {det_txt}"
                           + (" [ABANDONED]" if ev.get("abandoned")
                              else ""))
+        elif kind == "trace":
+            n_other += 1
+            from .tools.tracing import summarize_trace
+            summary = summarize_trace(record)
+            print(f"(trace) {summary['trace_id']}: "
+                  f"root {summary['root'] or '?'} "
+                  f"{round((summary['root_sec'] or 0.0) * 1e3, 3)} ms, "
+                  f"{summary['spans']} spans "
+                  f"(`python -m dedalus_tpu trace` for the span tree)")
         elif kind == "watchdog_postmortem":
             n_post += 1
             stacks = record.get("stacks") or []
@@ -315,6 +364,7 @@ def report(args):
             extra = f" = {val} {unit}".rstrip() if val is not None else ""
             stale = " [stale]" if record.get("stale") else ""
             print(f"(other) {ident}{extra}{stale}")
+            print(f"    {_format_plan(record)}")
             # ensemble benchmark rows (benchmarks/ensemble.py): one line
             # per sweep point so speedups read without opening the JSONL
             sweep = record.get("sweep")
@@ -499,6 +549,46 @@ def report(args):
         sys.exit(1)
 
 
+def trace(args):
+    """Inspect request traces (tools/tracing.py records, written by
+    `serve --trace` or the metrics sink): indented span trees by default,
+    `--chrome OUT` exports Chrome trace-event JSON for Perfetto /
+    chrome://tracing, `--summary` one line per trace."""
+    from .tools import tracing
+    try:
+        records = tracing.load_trace_records(args.jsonl)
+    except OSError as exc:
+        print(f"trace: cannot read {args.jsonl}: {exc}", file=sys.stderr)
+        sys.exit(1)
+    if args.trace_id:
+        records = [r for r in records
+                   if str(r.get("trace_id", "")).startswith(args.trace_id)]
+    if args.last is not None:
+        records = records[-args.last:] if args.last > 0 else []
+    if not records:
+        print("trace: no matching trace records", file=sys.stderr)
+        sys.exit(1)
+    if args.chrome:
+        out = pathlib.Path(args.chrome)
+        out.write_text(json.dumps(tracing.chrome_trace_from_records(records)))
+        total = sum(len(r.get("spans", [])) for r in records)
+        print(f"wrote {len(records)} trace(s), {total} span(s) -> {out}")
+        return
+    for record in records:
+        if args.summary:
+            summary = tracing.summarize_trace(record)
+            top = ", ".join(
+                f"{name} {sec * 1e3:.3f}ms"
+                for name, sec in list(summary["by_name"].items())[:4])
+            print(f"{summary['trace_id']}: "
+                  f"root {summary['root'] or '?'} "
+                  f"{(summary['root_sec'] or 0.0) * 1e3:.3f} ms, "
+                  f"{summary['spans']} spans ({top})")
+        else:
+            for line in tracing.format_trace_tree(record):
+                print(line)
+
+
 def postmortem(args):
     """Summarize a health flight-recorder dump (tools/health.py): accepts
     the post-mortem directory or a record file inside it."""
@@ -569,6 +659,20 @@ def build_parser():
     p.add_argument("--last", type=int, default=None, metavar="N",
                    help="only the N most recent parsable rows")
     p.set_defaults(func=report)
+    p = sub.add_parser("trace", help="inspect request traces "
+                                     "(span trees, Chrome JSON export)")
+    p.add_argument("jsonl", help="trace/metrics JSONL file "
+                                 "(serve --trace output or telemetry sink)")
+    p.add_argument("--trace-id", default=None, metavar="PREFIX",
+                   help="only traces whose id starts with PREFIX")
+    p.add_argument("--last", type=int, default=None, metavar="N",
+                   help="only the N most recent matching traces")
+    p.add_argument("--chrome", default=None, metavar="OUT",
+                   help="write Chrome trace-event JSON (Perfetto / "
+                        "chrome://tracing) instead of printing trees")
+    p.add_argument("--summary", action="store_true",
+                   help="one line per trace instead of the span tree")
+    p.set_defaults(func=trace)
     p = sub.add_parser("postmortem", help="summarize a health post-mortem "
                                           "dump (tools/health.py)")
     p.add_argument("directory", help="post-mortem directory or record file")

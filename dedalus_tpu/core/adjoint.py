@@ -526,4 +526,8 @@ class DifferentiableIVP:
         extra.setdefault("adjoint", self.summary())
         extra.setdefault("retraces_post_warmup",
                          retrace_mod.sentinel.post_arm_retraces)
+        # provenance of the wrapped forward solver: the adjoint programs
+        # differentiate through the same resolved plan
+        if hasattr(self.solver, "plan_provenance"):
+            extra.setdefault("plan", self.solver.plan_provenance())
         return self.metrics.flush(extra=extra)

@@ -1811,4 +1811,8 @@ class EnsembleSolver:
         extra.setdefault("ensemble", self.summary())
         extra.setdefault("retraces_post_warmup",
                          retrace_mod.sentinel.post_arm_retraces)
+        # the fleet compiles against the template solver's resolved plan,
+        # so its provenance IS the fleet's provenance
+        if hasattr(self.solver, "plan_provenance"):
+            extra.setdefault("plan", self.solver.plan_provenance())
         return self.metrics.flush(extra=extra)

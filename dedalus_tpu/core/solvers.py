@@ -1064,7 +1064,39 @@ class InitialValueSolver(SolverBase):
         if plan is not None and (plan.dtype != "native"
                                  or plan.composition != "sequential"):
             extra.setdefault("precision", self._precision_summary())
+        # resolved plan provenance: every flushed record names the plan
+        # that produced its numbers (ROADMAP item 2; `report` renders
+        # pre-provenance rows as plan=unversioned)
+        extra.setdefault("plan", self.plan_provenance())
         return self.metrics.flush(extra=extra)
+
+    def plan_provenance(self):
+        """The resolved execution plan this solver was built under, as one
+        flat telemetry block: fusion composition, solve composition +
+        precision ladder, transpose chunking, and the content identity
+        the warm pool keys on. Everything here was resolved ONCE in
+        `_build_pencil_system`, so the block names the plan the compiled
+        programs actually run — not whatever the config says now."""
+        block = {"plan_version": 1}
+        fusion = getattr(self, "_fusion_plan", None)
+        if fusion is not None:
+            block["fusion"] = {
+                "solve": fusion.solve, "matvec": fusion.matvec,
+                "transforms": fusion.transforms, "donate": fusion.donate,
+                "pallas": fusion.pallas}
+        solve = getattr(self, "_solve_plan", None)
+        if solve is not None:
+            block["solve_composition"] = solve.composition
+            block["solve_dtype"] = solve.dtype
+            block["refine_sweeps"] = solve.sweeps
+            block["spike_chunks"] = solve.spike_chunks
+        chunks = getattr(self, "_transpose_chunks", None)
+        if chunks is not None:
+            block["transpose_chunks"] = int(chunks)
+        key = getattr(self, "assembly_key", None)
+        if key:
+            block["solver_key"] = str(key)[:16]
+        return block
 
     def _precision_summary(self):
         """The `precision` telemetry block: the resolved solve plan and

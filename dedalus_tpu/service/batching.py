@@ -75,6 +75,7 @@ import time
 import numpy as np
 
 from . import faults, protocol
+from ..tools import tracing
 from ..tools.config import cfg_get
 
 logger = logging.getLogger(__name__)
@@ -326,7 +327,13 @@ class BatchDispatcher:
             # the largest allocation the request path makes
             svc._shed_memory()
             try:
-                entry, verdict, build_sec = svc.pool.acquire(spec)
+                # the anchor's trace owns the batch-level pool acquire
+                # (a cold build emits build/<phase> child spans under it)
+                with tracing.resume(first_item.get("trace")):
+                    with tracing.span("pool_acquire") as acq:
+                        entry, verdict, build_sec = svc.pool.acquire(spec)
+                        acq.set(verdict=verdict,
+                                build_sec=round(build_sec, 4))
             except protocol.SpecError as exc:
                 svc._count_error()
                 svc._send_error(first_item["wfile"], "bad-spec", str(exc))
@@ -477,12 +484,27 @@ class BatchDispatcher:
                 fleet.project_members(project)
             n = self.batch_block if all(
                 window_dist(s) >= self.batch_block for s in live) else 1
+            t_block0 = time.perf_counter()
             taken = fleet.step_fleet(n)
             ctx.blocks += 1
             ctx.peak = max(ctx.peak, len(live))
             # boundary sync doubles as the health probe AND the watchdog
             # progress stamp: a wedged dispatch blocks here
+            t_probe0 = time.perf_counter()
             nonfinite, max_abs = jax.device_get(fleet._probe())
+            if tracing.enabled():
+                # one block + boundary span per live member, so EVERY
+                # member's exported trace shows the blocks it rode
+                t_done = time.perf_counter()
+                for s in live:
+                    stctx = s.item.get("trace")
+                    if stctx is None:
+                        continue
+                    blk = tracing.add_span(
+                        "batch/block", t_done - t_block0, parent=stctx,
+                        attrs={"block": ctx.blocks, "iters": int(n)})
+                    tracing.add_span("batch/boundary", t_done - t_probe0,
+                                     parent=blk)
             if ctx.abandoned.is_set():
                 # the watchdog fired while we were stuck in the sync and
                 # already requeued these members' sockets for the
@@ -641,6 +663,13 @@ class BatchDispatcher:
         request_id = admitted["request_id"]
         params = admitted["params"]
         probe = admitted["probe"]
+        tctx = item.get("trace")
+        t_seat0 = time.perf_counter()
+        if tctx is not None:
+            # the member's queue wait ends here, at its seat attempt
+            tracing.add_span("queue",
+                             time.perf_counter() - item["t_accept"],
+                             parent=tctx)
         # from here until the seat registers in ctx.seats, the request
         # is covered as the PENDING item: a watchdog fire mid-seating
         # (wedged reset/gather/attach) answers this client instead of
@@ -696,6 +725,19 @@ class BatchDispatcher:
         # projected, with everyone else frozen (bit-identity with solo)
         ramped = fleet.ramp_members([seat_idx], project=bool(cadence))
         seat.steps_done += min(ramped, seat.steps_total)
+        if tctx is not None:
+            # seat span covers reset + IC install + attach + ramp;
+            # stamp the resolved plan + batch identity on the trace root
+            tracing.add_span("batch/join" if late else "batch/seat",
+                             time.perf_counter() - t_seat0, parent=tctx,
+                             attrs={"batch_id": ctx.request_id,
+                                    "seat": seat_idx, "late_join": late})
+            tctx.attrs.setdefault("request_id", request_id)
+            tctx.attrs.update(batch_id=ctx.request_id,
+                              pool_verdict=seat.verdict)
+            if hasattr(template, "plan_provenance"):
+                tctx.attrs.update(plan=template.plan_provenance(),
+                                  pool_key=str(entry.key)[:16])
         try:
             protocol.send_frame(wfile, {
                 "kind": "ack", "id": request_id,
@@ -842,6 +884,9 @@ class BatchDispatcher:
         }
         if s.params["deadline_sec"] is not None:
             serving["deadline_sec"] = s.params["deadline_sec"]
+        tctx = s.item.get("trace")
+        if tctx is not None:
+            serving["trace_id"] = tctx.trace_id
         from ..tools import retrace as retrace_mod
         record = {
             "kind": "step_metrics",
@@ -857,6 +902,9 @@ class BatchDispatcher:
             "retraces_post_warmup": retrace_mod.sentinel.post_arm_retraces,
             "serving": serving,
         }
+        if hasattr(template, "plan_provenance"):
+            # the fleet executes the template's resolved plan, vmapped
+            record["plan"] = template.plan_provenance()
         return record, serving
 
     def _member_fields(self, fleet, entry, s):
@@ -944,8 +992,15 @@ class BatchDispatcher:
         # a graceful finish judges the spec healthy (the solo rule); the
         # run completed even when the client stopped listening
         svc.breaker.record_success(ctx.digest)
+        t_send0 = time.perf_counter()
         self._send_member(ctx, fleet, s, record)
         self._send_member(ctx, fleet, s, result, payload=payload)
+        tctx = s.item.get("trace")
+        if tctx is not None:
+            tracing.add_span("result_send",
+                             time.perf_counter() - t_send0, parent=tctx,
+                             attrs={"payload_bytes": len(payload)})
+            tctx.attrs.setdefault("outcome", stopped_by)
         svc._count("requests_served")
         svc._observe_run_wall(s.t_dispatch)
         self._release(ctx, fleet, s, "deadline"
@@ -983,13 +1038,22 @@ class BatchDispatcher:
         if s.active:
             s.active = False
             fleet.detach_member(s.seat)
+        tctx = s.item.get("trace")
+        if tctx is not None:
+            tracing.add_span("batch/detach", 0.0, parent=tctx,
+                             attrs={"cause": cause})
+            tctx.attrs.setdefault("outcome", cause)
         ctx.detached[cause] += 1
         with self._lock:
             self.detached[cause] += 1
         self._close(s.item)
 
-    @staticmethod
-    def _close(item):
+    def _close(self, item):
+        # every member connection closes through here, so this is also
+        # where a member's trace is finished + flushed (idempotent; a
+        # watchdog-requeued survivor keeps its open trace because its
+        # item is requeued, never closed)
+        self.service._finish_trace(item.get("trace"))
         try:
             item["conn"].close()
         except OSError:

@@ -68,16 +68,17 @@ class RunContext:
     __slots__ = ("request_id", "digest", "conn", "wfile", "loop",
                  "deadline_ts", "last_progress", "abandoned",
                  "deadline_fired", "client_gone", "probe", "started_ts",
-                 "header")
+                 "header", "trace")
 
     def __init__(self, request_id, digest, conn, wfile, loop,
-                 deadline_ts=None, probe=False, header=None):
+                 deadline_ts=None, probe=False, header=None, trace=None):
         self.request_id = request_id
         self.digest = digest
         self.conn = conn
         self.wfile = wfile
         self.loop = loop
         self.header = header
+        self.trace = trace    # tools/tracing.TraceContext (None: off)
         self.deadline_ts = deadline_ts
         self.last_progress = time.monotonic()
         self.abandoned = threading.Event()

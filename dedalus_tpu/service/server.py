@@ -90,6 +90,7 @@ import numpy as np
 from . import batching, faults, protocol
 from .pool import SolverPool
 from ..tools import metrics as metrics_mod
+from ..tools import tracing
 from ..tools.config import cfg_get
 
 logger = logging.getLogger(__name__)
@@ -147,7 +148,8 @@ class SolverService:
                  breaker_cooloff=None, result_cache=None,
                  mem_watermark_mb=None, on_client_drop=None,
                  chaos_enabled=False, batching_enabled=None,
-                 batch_max=None, batch_window=None, batch_block=None):
+                 batch_max=None, batch_window=None, batch_block=None,
+                 trace_file=None):
         self.host = host
         self.port = int(port)
         self.pool = SolverPool(size=pool_size, allow_imports=allow_imports)
@@ -191,6 +193,13 @@ class SolverService:
         self.batcher = batching.BatchDispatcher(
             self, batch_max=batch_max, batch_window=batch_window,
             batch_block=batch_block) if batching_enabled else None
+        # ---- end-to-end request tracing (tools/tracing.py): opt-in;
+        # when enabled each run request gets one trace (accept ->
+        # admission -> queue -> pool acquire -> batch/run -> result
+        # send), flushed as a `kind: trace` record to the trace sink
+        # (--trace FILE, falling back to the telemetry sink)
+        if trace_file is not None:
+            tracing.enable(sink=str(trace_file) if trace_file else None)
         # ---- request accounting
         self.requests_served = 0
         self.errors = 0
@@ -199,6 +208,10 @@ class SolverService:
         self.watchdog_fires = 0
         self.client_drops = 0
         self.mem_evictions = 0
+        # per-error-code counters ({code: count}): the error MIX —
+        # bad-spec vs deadline-exceeded vs circuit-open vs overloaded —
+        # that the aggregate `errors` total cannot show
+        self.error_codes = {}
         self._request_seq = 0     # default-id counter: EVERY run request
                                   # advances it (success or not), so ids
                                   # in the telemetry sink never collide
@@ -353,6 +366,7 @@ class SolverService:
                 "replays": self.results.replays,
                 "result_cache": len(self.results),
                 "breaker": self.breaker.stats(),
+                "error_codes": dict(self.error_codes),
             },
         }
 
@@ -410,8 +424,20 @@ class SolverService:
                                             "draining": True})
                 self.request_drain("shutdown request")
             elif kind == "run":
-                enqueued = self._admit_run(conn, wfile, header, payload,
-                                           t_accept)
+                # one trace per run request, opened on the reader thread;
+                # accept = the request read we just finished. tctx is
+                # None with tracing off and every consumer tolerates it.
+                tctx = tracing.new_trace("request")
+                if tctx is not None:
+                    tracing.add_span("accept",
+                                     time.perf_counter() - t_accept,
+                                     parent=tctx)
+                with tracing.resume(tctx):
+                    with tracing.span("admission"):
+                        enqueued = self._admit_run(conn, wfile, header,
+                                                   payload, t_accept, tctx)
+                if not enqueued:
+                    self._finish_trace(tctx, outcome="refused")
             else:
                 self._count_error()
                 self._send_error(wfile, "unknown-kind",
@@ -426,7 +452,20 @@ class SolverService:
                 except OSError:
                     pass
 
-    def _admit_run(self, conn, wfile, header, payload, t_accept):
+    def _finish_trace(self, tctx, **attrs):
+        """Close a request trace's root span and flush the whole span
+        tree as one `kind: trace` record to the trace sink (falling back
+        to the telemetry sink). No-op for tctx=None (tracing off)."""
+        if tctx is None:
+            return
+        tctx.finish(**attrs)
+        # an explicit trace sink (--trace FILE / tracing.enable(sink))
+        # wins; otherwise trace records ride the telemetry sink
+        tracing.flush_trace(tctx.trace_id,
+                            sink=tracing.trace_sink() or self.sink)
+
+    def _admit_run(self, conn, wfile, header, payload, t_accept,
+                   tctx=None):
         """Admission control for one run request (reader thread). Returns
         True when the request was enqueued (the worker then owns the
         connection). Order matters: replay first (a finished result is
@@ -480,7 +519,8 @@ class SolverService:
             deadline_mono = time.monotonic() + float(deadline)
         self._queue.put({"conn": conn, "wfile": wfile, "header": header,
                          "payload": payload, "t_accept": t_accept,
-                         "deadline_mono": deadline_mono, "probe": probe})
+                         "deadline_mono": deadline_mono, "probe": probe,
+                         "trace": tctx})
         return True
 
     @staticmethod
@@ -582,6 +622,8 @@ class SolverService:
                     self._send_error(
                         wfile, "draining",
                         f"daemon is draining ({self._draining})")
+                    self._finish_trace(item.get("trace"),
+                                       outcome="draining")
                 elif self.batcher is not None \
                         and not item.get("force_solo") \
                         and self.batcher.batchable(item["header"]):
@@ -651,6 +693,7 @@ class SolverService:
                 self.errors += 1
             self._send_error(item["wfile"], "draining",
                              f"daemon is draining ({self._draining})")
+            self._finish_trace(item.get("trace"), outcome="draining")
             try:
                 item["conn"].close()
             except OSError:
@@ -664,8 +707,14 @@ class SolverService:
         with self._counters_lock:
             setattr(self, name, getattr(self, name) + n)
 
-    @staticmethod
-    def _send_error(wfile, code, message, **extra):
+    def _send_error(self, wfile, code, message, **extra):
+        # every structured refusal counts by its code, so the final
+        # service_stats record shows the error MIX, not just a total
+        with self._counters_lock:
+            self.error_codes[code] = self.error_codes.get(code, 0) + 1
+        if tracing.enabled() and tracing.current_context() is not None:
+            # zero-length marker span under the request's ambient trace
+            tracing.add_span("error", 0.0, attrs={"code": code})
         try:
             frame = {"kind": "error", "code": code, "message": message}
             frame.update(extra)
@@ -960,6 +1009,23 @@ class SolverService:
                 f"{dropped} cached result(s)")
 
     def _handle_run(self, item):
+        """Solo-path dispatch wrapper: stamps the request's queue-wait
+        span, resumes its trace on the executor thread (so build/run/
+        phase spans parent correctly), and guarantees the trace is
+        finished + flushed on every exit path — including AbandonedRun
+        unwinds."""
+        tctx = item.get("trace")
+        if tctx is None:
+            return self._dispatch_run(item)
+        tracing.add_span("queue", time.perf_counter() - item["t_accept"],
+                         parent=tctx)
+        try:
+            with tracing.resume(tctx):
+                return self._dispatch_run(item)
+        finally:
+            self._finish_trace(tctx)
+
+    def _dispatch_run(self, item):
         from ..tools.resilience import ResilientLoop
         from ..tools.exceptions import SolverHealthError
         import jax
@@ -975,6 +1041,9 @@ class SolverService:
             seq = self._request_seq
         client_id = header.get("id")
         request_id = str(client_id or f"r{seq}")
+        tctx = item.get("trace")
+        if tctx is not None:
+            tctx.attrs.setdefault("request_id", request_id)
         # NOTE: the replay -> params -> breaker -> deadline sequence
         # below is mirrored by service/batching.BatchDispatcher.
         # _admit_member for batched members; a change to the ordering or
@@ -1034,7 +1103,7 @@ class SolverService:
         # exceed the worst-case cold start — docs/serving.md)
         ctx = faults.RunContext(request_id, digest, conn, wfile, None,
                                 deadline_ts=deadline_mono, probe=probe,
-                                header=header)
+                                header=header, trace=tctx)
         with self._active_lock:
             self._active_run = ctx
         try:
@@ -1053,7 +1122,11 @@ class SolverService:
         request_id, digest, probe = ctx.request_id, ctx.digest, ctx.probe
         try:
             ics = protocol.decode_fields(payload) if payload else {}
-            entry, verdict, build_sec = self.pool.acquire(spec)
+            with tracing.span("pool_acquire") as acq:
+                # a cold build inside acquire() emits its own
+                # `build/<phase>` child spans (metrics.BuildPhases)
+                entry, verdict, build_sec = self.pool.acquire(spec)
+                acq.set(verdict=verdict, build_sec=round(build_sec, 4))
             if ctx.abandoned.is_set():
                 # the watchdog fired during OUR build: its quarantine ran
                 # before this build finished and re-inserted the entry,
@@ -1098,6 +1171,13 @@ class SolverService:
             solver.stop_sim_time = params["stop_sim_time"]
         solver.metrics.sink = self.sink
         solver.metrics.meta["config"] = f"{protocol.spec_name(spec)}_served"
+        tctx = ctx.trace
+        if tctx is not None and hasattr(solver, "plan_provenance"):
+            # the resolved plan rides the trace root, so an exported
+            # span tree names the plan that produced its latencies
+            tctx.attrs.update(plan=solver.plan_provenance(),
+                              pool_verdict=verdict,
+                              pool_key=str(entry.key)[:16])
         try:
             protocol.send_frame(wfile, {
                 "kind": "ack", "id": request_id, "pool_verdict": verdict,
@@ -1180,9 +1260,13 @@ class SolverService:
         }
         if params["deadline_sec"] is not None:
             serving["deadline_sec"] = params["deadline_sec"]
+        if tctx is not None:
+            # the key that joins this step record to its trace record
+            serving["trace_id"] = tctx.trace_id
         try:
             try:
-                summary = loop.run(log_cadence=0)
+                with tracing.span("run"):
+                    summary = loop.run(log_cadence=0)
             finally:
                 # the solve is over (or failed): everything below is
                 # reply-phase IO — telemetry flush, result encode, and a
@@ -1281,22 +1365,25 @@ class SolverService:
         # stalled send into an OSError the client-drop path absorbs
         reply_budget = self.idle_timeout \
             + len(result_payload) / MIN_TRANSFER_BYTES_PER_SEC
-        with _socket_deadline(ctx.conn, reply_budget,
-                              socket.SHUT_RDWR):
-            if record is not None:
+        with tracing.span("result_send",
+                          attrs={"payload_bytes": len(result_payload)}):
+            with _socket_deadline(ctx.conn, reply_budget,
+                                  socket.SHUT_RDWR):
+                if record is not None:
+                    try:
+                        protocol.send_frame(wfile, record)
+                    except (TypeError, ValueError):
+                        logger.warning("service: telemetry record not "
+                                       "JSON-serializable; skipped")
+                    except OSError:
+                        self._client_dropped(ctx, loop)
                 try:
-                    protocol.send_frame(wfile, record)
-                except (TypeError, ValueError):
-                    logger.warning("service: telemetry record not "
-                                   "JSON-serializable; skipped")
+                    protocol.send_frame(wfile, result,
+                                        payload=result_payload)
                 except OSError:
                     self._client_dropped(ctx, loop)
-            try:
-                protocol.send_frame(wfile, result, payload=result_payload)
-            except OSError:
-                self._client_dropped(ctx, loop)
-                logger.warning(f"service: client for {request_id} hung "
-                               "up before the result frame")
+                    logger.warning(f"service: client for {request_id} "
+                                   "hung up before the result frame")
         self._count("requests_served")
 
     def _client_dropped(self, ctx, loop):
@@ -1407,6 +1494,13 @@ def build_parser():
                         help="fleet block size in iterations between "
                              "join/detach boundaries (default: [service] "
                              "BATCH_BLOCK_ITERS)")
+    parser.add_argument("--trace", nargs="?", const="", default=None,
+                        metavar="FILE",
+                        help="end-to-end request tracing (tools/"
+                             "tracing.py): one span tree per request, "
+                             "flushed as 'trace' records to FILE (bare "
+                             "--trace rides the --sink); `python -m "
+                             "dedalus_tpu trace` dumps/converts them")
     return parser
 
 
@@ -1426,6 +1520,7 @@ def main(argv=None):
         mem_watermark_mb=args.mem_watermark_mb,
         on_client_drop=args.on_client_drop, chaos_enabled=args.chaos,
         batching_enabled=args.batch, batch_max=args.batch_max,
-        batch_window=args.batch_window, batch_block=args.batch_block)
+        batch_window=args.batch_window, batch_block=args.batch_block,
+        trace_file=args.trace)
     service.serve_forever()
     return 0

@@ -66,6 +66,7 @@ import time
 
 import numpy as np
 
+from . import tracing
 from .exceptions import CheckpointError
 
 logger = logging.getLogger(__name__)
@@ -461,7 +462,11 @@ class ShardedCheckpointer:
         self.submitted += 1
         if not self.async_write:
             path = self._commit(arrays, meta)
-            self.stall_sec += time.perf_counter() - t0
+            stall = time.perf_counter() - t0
+            self.stall_sec += stall
+            if tracing.enabled():
+                tracing.add_span("checkpoint/submit", stall,
+                                 attrs={"mode": "sync"})
             if path is None and self.errors:
                 # synchronous callers must SEE the failure (the HDF5 path
                 # raises; the resilient loop's final-checkpoint retry and
@@ -470,13 +475,19 @@ class ShardedCheckpointer:
                 raise self.errors[-1]
             return path
         self._ensure_thread()
+        waited = False
         with self._not_full:
             while len(self._pending) >= self.inflight:
+                waited = True
                 self._not_full.wait()   # the overrun barrier
             self._pending.append((arrays, meta))
             self.max_inflight = max(self.max_inflight, len(self._pending))
             self._drained.notify_all()
-        self.stall_sec += time.perf_counter() - t0
+        stall = time.perf_counter() - t0
+        self.stall_sec += stall
+        if tracing.enabled():
+            tracing.add_span("checkpoint/submit", stall,
+                             attrs={"mode": "async", "stalled": waited})
         return None
 
     def drain(self, timeout=60.0):

@@ -54,6 +54,15 @@ Contracts (ids DTP1xx, disjoint from the AST DTL0xx ids):
                                   in the GSPMD-auto subregion of a
                                   partially-manual shard_map are the
                                   hard-crash class).
+  DTP107 tracing-inert          — programs declaring an untraced-build
+                                  HLO hash (meta["untraced_sha256"])
+                                  must compile byte-identically with
+                                  request tracing enabled: the
+                                  observability layer (tools/tracing.py)
+                                  is host-side bookkeeping by contract,
+                                  and a span helper leaking into the
+                                  lowered computation is a lint failure,
+                                  not a perf mystery.
 
 Findings reuse the lint framework's Finding/baseline discipline
 (framework.py): keys are (contract, "__programs__/<name>", detail), the
@@ -68,6 +77,7 @@ Entry points: `python -m dedalus_tpu lint --programs` (cli.py) and
 `run_programs()` (tests/test_progcheck.py, the tier-1 gate).
 """
 
+import hashlib
 import pathlib
 import re
 import time
@@ -279,7 +289,8 @@ class ProgramRecord:
                 row["scan_lengths"] = sorted(set(lengths), reverse=True)
                 row["while_loops"] = whiles
         for key in ("state_bytes", "expected_a2a_min", "donated",
-                    "fused_solve", "manual_auto", "max_scan_length"):
+                    "fused_solve", "manual_auto", "max_scan_length",
+                    "untraced_sha256"):
             if key in self.meta:
                 row[key] = self.meta[key]
         return row
@@ -574,6 +585,40 @@ class ScanDepthBound(Contract):
                 "stays checkable")
 
 
+@register_contract
+class TracingInert(Contract):
+    """DTP107: request tracing must not change the compiled program.
+
+    The observability layer (tools/tracing.py, docs/observability.md)
+    promises "structurally free when off, host-side only when on": spans
+    wrap dispatch sites, never traced computations, so enabling tracing
+    must leave the lowered step program byte-identical. A span helper
+    that slips inside a jit boundary (or gates lowering on
+    tracing.enabled()) would silently fork the compiled artifact and
+    invalidate every cross-run comparison. Programs declare the
+    tracing-DISABLED build's HLO hash via meta["untraced_sha256"]; the
+    record's compiled_text is the tracing-ENABLED build of the same
+    program."""
+
+    id = "DTP107"
+    severity = "error"
+    title = "tracing-inert"
+
+    def check(self, record):
+        want = record.meta.get("untraced_sha256")
+        if want is None or record.compiled_text is None:
+            return
+        got = hashlib.sha256(record.compiled_text.encode()).hexdigest()
+        if got != want:
+            yield self.finding(
+                record, "traced/untraced HLO divergence",
+                "the compiled step program differs between tracing "
+                f"enabled (sha256 {got[:12]}) and disabled (sha256 "
+                f"{want[:12]}): instrumentation has leaked into the "
+                "lowered computation — spans must stay host-side "
+                "(docs/observability.md)")
+
+
 # ------------------------------------------------------------- the census
 
 CENSUS = {}
@@ -762,6 +807,43 @@ def _census_rb_ladder():
                 extra_meta={"fused_solve": True,
                             "max_scan_length": max(chunks, sweeps)})
     return [rec]
+
+
+@census("traced_step")
+def _census_traced_step():
+    """The dense diffusion step lowered twice — request tracing disabled,
+    then enabled — with the disabled build's HLO hash declared in meta so
+    DTP107 can assert the enabled build is byte-identical: the
+    observability layer's zero-overhead-when-off claim as a
+    machine-checked structural fact, not a benchmark delta."""
+    from ...tools import tracing
+    from ...extras.bench_problems import build_diffusion_solver
+    from ...core.timesteppers import step_program_handle
+
+    def compiled_step():
+        solver = build_diffusion_solver(32)
+        solver.step(1e-3)
+        prog, args = step_program_handle(solver, dt=1e-3)
+        meta = {"donated": len(getattr(prog, "donate_argnums", ()))}
+        return (prog.lower(*args).compile().as_text(),
+                prog.jaxpr(*args), meta)
+
+    was_on = tracing.enabled()
+    with _pinned_config("fusion", DONATE_STEP="on", PALLAS="off"):
+        try:
+            tracing.disable()
+            off_text, _, _ = compiled_step()
+            tracing.enable()
+            on_text, jaxpr, meta = compiled_step()
+        finally:
+            if not was_on:
+                tracing.disable()
+    meta["untraced_sha256"] = hashlib.sha256(off_text.encode()).hexdigest()
+    return [ProgramRecord(
+        "traced_step",
+        description="dense SBDF2 diffusion step lowered under tracing "
+                    "(must match the untraced build byte-for-byte)",
+        compiled_text=on_text, jaxpr=jaxpr, meta=meta)]
 
 
 @census("sharded_step_1d")

@@ -43,6 +43,11 @@ HOT_PATH_MODULES = (
     # every fused solve through BandedOps/DenseOps — same exposure as
     # pencilops itself
     "libraries/solvecomp.py",
+    # request tracing brackets every step/request phase by contract as
+    # HOST-ONLY bookkeeping (docs/observability.md): a device gather or
+    # block_until_ready smuggled into a span helper would charge every
+    # instrumented phase a sync and break the <1% overhead budget
+    "tools/tracing.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its

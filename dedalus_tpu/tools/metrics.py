@@ -72,6 +72,7 @@ import weakref
 import numpy as np
 import jax
 
+from . import tracing
 from .config import config
 
 __all__ = ["PHASES", "SUM_PHASES", "BUILD_PHASES", "CadenceGate", "Counter",
@@ -181,6 +182,10 @@ class BuildPhases:
         def __enter__(self):
             self.ann = annotate(f"dedalus/build/{self.name}")
             self.ann.__enter__()
+            # child span under the ambient trace (the server's
+            # pool_acquire span when a cold build runs inside a request)
+            self.span = tracing.span(f"build/{self.name}")
+            self.span.__enter__()
             self.t0 = time.perf_counter()
             return self
 
@@ -188,6 +193,7 @@ class BuildPhases:
             dt = time.perf_counter() - self.t0
             sec = self.phases.seconds
             sec[self.name] = sec.get(self.name, 0.0) + dt
+            self.span.__exit__(*exc)
             return self.ann.__exit__(*exc)
 
     def scope(self, name):
@@ -218,19 +224,38 @@ class Counter:
 
 
 class PhaseTimer:
-    """Accumulates sampled per-step seconds for each phase."""
+    """Accumulates sampled per-step seconds for each phase, plus a
+    log-bucketed histogram per phase (tools/tracing.LogHistogram) so
+    flushed records and the `report` CLI carry tail percentiles
+    (p50/p95/p99), not just means — the tails are what a serving tier
+    lives or dies by. The histogram feed is always on (one log + one
+    dict bump per sample) regardless of whether tracing is enabled."""
 
     def __init__(self, phases=PHASES):
         self.totals = {p: 0.0 for p in phases}
         self.counts = {p: 0 for p in phases}
+        self.hists = {}
 
     def add(self, phase, seconds):
         self.totals[phase] = self.totals.get(phase, 0.0) + float(seconds)
         self.counts[phase] = self.counts.get(phase, 0) + 1
+        h = self.hists.get(phase)
+        if h is None:
+            h = self.hists[phase] = tracing.LogHistogram()
+        h.add(seconds)
 
     def mean(self, phase):
         n = self.counts.get(phase, 0)
         return self.totals.get(phase, 0.0) / n if n else 0.0
+
+    def percentiles(self, phase):
+        """{p50, p95, p99} seconds for one phase, or None when the phase
+        has no samples."""
+        h = self.hists.get(phase)
+        if h is None or not h.total:
+            return None
+        return {"p50": h.percentile(50), "p95": h.percentile(95),
+                "p99": h.percentile(99)}
 
     @property
     def samples(self):
@@ -405,9 +430,14 @@ class Metrics:
         return time.perf_counter() - t0
 
     def add_phase_sample(self, seconds_by_phase):
-        """Record one sampled per-step attribution {phase: seconds}."""
+        """Record one sampled per-step attribution {phase: seconds}. With
+        tracing enabled each measurement also lands as a `phase/<name>`
+        span under the ambient trace (the request's `run` span when the
+        sample fires inside a served step loop)."""
         for phase, sec in seconds_by_phase.items():
             self.timer.add(phase, sec)
+            if tracing.enabled():
+                tracing.add_span(f"phase/{phase}", sec)
         self.inc("phase_samples")
         self.memory.sample()
 
@@ -456,6 +486,11 @@ class Metrics:
         iters = self.iterations
         phase_mean = {p: self.timer.mean(p) for p in PHASES}
         phase_total = {p: phase_mean[p] * iters for p in PHASES}
+        phase_pct = {}
+        for p in PHASES:
+            pct = self.timer.percentiles(p)
+            if pct:
+                phase_pct[p] = {k: round(v, 6) for k, v in pct.items()}
         # the fused whole-step row overlaps the decomposition rows (see
         # the PHASES note): only the decomposition enters the sum
         phase_sum = sum(phase_total[p] for p in SUM_PHASES)
@@ -468,6 +503,7 @@ class Metrics:
             "sample_cadence": self.sample_cadence,
             "phase_samples": self.timer.samples,
             "phase_mean_sec": {p: round(v, 6) for p, v in phase_mean.items()},
+            "phase_pct_sec": phase_pct,
             "phase_total_sec": {p: round(v, 6) for p, v in phase_total.items()},
             "phase_sum_frac": round(phase_sum / wall, 4) if wall > 0 else 0.0,
             "device_mem_peak_bytes": self.memory.peak_bytes,
@@ -607,13 +643,22 @@ def format_phase_table(record, indent="  "):
     iters = record.get("iterations") or 0
     total = record.get("phase_total_sec") or {}
     mean = record.get("phase_mean_sec") or {}
+    pct = record.get("phase_pct_sec") or {}
     lines = [f"Per-phase wall time ({record.get('phase_samples', 0)} samples,"
              f" cadence {record.get('sample_cadence', '?')}):"]
     for phase in SUM_PHASES:
         t = total.get(phase, 0.0)
         frac = 100.0 * t / wall if wall > 0 else 0.0
-        lines.append(f"{indent}{phase:<10} {mean.get(phase, 0.0):#.4g} s/step"
-                     f"  {t:#.4g} s total  {frac:5.1f}%")
+        line = (f"{indent}{phase:<10} {mean.get(phase, 0.0):#.4g} s/step"
+                f"  {t:#.4g} s total  {frac:5.1f}%")
+        p = pct.get(phase)
+        if p:
+            # tail columns from the log-bucketed sample histogram —
+            # absent on records flushed before the percentile tier
+            line += (f"  p50/p95/p99 {p.get('p50', 0.0):#.3g}"
+                     f"/{p.get('p95', 0.0):#.3g}"
+                     f"/{p.get('p99', 0.0):#.3g} s")
+        lines.append(line)
     psum = sum(total.get(p, 0.0) for p in SUM_PHASES)
     frac = 100.0 * psum / wall if wall > 0 else 0.0
     lines.append(f"{indent}{'sum':<10} {psum:#.4g} s of {wall:#.4g} s loop"

@@ -91,6 +91,7 @@ from .exceptions import (CheckpointError, SilentCorruptionError,
                          SolverHealthError)
 from . import dcheckpoint
 from . import metrics as metrics_mod
+from . import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -654,22 +655,27 @@ class ResilientLoop:
                 iteration=int(solver.iteration),
                 sim_time=float(solver.sim_time))
         t0 = time.perf_counter()
-        if self.checkpoint_format == "sharded":
-            arrays, meta = self._sharded_state()
-            result = self._ensure_checkpointer().save(arrays, meta)
-        else:
-            handler = self._ensure_checkpoint_handler()
-            saved, handler.io_retry = handler.io_retry, None
-            try:
-                handler.process(
-                    iteration=int(solver.iteration),
-                    wall_time=time.time() - solver.start_time,
-                    sim_time=float(solver.sim_time),
-                    timestep=float(solver.dt)
-                    if solver.dt is not None else None)
-            finally:
-                handler.io_retry = saved
-            result = handler.current_file
+        # span duration == the stall this write holds the step loop for
+        # (async sharded: just the submit + any overrun-barrier wait)
+        with tracing.span("checkpoint/write",
+                          attrs={"format": self.checkpoint_format,
+                                 "iteration": int(solver.iteration)}):
+            if self.checkpoint_format == "sharded":
+                arrays, meta = self._sharded_state()
+                result = self._ensure_checkpointer().save(arrays, meta)
+            else:
+                handler = self._ensure_checkpoint_handler()
+                saved, handler.io_retry = handler.io_retry, None
+                try:
+                    handler.process(
+                        iteration=int(solver.iteration),
+                        wall_time=time.time() - solver.start_time,
+                        sim_time=float(solver.sim_time),
+                        timestep=float(solver.dt)
+                        if solver.dt is not None else None)
+                finally:
+                    handler.io_retry = saved
+                result = handler.current_file
         stall = time.perf_counter() - t0
         self.checkpoint_stall_sec += stall
         solver.metrics.inc("resilience/checkpoint_stall_sec", stall)

@@ -1,0 +1,115 @@
+"""
+bench.py provenance-window plumbing: every results.jsonl probe
+(`_recent_tpu_row`, `_recent_ensemble_row`, `_recent_serving_row`, and
+the attach helpers behind them) shares ONE measurement window —
+`[bench] STALE_WINDOW_SEC` through `_stale_window_sec()` and the single
+`_recent_row` scan loop — so the staleness rules can never drift apart
+helper by helper. Fast, pure-host tests (no JAX import, no benchmark
+runs): bench.py is imported from the repo root and pointed at fixture
+results files.
+"""
+
+import inspect
+import json
+import pathlib
+import sys
+import time
+
+import pytest
+
+REPO = pathlib.Path(__file__).parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def results(tmp_path, monkeypatch):
+    """Point bench.py's results.jsonl scan at a fixture file; returns a
+    writer that appends rows."""
+    (tmp_path / "benchmarks").mkdir()
+    path = tmp_path / "benchmarks" / "results.jsonl"
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+
+    def write(*rows):
+        with open(path, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    return write
+
+
+def test_stale_window_is_config_pinned():
+    """The window comes from [bench] STALE_WINDOW_SEC — one knob, not a
+    hardcoded constant per helper."""
+    from dedalus_tpu.tools.config import config
+    assert bench._stale_window_sec() == pytest.approx(
+        float(config.get("bench", "STALE_WINDOW_SEC")))
+    old = config.get("bench", "STALE_WINDOW_SEC")
+    try:
+        config.set("bench", "STALE_WINDOW_SEC", "60")
+        assert bench._stale_window_sec() == 60.0
+    finally:
+        config.set("bench", "STALE_WINDOW_SEC", old)
+
+
+def test_every_probe_defaults_to_the_shared_window():
+    """Pinning: each probe helper takes max_age_sec=None (= the shared
+    config window) — a helper growing its own hardcoded default breaks
+    this."""
+    for fn in (bench._recent_row, bench._recent_tpu_row,
+               bench._recent_ensemble_row, bench._recent_serving_row):
+        sig = inspect.signature(fn)
+        assert "max_age_sec" in sig.parameters, fn.__name__
+        assert sig.parameters["max_age_sec"].default is None, fn.__name__
+
+
+def test_recent_row_window_semantics(results):
+    now = time.time()
+    fresh = {"config": "x", "ts": now - 10, "value": "fresh"}
+    stale = {"config": "x", "ts": now - 30 * 86400.0, "value": "stale"}
+    results(fresh, stale)
+    pred = lambda row: row.get("config") == "x"  # noqa: E731
+    # default window: the stale row (outside [bench] STALE_WINDOW_SEC)
+    # is invisible even though it is the LATEST line in the file
+    assert bench._recent_row(pred)["value"] == "fresh"
+    # max_age_sec=0 disables the window (the stale-headline guard's
+    # unfiltered probe): the latest matching line wins
+    assert bench._recent_row(pred, max_age_sec=0)["value"] == "stale"
+    # explicit narrow window drops both
+    assert bench._recent_row(pred, max_age_sec=5) is None
+    # rows without ts never match (no provenance, no reuse)
+    results({"config": "y", "value": "no-ts"})
+    assert bench._recent_row(lambda r: r.get("config") == "y",
+                             max_age_sec=0) is None
+
+
+def test_recent_row_missing_file_and_junk(results):
+    assert bench._recent_row(lambda row: True) is None  # no file yet
+    with open(pathlib.Path(bench.__file__).parent / "benchmarks"
+              / "results.jsonl", "w") as f:
+        f.write("not json\n")
+    results({"config": "z", "ts": time.time()})
+    assert bench._recent_row(
+        lambda row: row.get("config") == "z") is not None
+
+
+def test_probe_helpers_share_the_scan(results):
+    """The typed probes route through _recent_row with their own
+    predicates: in-window rows of the right shape are found, out-of-
+    window twins are not."""
+    now = time.time()
+    results(
+        {"config": "rb256x64", "backend": "tpu", "finite": True,
+         "steps_per_sec": 5.0, "ts": now - 20},
+        {"config": "diffusion64_ensemble", "sweep": [{"members": 64}],
+         "speedup_n64": 30.0, "ts": now - 20},
+        # a stale serving row: must be invisible under the default window
+        {"config": "rb256x64_serving", "ttfs_speedup": 12.0,
+         "bit_identical_cold_warm": True, "ts": now - 30 * 86400.0},
+    )
+    assert bench._recent_tpu_row()["steps_per_sec"] == 5.0
+    assert bench._recent_ensemble_row(
+        "diffusion64_ensemble")["speedup_n64"] == 30.0
+    assert bench._recent_serving_row("rb256x64_serving") is None
+    assert bench._recent_serving_row("rb256x64_serving",
+                                     max_age_sec=0) is not None

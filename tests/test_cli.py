@@ -184,3 +184,121 @@ def test_probe_strips_unknown_platform():
     backend, n = graft._probe_devices(env, timeout=90)
     assert backend == "cpu", n
     assert env["JAX_PLATFORMS"] == "cpu"
+
+
+def _trace_fixture(trace_id):
+    spans = [
+        {"trace_id": trace_id, "span_id": 1, "parent_id": None,
+         "name": "request", "t0": 100.0, "dur_sec": 0.5, "tid": 1,
+         "attrs": {"outcome": "ok", "plan": {"plan_version": 1}}},
+        {"trace_id": trace_id, "span_id": 2, "parent_id": 1,
+         "name": "queue", "t0": 100.05, "dur_sec": 0.01, "tid": 1},
+        {"trace_id": trace_id, "span_id": 3, "parent_id": 1,
+         "name": "run", "t0": 100.1, "dur_sec": 0.4, "tid": 2},
+    ]
+    return {"kind": "trace", "trace_id": trace_id, "ts": 1.0,
+            "spans": spans}
+
+
+def test_trace_subcommand(tmp_path):
+    """`trace` renders span trees, filters by id prefix, summarizes, and
+    exports valid Chrome trace-event JSON."""
+    fixture = tmp_path / "served.jsonl"
+    rows = [_trace_fixture("aaaa000011112222"),
+            _trace_fixture("bbbb000011112222"),
+            {"kind": "step_metrics", "iterations": 5}]   # ignored
+    fixture.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    proc = _run_cli(["trace", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    assert "trace aaaa000011112222" in proc.stdout
+    assert "trace bbbb000011112222" in proc.stdout
+    assert "request" in proc.stdout and "queue" in proc.stdout
+
+    proc = _run_cli(["trace", str(fixture), "--trace-id", "bbbb",
+                     "--summary"])
+    assert proc.returncode == 0, proc.stderr
+    assert "aaaa" not in proc.stdout
+    assert "root request 500.000 ms, 3 spans" in proc.stdout
+
+    out = tmp_path / "chrome.json"
+    proc = _run_cli(["trace", str(fixture), "--last", "1", "--chrome",
+                     str(out)])
+    assert proc.returncode == 0, proc.stderr
+    assert "wrote 1 trace(s), 3 span(s)" in proc.stdout
+    doc = json.loads(out.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    assert len(doc["traceEvents"]) == 3
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X" and ev["ts"] > 0
+    root = next(ev for ev in doc["traceEvents"] if ev["name"] == "request")
+    assert root["args"]["trace_id"] == "bbbb000011112222"
+
+
+def test_trace_subcommand_errors(tmp_path):
+    proc = _run_cli(["trace", "/nonexistent/traces.jsonl"])
+    assert proc.returncode == 1
+    assert "cannot read" in proc.stderr
+    fixture = tmp_path / "t.jsonl"
+    fixture.write_text(json.dumps(_trace_fixture("aaaa")) + "\n")
+    proc = _run_cli(["trace", str(fixture), "--trace-id", "zzzz"])
+    assert proc.returncode == 1
+    assert "no matching" in proc.stderr
+
+
+def test_report_plan_provenance_and_backfill(tmp_path):
+    """Report renders resolved plan provenance on stamped rows and the
+    literal `plan=unversioned` on pre-provenance rows (the backfill
+    guard: absence is explicit, never faked or crashed on)."""
+    fixture = tmp_path / "mixed.jsonl"
+    plan = {"plan_version": 1,
+            "fusion": {"solve": True, "matvec": True, "transforms": False,
+                       "donate": True, "pallas": False},
+            "solve_composition": "sequential", "solve_dtype": "native",
+            "spike_chunks": 0, "transpose_chunks": 2,
+            "solver_key": "f760738c9e28c192"}
+    rows = [
+        {"kind": "step_metrics", "iterations": 5, "plan": plan},
+        # a pre-PR-16 row: no plan block at all
+        {"kind": "step_metrics", "iterations": 7},
+        # bench-style row with provenance
+        {"config": "rb256x64_tracing", "overhead_frac": 0.004,
+         "plan": plan, "ts": 2.0},
+    ]
+    fixture.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert out.count("plan[v1]: fusion=solve+matvec+donate, "
+                     "solve=sequential/native, spike=0, chunks=2, "
+                     "key=f760738c9e28c192") == 2
+    assert out.count("plan=unversioned") == 1
+
+
+def test_report_service_stats_error_codes(tmp_path):
+    """The service_stats faults block's per-error-code counters render as
+    a census line; uptime rides the header line."""
+    fixture = tmp_path / "stats.jsonl"
+    record = {"kind": "service_stats", "requests_served": 9, "errors": 3,
+              "uptime_sec": 42.5,
+              "pool": {"hits": 5, "misses": 4, "evictions": 1,
+                       "entries": []},
+              "faults": {"shed": 2, "error_codes": {"overloaded": 2,
+                                                    "bad-spec": 1}}}
+    fixture.write_text(json.dumps(record) + "\n")
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    assert "uptime 42.5s" in proc.stdout
+    assert "error codes: 1 bad-spec, 2 overloaded" in proc.stdout
+
+
+def test_report_trace_record_line(tmp_path):
+    """`kind: trace` records in a telemetry file get a one-line summary
+    pointing at the `trace` subcommand."""
+    fixture = tmp_path / "served.jsonl"
+    fixture.write_text(json.dumps(_trace_fixture("cccc000011112222"))
+                       + "\n")
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    assert "(trace) cccc000011112222: root request 500.0 ms, 3 spans" \
+        in proc.stdout

@@ -292,3 +292,89 @@ print("UNREACHABLE")   # the redelivered SIGINT raises KeyboardInterrupt
     assert len(records) == 1
     assert records[0]["flush_source"] == f"signal:{2}"
     assert records[0]["iterations"] == 3
+
+
+def test_format_phase_table_zero_samples():
+    """A record with no samples (flushed before the first cadence fire)
+    renders a complete table of zeros — header, every decomposition row,
+    and the sum line — without dividing by the zero wall."""
+    from dedalus_tpu.tools.metrics import format_phase_table
+    lines = format_phase_table({"phase_samples": 0, "iterations": 0,
+                                "loop_wall_sec": 0.0})
+    assert lines[0].startswith("Per-phase wall time (0 samples")
+    text = "\n".join(lines)
+    for phase in ("transform", "matsolve", "transpose", "evaluator"):
+        assert phase in text
+    assert "0 iterations" in text
+    # empty/None records render to nothing rather than raising
+    assert format_phase_table({}) == []
+    assert format_phase_table(None) == []
+
+
+def test_format_phase_table_overlap_split_only():
+    """A record carrying ONLY the transpose exposed/overlapped split
+    (benchmarks/scaling.py feeds it without the in-loop sampler rows)
+    renders the split line with its hidden-fraction, excluded from the
+    phase sum."""
+    from dedalus_tpu.tools.metrics import format_phase_table
+    lines = format_phase_table({
+        "phase_samples": 0, "iterations": 10, "loop_wall_sec": 1.0,
+        "phase_total_sec": {"transpose_exposed": 0.25,
+                            "transpose_overlapped": 0.75}})
+    text = "\n".join(lines)
+    assert "exposed 0.2500 s" in text
+    assert "overlapped 0.7500 s" in text
+    assert "(75% hidden" in text
+    assert "excluded from sum" in text
+    # the decomposition sum stays zero: the split rows never enter it
+    assert "sum        0.000 s" in text
+
+
+def test_format_phase_table_percentile_columns():
+    """Records carrying phase_pct_sec grow p50/p95/p99 tail columns on
+    exactly the phases that have them; pre-percentile records render the
+    plain row unchanged."""
+    from dedalus_tpu.tools.metrics import format_phase_table
+    rec = {
+        "phase_samples": 8, "iterations": 40, "loop_wall_sec": 4.0,
+        "sample_cadence": 5,
+        "phase_mean_sec": {"transform": 0.01, "matsolve": 0.02},
+        "phase_total_sec": {"transform": 0.4, "matsolve": 0.8},
+        "phase_pct_sec": {"matsolve": {"p50": 0.019, "p95": 0.03,
+                                       "p99": 0.05}},
+    }
+    lines = format_phase_table(rec)
+    mat = next(ln for ln in lines if ln.strip().startswith("matsolve"))
+    assert "p50/p95/p99" in mat
+    assert "0.0190/0.0300/0.0500 s" in mat
+    tra = next(ln for ln in lines if ln.strip().startswith("transform"))
+    assert "p50" not in tra               # no histogram, no column
+
+
+def test_phase_timer_feeds_histograms():
+    """Every add() lands in the per-phase LogHistogram (always-on,
+    independent of tracing) and percentiles() reads back ordered tails;
+    phases without samples report None."""
+    t = PhaseTimer()
+    for sec in (0.01, 0.011, 0.012, 0.1):
+        t.add("matsolve", sec)
+    pct = t.percentiles("matsolve")
+    assert pct["p50"] <= pct["p95"] <= pct["p99"]
+    assert 0.005 <= pct["p50"] <= 0.02
+    assert t.percentiles("transpose") is None
+
+
+def test_flush_carries_phase_percentiles(tmp_path):
+    """Flushed records carry phase_pct_sec for sampled phases — the
+    serving tier's tail telemetry — alongside the means."""
+    m = Metrics(sample_cadence=1, sink=str(tmp_path / "m.jsonl"))
+    m.observe_steps(3)
+    for _ in range(3):
+        m.add_phase_sample({"transform": 0.01, "matsolve": 0.02,
+                            "transpose": 0.0, "evaluator": 0.005})
+    rec = m.flush()
+    assert "matsolve" in rec["phase_pct_sec"]
+    p = rec["phase_pct_sec"]["matsolve"]
+    assert set(p) == {"p50", "p95", "p99"}
+    assert p["p50"] <= p["p99"]
+    assert p["p50"] == pytest.approx(0.02, rel=0.25)

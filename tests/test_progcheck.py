@@ -16,6 +16,8 @@ Two layers of proof:
     contracts look, not that they cannot see.
 """
 
+import hashlib
+import re
 from functools import partial
 
 import numpy as np
@@ -45,9 +47,12 @@ needs_8 = pytest.mark.skipif(N_DEV < 8, reason="needs >= 8 devices")
 # the tier-1 subset: every contract exercised on at least one REAL
 # program, the expensive banded-RB builds left to the full CLI census
 # (tau_step_ascan is the fast DTP106 anchor: a small banded build whose
-# lowered step must carry no sequential substitution scan)
+# lowered step must carry no sequential substitution scan; traced_step
+# is the DTP107 anchor: the same step lowered with tracing on must hash
+# to the untraced build)
 FAST_SUBSET = ["diffusion_step", "sharded_step_1d", "chunked_walk_1d",
-               "fleet_2d", "adjoint_grad", "pool_step", "tau_step_ascan"]
+               "fleet_2d", "adjoint_grad", "pool_step", "tau_step_ascan",
+               "traced_step"]
 
 
 def _rules_fired(findings):
@@ -86,7 +91,7 @@ def test_census_breadth(fast_report):
     assert set(rows) == {"diffusion_step", "sharded_step_1d",
                          "chunked_walk_to_grid", "chunked_walk_to_coeff",
                          "fleet_2d", "adjoint_grad", "pool_step",
-                         "tau_step_ascan"}
+                         "tau_step_ascan", "traced_step"}
     # collective placement facts the weak-scaling/fusion claims rest on
     assert rows["sharded_step_1d"]["collectives"]["all-to-all"] >= 2
     assert rows["sharded_step_1d"]["collectives"]["all-gather"] == 0
@@ -102,6 +107,10 @@ def test_census_breadth(fast_report):
     assert ascan["fused_solve"] is True
     assert ascan["while_loops"] == 0
     assert max(ascan["scan_lengths"], default=0) <= ascan["max_scan_length"]
+    # the tracing-inert anchor: the census carried the untraced build's
+    # hash, and head-clean above means the traced build matched it
+    traced = rows["traced_step"]
+    assert re.fullmatch(r"[0-9a-f]{64}", traced["untraced_sha256"])
     # per-contract timings recorded for every registered contract
     assert set(fast_report["timings"]["contracts"]) == set(CONTRACTS)
 
@@ -115,7 +124,8 @@ def test_full_census_names_cover_required_shapes():
                      "sharded_step_1d", "chunked_walk_1d",
                      "chunked_walk_2dmesh", "fleet_2d",
                      "ensemble_fleet_1d", "adjoint_grad", "pool_step",
-                     "tau_step_ascan", "rb_step_spike", "rb_step_ladder"):
+                     "tau_step_ascan", "rb_step_spike", "rb_step_ladder",
+                     "traced_step"):
         assert required in names
     fast = progcheck.census_names(fast_only=True)
     assert "rb_step_fused" not in fast and "rb_step_unfused" not in fast
@@ -143,6 +153,34 @@ def test_seeded_dropped_donation():
                               meta={"donated": 2}, donate_argnums=(0, 1))
     assert donated_alias_count(honored.compiled_text) == 2
     findings, _, _ = check_records([honored])
+    assert findings == []
+
+
+def test_seeded_tracing_divergence():
+    """A program whose tracing-enabled build hashes differently from its
+    declared untraced build (instrumentation leaked into the lowered
+    computation) produces a named DTP107 finding; a matching hash — and
+    a record with no declared hash — pass."""
+    args = (jnp.ones((8, 8)),)
+
+    def body(a):
+        return a * 2.0
+
+    rec = record_from_jit("seed_traced_match", body, args)
+    rec.meta["untraced_sha256"] = hashlib.sha256(
+        rec.compiled_text.encode()).hexdigest()
+    findings, _, _ = check_records([rec])
+    assert findings == []
+
+    diverged = record_from_jit("seed_traced_diverged", body, args)
+    diverged.meta["untraced_sha256"] = hashlib.sha256(
+        (diverged.compiled_text + "x").encode()).hexdigest()
+    findings, _, _ = check_records([diverged])
+    assert _rules_fired(findings) == ["DTP107"]
+    assert "instrumentation has leaked" in findings[0].message
+
+    undeclared = record_from_jit("seed_traced_undeclared", body, args)
+    findings, _, _ = check_records([undeclared])
     assert findings == []
 
 

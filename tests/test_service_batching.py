@@ -506,3 +506,72 @@ def test_occupancy_telemetry_and_report(daemon):
     assert proc.returncode == 0, proc.stderr
     assert "batching:" in proc.stdout
     assert "batch=batch-" in proc.stdout
+
+
+# ------------------------------------------------- end-to-end trace
+
+def test_batched_request_exports_one_linked_trace(tmp_path):
+    """The observability acceptance bar: one request served through the
+    --batch daemon produces exactly ONE exported trace record linking
+    accept -> queue -> pool acquire -> batch seat -> >= 1 batch block ->
+    result send, every span sharing the request's trace_id (joined to
+    the step record by `serving.trace_id`), the resolved plan stamped on
+    the root, and the Chrome export structurally valid trace-event
+    JSON."""
+    from dedalus_tpu.tools import tracing
+    sink = tmp_path / "served.jsonl"
+    was_on = tracing.enabled()
+    old_sink = tracing.trace_sink()
+    try:
+        # trace_file="" = bare `serve --trace`: records ride the sink
+        with batch_service(sink=str(sink), trace_file="") as svc:
+            client = ServiceClient(port=svc.port, timeout=300)
+            result = client.run(DIFF, ics=diff_ics(), dt=DT,
+                                stop_iteration=STEPS)
+        assert result.result["stopped_by"] == "completed"
+        trace_id = result.record["serving"]["trace_id"]
+        assert trace_id
+    finally:
+        tracing.disable()
+        tracing._sink = old_sink
+        if was_on:
+            tracing.enable()
+
+    records = tracing.load_trace_records(str(sink))
+    mine = [r for r in records if r["trace_id"] == trace_id]
+    assert len(mine) == 1, \
+        f"expected ONE trace for the request, got {len(mine)}"
+    rec = mine[0]
+    spans = rec["spans"]
+    assert all(s["trace_id"] == trace_id for s in spans)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    for required in ("request", "accept", "queue", "pool_acquire",
+                     "batch/seat", "batch/block", "result_send"):
+        assert required in by_name, \
+            f"span {required!r} missing from the request trace"
+    assert len(by_name["batch/block"]) >= 1
+    # lifecycle linkage: every non-root span parents (transitively)
+    # under the request root
+    root = by_name["request"][0]
+    assert root["parent_id"] is None
+    ids = {s["span_id"]: s for s in spans}
+    for s in spans:
+        node = s
+        for _ in range(len(spans)):
+            if node["parent_id"] is None:
+                break
+            node = ids[node["parent_id"]]
+        assert node["span_id"] == root["span_id"], \
+            f"span {s['name']!r} not linked under the request root"
+    # provenance rides the root span
+    assert root["attrs"]["plan"]["plan_version"] == 1
+    # Chrome export validity (loadable in Perfetto / chrome://tracing)
+    doc = tracing.chrome_trace_from_records([rec])
+    doc = json.loads(json.dumps(doc))
+    assert doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], float) and ev["dur"] >= 0
+        assert "trace_id" in ev["args"]

@@ -1,0 +1,219 @@
+"""
+Request tracing (tools/tracing.py): log-histogram percentile semantics,
+span-tree construction and cross-thread propagation, the disabled-path
+zero-cost contract, flush/load round-trip, and Chrome trace-event export
+validity. The end-to-end served-request trace structure is asserted in
+tests/test_service_batching.py; the compiled-program inertness contract
+(DTP107) in tests/test_progcheck.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from dedalus_tpu.tools import tracing
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Tracing enabled with a tmp sink, ring cleared; global state
+    (enabled flag, sink path) restored afterwards so no other test sees
+    this one's spans."""
+    was_on = tracing.enabled()
+    old_sink = tracing.trace_sink()
+    sink = tmp_path / "traces.jsonl"
+    tracing.enable(str(sink))
+    tracing.recorder().clear()
+    yield sink
+    tracing.disable()
+    tracing._sink = old_sink
+    tracing.recorder().clear()
+    if was_on:
+        tracing.enable()
+
+
+# ------------------------------------------------------------- histogram
+
+def test_histogram_empty_and_single():
+    h = tracing.LogHistogram()
+    assert h.percentile(50) == 0.0
+    assert h.summary() == {"count": 0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    h.add(0.25)
+    # a one-sample histogram is clamped to its own min/max: every
+    # percentile IS the sample
+    for q in (1, 50, 99):
+        assert h.percentile(q) == pytest.approx(0.25)
+
+
+def test_histogram_percentiles_ordered_and_bounded():
+    h = tracing.LogHistogram()
+    values = [0.001] * 90 + [0.1] * 9 + [5.0]
+    for v in values:
+        h.add(v)
+    s = h.summary()
+    assert s["count"] == 100
+    assert s["p50"] <= s["p95"] <= s["p99"]
+    # p50 lands in the bulk, p99 in the tail; bucket midpoint error is
+    # bounded by one geometric bucket (~19%)
+    assert s["p50"] == pytest.approx(0.001, rel=0.25)
+    assert s["p99"] >= 0.05
+    assert h.min == 0.001 and h.max == 5.0
+    assert h.sum == pytest.approx(sum(values))
+    # percentiles never leave the observed range
+    assert h.percentile(100) <= 5.0
+    assert h.percentile(0) >= 0.001
+
+
+def test_histogram_degenerate_samples():
+    h = tracing.LogHistogram()
+    h.add(0.0)
+    h.add(-1.0)          # clock skew / subtraction noise: bucket 0
+    assert h.total == 2
+    assert h.percentile(99) <= 1e-9 or h.percentile(99) == h.max
+
+
+# ------------------------------------------------------------ span trees
+
+def test_disabled_span_is_shared_noop():
+    assert not tracing.enabled()
+    s1 = tracing.span("a")
+    s2 = tracing.span("b", attrs={"x": 1})
+    assert s1 is s2                       # shared singleton: no per-call cost
+    with s1 as inner:
+        assert inner.set(y=2) is inner    # attrs accepted and dropped
+    assert tracing.new_trace("t") is None
+    assert tracing.add_span("c", 0.1) is None
+    with tracing.resume(None):
+        assert tracing.current_context() is None
+
+
+def test_nested_spans_share_trace_and_parent(traced):
+    with tracing.span("outer") as outer:
+        with tracing.span("inner", attrs={"k": "v"}):
+            pass
+    spans = tracing.recorder().spans()
+    assert len(spans) == 2
+    inner, outer_s = sorted(spans, key=lambda s: s.name != "inner")
+    assert inner.trace_id == outer_s.trace_id
+    assert inner.parent_id == outer_s.span_id
+    assert outer_s.parent_id is None      # orphan root: its own trace
+    assert inner.attrs == {"k": "v"}
+    assert inner.dur >= 0.0
+
+
+def test_context_resume_across_threads(traced):
+    ctx = tracing.new_trace("request", attrs={"id": "r1"})
+    assert ctx is not None
+    tracing.add_span("accept", 0.01, parent=ctx)
+
+    def worker():
+        with tracing.resume(ctx):
+            with tracing.span("run"):
+                with tracing.span("phase/matsolve"):
+                    pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    root = ctx.finish(outcome="ok")
+    assert root is not None and root.span_id == ctx.root_id
+    spans = tracing.recorder().spans(ctx.trace_id)
+    by_name = {s.name: s for s in spans}
+    assert set(by_name) == {"request", "accept", "run", "phase/matsolve"}
+    assert by_name["accept"].parent_id == ctx.root_id
+    assert by_name["run"].parent_id == ctx.root_id
+    assert by_name["phase/matsolve"].parent_id == by_name["run"].span_id
+    assert len({s.trace_id for s in spans}) == 1
+    # finish is idempotent
+    assert ctx.finish() is None
+
+
+def test_ring_bounded(traced):
+    rec = tracing.TraceRecorder(capacity=16)
+    for i in range(100):
+        rec.record(tracing.Span("t", i, None, f"s{i}", 0.0, 0.0))
+    spans = rec.spans()
+    assert len(spans) == 16
+    assert spans[0].name == "s84"         # oldest evicted first
+
+
+# ------------------------------------------------------- flush and export
+
+def _one_trace(sink):
+    ctx = tracing.new_trace("request", attrs={"id": "r1"})
+    with tracing.resume(ctx):
+        with tracing.span("run"):
+            pass
+    ctx.finish(outcome="ok")
+    return ctx
+
+
+def test_flush_pops_and_appends(traced):
+    ctx = _one_trace(traced)
+    rec = tracing.flush_trace(ctx.trace_id, plan={"plan_version": 1})
+    assert rec["kind"] == "trace"
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["plan"] == {"plan_version": 1}
+    assert {s["name"] for s in rec["spans"]} == {"request", "run"}
+    # pop semantics: the ring no longer holds the trace, a second flush
+    # is a no-op (flush-once for the JSONL sink)
+    assert tracing.recorder().spans(ctx.trace_id) == []
+    assert tracing.flush_trace(ctx.trace_id) is None
+    assert tracing.flush_trace(None) is None
+    loaded = tracing.load_trace_records(str(traced))
+    assert len(loaded) == 1
+    assert loaded[0]["trace_id"] == ctx.trace_id
+
+
+def test_summarize_and_tree(traced):
+    ctx = _one_trace(traced)
+    rec = tracing.flush_trace(ctx.trace_id)
+    s = tracing.summarize_trace(rec)
+    assert s["root"] == "request"
+    assert s["spans"] == 2
+    assert s["root_attrs"]["outcome"] == "ok"
+    assert set(s["by_name"]) == {"request", "run"}
+    lines = tracing.format_trace_tree(rec)
+    assert ctx.trace_id in lines[0]
+    text = "\n".join(lines)
+    assert "request" in text and "run" in text
+    # the child renders deeper than the root
+    req = next(ln for ln in lines if "request" in ln and "trace" not in ln)
+    run = next(ln for ln in lines if ln.strip().startswith("run"))
+    assert len(run) - len(run.lstrip()) > len(req) - len(req.lstrip())
+
+
+def _assert_valid_chrome(doc):
+    json.loads(json.dumps(doc))          # JSON-serializable throughout
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], float) and isinstance(ev["dur"], float)
+        assert ev["ts"] > 0 and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert ev["name"] and ev["cat"] == "dedalus"
+        assert "trace_id" in ev["args"] and "span_id" in ev["args"]
+
+
+def test_chrome_export_valid(traced):
+    ctx = _one_trace(traced)
+    spans = tracing.recorder().spans(ctx.trace_id)
+    _assert_valid_chrome(tracing.chrome_trace(spans))
+    rec = tracing.flush_trace(ctx.trace_id)
+    doc = tracing.chrome_trace_from_records([rec])
+    _assert_valid_chrome(doc)
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert names == {"request", "run"}
+    # parent linkage survives the round-trip
+    child = next(ev for ev in doc["traceEvents"] if ev["name"] == "run")
+    assert child["args"]["parent_id"] == ctx.root_id
+
+
+def test_flush_never_raises_on_bad_sink(traced):
+    ctx = _one_trace(traced)
+    rec = tracing.flush_trace(ctx.trace_id, sink="/dev/null/not/a/dir/x.jsonl")
+    # telemetry must never kill a request: the unwritable sink is
+    # swallowed (record may be None or returned ringless, but no raise)
+    assert rec is None or rec["trace_id"] == ctx.trace_id
