@@ -147,6 +147,7 @@ def report(args):
     if args.last is not None:
         records = records[-args.last:] if args.last > 0 else []
     n_metrics = n_post = n_other = 0
+    prev_ledger = {}      # (program, backend) -> previous ledger row
     for record in records:
         kind = record.get("kind")
         if kind == "step_metrics":
@@ -356,6 +357,43 @@ def report(args):
                   f"(limit {record.get('watchdog_sec', '?')}s) at "
                   f"iter={record.get('iteration', '?')}, "
                   f"{len(stacks)} thread stack(s) recorded")
+        elif kind == "ledger":
+            # resource-ledger rows (tools/lint/progcheck.py cost tier):
+            # one line per census program with deltas against the
+            # previous round of the same (program, backend) series, so
+            # compile-cost creep reads off the report directly
+            n_other += 1
+            program = record.get("program") or "?"
+            series = (program, record.get("backend"))
+            prev = prev_ledger.get(series) or {}
+            prev_ledger[series] = record
+            if record.get("ledger_version") is None:
+                # a row written before the cost tier versioned its
+                # fields must render, not crash (mirrors the
+                # plan=unversioned backfill rule)
+                print(f"(ledger) {program}: ledger=unversioned")
+                continue
+            cells = []
+            for key, label in (("flops", "flops"),
+                               ("bytes_accessed", "bytes"),
+                               ("peak_bytes", "peak_mem"),
+                               ("hlo_instructions", "hlo"),
+                               ("scan_max_length", "scan_depth")):
+                value = record.get(key)
+                if value is None:
+                    continue
+                cell = f"{label}={value:,}" if isinstance(value, int) \
+                    else f"{label}={value}"
+                before = prev.get(key)
+                if isinstance(before, (int, float)) \
+                        and not isinstance(before, bool) and before:
+                    delta = 100.0 * (value - before) / before
+                    cell += f" ({delta:+.1f}%)"
+                cells.append(cell)
+            print(f"(ledger) {program} "
+                  f"[{record.get('backend') or '?'}]: "
+                  + (", ".join(cells) or "no cost data"))
+            print(f"    {_format_plan(record)}")
         else:
             n_other += 1
             ident = record.get("metric") or record.get("config") or "record"
@@ -543,6 +581,18 @@ def report(args):
                              f"{record['max_queued_observed']}"
                              f"/{record.get('queue_depth', '?')}")
                 print(line)
+    # perf-trajectory trend table (tools/perfwatch.py): only series with
+    # enough history to analyze render, so short fixture files and fresh
+    # sinks add nothing here
+    try:
+        from .tools import perfwatch
+        trends = perfwatch.trend_lines(records)
+    except Exception:
+        trends = []
+    if trends:
+        print("perfwatch trends:")
+        for tline in trends:
+            print(f"    {tline}")
     print(f"{n_metrics} metrics record(s), {n_other} other, "
           f"{n_post} postmortem, {n_bad} unparsable")
     if n_metrics == 0 and n_other == 0 and n_post == 0:
@@ -614,6 +664,14 @@ def lint(argv):
     sys.exit(lint_main(argv))
 
 
+def perfwatch(argv):
+    """Perf-trajectory regression sentinel (tools/perfwatch.py): noise-
+    banded trend analysis over benchmarks/results.jsonl; `--check` exits
+    nonzero on an unwaived regression."""
+    from .tools.perfwatch import main as perfwatch_main
+    sys.exit(perfwatch_main(argv))
+
+
 def serve(argv):
     """Warm-pool solver daemon (dedalus_tpu/service/server.py)."""
     from .service.server import main as serve_main
@@ -630,7 +688,8 @@ def submit(argv):
 # argparse parser, including --help): dispatched BEFORE the top-level
 # parser sees the argv tail — argparse's REMAINDER does not reliably
 # capture leading options like `--help`, so forwarding must bypass it.
-PASSTHROUGH = {"lint": lint, "serve": serve, "submit": submit}
+PASSTHROUGH = {"lint": lint, "perfwatch": perfwatch, "serve": serve,
+               "submit": submit}
 
 
 def build_parser():
@@ -682,6 +741,9 @@ def build_parser():
     for name, helptext in (
             ("lint", "static analysis (DTL AST rules; DTP program "
                      "contracts via --programs); see `lint --help`"),
+            ("perfwatch", "perf-trajectory regression sentinel over "
+                          "benchmarks/results.jsonl; see "
+                          "`perfwatch --help`"),
             ("serve", "warm-pool solver daemon (docs/serving.md); "
                       "see `serve --help`"),
             ("submit", "submit one run to a serve daemon; "

@@ -207,6 +207,32 @@ class ServiceClient:
     def stats(self):
         return self._simple({"kind": "stats"}, "stats")
 
+    def stats_prom(self):
+        """The daemon's stats surface as Prometheus text exposition
+        (str). Cannot ride `_simple`, which discards the payload frame
+        the text arrives in."""
+        def attempt():
+            conn, rfile, wfile = self._connect()
+            try:
+                protocol.send_frame(wfile, {"kind": "stats",
+                                            "prom": True})
+                header, payload = protocol.recv_frame(rfile)
+                if header is None:
+                    raise ServiceError("closed",
+                                       "daemon closed the connection")
+                if header.get("kind") == "error":
+                    raise ServiceError(header.get("code", "error"),
+                                       header.get("message", ""),
+                                       frame=header)
+                if header.get("kind") != "stats":
+                    raise ServiceError(
+                        "protocol", f"expected 'stats' reply, got "
+                        f"{header.get('kind')!r}")
+                return (payload or b"").decode("utf-8")
+            finally:
+                conn.close()
+        return self._with_retries(attempt)
+
     def shutdown(self):
         """Ask the daemon to drain and exit (same path as SIGTERM).
         NEVER retried, whatever `retries` is set to: a shutdown whose
@@ -377,6 +403,10 @@ def build_parser():
                         help="just ping the daemon and exit")
     parser.add_argument("--stats", action="store_true",
                         help="print daemon/pool stats JSON and exit")
+    parser.add_argument("--prom", action="store_true",
+                        help="with --stats: print the stats surface in "
+                             "Prometheus text exposition format instead "
+                             "of JSON (same text GET /metrics serves)")
     parser.add_argument("--shutdown", action="store_true",
                         help="ask the daemon to drain and exit")
     return parser
@@ -421,7 +451,10 @@ def main(argv=None):
             print("pong")
             return 0
         if args.stats:
-            print(json.dumps(client.stats(), indent=2))
+            if args.prom:
+                sys.stdout.write(client.stats_prom())
+            else:
+                print(json.dumps(client.stats(), indent=2))
             return 0
         if args.shutdown:
             client.shutdown()

@@ -1,0 +1,393 @@
+"""
+Prometheus text-exposition rendering of the daemon's stats surface.
+
+`render_stats(stats, hists)` turns the `SolverService.stats()` dict —
+request/error counters, warm-pool occupancy, fault/breaker/queue state,
+continuous-batching occupancy, per-error-code counts — plus the
+daemon's LogHistograms (tools/tracing.py) into Prometheus text
+exposition format 0.0.4: the pull-side contract a replica router or any
+standard scraper consumes (`stats --prom` frame, or GET /metrics on
+`[service] METRICS_PORT`; docs/observability.md#scraping-the-daemon has
+the metric-name reference table).
+
+LogHistograms map to NATIVE Prometheus histograms, not summaries: the
+log-bucket upper bound `_LOG_FLOOR * _LOG_BASE**b` becomes the `le`
+label, counts are re-emitted cumulatively, `+Inf` carries the total and
+`_sum` the accumulated seconds — so `histogram_quantile()` works on the
+scrape exactly like `LogHistogram.percentile()` works in-process.
+
+`validate_exposition(text)` is the in-repo format validator (no
+external deps by policy): HELP/TYPE discipline, name/label/value
+syntax, duplicate sample detection, and histogram completeness
+(cumulative non-decreasing buckets, a `+Inf` bucket equal to `_count`,
+a `_sum` sample). Tests pin every rendered surface through it.
+"""
+
+import math
+import re
+
+from ..tools import tracing
+
+__all__ = ["render_stats", "render_histogram", "validate_exposition"]
+
+_PREFIX = "dedalus"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# one sample line: name{labels} value — labels optional, timestamp not
+# emitted by this module (and rejected lax-ly by the validator)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)(?:\s+(?P<ts>-?\d+))?$")
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"\s*(?:,|$)')
+
+
+def _fmt_value(value):
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "+Inf" if value > 0 else "-Inf"
+        return repr(value)
+    return None
+
+
+def _escape_label(value):
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class _Writer:
+    """Accumulates one exposition: HELP/TYPE header then samples, one
+    family at a time (the format requires family grouping)."""
+
+    def __init__(self):
+        self.lines = []
+
+    def family(self, name, mtype, help_text, samples):
+        """samples: [(labels dict or None, value), ...]; None values are
+        skipped (a stats field a build lacks simply is not exported)."""
+        rendered = []
+        for labels, value in samples:
+            text = _fmt_value(value)
+            if text is None:
+                continue
+            if labels:
+                body = ",".join(f'{k}="{_escape_label(v)}"'
+                                for k, v in sorted(labels.items()))
+                rendered.append(f"{name}{{{body}}} {text}")
+            else:
+                rendered.append(f"{name} {text}")
+        if not rendered:
+            return
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+        self.lines.extend(rendered)
+
+    def text(self):
+        return "\n".join(self.lines) + "\n" if self.lines else "\n"
+
+
+def _bucket_upper(bucket):
+    """Upper bound of LogHistogram bucket b (its `le` label): bucket 0
+    holds <= _LOG_FLOOR, bucket b holds (floor*base^(b-1), floor*base^b].
+    """
+    return tracing._LOG_FLOOR * tracing._LOG_BASE ** bucket
+
+
+def _hist_fields(hist):
+    """(counts, total, sum) off a LogHistogram or a snapshot dict of one
+    (the server snapshots under its counters lock; tests pass dicts)."""
+    if isinstance(hist, dict):
+        counts = hist.get("counts") or {}
+        return ({int(k): int(v) for k, v in counts.items()},
+                int(hist.get("total") or 0), float(hist.get("sum") or 0.0))
+    return (dict(hist.counts), hist.total, hist.sum)
+
+
+def render_histogram(writer, name, hist, help_text):
+    """One native Prometheus histogram family from a LogHistogram:
+    cumulative `_bucket{le=...}` samples at the log-bucket upper bounds,
+    `+Inf` = `_count` = total observations, `_sum` = accumulated
+    seconds. An empty histogram still renders (all-zero scrape targets
+    beat absent ones for rate() continuity)."""
+    counts, total, total_sum = _hist_fields(hist)
+    writer.lines.append(f"# HELP {name} {help_text}")
+    writer.lines.append(f"# TYPE {name} histogram")
+    seen = 0
+    for bucket in sorted(counts):
+        seen += counts[bucket]
+        le = _fmt_value(_bucket_upper(bucket))
+        writer.lines.append(f'{name}_bucket{{le="{le}"}} {seen}')
+    writer.lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+    writer.lines.append(f"{name}_sum {_fmt_value(float(total_sum))}")
+    writer.lines.append(f"{name}_count {total}")
+
+
+def render_stats(stats, hists=None):
+    """The whole exposition from one `SolverService.stats()` dict plus
+    optional {suffix: LogHistogram-or-snapshot} latency histograms."""
+    stats = stats or {}
+    pool = stats.get("pool") or {}
+    faults = stats.get("faults") or {}
+    breaker = faults.get("breaker") or {}
+    batching = (stats.get("serving") or {}).get("batching") or {}
+    w = _Writer()
+    p = _PREFIX
+
+    w.family(f"{p}_up", "gauge",
+             "1 while the daemon is serving.", [(None, 1)])
+    w.family(f"{p}_uptime_seconds", "gauge",
+             "Seconds since the daemon bound its socket.",
+             [(None, stats.get("uptime_sec"))])
+    w.family(f"{p}_draining", "gauge",
+             "1 once a graceful drain began (new work is refused).",
+             [(None, stats.get("draining") is not None)])
+    w.family(f"{p}_requests_served_total", "counter",
+             "Run requests completed successfully.",
+             [(None, stats.get("requests_served"))])
+    w.family(f"{p}_errors_total", "counter",
+             "Requests answered with a structured error frame.",
+             [(None, stats.get("errors"))])
+    w.family(f"{p}_errors_by_code_total", "counter",
+             "Structured error frames by protocol error code.",
+             [({"code": code}, count)
+              for code, count in sorted(
+                  (faults.get("error_codes") or {}).items())])
+
+    # ---- warm pool
+    w.family(f"{p}_pool_entries", "gauge",
+             "Warm solver entries currently pooled.",
+             [(None, len(pool.get("entries") or ())
+               if "entries" in pool else None)])
+    w.family(f"{p}_pool_capacity", "gauge",
+             "Configured warm-pool capacity.", [(None, pool.get("size"))])
+    w.family(f"{p}_pool_hits_total", "counter",
+             "Pool acquisitions served warm (hit or warm-cache).",
+             [(None, pool.get("hits"))])
+    w.family(f"{p}_pool_misses_total", "counter",
+             "Pool acquisitions that required a cold build.",
+             [(None, pool.get("misses"))])
+    w.family(f"{p}_pool_evictions_total", "counter",
+             "Pool entries evicted (LRU or memory watermark).",
+             [(None, pool.get("evictions"))])
+    w.family(f"{p}_pool_resets_total", "counter",
+             "Pooled solver state resets between requests.",
+             [(None, pool.get("resets"))])
+
+    # ---- admission / faults
+    w.family(f"{p}_queue_depth_limit", "gauge",
+             "Admission queue depth limit.",
+             [(None, faults.get("queue_depth"))])
+    w.family(f"{p}_queued_runs", "gauge",
+             "Run requests currently queued for the executor.",
+             [(None, faults.get("queued"))])
+    w.family(f"{p}_shed_total", "counter",
+             "Requests refused at admission (queue full).",
+             [(None, faults.get("shed"))])
+    w.family(f"{p}_deadline_exceeded_total", "counter",
+             "Requests dropped for exceeding their deadline.",
+             [(None, faults.get("deadline_exceeded"))])
+    w.family(f"{p}_watchdog_fires_total", "counter",
+             "Executor watchdog fires (wedged run abandoned).",
+             [(None, faults.get("watchdog_fires"))])
+    w.family(f"{p}_client_drops_total", "counter",
+             "Client connections lost mid-run.",
+             [(None, faults.get("client_drops"))])
+    w.family(f"{p}_mem_evictions_total", "counter",
+             "Warm entries evicted by the RSS watermark.",
+             [(None, faults.get("mem_evictions"))])
+    w.family(f"{p}_replays_total", "counter",
+             "Idempotent retries served from the result cache.",
+             [(None, faults.get("replays"))])
+    w.family(f"{p}_result_cache_entries", "gauge",
+             "Completed results held for idempotent replay.",
+             [(None, faults.get("result_cache"))])
+
+    # ---- circuit breaker
+    w.family(f"{p}_breaker_opens_total", "counter",
+             "Circuit-breaker opens (per-spec failure threshold hit).",
+             [(None, breaker.get("opens"))])
+    w.family(f"{p}_breaker_closes_total", "counter",
+             "Circuit-breaker closes after a cool-off probe succeeded.",
+             [(None, breaker.get("closes"))])
+    w.family(f"{p}_breaker_fastfails_total", "counter",
+             "Requests fast-failed by an open circuit.",
+             [(None, breaker.get("fastfails"))])
+    w.family(f"{p}_breaker_open_circuits", "gauge",
+             "Spec circuits currently open.",
+             [(None, len(breaker.get("open") or ())
+               if "open" in breaker else None)])
+
+    # ---- continuous batching occupancy
+    w.family(f"{p}_batching_enabled", "gauge",
+             "1 when the continuous batcher dispatches runs.",
+             [(None, bool(batching.get("enabled")))])
+    if batching.get("enabled"):
+        w.family(f"{p}_batch_capacity", "gauge",
+                 "Maximum members per fused batch.",
+                 [(None, batching.get("batch_max"))])
+        w.family(f"{p}_batch_peak_members", "gauge",
+                 "Peak members seated in one batch.",
+                 [(None, batching.get("peak_members"))])
+        w.family(f"{p}_batches_total", "counter",
+                 "Fused batches dispatched.",
+                 [(None, batching.get("batches"))])
+        w.family(f"{p}_batch_members_total", "counter",
+                 "Members seated across all batches.",
+                 [(None, batching.get("members"))])
+        w.family(f"{p}_batch_late_joins_total", "counter",
+                 "Members that joined a running batch at a boundary.",
+                 [(None, batching.get("late_joins"))])
+        w.family(f"{p}_batch_blocks_total", "counter",
+                 "Fixed-size step blocks executed by the batcher.",
+                 [(None, batching.get("blocks"))])
+        w.family(f"{p}_batch_detached_total", "counter",
+                 "Members detached from a batch, by cause.",
+                 [({"cause": cause}, count)
+                  for cause, count in sorted(
+                      (batching.get("detached") or {}).items())])
+
+    for suffix, (hist, help_text) in sorted((hists or {}).items()):
+        render_histogram(w, f"{p}_{suffix}", hist, help_text)
+    return w.text()
+
+
+# ------------------------------------------------------------- validation
+
+def _parse_value(text):
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    return float(text)   # raises ValueError on garbage
+
+
+def validate_exposition(text):
+    """Validate Prometheus text format 0.0.4. Raises ValueError on the
+    first violation; returns {family: {"type", "samples"}} on success.
+
+    Checked: HELP/TYPE syntax and one-TYPE-per-family discipline,
+    metric/label name grammar, label quoting/escapes, float-parsable
+    values, duplicate (name, labelset) samples, and — for every
+    `histogram` family — cumulative non-decreasing `le` buckets, a
+    mandatory `+Inf` bucket, and `_count` == the `+Inf` bucket with a
+    `_sum` present."""
+    families = {}      # family -> {"type": str|None, "samples": int}
+    samples_seen = set()
+    hist = {}          # family -> {"buckets": [(le, v)], "count": v,
+                       #            "sum": v}
+
+    def family_of(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                if families.get(base, {}).get("type") == "histogram":
+                    return base
+        return name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                raise ValueError(f"line {lineno}: malformed {parts[1]}")
+            _, keyword, name, rest = parts
+            if not _NAME_RE.match(name):
+                raise ValueError(f"line {lineno}: bad metric name {name!r}")
+            entry = families.setdefault(name,
+                                        {"type": None, "samples": 0})
+            if keyword == "TYPE":
+                if entry["type"] is not None:
+                    raise ValueError(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                if entry["samples"]:
+                    raise ValueError(
+                        f"line {lineno}: TYPE for {name} after samples")
+                if rest not in ("counter", "gauge", "histogram",
+                                "summary", "untyped"):
+                    raise ValueError(
+                        f"line {lineno}: unknown type {rest!r}")
+                entry["type"] = rest
+                if rest == "histogram":
+                    hist[name] = {"buckets": [], "count": None,
+                                  "sum": None}
+            continue
+        if line.startswith("#"):
+            continue                      # free-form comment
+        match = _SAMPLE_RE.match(line)
+        if not match:
+            raise ValueError(f"line {lineno}: unparsable sample {line!r}")
+        name = match.group("name")
+        labels = {}
+        raw = match.group("labels")
+        if raw is not None:
+            pos = 0
+            while pos < len(raw):
+                pair = _LABEL_PAIR_RE.match(raw, pos)
+                if not pair:
+                    raise ValueError(
+                        f"line {lineno}: bad labels {raw!r}")
+                key = pair.group("key")
+                if not _LABEL_RE.match(key):
+                    raise ValueError(
+                        f"line {lineno}: bad label name {key!r}")
+                if key in labels:
+                    raise ValueError(
+                        f"line {lineno}: duplicate label {key!r}")
+                labels[key] = pair.group("val")
+                pos = pair.end()
+        try:
+            value = _parse_value(match.group("value"))
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad value "
+                             f"{match.group('value')!r}")
+        sample_key = (name, tuple(sorted(labels.items())))
+        if sample_key in samples_seen:
+            raise ValueError(f"line {lineno}: duplicate sample {name} "
+                             f"{labels}")
+        samples_seen.add(sample_key)
+        base = family_of(name)
+        families.setdefault(base, {"type": None, "samples": 0})
+        families[base]["samples"] += 1
+        if base in hist:
+            if name == f"{base}_bucket":
+                if "le" not in labels:
+                    raise ValueError(
+                        f"line {lineno}: histogram bucket without le")
+                hist[base]["buckets"].append(
+                    (_parse_value(labels["le"]), value))
+            elif name == f"{base}_count":
+                hist[base]["count"] = value
+            elif name == f"{base}_sum":
+                hist[base]["sum"] = value
+            elif name == base:
+                raise ValueError(
+                    f"line {lineno}: bare sample for histogram {base}")
+
+    for base, data in hist.items():
+        buckets = data["buckets"]
+        if not buckets or not math.isinf(buckets[-1][0]):
+            raise ValueError(f"histogram {base}: missing +Inf bucket")
+        les = [le for le, _ in buckets]
+        if les != sorted(les):
+            raise ValueError(f"histogram {base}: le not increasing")
+        counts = [v for _, v in buckets]
+        if any(b > a for b, a in zip(counts, counts[1:])):
+            raise ValueError(f"histogram {base}: buckets not cumulative")
+        if data["count"] is None or data["sum"] is None:
+            raise ValueError(f"histogram {base}: missing _count/_sum")
+        if data["count"] != buckets[-1][1]:
+            raise ValueError(
+                f"histogram {base}: _count != +Inf bucket")
+    return families

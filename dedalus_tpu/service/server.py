@@ -104,6 +104,14 @@ __all__ = ["SolverService", "main"]
 # byte-dripper is still cut off in bounded time
 MIN_TRANSFER_BYTES_PER_SEC = 1 << 20
 
+# HELP strings for the latency histograms exported on the Prometheus
+# surface (prom_text / GET /metrics)
+_HIST_HELP = {
+    "run_seconds": "Executor wall seconds per dispatched run request.",
+    "queue_seconds": "Seconds a run request waited in the admission "
+                     "queue before dispatch.",
+}
+
 # run-header chaos keys a --chaos daemon accepts (tools/chaos.py
 # ChaosInjector constructor surface; test machinery, never production)
 _CHAOS_KEYS = frozenset({"seed", "nan_field", "nan_iteration",
@@ -149,7 +157,7 @@ class SolverService:
                  mem_watermark_mb=None, on_client_drop=None,
                  chaos_enabled=False, batching_enabled=None,
                  batch_max=None, batch_window=None, batch_block=None,
-                 trace_file=None):
+                 trace_file=None, metrics_port=None):
         self.host = host
         self.port = int(port)
         self.pool = SolverPool(size=pool_size, allow_imports=allow_imports)
@@ -218,6 +226,24 @@ class SolverService:
         # counters are bumped from reader threads, workers, the watchdog,
         # and the drain sweep concurrently; unguarded `+= 1` loses counts
         self._counters_lock = threading.Lock()
+        # latency histograms behind the Prometheus surface (service/
+        # promexport.py): fed under _counters_lock, snapshotted by
+        # prom_text() so a scrape never reads a half-updated bucket map
+        self.hists = {
+            "run_seconds": tracing.LogHistogram(),
+            "queue_seconds": tracing.LogHistogram(),
+        }
+        # /metrics listener port: None pulls [service] METRICS_PORT,
+        # where 0 means disabled; an EXPLICIT 0 binds an ephemeral port
+        # (tests read the bound port back off `metrics_port` after start)
+        if metrics_port is None:
+            configured = int(float(cfg_get("service", "METRICS_PORT",
+                                           "0")))
+            self.metrics_port = configured if configured > 0 else None
+        else:
+            self.metrics_port = (int(metrics_port)
+                                 if int(metrics_port) >= 0 else None)
+        self._metrics_server = None
         self.started_ts = None
         # the queue object is unbounded; admission is bounded by the
         # _queued_runs counter so the drain sentinel can never block on
@@ -287,6 +313,7 @@ class SolverService:
         self.started_ts = time.time()
         self._start_worker()
         self._watchdog.start()
+        self._start_metrics_server()
         import os
         banner = {"kind": "ready", "port": self.port, "pid": os.getpid(),
                   "pool_size": self.pool.size}
@@ -309,6 +336,7 @@ class SolverService:
         finally:
             self._sock.close()
             self._watchdog.stop()
+            self._stop_metrics_server()
             self._queue.put(None)           # worker stop sentinel
             worker = self._worker_thread
             if worker is not None:
@@ -339,6 +367,67 @@ class SolverService:
         """Append one record to the telemetry sink (no-op when sinkless)."""
         if self.sink:
             metrics_mod.Metrics(sink=self.sink, enabled=True).emit(record)
+
+    def prom_text(self):
+        """The daemon's stats surface as Prometheus text exposition
+        0.0.4 (service/promexport.py): counters, occupancy gauges,
+        per-error-code counters, and the run/queue latency LogHistograms
+        as native Prometheus histograms. Served by the `stats` frame
+        with `prom: true` and by GET /metrics on the [service]
+        METRICS_PORT listener."""
+        from . import promexport
+        with self._counters_lock:
+            hists = {
+                name: ({"counts": dict(h.counts), "total": h.total,
+                        "sum": h.sum}, _HIST_HELP[name])
+                for name, h in self.hists.items()
+            }
+        return promexport.render_stats(self.stats(), hists)
+
+    def _start_metrics_server(self):
+        """Bind the plaintext GET /metrics listener when configured
+        (`[service] METRICS_PORT` > 0, `--metrics-port`, or an explicit
+        ephemeral 0 from tests). Serves scrapes on daemon threads so a
+        slow scraper can never wedge the request loop; everything else
+        404s."""
+        if self.metrics_port is None:
+            return
+        import http.server
+        service = self
+
+        class MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0].rstrip("/") not in (
+                        "", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = service.prom_text().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass      # scrapes every few seconds would flood the log
+
+        server = http.server.ThreadingHTTPServer(
+            (self.host, self.metrics_port), MetricsHandler)
+        server.daemon_threads = True
+        self.metrics_port = server.server_address[1]
+        self._metrics_server = server
+        threading.Thread(target=server.serve_forever,
+                         name="service-metrics", daemon=True).start()
+        logger.info(f"service: /metrics listening on "
+                    f"{self.host}:{self.metrics_port}")
+
+    def _stop_metrics_server(self):
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
 
     def stats(self):
         return {
@@ -417,8 +506,16 @@ class SolverService:
             if kind == "ping":
                 protocol.send_frame(wfile, {"kind": "pong"})
             elif kind == "stats":
-                protocol.send_frame(wfile, dict(self.stats(),
-                                                kind="stats"))
+                if header.get("prom"):
+                    # Prometheus text exposition rides the payload slot
+                    # (a raw byte body, not a JSON header field) so the
+                    # header stays a clean one-line JSON frame
+                    protocol.send_frame(
+                        wfile, {"kind": "stats", "format": "prometheus"},
+                        self.prom_text().encode("utf-8"))
+                else:
+                    protocol.send_frame(wfile, dict(self.stats(),
+                                                    kind="stats"))
             elif kind == "shutdown":
                 protocol.send_frame(wfile, {"kind": "ok",
                                             "draining": True})
@@ -982,6 +1079,8 @@ class SolverService:
             self._avg_run_sec = wall
         else:
             self._avg_run_sec = 0.7 * self._avg_run_sec + 0.3 * wall
+        with self._counters_lock:
+            self.hists["run_seconds"].add(wall)
 
     def _shed_memory(self):
         """Process-RSS watermark: above [service] MEM_WATERMARK_MB, evict
@@ -1039,6 +1138,7 @@ class SolverService:
         with self._counters_lock:
             self._request_seq += 1
             seq = self._request_seq
+            self.hists["queue_seconds"].add(queue_sec)
         client_id = header.get("id")
         request_id = str(client_id or f"r{seq}")
         tctx = item.get("trace")
@@ -1494,6 +1594,13 @@ def build_parser():
                         help="fleet block size in iterations between "
                              "join/detach boundaries (default: [service] "
                              "BATCH_BLOCK_ITERS)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        metavar="PORT",
+                        help="plaintext GET /metrics listener serving "
+                             "the stats surface in Prometheus text "
+                             "exposition format; 0 binds an ephemeral "
+                             "port (default: [service] METRICS_PORT, "
+                             "where 0 disables; docs/observability.md)")
     parser.add_argument("--trace", nargs="?", const="", default=None,
                         metavar="FILE",
                         help="end-to-end request tracing (tools/"
@@ -1521,6 +1628,6 @@ def main(argv=None):
         on_client_drop=args.on_client_drop, chaos_enabled=args.chaos,
         batching_enabled=args.batch, batch_max=args.batch_max,
         batch_window=args.batch_window, batch_block=args.batch_block,
-        trace_file=args.trace)
+        trace_file=args.trace, metrics_port=args.metrics_port)
     service.serve_forever()
     return 0

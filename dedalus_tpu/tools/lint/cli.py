@@ -68,6 +68,18 @@ def build_parser():
     parser.add_argument("--fast", action="store_true",
                         help="restrict the census to the fast subset "
                              "(the tier-1 gate's selection)")
+    parser.add_argument("--ledger", nargs="?", const="", default=None,
+                        metavar="PATH",
+                        help="--programs mode: append one `kind: ledger` "
+                             "resource-trajectory row per census program "
+                             "(XLA cost/memory analysis + plan provenance "
+                             "+ env fingerprint) to PATH (default: "
+                             "benchmarks/results.jsonl)")
+    parser.add_argument("--perfwatch", action="store_true",
+                        help="after a clean run, also run the perf-"
+                             "trajectory sentinel (`perfwatch --check`) "
+                             "over results.jsonl; exit nonzero on a "
+                             "confirmed, unwaived regression")
     return parser
 
 
@@ -133,10 +145,15 @@ def _run_programs(args):
               f"-> {baseline_path}")
         return 0
 
+    ledger_path = None
+    if args.ledger is not None:
+        ledger_path = pathlib.Path(args.ledger) if args.ledger \
+            else PACKAGE_DIR.parent / "benchmarks" / "results.jsonl"
     try:
         report = progcheck.run_programs(
             names=names, contracts=contracts, fast_only=args.fast,
-            baseline_path=baseline_path, no_baseline=args.no_baseline)
+            baseline_path=baseline_path, no_baseline=args.no_baseline,
+            ledger_path=ledger_path)
     except KeyError as exc:
         print(f"lint: {exc.args[0]}", file=sys.stderr)
         return 2
@@ -172,9 +189,19 @@ def _run_programs(args):
         for f in report["findings"]:
             print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} "
                   f"[{f['severity']}] {f['message']}")
+        if ledger_path is not None:
+            print(f"ledger: {summary.get('ledger_rows', 0)} trajectory "
+                  f"row(s) appended -> {ledger_path}")
         _render_stale(stale)
         _summary_line(summary, stale)
-    return 1 if (summary["new"] or stale) else 0
+    rc = 1 if (summary["new"] or stale) else 0
+    if args.perfwatch:
+        # the standalone-CI tail: a structurally clean census can still
+        # ship a perf regression — the sentinel reads the trajectory the
+        # ledger rows just extended
+        from ..perfwatch import main as perfwatch_main
+        rc = max(rc, perfwatch_main(["--check"]))
+    return rc
 
 
 def main(argv=None):
@@ -290,4 +317,8 @@ def main(argv=None):
             print(f.format())
         _render_stale(stale)
         _summary_line(summary, stale)
-    return 1 if (new or stale) else 0
+    rc = 1 if (new or stale) else 0
+    if args.perfwatch:
+        from ..perfwatch import main as perfwatch_main
+        rc = max(rc, perfwatch_main(["--check"]))
+    return rc

@@ -113,7 +113,8 @@ __all__ = ["ProgramRecord", "Contract", "all_contracts", "collective_counts",
            "pads_in_auto_regions", "scan_lengths", "record_from_jit",
            "register_contract", "run_census", "check_records",
            "run_programs", "census_names", "PROGRAMS_BASELINE",
-           "GATHER_FRACTION"]
+           "GATHER_FRACTION", "program_ledger", "hlo_instruction_count",
+           "ledger_rows", "append_ledger_rows", "LEDGER_FIELDS"]
 
 
 # ------------------------------------------------------- program analyses
@@ -239,6 +240,106 @@ def pads_in_auto_regions(jaxpr):
     return hits[0]
 
 
+# ------------------------------------------------------ the resource ledger
+
+# one HLO instruction per `name = type[...] op(...)` line (ROOT-prefixed
+# or %-sigiled in older dumps); computation headers/braces don't match
+_HLO_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s", re.M)
+
+# every quantitative field a ledger can carry, all nullable: a backend
+# lacking (or raising from) cost_analysis/memory_analysis degrades to
+# partial rows, never a failed census
+LEDGER_FIELDS = ("flops", "transcendentals", "bytes_accessed",
+                 "argument_bytes", "output_bytes", "temp_bytes",
+                 "generated_code_bytes", "peak_bytes", "hlo_instructions")
+
+
+def hlo_instruction_count(hlo_text):
+    """Instruction count of a compiled HLO module — the cheapest stable
+    proxy for compiled-program size (tracks fusion regressions that flops
+    alone cannot: an unfused program re-materializes as more
+    instructions, not more arithmetic)."""
+    return len(_HLO_INSTR_RE.findall(hlo_text or ""))
+
+
+def _as_cost_dict(cost):
+    """cost_analysis() returns a flat dict on current jax and a
+    list-of-dicts (one per computation, main first) on older releases."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return cost if isinstance(cost, dict) else None
+
+
+def program_ledger(compiled, hlo_text=None):
+    """Resource ledger of one compiled program: XLA ``cost_analysis()``
+    (flops, transcendentals, bytes accessed) and ``memory_analysis()``
+    (argument/output/temp/code bytes, with ``peak_bytes`` derived as
+    their alias-corrected sum) plus the HLO instruction count. Every
+    probe is guarded: a backend where an analysis is absent or raises
+    yields nulls for its fields — the census stays green, the trajectory
+    row records the absence explicitly."""
+    ledger = {"ledger_version": 1}
+    ledger.update({field: None for field in LEDGER_FIELDS})
+    try:
+        cost = _as_cost_dict(compiled.cost_analysis())
+    except Exception:
+        cost = None
+    if cost:
+        for field, key in (("flops", "flops"),
+                           ("transcendentals", "transcendentals"),
+                           ("bytes_accessed", "bytes accessed")):
+            try:
+                value = cost.get(key)
+                if value is not None:
+                    ledger[field] = int(value)
+            except (TypeError, ValueError):
+                pass
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        for field, attr in (
+                ("argument_bytes", "argument_size_in_bytes"),
+                ("output_bytes", "output_size_in_bytes"),
+                ("temp_bytes", "temp_size_in_bytes"),
+                ("generated_code_bytes", "generated_code_size_in_bytes")):
+            try:
+                ledger[field] = int(getattr(mem, attr))
+            except Exception:
+                pass
+        sized = [ledger[f] for f in
+                 ("argument_bytes", "output_bytes", "temp_bytes")]
+        if any(v is not None for v in sized):
+            try:
+                alias = int(getattr(mem, "alias_size_in_bytes"))
+            except Exception:
+                alias = 0
+            ledger["peak_bytes"] = max(
+                sum(v or 0 for v in sized) - alias, 0)
+    if hlo_text is not None:
+        ledger["hlo_instructions"] = hlo_instruction_count(hlo_text)
+    return ledger
+
+
+def _compile_views(lowered):
+    """(hlo_text, ledger) off ONE compile of a lowered program — the
+    census must never pay a second XLA compile just to read costs."""
+    compiled = lowered.compile()
+    text = compiled.as_text()
+    return text, program_ledger(compiled, hlo_text=text)
+
+
+def _plan_of(solver):
+    """Guarded plan provenance: a handle without plan_provenance() (or
+    one that raises during lowering-time introspection) yields None —
+    rendered downstream as plan=unversioned, never faked."""
+    try:
+        return solver.plan_provenance()
+    except Exception:
+        return None
+
+
 # ------------------------------------------------------------ the records
 
 class ProgramRecord:
@@ -258,10 +359,11 @@ class ProgramRecord:
     """
 
     __slots__ = ("name", "description", "compiled_text", "jaxpr", "meta",
-                 "build_sec", "skipped")
+                 "build_sec", "skipped", "ledger", "plan")
 
     def __init__(self, name, description="", compiled_text=None, jaxpr=None,
-                 meta=None, build_sec=0.0, skipped=None):
+                 meta=None, build_sec=0.0, skipped=None, ledger=None,
+                 plan=None):
         self.name = name
         self.description = description
         self.compiled_text = compiled_text
@@ -269,6 +371,8 @@ class ProgramRecord:
         self.meta = dict(meta or {})
         self.build_sec = build_sec
         self.skipped = skipped
+        self.ledger = ledger      # program_ledger() dict (None: not costed)
+        self.plan = plan          # plan_provenance() dict (None: no plan)
 
     def pseudo_path(self):
         return _PSEUDO_ROOT / f"{self.name}.hlo"
@@ -293,6 +397,8 @@ class ProgramRecord:
                     "untraced_sha256"):
             if key in self.meta:
                 row[key] = self.meta[key]
+        if self.ledger is not None:
+            row["ledger"] = self.ledger
         return row
 
 
@@ -308,15 +414,16 @@ def record_from_jit(name, fn, args, meta=None, donate_argnums=(),
     the jaxpr level — which is exactly the tier the contract runs at."""
     import jax
     t0 = time.perf_counter()
-    compiled_text = None
+    compiled_text = ledger = None
     if compile:
         lowered = jax.jit(  # dedalus-lint: disable=DTL003 (one-shot fixture lowering, never dispatched)
             fn, donate_argnums=donate_argnums).lower(*args)
-        compiled_text = lowered.compile().as_text()
+        compiled_text, ledger = _compile_views(lowered)
     jaxpr = jax.make_jaxpr(fn)(*args)
     return ProgramRecord(name, description=description,
                          compiled_text=compiled_text, jaxpr=jaxpr,
-                         meta=meta, build_sec=time.perf_counter() - t0)
+                         meta=meta, build_sec=time.perf_counter() - t0,
+                         ledger=ledger)
 
 
 # ---------------------------------------------------------- the contracts
@@ -672,11 +779,11 @@ def _solver_record(name, solver, description, extra_meta=None, dt=1e-3):
     prog, args = step_program_handle(solver, dt=dt)
     meta = {"donated": len(getattr(prog, "donate_argnums", ()))}
     meta.update(extra_meta or {})
-    compiled_text = prog.lower(*args).compile().as_text()
+    compiled_text, ledger = _compile_views(prog.lower(*args))
     jaxpr = prog.jaxpr(*args)
     return ProgramRecord(name, description=description,
                          compiled_text=compiled_text, jaxpr=jaxpr,
-                         meta=meta)
+                         meta=meta, ledger=ledger, plan=_plan_of(solver))
 
 
 def _need_devices(n):
@@ -825,16 +932,16 @@ def _census_traced_step():
         solver.step(1e-3)
         prog, args = step_program_handle(solver, dt=1e-3)
         meta = {"donated": len(getattr(prog, "donate_argnums", ()))}
-        return (prog.lower(*args).compile().as_text(),
-                prog.jaxpr(*args), meta)
+        text, ledger = _compile_views(prog.lower(*args))
+        return text, prog.jaxpr(*args), meta, ledger, _plan_of(solver)
 
     was_on = tracing.enabled()
     with _pinned_config("fusion", DONATE_STEP="on", PALLAS="off"):
         try:
             tracing.disable()
-            off_text, _, _ = compiled_step()
+            off_text, _, _, _, _ = compiled_step()
             tracing.enable()
-            on_text, jaxpr, meta = compiled_step()
+            on_text, jaxpr, meta, ledger, plan = compiled_step()
         finally:
             if not was_on:
                 tracing.disable()
@@ -843,7 +950,8 @@ def _census_traced_step():
         "traced_step",
         description="dense SBDF2 diffusion step lowered under tracing "
                     "(must match the untraced build byte-for-byte)",
-        compiled_text=on_text, jaxpr=jaxpr, meta=meta)]
+        compiled_text=on_text, jaxpr=jaxpr, meta=meta, ledger=ledger,
+        plan=plan)]
 
 
 @census("sharded_step_1d")
@@ -887,23 +995,30 @@ def _census_chunked_walk():
     cdata = np.asarray(u["c"])
     c_sh = jax.device_put(cdata, NamedSharding(mesh, P("x", None)))
     records = []
+    # pipeline walks have no solver and thus no plan_provenance(); the
+    # chunk count IS the plan-relevant knob, declared as a minimal plan
+    walk_plan = {"plan_version": 1, "transpose_chunks": 2}
     prog_g = jax.jit(pipe.to_grid)  # dedalus-lint: disable=DTL003 (one-shot census lowering)
     g = prog_g(c_sh)
+    text_g, ledger_g = _compile_views(prog_g.lower(c_sh))
     records.append(ProgramRecord(
         "chunked_walk_to_grid",
         description="chunked (C=2) coeff->grid walk, 1-D pencil mesh",
-        compiled_text=prog_g.lower(c_sh).compile().as_text(),
+        compiled_text=text_g,
         jaxpr=jax.make_jaxpr(pipe.to_grid)(c_sh),
         meta={"sharded": True, "state_bytes": int(cdata.nbytes),
-              "expected_a2a_min": 2}))
+              "expected_a2a_min": 2},
+        ledger=ledger_g, plan=dict(walk_plan)))
     prog_c = jax.jit(pipe.to_coeff)  # dedalus-lint: disable=DTL003 (one-shot census lowering)
+    text_c, ledger_c = _compile_views(prog_c.lower(g))
     records.append(ProgramRecord(
         "chunked_walk_to_coeff",
         description="chunked (C=2) grid->coeff walk, 1-D pencil mesh",
-        compiled_text=prog_c.lower(g).compile().as_text(),
+        compiled_text=text_c,
         jaxpr=jax.make_jaxpr(pipe.to_coeff)(g),
         meta={"sharded": True, "state_bytes": int(cdata.nbytes),
-              "expected_a2a_min": 2}))
+              "expected_a2a_min": 2},
+        ledger=ledger_c, plan=dict(walk_plan)))
     return records
 
 
@@ -935,14 +1050,16 @@ def _census_chunked_walk_2d():
     c_sh = jax.device_put(cdata,
                           NamedSharding(mesh, P("px", "py", None)))
     prog = jax.jit(pipe.to_grid)  # dedalus-lint: disable=DTL003 (one-shot census lowering)
+    text, ledger = _compile_views(prog.lower(c_sh))
     return [ProgramRecord(
         "chunked_walk_2dmesh",
         description="chunked (C=2) coeff->grid walk, 2-D (2x4) mesh, "
                     "3-D domain",
-        compiled_text=prog.lower(c_sh).compile().as_text(),
+        compiled_text=text,
         jaxpr=jax.make_jaxpr(pipe.to_grid)(c_sh),
         meta={"sharded": True, "state_bytes": int(cdata.nbytes),
-              "expected_a2a_min": 4})]
+              "expected_a2a_min": 4},
+        ledger=ledger, plan={"plan_version": 1, "transpose_chunks": 2})]
 
 
 @census("fleet_2d")
@@ -969,14 +1086,16 @@ def _census_fleet_2d():
     fleet.init_members(ics)
     fleet.step_many(4, 1e-3)
     prog, args = fleet.step_program_handle()
+    text, ledger = _compile_views(prog.lower(*args))
     return [ProgramRecord(
         "fleet_2d",
         description="2-member fleet step on a 2-D (2 batch x 4 pencil) "
                     "mesh",
-        compiled_text=prog.lower(*args).compile().as_text(),
+        compiled_text=text,
         jaxpr=jax.make_jaxpr(prog)(*args),
         meta={"sharded": True, "state_bytes": int(fleet.X.nbytes),
-              "expected_a2a_min": 2, "manual_auto": True})]
+              "expected_a2a_min": 2, "manual_auto": True},
+        ledger=ledger, plan=_plan_of(solver))]
 
 
 @census("ensemble_fleet_1d")
@@ -1001,12 +1120,14 @@ def _census_fleet_1d():
     fleet.init_members(ics)
     fleet.step_many(4, 1e-3)
     prog, args = fleet.step_program_handle()
+    text, ledger = _compile_views(prog.lower(*args))
     return [ProgramRecord(
         "ensemble_fleet_1d",
         description="2-member vmapped fleet step, 1-D member mesh",
-        compiled_text=prog.lower(*args).compile().as_text(),
+        compiled_text=text,
         jaxpr=jax.make_jaxpr(prog)(*args),
-        meta={"sharded": True, "state_bytes": int(fleet.X.nbytes)})]
+        meta={"sharded": True, "state_bytes": int(fleet.X.nbytes)},
+        ledger=ledger, plan=_plan_of(solver))]
 
 
 @census("adjoint_grad")
@@ -1020,12 +1141,14 @@ def _census_adjoint():
     div = solver.differentiable(wrt=("initial_state",),
                                 loss=lambda X: jnp.sum(X * X))
     prog, args = div.grad_program_handle(4, 1e-3)
+    text, ledger = _compile_views(prog.lower(*args))
     return [ProgramRecord(
         "adjoint_grad",
         description="value_and_grad over 4 SBDF2 diffusion steps "
                     "(checkpointed adjoint)",
-        compiled_text=prog.lower(*args).compile().as_text(),
-        jaxpr=prog.jaxpr(*args))]
+        compiled_text=text,
+        jaxpr=prog.jaxpr(*args),
+        ledger=ledger, plan=_plan_of(solver))]
 
 
 @census("pool_step")
@@ -1076,6 +1199,53 @@ def run_census(names=None, fast_only=False):
     return records, timings
 
 
+def ledger_rows(records):
+    """One `kind: ledger` trajectory row per costed census program, in
+    the benchmarks/results.jsonl vocabulary: the program's resource
+    ledger plus scan depth, plan provenance, and the host/environment
+    fingerprint — the read-side input of tools/perfwatch.py. Skipped or
+    un-costed records yield no row (absence stays explicit in the census
+    report instead)."""
+    from ..envinfo import env_fingerprint
+    try:
+        import jax
+        backend = str(jax.default_backend())
+    except Exception:
+        backend = None
+    env = env_fingerprint()
+    rows = []
+    for rec in records:
+        if rec.skipped or rec.ledger is None:
+            continue
+        row = {"kind": "ledger", "config": "progcheck_census",
+               "program": rec.name, "backend": backend}
+        row.update(rec.ledger)
+        if rec.jaxpr is not None:
+            lengths, whiles = scan_lengths(rec.jaxpr)
+            row["scan_max_length"] = max(lengths, default=0)
+            row["while_loops"] = whiles
+        row["plan"] = rec.plan
+        row["env"] = env
+        rows.append(row)
+    return rows
+
+
+def append_ledger_rows(records, path=None):
+    """Persist ledger rows alongside the perf rows. Opt-in by design:
+    the census itself never writes — tests and ad-hoc runs must not
+    grow the checked-in trajectory. Returns the appended rows."""
+    import json
+    path = pathlib.Path(path) if path \
+        else PACKAGE_DIR.parent / "benchmarks" / "results.jsonl"
+    rows = ledger_rows(records)
+    ts = round(time.time(), 1)
+    with open(path, "a") as f:
+        for row in rows:
+            row.setdefault("ts", ts)
+            f.write(json.dumps(row) + "\n")
+    return rows
+
+
 def check_records(records, contracts=None):
     """Run the contract registry over census records. Returns
     (findings, suppressed, contract_timings); per-record waivers land in
@@ -1100,12 +1270,16 @@ def check_records(records, contracts=None):
 
 
 def run_programs(names=None, contracts=None, fast_only=False,
-                 baseline_path=None, no_baseline=False):
+                 baseline_path=None, no_baseline=False, ledger_path=None):
     """The programs-tier entry point (cli --programs and
     tests/test_progcheck.py): census + contracts + baseline. Returns the
     summary dict the CLI renders:
     {programs, findings (new, as dicts), summary{total,new,baselined,
-    suppressed,stale}, timings{census,contracts}}."""
+    suppressed,stale}, timings{census,contracts}}.
+
+    `ledger_path` (cli --ledger) additionally appends one `kind: ledger`
+    trajectory row per costed program there; the default call appends
+    nothing."""
     if contracts is not None:
         unknown = [c for c in contracts if c not in CONTRACTS]
         if unknown:
@@ -1118,6 +1292,9 @@ def run_programs(names=None, contracts=None, fast_only=False,
     baseline = {} if no_baseline \
         else load_baseline(baseline_path or PROGRAMS_BASELINE)
     new, stale = apply_baseline(findings, baseline)
+    ledger_appended = None
+    if ledger_path is not None:
+        ledger_appended = len(append_ledger_rows(records, ledger_path))
     return {
         "programs": [rec.stats() for rec in records],
         "findings": [f.to_dict() for f in new],
@@ -1129,6 +1306,8 @@ def run_programs(names=None, contracts=None, fast_only=False,
             "stale": stale,
             "checked": sum(1 for r in records if not r.skipped),
             "skipped": [r.name for r in records if r.skipped],
+            **({"ledger_rows": ledger_appended}
+               if ledger_appended is not None else {}),
         },
         "timings": {
             "census": {k: round(v, 3) for k, v in census_timings.items()},

@@ -59,6 +59,20 @@ dispatch additionally leaves a `watchdog_postmortem` record (request
 id, stuck seconds, thread stacks). This sink format doubles as the
 service's wire format, so streamed frames and the daemon's JSONL file
 are the same records.
+
+Trajectory vocabulary: every row appended through the bench driver or
+the lint cost tier is stamped with an `env` host/environment fingerprint
+(tools/envinfo.py: backend, device kind/count, jax/jaxlib/python
+versions, hashed hostname, load average) so cross-host history is
+attributable. `kind: ledger` rows (tools/lint/progcheck.py cost tier,
+`lint --programs --ledger`) carry per-census-program compile-time
+resource costs — flops, transcendentals, bytes accessed,
+argument/output/temp/peak memory, HLO instruction count, scan depths —
+plus the resolved-plan provenance block. `kind: probe` rows record TPU
+backend-probe verdicts (bench.py) for TTL replay ([bench]
+PROBE_CACHE_SEC). `python -m dedalus_tpu perfwatch` reads the whole
+file as a perf trajectory and flags noise-band regressions per series
+(docs/observability.md).
 """
 
 import atexit

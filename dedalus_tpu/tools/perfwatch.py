@@ -1,0 +1,451 @@
+"""
+perfwatch: the perf-trajectory regression sentinel.
+
+benchmarks/results.jsonl accumulates one row per measurement, round
+after round — steps/s headlines, serving throughput, `kind: ledger`
+compiled-resource rows (tools/lint/progcheck.py), probe history. Nothing
+watched those numbers over time: a silent 20% steps/s regression or a
+doubling of compiled peak memory would ship undetected. This module
+reads the FULL history, groups comparable measurements into series, and
+flags the newest point when it moves outside the series' own noise band.
+
+Series identity
+---------------
+A point joins a series only when everything that legitimately changes a
+number matches: `(metric, identifier, backend, plan)` — the plan key is
+a structural digest of the row's plan provenance (fusion flags, solve
+composition/dtype, sweep/chunk counts; NOT the solver content key, which
+re-keys on every assembly change). Rows without provenance are excluded
+outright: no `ts`, an explicitly non-finite run (`finite: false`), or a
+zero value never become evidence. Stale re-reports (rows carrying
+`measured_ts`/`source`, bench's stale-headline guard) collapse to one
+point per original measurement, stamped at the time it was MEASURED.
+
+Noise bands
+-----------
+baseline = median(history), band = max(MAD_MULT x relative-MAD,
+DRIFT_FLOOR). The floor defaults to 0.15 — the documented ±15% wall-
+clock drift of the shared host (CHANGES.md, PR 16) must never
+false-positive — and the MAD term widens the band further for series
+that are intrinsically noisier (serving throughput). A series is
+analyzed only once its history (excluding the newest point) has
+MIN_HISTORY points; younger series report `insufficient-history` and
+stay quiet. Direction matters: steps/s and requests/s regress DOWN;
+memory, flops, bytes, HLO size, and scan depth regress UP.
+
+Waivers
+-------
+benchmarks/perfwatch_waivers.json lists intentional trade-offs as
+`{"series": <fnmatch pattern>, "reason": ...}` entries — e.g. the
+PR-15-documented ascan 0.40x CPU cell. A waived regression is reported
+(counted, never hidden) but does not fail `--check`.
+
+Entry points: `python -m dedalus_tpu perfwatch [--check|--json]`,
+`lint --perfwatch` (the standalone-CI tail), and `trend_lines()` (the
+`report` CLI's trend table).
+"""
+
+import argparse
+import fnmatch
+import json
+import pathlib
+import sys
+
+PACKAGE_DIR = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_RESULTS = PACKAGE_DIR.parent / "benchmarks" / "results.jsonl"
+DEFAULT_WAIVERS = PACKAGE_DIR.parent / "benchmarks" / "perfwatch_waivers.json"
+
+# row kinds that are bookkeeping, not measurements
+_NON_MEASUREMENT_KINDS = {"probe", "trace", "service_stats",
+                          "health_postmortem", "watchdog_postmortem"}
+
+# ledger fields watched for UPWARD drift (field -> metric name)
+_LEDGER_METRICS = (("flops", "ledger_flops"),
+                   ("bytes_accessed", "ledger_bytes"),
+                   ("peak_bytes", "ledger_peak_bytes"),
+                   ("hlo_instructions", "ledger_hlo_instructions"),
+                   ("scan_max_length", "ledger_scan_depth"))
+
+__all__ = ["load_rows", "extract_points", "build_series", "analyze_series",
+           "analyze", "plan_key", "load_waivers", "trend_lines", "main",
+           "DEFAULT_RESULTS", "DEFAULT_WAIVERS"]
+
+
+def _cfg(key, fallback):
+    try:
+        from .config import cfg_get
+        return float(cfg_get("perfwatch", key, str(fallback)))
+    except Exception:
+        return float(fallback)
+
+
+def _drift_floor():
+    return _cfg("DRIFT_FLOOR", 0.15)
+
+
+def _min_history():
+    return max(int(_cfg("MIN_HISTORY", 3)), 1)
+
+
+def _mad_mult():
+    return _cfg("MAD_MULT", 3.0)
+
+
+# ----------------------------------------------------------- row ingestion
+
+def load_rows(path=None):
+    """Tolerant JSONL read: junk lines and non-dict rows are skipped (the
+    trajectory file outlives every schema that wrote into it)."""
+    path = pathlib.Path(path) if path else DEFAULT_RESULTS
+    rows = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return rows
+    for line in lines:
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def plan_key(plan):
+    """Structural digest of a plan-provenance dict: everything that
+    changes the PROGRAM (fusion flags, solve composition/dtype, sweeps,
+    chunk counts) and nothing that merely re-keys the assembly cache.
+    Rows without provenance digest to 'unversioned' — pre-provenance
+    history stays comparable with itself, never with planned rows."""
+    if not isinstance(plan, dict):
+        return "unversioned"
+    fusion = plan.get("fusion") or {}
+    ftag = "".join(k[0] for k in ("solve", "matvec", "transforms",
+                                  "donate", "pallas")
+                   if fusion.get(k)) or "-"
+    sweeps = plan.get("refine_sweeps")
+    return ".".join([
+        f"v{plan.get('plan_version', '?')}", ftag,
+        str(plan.get("solve_composition") or "-"),
+        str(plan.get("solve_dtype") or "-"),
+        f"s{'-' if sweeps is None else sweeps}",
+        f"k{plan.get('spike_chunks', '-')}",
+        f"t{plan.get('transpose_chunks', '-')}",
+    ])
+
+
+def _num(value):
+    return value if isinstance(value, (int, float)) \
+        and not isinstance(value, bool) else None
+
+
+def _point(metric, ident, value, direction, row, ts):
+    return {"metric": metric, "ident": str(ident), "value": float(value),
+            "direction": direction,              # 'down'|'up' = bad way
+            "backend": row.get("backend") or "?",
+            "plan": plan_key(row.get("plan")), "ts": float(ts)}
+
+
+def extract_points(rows):
+    """Measurement points from raw rows. Positive-matching per known row
+    shape; everything unrecognized contributes nothing (a new row kind
+    can never crash the sentinel)."""
+    points = []
+    seen_measured = set()
+    for row in rows:
+        if row.get("kind") in _NON_MEASUREMENT_KINDS:
+            continue
+        ts = _num(row.get("ts"))
+        if ts is None:
+            continue                    # no provenance, no evidence
+        if row.get("kind") == "ledger":
+            program = row.get("program") or "?"
+            for field, metric in _LEDGER_METRICS:
+                value = _num(row.get(field))
+                if value is not None and value > 0:
+                    points.append(_point(metric, program, value, "up",
+                                         row, ts))
+            continue
+        if row.get("finite") is False:
+            continue                    # a non-finite run measures nothing
+        measured = _num(row.get("measured_ts"))
+        if measured is not None or row.get("source") or row.get("stale"):
+            # stale re-report: one point per ORIGINAL measurement
+            key = (row.get("metric") or row.get("config"), measured)
+            if key in seen_measured:
+                continue
+            seen_measured.add(key)
+            ts = measured if measured is not None else ts
+        # bench headline rows: metric/value/unit
+        metric, value = row.get("metric"), _num(row.get("value"))
+        if metric and value is not None and value > 0:
+            unit = str(row.get("unit") or "")
+            if "steps/sec" in unit or "requests/sec" in unit:
+                points.append(_point(str(metric), row.get("config") or "",
+                                     value, "down", row, ts))
+        # per-config perf rows (bench shapes + step_metrics telemetry)
+        sps = _num(row.get("steps_per_sec"))
+        if sps is not None and sps > 0 and row.get("config"):
+            ident = row["config"]
+            if row.get("dtype"):
+                ident = f"{ident}/{row['dtype']}"
+            points.append(_point("steps_per_sec", ident, sps, "down",
+                                 row, ts))
+        mem = _num(row.get("device_mem_peak_bytes"))
+        if mem is not None and mem > 0 and row.get("config"):
+            points.append(_point("device_mem_peak_bytes", row["config"],
+                                 mem, "up", row, ts))
+        thr = _num(row.get("throughput_requests_per_sec"))
+        if thr is not None and thr > 0:
+            points.append(_point("requests_per_sec",
+                                 row.get("config") or "", thr, "down",
+                                 row, ts))
+        bat = _num(row.get("batched_requests_per_sec"))
+        if bat is not None and bat > 0:
+            points.append(_point("batched_requests_per_sec",
+                                 row.get("config") or "", bat, "down",
+                                 row, ts))
+        # solvecomp sweeps: one series per (config, composition, dtype)
+        # cell — the grid the PR-15 ascan waiver addresses
+        if row.get("benchmark") == "solvecomp":
+            for cell in row.get("sweep") or []:
+                if not isinstance(cell, dict):
+                    continue
+                csps = _num(cell.get("steps_per_sec"))
+                if csps is None or csps <= 0:
+                    continue
+                ident = (f"{row.get('config', '?')}/"
+                         f"{cell.get('composition', '?')}/"
+                         f"{cell.get('solve_dtype', '?')}")
+                points.append(_point("steps_per_sec", ident, csps,
+                                     "down", row, ts))
+    return points
+
+
+def series_key(point):
+    return (f"{point['metric']}:{point['ident']}:{point['backend']}:"
+            f"{point['plan']}")
+
+
+def build_series(rows):
+    """{series key -> {'direction', 'values': [...chronological...]}}"""
+    series = {}
+    for point in sorted(extract_points(rows), key=lambda p: p["ts"]):
+        entry = series.setdefault(series_key(point),
+                                  {"direction": point["direction"],
+                                   "values": []})
+        entry["values"].append(point["value"])
+    return series
+
+
+# --------------------------------------------------------------- the bands
+
+def _median(values):
+    ordered = sorted(values)
+    n = len(ordered)
+    mid = n // 2
+    return ordered[mid] if n % 2 else (ordered[mid - 1] + ordered[mid]) / 2
+
+
+def analyze_series(values, direction, drift_floor=None, min_history=None,
+                   mad_mult=None):
+    """Verdict for one chronological series: the newest point against a
+    noise band computed from the REST (median ± max(MAD_MULT x relative
+    MAD, DRIFT_FLOOR)). Returns {n, newest, baseline, band, delta,
+    verdict} with verdict one of ok | regression | insufficient-history.
+    """
+    drift_floor = _drift_floor() if drift_floor is None else drift_floor
+    min_history = _min_history() if min_history is None else min_history
+    mad_mult = _mad_mult() if mad_mult is None else mad_mult
+    newest = values[-1]
+    history = values[:-1]
+    out = {"n": len(values), "newest": newest, "baseline": None,
+           "band": None, "delta": None, "verdict": "insufficient-history"}
+    if len(history) < min_history:
+        return out
+    baseline = _median(history)
+    out["baseline"] = baseline
+    if baseline == 0:
+        out["verdict"] = "ok"
+        return out
+    rel_mad = _median([abs(v - baseline) for v in history]) / abs(baseline)
+    band = max(mad_mult * rel_mad, drift_floor)
+    delta = (newest - baseline) / abs(baseline)
+    out["band"] = band
+    out["delta"] = delta
+    worse = delta > band if direction == "up" else delta < -band
+    out["verdict"] = "regression" if worse else "ok"
+    return out
+
+
+# ----------------------------------------------------------------- waivers
+
+def load_waivers(path=None):
+    """[{series: pattern, reason: str}, ...]; a missing or malformed
+    file waives nothing (and --check says so rather than crashing)."""
+    path = pathlib.Path(path) if path else DEFAULT_WAIVERS
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return []
+    entries = data.get("waivers") if isinstance(data, dict) else data
+    if not isinstance(entries, list):
+        return []
+    return [e for e in entries
+            if isinstance(e, dict) and isinstance(e.get("series"), str)]
+
+
+def _waived_by(key, waivers):
+    for entry in waivers:
+        if fnmatch.fnmatch(key, entry["series"]):
+            return entry
+    return None
+
+
+# ---------------------------------------------------------------- analysis
+
+def analyze(rows, waivers=None, drift_floor=None, min_history=None,
+            mad_mult=None):
+    """Full-history analysis. Returns {series: [per-series dicts, sorted
+    worst-delta first], regressions: [...unwaived...], waived: [...]}."""
+    waivers = [] if waivers is None else waivers
+    results = []
+    for key, entry in sorted(build_series(rows).items()):
+        verdict = analyze_series(entry["values"], entry["direction"],
+                                 drift_floor=drift_floor,
+                                 min_history=min_history,
+                                 mad_mult=mad_mult)
+        verdict.update(series=key, direction=entry["direction"])
+        if verdict["verdict"] == "regression":
+            waiver = _waived_by(key, waivers)
+            if waiver is not None:
+                verdict["verdict"] = "waived"
+                verdict["waive_reason"] = waiver.get("reason", "")
+        results.append(verdict)
+
+    def badness(r):
+        if r["delta"] is None:
+            return 0.0
+        return abs(r["delta"]) if (
+            (r["direction"] == "up" and r["delta"] > 0)
+            or (r["direction"] == "down" and r["delta"] < 0)) else 0.0
+
+    results.sort(key=badness, reverse=True)
+    return {
+        "series": results,
+        "regressions": [r for r in results if r["verdict"] == "regression"],
+        "waived": [r for r in results if r["verdict"] == "waived"],
+    }
+
+
+def _fmt_value(value):
+    if value is None:
+        return "-"
+    if abs(value) >= 1e6 or (value and abs(value) < 1e-3):
+        return f"{value:.3g}"
+    return f"{value:.3f}".rstrip("0").rstrip(".")
+
+
+def _series_line(r):
+    delta = f"{r['delta']:+.1%}" if r["delta"] is not None else "-"
+    band = f"±{r['band']:.0%}" if r["band"] is not None else "-"
+    return (f"{r['series']}  n={r['n']}  baseline={_fmt_value(r['baseline'])}"
+            f"  newest={_fmt_value(r['newest'])}  delta={delta}  "
+            f"band={band}  {r['verdict']}")
+
+
+def trend_lines(rows, waivers=None, limit=20):
+    """Trend-table lines for the `report` CLI: analyzed series only
+    (insufficient-history series would drown a young file in noise),
+    worst first, capped at `limit` with an elision note."""
+    analyzed = [r for r in analyze(rows, waivers=waivers)["series"]
+                if r["verdict"] != "insufficient-history"]
+    lines = [_series_line(r) for r in analyzed[:limit]]
+    if len(analyzed) > limit:
+        lines.append(f"... {len(analyzed) - limit} more series "
+                     "(python -m dedalus_tpu perfwatch for all)")
+    return lines
+
+
+# --------------------------------------------------------------------- CLI
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m dedalus_tpu perfwatch",
+        description="Perf-trajectory regression sentinel over "
+                    "benchmarks/results.jsonl: per-series noise bands "
+                    "from historical dispersion; flags the newest point "
+                    "of any series that moved outside its band the bad "
+                    "way. Exit codes: 0 quiet, 1 unwaived regression, "
+                    "2 usage error.")
+    parser.add_argument("jsonl", nargs="?", default=None,
+                        help="results history to read (default: "
+                             "benchmarks/results.jsonl)")
+    parser.add_argument("--check", action="store_true",
+                        help="CI mode: quiet on a clean trajectory, "
+                             "named findings + exit 1 on an unwaived "
+                             "regression")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full analysis as JSON")
+    parser.add_argument("--waivers", default=None, metavar="FILE",
+                        help="waiver file (default: "
+                             "benchmarks/perfwatch_waivers.json)")
+    parser.add_argument("--drift-floor", type=float, default=None,
+                        metavar="FRAC",
+                        help="minimum relative noise band (default: "
+                             "[perfwatch] DRIFT_FLOOR, 0.15 — the "
+                             "documented host drift)")
+    parser.add_argument("--min-history", type=int, default=None,
+                        metavar="N",
+                        help="history points required before a series "
+                             "is judged (default: [perfwatch] "
+                             "MIN_HISTORY, 3)")
+    return parser
+
+
+def main(argv=None):
+    """Entry point; returns the exit code (the __main__ shim sys.exits).
+    """
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as exc:
+        return int(exc.code or 0)
+    path = pathlib.Path(args.jsonl) if args.jsonl else DEFAULT_RESULTS
+    rows = load_rows(path)
+    if not rows and not path.exists():
+        print(f"perfwatch: no history at {path}", file=sys.stderr)
+        return 2
+    waivers = load_waivers(args.waivers)
+    report = analyze(rows, waivers=waivers, drift_floor=args.drift_floor,
+                     min_history=args.min_history)
+    regressions, waived = report["regressions"], report["waived"]
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+        return 1 if regressions else 0
+
+    if args.check:
+        for r in regressions:
+            print(f"perfwatch regression: {r['series']} — newest "
+                  f"{_fmt_value(r['newest'])} is {r['delta']:+.1%} vs "
+                  f"baseline {_fmt_value(r['baseline'])} (noise band "
+                  f"±{r['band']:.0%}, n={r['n']})")
+        for r in waived:
+            print(f"perfwatch waived: {r['series']} ({r['delta']:+.1%}) "
+                  f"— {r.get('waive_reason', '')}")
+        return 1 if regressions else 0
+
+    analyzed = [r for r in report["series"]
+                if r["verdict"] != "insufficient-history"]
+    young = len(report["series"]) - len(analyzed)
+    print(f"perfwatch: {len(report['series'])} series, {len(analyzed)} "
+          f"analyzed, {len(regressions)} regression(s), "
+          f"{len(waived)} waived, {young} insufficient-history")
+    for r in analyzed:
+        print("  " + _series_line(r))
+    if young:
+        print(f"  ({young} series below --min-history="
+              f"{args.min_history or _min_history()} not judged)")
+    return 1 if regressions else 0

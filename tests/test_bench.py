@@ -113,3 +113,140 @@ def test_probe_helpers_share_the_scan(results):
     assert bench._recent_serving_row("rb256x64_serving") is None
     assert bench._recent_serving_row("rb256x64_serving",
                                      max_age_sec=0) is not None
+
+
+# ---------------------------------------------------- probe TTL cache
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+@pytest.fixture
+def probe_log(tmp_path):
+    """A results.jsonl fixture path plus a writer; tests pass the path
+    explicitly (results_path=...) so the real trajectory is untouched."""
+    path = tmp_path / "results.jsonl"
+
+    def write(*rows):
+        with open(path, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    return path, write
+
+
+@pytest.fixture
+def no_live_probe(monkeypatch):
+    """Fails the test if the cached path falls through to a live probe;
+    the returned setter swaps in a canned live verdict instead."""
+    def boom(env, timeouts=None, spacing=45):
+        raise AssertionError("live probe ran despite a fresh cached row")
+    monkeypatch.setattr(graft, "_probe_backend_retrying", boom)
+
+    def allow(backend, info, platforms_after=None):
+        def fake(env, timeouts=None, spacing=45):
+            if platforms_after is not None:
+                env["JAX_PLATFORMS"] = platforms_after
+            return backend, info
+        monkeypatch.setattr(graft, "_probe_backend_retrying", fake)
+    return allow
+
+
+def test_probe_cache_replays_ok_verdict(probe_log, no_live_probe):
+    path, write = probe_log
+    write({"kind": "probe", "config": "backend_probe", "ok": True,
+           "backend": "tpu", "devices": 4, "info": None,
+           "platforms": "tpu,cpu", "platforms_after": "tpu,cpu",
+           "ts": time.time() - 60})
+    env = {"JAX_PLATFORMS": "tpu,cpu"}
+    backend, devices = graft._probe_backend_cached(env, results_path=path)
+    assert (backend, devices) == ("tpu", 4)
+    assert env["JAX_PLATFORMS"] == "tpu,cpu"
+    # a cache replay appends nothing — only LIVE probes make history
+    assert len(path.read_text().splitlines()) == 1
+
+
+def test_probe_cache_replays_failure_and_platform_fallback(
+        probe_log, no_live_probe):
+    """A recorded failed probe that settled JAX_PLATFORMS onto the CPU
+    fallback replays BOTH the verdict and the env mutation."""
+    path, write = probe_log
+    write({"kind": "probe", "config": "backend_probe", "ok": False,
+           "backend": None, "devices": None,
+           "info": "device probe timed out after 90s",
+           "platforms": "tpu,cpu", "platforms_after": None,
+           "ts": time.time() - 60})
+    env = {"JAX_PLATFORMS": "tpu,cpu"}
+    backend, info = graft._probe_backend_cached(env, results_path=path)
+    assert backend is None
+    assert "cached probe failure" in info and "timed out" in info
+    assert "JAX_PLATFORMS" not in env        # replayed the fallback pop
+
+
+def test_probe_cache_ttl_expiry_probes_live(probe_log, no_live_probe):
+    path, write = probe_log
+    write({"kind": "probe", "config": "backend_probe", "ok": True,
+           "backend": "tpu", "devices": 4, "platforms": None,
+           "platforms_after": None, "ts": time.time() - 3600})
+    no_live_probe("cpu", 1)
+    backend, devices = graft._probe_backend_cached(
+        {}, cache_sec=900, results_path=path)
+    assert (backend, devices) == ("cpu", 1)
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(rows) == 2                    # the live probe wrote history
+    assert rows[-1]["ok"] is True and rows[-1]["backend"] == "cpu"
+    assert rows[-1]["wall_sec"] >= 0
+    assert "env" in rows[-1]                 # fingerprint-stamped
+
+
+def test_probe_cache_platforms_mismatch_probes_live(
+        probe_log, no_live_probe):
+    """A verdict recorded for a different requested JAX_PLATFORMS never
+    answers for this one."""
+    path, write = probe_log
+    write({"kind": "probe", "config": "backend_probe", "ok": True,
+           "backend": "tpu", "devices": 4, "platforms": "tpu,cpu",
+           "platforms_after": "tpu,cpu", "ts": time.time() - 10})
+    no_live_probe("cpu", 1)
+    backend, _ = graft._probe_backend_cached(
+        {"JAX_PLATFORMS": "cpu"}, results_path=path)
+    assert backend == "cpu"
+    assert len(path.read_text().splitlines()) == 2
+
+
+def test_probe_cache_zero_ttl_disables(probe_log, no_live_probe):
+    path, write = probe_log
+    write({"kind": "probe", "config": "backend_probe", "ok": True,
+           "backend": "tpu", "devices": 4, "platforms": None,
+           "platforms_after": None, "ts": time.time() - 1})
+    no_live_probe("cpu", 1)
+    backend, _ = graft._probe_backend_cached(
+        {}, cache_sec=0, results_path=path)
+    assert backend == "cpu"                  # fresh row ignored: TTL off
+
+
+def test_probe_cache_ttl_is_config_pinned():
+    from dedalus_tpu.tools.config import config
+    assert graft._probe_cache_sec() == pytest.approx(
+        float(config.get("bench", "PROBE_CACHE_SEC")))
+    old = config.get("bench", "PROBE_CACHE_SEC")
+    try:
+        config.set("bench", "PROBE_CACHE_SEC", "60")
+        assert graft._probe_cache_sec() == 60.0
+    finally:
+        config.set("bench", "PROBE_CACHE_SEC", old)
+
+
+def test_append_result_stamps_env_fingerprint(tmp_path):
+    """Every results.jsonl row grows the host/environment fingerprint —
+    the provenance perfwatch needs to tell host drift from regressions."""
+    path = tmp_path / "results.jsonl"
+    graft._append_result({"config": "x", "value": 1.0}, path=path)
+    row = json.loads(path.read_text().splitlines()[0])
+    env = row["env"]
+    assert env["env_version"] == 1
+    assert env["python"] and env["host"]
+    assert isinstance(env["cpu_count"], int)
+    # an explicit env on the record is never overwritten
+    graft._append_result({"config": "y", "env": {"canned": True}},
+                         path=path)
+    row2 = json.loads(path.read_text().splitlines()[1])
+    assert row2["env"] == {"canned": True}

@@ -302,3 +302,84 @@ def test_report_trace_record_line(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "(trace) cccc000011112222: root request 500.0 ms, 3 spans" \
         in proc.stdout
+
+
+def test_report_ledger_rows_with_deltas(tmp_path):
+    """`kind: ledger` rows render one line per program with deltas vs
+    the previous round of the same (program, backend) series; rows from
+    before the cost tier versioned its fields render as the literal
+    `ledger=unversioned` backfill instead of crashing."""
+    fixture = tmp_path / "ledger.jsonl"
+    plan = {"plan_version": 1, "solve_composition": "sequential"}
+    rows = [
+        {"kind": "ledger", "config": "progcheck_census",
+         "program": "diffusion_step", "backend": "cpu",
+         "ledger_version": 1, "flops": 1000000, "bytes_accessed": 5000000,
+         "peak_bytes": 2000000, "hlo_instructions": 300,
+         "scan_max_length": 6, "plan": plan, "ts": 1.0},
+        {"kind": "ledger", "config": "progcheck_census",
+         "program": "diffusion_step", "backend": "cpu",
+         "ledger_version": 1, "flops": 1200000, "bytes_accessed": 5000000,
+         "peak_bytes": 2500000, "hlo_instructions": 300,
+         "scan_max_length": 6, "plan": plan, "ts": 2.0},
+        # a pre-cost-tier row: no ledger_version, no fields
+        {"kind": "ledger", "program": "old_prog", "ts": 3.0},
+    ]
+    fixture.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert out.count("(ledger) diffusion_step [cpu]:") == 2
+    assert "flops=1,000,000" in out                  # first round, no delta
+    assert "flops=1,200,000 (+20.0%)" in out         # second round delta
+    assert "peak_mem=2,500,000 (+25.0%)" in out
+    assert "scan_depth=6" in out
+    assert "solve=sequential" in out                 # plan provenance line
+    assert out.count("ledger=unversioned") == 1      # the backfill guard
+    assert "3 other" in out
+
+
+def test_report_perfwatch_trend_table(tmp_path):
+    """With enough history in the file, report appends the perfwatch
+    trend table before the summary line; a short fixture renders none
+    (analyzed-series-only keeps young files quiet)."""
+    fixture = tmp_path / "trend.jsonl"
+    rows = [{"config": "trendcfg", "backend": "cpu", "steps_per_sec": v,
+             "ts": float(i)}
+            for i, v in enumerate([10.0, 10.1, 9.9, 10.0, 6.0])]
+    fixture.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run_cli(["report", str(fixture)])
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "perfwatch trends:" in out
+    assert "steps_per_sec:trendcfg:cpu:unversioned" in out
+    assert "regression" in out
+    # the trend table precedes the summary line
+    assert out.index("perfwatch trends:") < out.index("metrics record(s)")
+    # a young file adds no table
+    short = tmp_path / "short.jsonl"
+    short.write_text(json.dumps(rows[0]) + "\n")
+    proc = _run_cli(["report", str(short)])
+    assert proc.returncode == 0, proc.stderr
+    assert "perfwatch trends:" not in proc.stdout
+
+
+def test_perfwatch_subprocess(tmp_path):
+    """`python -m dedalus_tpu perfwatch` end to end: rc 0 + summary on a
+    stable fixture, rc 1 + named finding under --check on a regressed
+    one."""
+    stable = tmp_path / "stable.jsonl"
+    rows = [{"config": "c", "backend": "cpu", "steps_per_sec": v,
+             "ts": float(i)}
+            for i, v in enumerate([10.0, 10.1, 9.9, 10.0, 10.05])]
+    stable.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run_cli(["perfwatch", str(stable)])
+    assert proc.returncode == 0, proc.stderr
+    assert "1 analyzed, 0 regression(s)" in proc.stdout
+    regressed = tmp_path / "regressed.jsonl"
+    rows[-1]["steps_per_sec"] = 6.0
+    regressed.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run_cli(["perfwatch", str(regressed), "--check"])
+    assert proc.returncode == 1
+    assert "perfwatch regression: steps_per_sec:c:cpu:unversioned" \
+        in proc.stdout

@@ -1,0 +1,294 @@
+"""
+Perf-trajectory regression sentinel (tools/perfwatch.py): each seeded
+regression class must FIRE (steps/s down, requests/s down, peak memory
+up, ledger flops/HLO/scan-depth up), the documented ±15% host drift must
+NOT, and the evidence rules that keep the sentinel quiet on real history
+— no-ts exclusion, finite:false exclusion, stale-re-report dedupe,
+waivers — each hold on a minimal fixture. No jax import anywhere: the
+sentinel reads JSONL, and so do these tests.
+"""
+
+import json
+
+import pytest
+
+from dedalus_tpu.tools import perfwatch
+
+
+def _write(path, rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return path
+
+
+def _series(rows, key):
+    return perfwatch.build_series(rows).get(key)
+
+
+# ------------------------------------------------------- regression classes
+
+def _steps_rows(values, config="rbX", backend="cpu"):
+    return [{"config": config, "backend": backend, "steps_per_sec": v,
+             "ts": float(i)} for i, v in enumerate(values)]
+
+
+def test_steps_per_sec_drop_fires():
+    rows = _steps_rows([10.0, 10.2, 9.9, 10.1, 6.0])
+    report = perfwatch.analyze(rows)
+    (reg,) = report["regressions"]
+    assert reg["series"] == "steps_per_sec:rbX:cpu:unversioned"
+    assert reg["delta"] < -0.15
+
+
+def test_requests_per_sec_drop_fires():
+    rows = [{"config": "srv", "backend": "cpu",
+             "throughput_requests_per_sec": v, "ts": float(i)}
+            for i, v in enumerate([20.0, 19.5, 20.5, 20.1, 11.0])]
+    report = perfwatch.analyze(rows)
+    (reg,) = report["regressions"]
+    assert reg["series"].startswith("requests_per_sec:srv:")
+
+
+def test_peak_memory_growth_fires():
+    rows = [{"config": "rbX", "backend": "cpu",
+             "device_mem_peak_bytes": v, "ts": float(i)}
+            for i, v in enumerate([1e9, 1.02e9, 0.99e9, 1.01e9, 2.2e9])]
+    report = perfwatch.analyze(rows)
+    (reg,) = report["regressions"]
+    assert reg["series"] == "device_mem_peak_bytes:rbX:cpu:unversioned"
+    assert reg["direction"] == "up" and reg["delta"] > 0.15
+
+
+@pytest.mark.parametrize("field,metric", [
+    ("flops", "ledger_flops"),
+    ("bytes_accessed", "ledger_bytes"),
+    ("hlo_instructions", "ledger_hlo_instructions"),
+    ("scan_max_length", "ledger_scan_depth"),
+])
+def test_ledger_growth_fires(field, metric):
+    rows = [{"kind": "ledger", "program": "prog", "backend": "cpu",
+             field: v, "ts": float(i)}
+            for i, v in enumerate([100, 101, 99, 100, 180])]
+    report = perfwatch.analyze(rows)
+    (reg,) = report["regressions"]
+    assert reg["series"] == f"{metric}:prog:cpu:unversioned"
+
+
+def test_improvement_is_quiet():
+    """The bands are one-sided: moving the GOOD way never fires."""
+    faster = perfwatch.analyze(_steps_rows([10.0, 10.1, 9.9, 10.0, 30.0]))
+    assert not faster["regressions"]
+    leaner = perfwatch.analyze(
+        [{"kind": "ledger", "program": "p", "backend": "cpu", "flops": v,
+          "ts": float(i)} for i, v in enumerate([100, 101, 99, 100, 20])])
+    assert not leaner["regressions"]
+
+
+# ------------------------------------------------------------ noise bands
+
+def test_host_drift_absorbed():
+    """±15% scatter around a stable baseline — the documented host drift
+    — stays inside the floor band even when the newest point lands at
+    the bottom of the range."""
+    rows = _steps_rows([100.0, 103.0, 97.0, 101.0, 99.0, 86.0])
+    report = perfwatch.analyze(rows)
+    assert not report["regressions"]
+    (res,) = [r for r in report["series"] if r["verdict"] != "waived"]
+    assert res["verdict"] == "ok"
+    assert res["band"] >= 0.15
+
+
+def test_noisy_series_widens_band():
+    """Historical dispersion beyond the floor widens the band: a swing
+    that would fire against a tight history is absorbed by a noisy one.
+    """
+    noisy_hist = [100.0, 140.0, 70.0, 125.0, 80.0]
+    noisy = perfwatch.analyze(_steps_rows(noisy_hist + [60.0]))
+    assert not noisy["regressions"]
+    tight = perfwatch.analyze(
+        _steps_rows([100.0, 101.0, 99.0, 100.5, 99.5] + [60.0]))
+    assert len(tight["regressions"]) == 1
+
+
+def test_insufficient_history_not_judged():
+    report = perfwatch.analyze(_steps_rows([10.0, 4.0]))
+    assert not report["regressions"]
+    assert report["series"][0]["verdict"] == "insufficient-history"
+
+
+def test_analyze_series_min_history_boundary():
+    values = [10.0, 10.0, 10.0, 5.0]
+    judged = perfwatch.analyze_series(values, "down", min_history=3)
+    assert judged["verdict"] == "regression"
+    young = perfwatch.analyze_series(values, "down", min_history=4)
+    assert young["verdict"] == "insufficient-history"
+
+
+# -------------------------------------------------------- evidence rules
+
+def test_rows_without_ts_excluded():
+    """No provenance, no evidence: undated rows never enter a series."""
+    rows = _steps_rows([10.0, 10.1, 9.9, 10.0, 6.0])
+    for row in rows[:3]:
+        del row["ts"]
+    assert not perfwatch.analyze(rows)["regressions"]
+    series = _series(rows, "steps_per_sec:rbX:cpu:unversioned")
+    assert len(series["values"]) == 2
+
+
+def test_nonfinite_rows_excluded():
+    rows = _steps_rows([10.0, 10.1, 9.9, 10.0])
+    rows.append({"config": "rbX", "backend": "cpu", "finite": False,
+                 "steps_per_sec": 52.0, "ts": 4.0})
+    series = _series(rows, "steps_per_sec:rbX:cpu:unversioned")
+    assert 52.0 not in series["values"]
+
+
+def test_stale_rereports_deduped():
+    """A measurement re-reported by later doc builds (measured_ts +
+    source) counts ONCE, at its original time — re-reports must neither
+    pad the history nor masquerade as fresh points."""
+    rows = [{"config": "rbX", "backend": "cpu", "metric": "m",
+             "value": 10.0, "unit": "steps/sec", "ts": float(i)}
+            for i in range(4)]
+    for i, ts in enumerate((10.0, 11.0, 12.0)):
+        rows.append({"config": "rbX", "backend": "cpu", "metric": "m",
+                     "value": 9.8, "unit": "steps/sec", "ts": ts,
+                     "measured_ts": 5.0, "source": "docs", "stale": True})
+    series = _series(rows, "m:rbX:cpu:unversioned")
+    assert series["values"] == [10.0] * 4 + [9.8]
+
+
+def test_non_measurement_kinds_skipped():
+    rows = [{"kind": "probe", "config": "backend_probe", "ok": True,
+             "ts": 1.0, "wall_sec": 800.0},
+            {"kind": "service_stats", "requests_served": 3, "ts": 2.0},
+            {"kind": "trace", "trace_id": "t1", "ts": 3.0}]
+    assert perfwatch.extract_points(rows) == []
+
+
+def test_plan_digest_separates_series():
+    """A plan change re-keys the series: points before and after never
+    share a baseline."""
+    plan = {"plan_version": 1, "fusion": {"solve": True, "matvec": True},
+            "solve_composition": "ascan", "solve_dtype": "f32",
+            "refine_sweeps": 2, "spike_chunks": 0, "transpose_chunks": 2,
+            "solver_key": "abc123"}
+    assert perfwatch.plan_key(plan) == "v1.sm.ascan.f32.s2.k0.t2"
+    assert perfwatch.plan_key(None) == "unversioned"
+    rows = _steps_rows([10.0, 10.1, 9.9, 10.0])
+    rows.append({"config": "rbX", "backend": "cpu", "steps_per_sec": 6.0,
+                 "ts": 4.0, "plan": plan})
+    assert not perfwatch.analyze(rows)["regressions"]
+    assert len(perfwatch.build_series(rows)) == 2
+
+
+def test_solver_key_does_not_rekey():
+    """solver_key re-keys the assembly cache on ANY assembly change; the
+    series digest must ignore it or every tweak would orphan history."""
+    a = {"plan_version": 1, "solver_key": "aaa"}
+    b = {"plan_version": 1, "solver_key": "bbb"}
+    assert perfwatch.plan_key(a) == perfwatch.plan_key(b)
+
+
+def test_solvecomp_sweep_cells_are_series():
+    rows = [{"benchmark": "solvecomp", "config": "rb", "backend": "cpu",
+             "ts": float(i),
+             "sweep": [{"composition": "ascan", "solve_dtype": "f64",
+                        "steps_per_sec": v}]}
+            for i, v in enumerate([5.0, 5.1, 4.9, 5.0, 2.0])]
+    report = perfwatch.analyze(rows)
+    (reg,) = report["regressions"]
+    assert reg["series"] == "steps_per_sec:rb/ascan/f64:cpu:unversioned"
+
+
+# --------------------------------------------------------------- waivers
+
+def test_waiver_matches_and_exits_zero(tmp_path):
+    rows = _steps_rows([10.0, 10.1, 9.9, 10.0, 6.0])
+    waivers = [{"series": "steps_per_sec:rbX:*", "reason": "by design"}]
+    report = perfwatch.analyze(rows, waivers=waivers)
+    assert not report["regressions"]
+    (waived,) = report["waived"]
+    assert waived["waive_reason"] == "by design"
+    fixture = _write(tmp_path / "r.jsonl", rows)
+    wfile = tmp_path / "w.json"
+    wfile.write_text(json.dumps({"waivers": waivers}))
+    assert perfwatch.main([str(fixture), "--check",
+                           "--waivers", str(wfile)]) == 0
+
+
+def test_repo_waiver_file_loads():
+    """The checked-in waiver file must parse and carry the PR-15 ascan
+    entry (the one known intentional CPU slowdown)."""
+    waivers = perfwatch.load_waivers()
+    assert any("solvecomp/ascan" in w["series"] for w in waivers)
+    assert all(w.get("reason") for w in waivers)
+
+
+def test_malformed_waiver_file_waives_nothing(tmp_path):
+    bad = tmp_path / "w.json"
+    bad.write_text("{not json")
+    assert perfwatch.load_waivers(bad) == []
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_quiet_on_stable_history(tmp_path, capsys):
+    fixture = _write(tmp_path / "r.jsonl",
+                     _steps_rows([10.0, 10.1, 9.9, 10.0, 10.05]))
+    assert perfwatch.main([str(fixture), "--check"]) == 0
+    assert capsys.readouterr().out == ""
+    assert perfwatch.main([str(fixture)]) == 0
+    out = capsys.readouterr().out
+    assert "1 analyzed, 0 regression(s)" in out
+
+
+def test_cli_fires_with_named_finding(tmp_path, capsys):
+    rows = (_steps_rows([10.0, 10.1, 9.9, 10.0, 6.0])
+            + [{"kind": "ledger", "program": "p", "backend": "cpu",
+                "flops": v, "ts": float(i)}
+               for i, v in enumerate([100, 101, 99, 100, 180])])
+    fixture = _write(tmp_path / "r.jsonl", rows)
+    assert perfwatch.main([str(fixture), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "perfwatch regression: steps_per_sec:rbX:cpu:unversioned" in out
+    assert "perfwatch regression: ledger_flops:p:cpu:unversioned" in out
+    assert "-40" in out         # the measured drop, human-readable
+
+
+def test_cli_json_mode(tmp_path, capsys):
+    fixture = _write(tmp_path / "r.jsonl",
+                     _steps_rows([10.0, 10.1, 9.9, 10.0, 6.0]))
+    assert perfwatch.main([str(fixture), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["regressions"][0]["verdict"] == "regression"
+
+
+def test_cli_missing_file(tmp_path, capsys):
+    assert perfwatch.main([str(tmp_path / "absent.jsonl")]) == 2
+    assert "no history" in capsys.readouterr().err
+
+
+def test_cli_drift_floor_override(tmp_path):
+    rows = _steps_rows([10.0, 10.1, 9.9, 10.0, 9.0])   # -10.5%
+    fixture = _write(tmp_path / "r.jsonl", rows)
+    assert perfwatch.main([str(fixture), "--check"]) == 0
+    assert perfwatch.main([str(fixture), "--check",
+                           "--drift-floor", "0.05"]) == 1
+
+
+def test_trend_lines_analyzed_only():
+    rows = _steps_rows([10.0, 10.1, 9.9, 10.0, 6.0])
+    rows += _steps_rows([5.0, 5.0], config="young")
+    lines = perfwatch.trend_lines(rows)
+    assert len(lines) == 1
+    assert "steps_per_sec:rbX:cpu:unversioned" in lines[0]
+    assert "regression" in lines[0]
+    assert perfwatch.trend_lines(_steps_rows([1.0])) == []
+
+
+def test_load_rows_tolerates_junk(tmp_path):
+    path = tmp_path / "r.jsonl"
+    path.write_text('{"config": "a", "ts": 1.0}\nnot json\n[1,2]\n')
+    rows = perfwatch.load_rows(path)
+    assert rows == [{"config": "a", "ts": 1.0}]

@@ -461,3 +461,147 @@ def test_donated_alias_count_parser():
             "ENTRY %main ...")
     assert donated_alias_count(head) == 3
     assert donated_alias_count("HloModule jit_f, is_scheduled=true\n") == 0
+
+
+# ------------------------------------------------------- resource ledger
+
+class _FakeMem:
+    argument_size_in_bytes = 100
+    output_size_in_bytes = 40
+    temp_size_in_bytes = 60
+    generated_code_size_in_bytes = 7
+    alias_size_in_bytes = 30
+
+
+class _FakeCompiled:
+    def cost_analysis(self):
+        return {"flops": 123.0, "transcendentals": 4.0,
+                "bytes accessed": 456.0}
+
+    def memory_analysis(self):
+        return _FakeMem()
+
+
+def test_program_ledger_full():
+    text = ("  %a = f32[4]{0} add(f32[4]{0} %p, f32[4]{0} %p)\n"
+            "  ROOT %b = f32[4]{0} multiply(%a, %a)\n")
+    led = progcheck.program_ledger(_FakeCompiled(), hlo_text=text)
+    assert led["ledger_version"] == 1
+    assert led["flops"] == 123
+    assert led["transcendentals"] == 4
+    assert led["bytes_accessed"] == 456
+    assert led["argument_bytes"] == 100
+    assert led["peak_bytes"] == 100 + 40 + 60 - 30   # alias-corrected
+    assert led["hlo_instructions"] == 2
+
+
+def test_program_ledger_guarded_fallbacks():
+    """A backend where the analyses are absent or raise yields nulls for
+    their fields and never an exception — the census stays green."""
+    class Raising:
+        def cost_analysis(self):
+            raise NotImplementedError("no cost analysis here")
+
+        def memory_analysis(self):
+            raise RuntimeError("nor memory analysis")
+
+    led = progcheck.program_ledger(Raising())
+    assert led["ledger_version"] == 1
+    assert all(led[f] is None for f in progcheck.LEDGER_FIELDS)
+
+    class Missing:
+        pass                      # neither method exists at all
+
+    led = progcheck.program_ledger(Missing(), hlo_text="%r = f32[] x()")
+    assert led["flops"] is None and led["peak_bytes"] is None
+    assert led["hlo_instructions"] == 1
+
+    class OldStyle:               # list-of-dicts cost_analysis (old jax)
+        def cost_analysis(self):
+            return [{"flops": 9.0}, {"flops": 1.0}]
+
+        def memory_analysis(self):
+            raise RuntimeError("unavailable")
+
+    led = progcheck.program_ledger(OldStyle())
+    assert led["flops"] == 9                   # main computation first
+    assert led["bytes_accessed"] is None
+    assert led["argument_bytes"] is None
+
+
+def test_record_from_jit_carries_ledger():
+    rec = record_from_jit("seed_ledgered",
+                          lambda a: jnp.sin(a) * 2.0, (jnp.ones(64),))
+    assert rec.ledger is not None
+    assert rec.ledger["ledger_version"] == 1
+    assert rec.ledger["hlo_instructions"] > 0
+    assert rec.stats()["ledger"] == rec.ledger
+    # jaxpr-only records (the DTP105 tier) carry no ledger — and report
+    # none rather than zeros
+    uncompiled = record_from_jit("seed_uncompiled", lambda a: a + 1.0,
+                                 (jnp.ones(4),), compile=False)
+    assert uncompiled.ledger is None
+    assert "ledger" not in uncompiled.stats()
+
+
+def test_ledger_rows_shape_and_scan_depth():
+    def scanned(x):
+        return jax.lax.scan(lambda c, _: (c + 1.0, None), x, None,
+                            length=17)[0]
+
+    rec = record_from_jit("seed_ledger_row", scanned, (jnp.ones(8),))
+    skipped = ProgramRecord("too_big", skipped="needs >= 64 devices")
+    rows = progcheck.ledger_rows([rec, skipped])
+    assert len(rows) == 1                       # skipped yields no row
+    row = rows[0]
+    assert row["kind"] == "ledger"
+    assert row["config"] == "progcheck_census"
+    assert row["program"] == "seed_ledger_row"
+    assert row["scan_max_length"] == 17
+    assert row["while_loops"] == 0
+    assert row["plan"] is None                  # fixture has no solver
+    assert row["env"]["env_version"] == 1
+    assert row["env"]["python"]                 # fingerprint is stamped
+    assert row["hlo_instructions"] > 0
+
+
+def test_append_ledger_rows_appends(tmp_path):
+    import json
+    rec = record_from_jit("seed_ledger_append", lambda a: a * 2.0,
+                          (jnp.ones(8),))
+    sink = tmp_path / "results.jsonl"
+    rows = progcheck.append_ledger_rows([rec], sink)
+    assert len(rows) == 1
+    lines = sink.read_text().splitlines()
+    assert len(lines) == 1
+    row = json.loads(lines[0])
+    assert row["program"] == "seed_ledger_append"
+    assert row["ts"] > 0
+    progcheck.append_ledger_rows([rec], sink)   # append, never truncate
+    assert len(sink.read_text().splitlines()) == 2
+
+
+def test_cli_programs_ledger_flag(capsys, monkeypatch, tmp_path):
+    """`lint --programs --ledger PATH` appends trajectory rows and says
+    so; without the flag the census writes nothing."""
+    import json
+
+    def builder():
+        return [record_from_jit("seed_ledger_cli", lambda a: a * 2.0,
+                                (jnp.ones(8),))]
+
+    monkeypatch.setitem(progcheck.CENSUS, "seed_ledger_cli",
+                        (builder, True))
+    sink = tmp_path / "results.jsonl"
+    rc = lint_main(["--programs", "--select", "seed_ledger_cli",
+                    "--ledger", str(sink)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ledger: 1 trajectory row(s) appended" in out
+    row = json.loads(sink.read_text().splitlines()[0])
+    assert row["kind"] == "ledger"
+    assert row["program"] == "seed_ledger_cli"
+    rc = lint_main(["--programs", "--select", "seed_ledger_cli"])
+    capsys.readouterr()
+    assert rc == 0
+    assert len(sink.read_text().splitlines()) == 1   # opt-in: no growth

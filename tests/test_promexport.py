@@ -1,0 +1,232 @@
+"""
+Scrapeable serving metrics (service/promexport.py): the Prometheus text
+exposition renderer against the in-repo format validator, LogHistogram
+-> native-histogram conversion with exact bucket bounds, and the two
+transport paths off a live in-process daemon — the `stats` frame with
+`prom: true` (ServiceClient.stats_prom) and GET /metrics on the
+[service] METRICS_PORT listener. The acceptance bar: everything either
+path serves parses under validate_exposition, histograms included.
+"""
+
+import io
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dedalus_tpu.service import promexport
+from dedalus_tpu.tools import tracing
+
+pytestmark = pytest.mark.service
+
+
+def _stats(**overrides):
+    """A stats() dict shaped like SolverService.stats() emits."""
+    stats = {
+        "requests_served": 7, "errors": 2, "draining": None,
+        "uptime_sec": 12.5,
+        "pool": {"size": 4, "entries": [{"key": "a"}], "hits": 5,
+                 "misses": 2, "evictions": 1, "resets": 3},
+        "serving": {"batching": {"enabled": False}},
+        "faults": {"queue_depth": 8, "queued": 1, "shed": 4,
+                   "deadline_exceeded": 1, "watchdog_fires": 0,
+                   "client_drops": 2, "mem_evictions": 0, "replays": 3,
+                   "result_cache": 2,
+                   "breaker": {"opens": 1, "closes": 1, "fastfails": 6,
+                               "open": ["spec-a"]},
+                   "error_codes": {"bad-spec": 1, "overloaded": 1}},
+    }
+    stats.update(overrides)
+    return stats
+
+
+# ------------------------------------------------------------- rendering
+
+def test_render_counters_and_gauges():
+    text = promexport.render_stats(_stats())
+    families = promexport.validate_exposition(text)
+    assert "dedalus_requests_served_total 7" in text
+    assert "dedalus_errors_total 2" in text
+    assert "dedalus_pool_hits_total 5" in text
+    assert "dedalus_pool_entries 1" in text
+    assert "dedalus_queued_runs 1" in text
+    assert "dedalus_shed_total 4" in text
+    assert "dedalus_replays_total 3" in text
+    assert "dedalus_breaker_fastfails_total 6" in text
+    assert "dedalus_breaker_open_circuits 1" in text
+    assert "dedalus_draining 0" in text
+    assert 'dedalus_errors_by_code_total{code="bad-spec"} 1' in text
+    assert 'dedalus_errors_by_code_total{code="overloaded"} 1' in text
+    assert families["dedalus_requests_served_total"]["type"] == "counter"
+    assert families["dedalus_pool_entries"]["type"] == "gauge"
+
+
+def test_render_draining_and_batching():
+    batching = {"enabled": True, "batch_max": 4, "batches": 9,
+                "members": 21, "late_joins": 2, "blocks": 30,
+                "peak_members": 4,
+                "detached": {"finished": 19, "deadline": 2}}
+    text = promexport.render_stats(
+        _stats(draining="SIGTERM",
+               serving={"batching": batching}))
+    promexport.validate_exposition(text)
+    assert "dedalus_draining 1" in text
+    assert "dedalus_batching_enabled 1" in text
+    assert "dedalus_batches_total 9" in text
+    assert "dedalus_batch_peak_members 4" in text
+    assert 'dedalus_batch_detached_total{cause="finished"} 19' in text
+    # disabled batching exports only the enabled gauge
+    off = promexport.render_stats(_stats())
+    assert "dedalus_batching_enabled 0" in off
+    assert "dedalus_batches_total" not in off
+
+
+def test_render_tolerates_sparse_stats():
+    """Rows from older daemons (missing whole sub-dicts) render what
+    they have instead of crashing — and still validate."""
+    for stats in ({}, {"requests_served": 1}, {"pool": {}},
+                  {"faults": {"breaker": {}}}):
+        text = promexport.render_stats(stats)
+        promexport.validate_exposition(text)
+        assert "dedalus_up 1" in text
+
+
+# ------------------------------------------------------------ histograms
+
+def test_histogram_conversion_exact():
+    hist = tracing.LogHistogram()
+    for s in (0.1, 0.1, 0.2, 3.0):
+        hist.add(s)
+    text = promexport.render_stats(
+        {}, {"run_seconds": (hist, "run wall")})
+    families = promexport.validate_exposition(text)
+    assert families["dedalus_run_seconds"]["type"] == "histogram"
+    assert 'dedalus_run_seconds_bucket{le="+Inf"} 4' in text
+    assert "dedalus_run_seconds_count 4" in text
+    assert "dedalus_run_seconds_sum 3.4" in text
+    # each le is the exact log-bucket upper bound, and every observation
+    # sits at or below its bucket's bound
+    for line in text.splitlines():
+        if "_bucket" in line and "+Inf" not in line:
+            le = float(line.split('le="')[1].split('"')[0])
+            b = hist._bucket(le * 0.999999)
+            assert math.isclose(le, tracing._LOG_FLOOR
+                                * tracing._LOG_BASE ** b, rel_tol=1e-9)
+    # cumulative counts non-decreasing is validator-enforced; check the
+    # 0.1s pair shares a bucket (same le line carries >= 2)
+    b01 = hist._bucket(0.1)
+    le01 = tracing._LOG_FLOOR * tracing._LOG_BASE ** b01
+    assert f'le="{le01!r}"' in text
+
+
+def test_histogram_from_snapshot_dict():
+    """The server snapshots hists under its counters lock and hands the
+    renderer plain dicts; empty histograms still render completely."""
+    snap = {"counts": {3: 2, 10: 1}, "total": 3, "sum": 0.5}
+    text = promexport.render_stats({}, {"queue_seconds": (snap, "queue")})
+    promexport.validate_exposition(text)
+    assert 'dedalus_queue_seconds_bucket{le="+Inf"} 3' in text
+    empty = promexport.render_stats(
+        {}, {"queue_seconds": ({"counts": {}, "total": 0, "sum": 0.0},
+                               "queue")})
+    fams = promexport.validate_exposition(empty)
+    assert fams["dedalus_queue_seconds"]["type"] == "histogram"
+    assert "dedalus_queue_seconds_count 0" in empty
+
+
+# ------------------------------------------------------------- validator
+
+def test_validator_rejects_malformed():
+    bad = [
+        "dedalus_x{unclosed 1\n",                          # label syntax
+        "dedalus_x 1\ndedalus_x 2\n",                      # duplicate
+        "# TYPE dedalus_x wat\ndedalus_x 1\n",             # unknown type
+        "dedalus_x notanumber\n",                          # value
+        "# TYPE dedalus_h histogram\n"                     # no +Inf
+        'dedalus_h_bucket{le="1"} 1\n'
+        "dedalus_h_sum 1.0\ndedalus_h_count 1\n",
+        "# TYPE dedalus_h histogram\n"                     # not cumulative
+        'dedalus_h_bucket{le="1"} 3\n'
+        'dedalus_h_bucket{le="2"} 2\n'
+        'dedalus_h_bucket{le="+Inf"} 3\n'
+        "dedalus_h_sum 1.0\ndedalus_h_count 3\n",
+        "# TYPE dedalus_h histogram\n"                     # count mismatch
+        'dedalus_h_bucket{le="+Inf"} 3\n'
+        "dedalus_h_sum 1.0\ndedalus_h_count 2\n",
+    ]
+    for text in bad:
+        with pytest.raises(ValueError):
+            promexport.validate_exposition(text)
+
+
+def test_validator_accepts_escapes_and_comments():
+    ok = ('# random comment\n'
+          '# HELP m help text with "quotes"\n'
+          '# TYPE m counter\n'
+          'm{path="C:\\\\dir\\"x\\""} 1\n'
+          'm{path="other"} 2\n')
+    families = promexport.validate_exposition(ok)
+    assert families["m"]["samples"] == 2
+
+
+# ------------------------------------------------- live daemon transports
+
+@pytest.fixture()
+def live_service():
+    """In-process daemon with an ephemeral /metrics listener: exercises
+    serve_forever's real bind/teardown without a subprocess."""
+    from dedalus_tpu.service.server import SolverService
+    svc = SolverService(port=0, metrics_port=0)
+    ready = io.StringIO()
+    thread = threading.Thread(target=svc.serve_forever,
+                              kwargs={"ready_stream": ready}, daemon=True)
+    thread.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if svc.started_ts and svc.port and svc._metrics_server is not None:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("daemon did not come up")
+    yield svc
+    svc.request_drain("test teardown")
+    thread.join(timeout=30)
+
+
+def test_stats_prom_frame_and_http(live_service):
+    from dedalus_tpu.service.client import ServiceClient
+    svc = live_service
+    with svc._counters_lock:
+        svc.hists["run_seconds"].add(0.25)
+        svc.hists["queue_seconds"].add(0.002)
+    text = ServiceClient(port=svc.port, retries=0).stats_prom()
+    promexport.validate_exposition(text)
+    assert "dedalus_up 1" in text
+    assert 'dedalus_run_seconds_bucket{le="+Inf"} 1' in text
+    # the HTTP listener serves the same surface
+    url = f"http://127.0.0.1:{svc.metrics_port}/metrics"
+    resp = urllib.request.urlopen(url, timeout=10)
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith(
+        "text/plain; version=0.0.4")
+    body = resp.read().decode("utf-8")
+    promexport.validate_exposition(body)
+    assert "dedalus_queue_seconds_count 1" in body
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.metrics_port}/other", timeout=10)
+    # plain JSON stats still work on the same daemon
+    stats = ServiceClient(port=svc.port, retries=0).stats()
+    assert stats["kind"] == "stats"
+    assert "pool" in stats
+
+
+def test_metrics_listener_disabled_by_default():
+    from dedalus_tpu.service.server import SolverService
+    svc = SolverService(port=0)                # config METRICS_PORT = 0
+    assert svc.metrics_port is None
+    svc._start_metrics_server()                # must be a no-op
+    assert svc._metrics_server is None
