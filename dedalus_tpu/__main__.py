@@ -357,6 +357,16 @@ def report(args):
                   f"(limit {record.get('watchdog_sec', '?')}s) at "
                   f"iter={record.get('iteration', '?')}, "
                   f"{len(stacks)} thread stack(s) recorded")
+            # held-locks map beside the stacks: recorded only when the
+            # daemon ran with the lock-order sanitizer on ([sanitize]
+            # LOCK_ORDER) — on a deadlock postmortem this names the lock
+            # each thread is blocked on, not just the frame it sits in
+            for tname, locks in sorted(
+                    (record.get("held_locks") or {}).items()):
+                held = ", ".join(locks.get("held") or []) or "none"
+                waiting = locks.get("waiting")
+                print(f"    locks[{tname}]: held {held}"
+                      + (f"; waiting on {waiting}" if waiting else ""))
         elif kind == "ledger":
             # resource-ledger rows (tools/lint/progcheck.py cost tier):
             # one line per census program with deltas against the

@@ -77,6 +77,7 @@ import numpy as np
 from . import faults, protocol
 from ..tools import tracing
 from ..tools.config import cfg_get
+from ..tools.lint.threadcheck import named_lock
 
 logger = logging.getLogger(__name__)
 
@@ -203,7 +204,8 @@ class BatchDispatcher:
             batch_block if batch_block is not None
             else cfg_get("service", "BATCH_BLOCK_ITERS", "8")), 1)
         self._batch_seq = 0
-        self._lock = threading.Lock()     # stats vs executor mutation
+        self._lock = named_lock(          # stats vs executor mutation
+            "service/batching.py:BatchDispatcher._lock")
         self.batches = 0
         self.members_seated = 0
         self.late_joins = 0
@@ -442,8 +444,12 @@ class BatchDispatcher:
         # batch together from block one (later arrivals still join at
         # boundaries)
         self._poll_joins(ctx, entry, fleet, digest, dt, cadence, deferred)
-        if self.batch_window > 0 and len(ctx.seats) == 1 \
-                and svc._queued_runs == 0:
+        # the reservation count is mutated by reader threads and the
+        # drain sweep while this executor reads it — locked read (the
+        # window decision only needs a point-in-time answer)
+        with svc._counters_lock:
+            queue_empty = svc._queued_runs == 0
+        if self.batch_window > 0 and len(ctx.seats) == 1 and queue_empty:
             time.sleep(self.batch_window)
             self._poll_joins(ctx, entry, fleet, digest, dt, cadence,
                              deferred)
@@ -1111,6 +1117,9 @@ class BatchDispatcher:
             "watchdog_sec": svc.watchdog_sec,
             "request_age_sec": round(time.monotonic() - ctx.started_ts, 3),
             "stacks": faults.thread_stacks(),
+            # held/waiting named-lock map per thread (non-empty only when
+            # the runtime lock-order sanitizer is enabled)
+            "held_locks": faults.held_locks(),
         }
         logger.error(
             f"batching: WATCHDOG — {ctx.request_id} made no boundary "

@@ -45,6 +45,8 @@ import time
 import traceback
 from collections import OrderedDict
 
+from ..tools.lint.threadcheck import named_lock
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["AbandonedRun", "CircuitBreaker", "ResultCache", "RunContext",
@@ -115,7 +117,8 @@ class CircuitBreaker:
         self.max_cooloff_sec = float(max_cooloff_sec)
         self.max_keys = int(max_keys)
         self._keys = OrderedDict()   # key -> state dict
-        self._lock = threading.Lock()
+        self._lock = named_lock(
+            "service/faults.py:CircuitBreaker._lock")
         self.opens = 0
         self.fastfails = 0
         self.closes = 0
@@ -230,7 +233,8 @@ class ResultCache:
         self.max_bytes = int(max_bytes)
         self._entries = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock(
+            "service/faults.py:ResultCache._lock")
         self.replays = 0
 
     def __len__(self):
@@ -301,6 +305,15 @@ def thread_stacks():
         stack = "".join(traceback.format_stack(frame, limit=12))
         out.append(f"thread {names.get(ident, ident)}:\n{stack}")
     return out
+
+
+def held_locks():
+    """Per-thread held/waiting named-lock map for the postmortem record:
+    which service locks each thread holds and the one it is blocked on,
+    when the runtime lock-order sanitizer is enabled ({} when it is off
+    — the default — so the record stays cheap and honest)."""
+    from ..tools.lint.threadcheck import held_locks_dump
+    return held_locks_dump()
 
 
 class Watchdog:

@@ -33,6 +33,7 @@ import numpy as np
 from . import protocol
 from ..tools import assembly_cache
 from ..tools.config import cfg_get
+from ..tools.lint.threadcheck import named_lock
 
 logger = logging.getLogger(__name__)
 
@@ -107,14 +108,19 @@ class SolverPool:
         self.allow_imports = bool(allow_imports)
         self._entries = OrderedDict()   # pool key -> PoolEntry
         self._aliases = {}              # spec digest -> pool key
-        self._lock = threading.Lock()
+        self._lock = named_lock("service/pool.py:SolverPool._lock")
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.resets = 0
 
     def __len__(self):
-        return len(self._entries)
+        # reader threads size the pool (server._shed_memory, stats
+        # surfaces) while the worker mutates it; the lock is never held
+        # at a len(self) call site (the _build log line sits outside
+        # its bookkeeping block), so this cannot self-deadlock
+        with self._lock:
+            return len(self._entries)
 
     # ------------------------------------------------------------ lookup
 
@@ -280,7 +286,12 @@ class SolverPool:
         solver.resilience = None
         solver.health.reset_run()
         solver.metrics.reset_run()
-        self.resets += 1
+        # reset_entry runs on the worker OUTSIDE _lock (never held
+        # across a reset — class docstring), but the counter it bumps
+        # is read by stats() from reader threads: the increment itself
+        # takes the lock or concurrent stats snapshots lose counts
+        with self._lock:
+            self.resets += 1
 
     # ------------------------------------------------------------- stats
 

@@ -92,6 +92,7 @@ from .pool import SolverPool
 from ..tools import metrics as metrics_mod
 from ..tools import tracing
 from ..tools.config import cfg_get
+from ..tools.lint.threadcheck import named_lock
 
 logger = logging.getLogger(__name__)
 
@@ -225,7 +226,8 @@ class SolverService:
                                   # in the telemetry sink never collide
         # counters are bumped from reader threads, workers, the watchdog,
         # and the drain sweep concurrently; unguarded `+= 1` loses counts
-        self._counters_lock = threading.Lock()
+        self._counters_lock = named_lock(
+            "service/server.py:SolverService._counters_lock")
         # latency histograms behind the Prometheus surface (service/
         # promexport.py): fed under _counters_lock, snapshotted by
         # prom_text() so a scrape never reads a half-updated bucket map
@@ -253,7 +255,8 @@ class SolverService:
         self._avg_run_sec = None      # EWMA of per-request executor wall
         self._draining = None
         self._active_run = None       # faults.RunContext while executing
-        self._active_lock = threading.Lock()
+        self._active_lock = named_lock(
+            "service/server.py:SolverService._active_lock")
         self._worker_gen = 0          # bumped when the watchdog replaces
                                       # a wedged executor thread
         self._worker_thread = None
@@ -430,9 +433,28 @@ class SolverService:
             server.server_close()
 
     def stats(self):
+        # counter snapshot under the lock: these are bumped from reader
+        # threads, the executor, the watchdog, and the drain sweep, so a
+        # lock-free read here can see a torn mix of mid-update values.
+        # The pool/batcher/breaker/cache blocks below take their OWN
+        # locks and are deliberately called OUTSIDE this one — the
+        # service never nests lock acquisitions (threadcheck DTC003
+        # keeps the acquisition-order graph edge-free).
+        with self._counters_lock:
+            counters = {
+                "requests_served": self.requests_served,
+                "errors": self.errors,
+                "queued": self._queued_runs,
+                "shed": self.shed,
+                "deadline_exceeded": self.deadline_exceeded,
+                "watchdog_fires": self.watchdog_fires,
+                "client_drops": self.client_drops,
+                "mem_evictions": self.mem_evictions,
+                "error_codes": dict(self.error_codes),
+            }
         return {
-            "requests_served": self.requests_served,
-            "errors": self.errors,
+            "requests_served": counters["requests_served"],
+            "errors": counters["errors"],
             "draining": self._draining,
             "uptime_sec": round(time.time() - self.started_ts, 1)
             if self.started_ts else 0.0,
@@ -446,16 +468,16 @@ class SolverService:
             },
             "faults": {
                 "queue_depth": self.queue_depth,
-                "queued": self._queued_runs,
-                "shed": self.shed,
-                "deadline_exceeded": self.deadline_exceeded,
-                "watchdog_fires": self.watchdog_fires,
-                "client_drops": self.client_drops,
-                "mem_evictions": self.mem_evictions,
+                "queued": counters["queued"],
+                "shed": counters["shed"],
+                "deadline_exceeded": counters["deadline_exceeded"],
+                "watchdog_fires": counters["watchdog_fires"],
+                "client_drops": counters["client_drops"],
+                "mem_evictions": counters["mem_evictions"],
                 "replays": self.results.replays,
                 "result_cache": len(self.results),
                 "breaker": self.breaker.stats(),
-                "error_codes": dict(self.error_codes),
+                "error_codes": counters["error_codes"],
             },
         }
 
@@ -874,6 +896,9 @@ class SolverService:
             "request_age_sec": round(time.monotonic() - ctx.started_ts, 3),
             "iteration": iteration,
             "stacks": faults.thread_stacks(),
+            # which service locks each thread holds / waits on, when the
+            # runtime lock-order sanitizer is enabled ({} when off)
+            "held_locks": faults.held_locks(),
         }
         logger.error(
             f"service: WATCHDOG — request {ctx.request_id} made no step "
@@ -1069,9 +1094,15 @@ class SolverService:
 
     def _retry_after(self):
         """Load-shed hint: roughly how long until a queue slot drains,
-        from the per-request executor-wall EWMA."""
+        from the per-request executor-wall EWMA. The reservation count
+        is read under its lock (reader threads call this while the
+        executor and drain sweep mutate it); _avg_run_sec is the
+        executor-only EWMA — a single-word float read is GIL-atomic, so
+        it stays lock-free by design (threadcheck catalog exclusion)."""
+        with self._counters_lock:
+            queued = self._queued_runs
         base = self._avg_run_sec if self._avg_run_sec else 1.0
-        return round(min(max(base * (self._queued_runs + 1), 1.0), 600.0), 1)
+        return round(min(max(base * (queued + 1), 1.0), 600.0), 1)
 
     def _observe_run_wall(self, t_dispatch):
         wall = time.perf_counter() - t_dispatch

@@ -19,6 +19,13 @@ Components:
                  collective placement, donation aliasing, forbidden
                  primitives, manual-region integrity
                  (`lint --programs`; baseline progcheck_baseline.json).
+  threadcheck.py — the THIRD tier: thread-safety rules (DTC ids) over
+                 the serving stack's threaded modules — a curated
+                 guarded-by lock catalog, thread-aliased mutation, and
+                 the lock-order graph — plus the opt-in runtime
+                 lock-order sanitizer (`lint --threads`; baseline
+                 threadcheck_baseline.json). The DTC rules register in
+                 the shared rule set, so the default run covers them.
   cli.py       — `python -m dedalus_tpu lint [paths]`; exits nonzero on
                  findings not covered by the baseline.
 
@@ -35,11 +42,14 @@ from .framework import (DEFAULT_BASELINE, PACKAGE_DIR, Finding, LintResult,
                         Rule, all_rules, apply_baseline, baseline_rel,
                         load_baseline, make_baseline, register, run_lint)
 from . import rules  # noqa: F401  (imports register the rule set)
+from . import threadcheck  # noqa: F401  (registers the DTC rules)
+from .threadcheck import THREADCHECK_BASELINE
 
-__all__ = ["PACKAGE_DIR", "DEFAULT_BASELINE", "Finding", "LintResult",
-           "Rule", "all_rules", "apply_baseline", "baseline_rel",
-           "check_baseline_fresh", "lint_package", "load_baseline",
-           "make_baseline", "register", "run_lint"]
+__all__ = ["PACKAGE_DIR", "DEFAULT_BASELINE", "THREADCHECK_BASELINE",
+           "Finding", "LintResult", "Rule", "all_rules",
+           "apply_baseline", "baseline_rel", "check_baseline_fresh",
+           "lint_package", "load_baseline", "make_baseline", "register",
+           "run_lint"]
 
 
 def lint_package(baseline_path=None):
@@ -49,9 +59,17 @@ def lint_package(baseline_path=None):
     {"total", "new", "baselined", "suppressed", "stale", "findings"}
     where `findings` holds the NEW (un-baselined) findings as dicts and
     `stale` the baseline entries no longer matched by any finding."""
+    import pathlib
     baseline_path = DEFAULT_BASELINE if baseline_path is None else baseline_path
+    merge_threads = (pathlib.Path(baseline_path).resolve()
+                     == DEFAULT_BASELINE.resolve())
     result = run_lint([PACKAGE_DIR])
     baseline = load_baseline(baseline_path)
+    if merge_threads:
+        # the default run includes the DTC thread-safety rules, whose
+        # grandfathered entries live in their own per-tier baseline;
+        # keys cannot collide (distinct rule-id prefixes)
+        baseline = {**baseline, **load_baseline(THREADCHECK_BASELINE)}
     new, stale = apply_baseline(result.findings, baseline)
     return {
         "total": len(result.findings),

@@ -1,13 +1,20 @@
 """
 `python -m dedalus_tpu lint [paths]` — the static-analysis CLI.
 
-Two tiers share the Finding/baseline machinery:
+Three tiers share the Finding/baseline machinery:
 
-  * default: the AST rule set (DTL0xx, rules.py) over Python source;
+  * default: the AST rule set (DTL0xx, rules.py, plus the DTC
+    thread-safety rules from threadcheck.py) over Python source — the
+    DTL and DTC tiers keep separate baselines (baseline.json /
+    threadcheck_baseline.json), merged for the default run and split
+    again by rule-id prefix under --update-baseline;
   * `--programs`: the compiled-program contract checker (DTP1xx,
     progcheck.py) — lowers the census of representative step/grad/fleet
     programs on CPU and checks collective placement, donation aliasing,
-    forbidden primitives and manual-region integrity.
+    forbidden primitives and manual-region integrity;
+  * `--threads`: the thread-safety tier standalone (DTC0xx,
+    threadcheck.py) over the serving stack's threaded modules, with
+    per-rule timings and the global lock-order acquisition graph.
 
 Exit codes: 0 clean (every finding suppressed or baselined, baseline not
 stale), 1 new findings or stale baseline entries, 2 usage error.
@@ -59,9 +66,16 @@ def build_parser():
                         help="run the compiled-program contract census "
                              "(tools/lint/progcheck.py) instead of the "
                              "AST scan; CPU-only, no chip needed")
+    parser.add_argument("--threads", action="store_true",
+                        help="run the thread-safety tier standalone "
+                             "(tools/lint/threadcheck.py): DTC rules "
+                             "over the threaded serving modules (or "
+                             "explicit paths) with per-rule timings "
+                             "and the global lock-order graph")
     parser.add_argument("--select", default=None, metavar="NAMES",
                         help="comma-separated census program names "
-                             "(--programs mode; default: the full census)")
+                             "(--programs mode) or DTC rule ids "
+                             "(--threads mode; e.g. DTC001,DTC003)")
     parser.add_argument("--contracts", default=None, metavar="IDS",
                         help="comma-separated contract ids to check "
                              "(--programs mode; e.g. DTP101,DTP104)")
@@ -204,6 +218,79 @@ def _run_programs(args):
     return rc
 
 
+def _run_threads(args):
+    """The --threads tier: DTC rules over the threaded-module set (or
+    explicit paths — fixtures/tests scope the scan), per-rule timings,
+    and the global lock-order acquisition graph."""
+    from . import threadcheck
+
+    ids = _split_ids(args.select) if args.select else None
+    paths = args.paths or None
+    for p in paths or ():
+        path = pathlib.Path(p)
+        if not (path.is_dir() or (path.is_file() and path.suffix == ".py")):
+            print(f"lint: no such file or directory (or not .py): {p}",
+                  file=sys.stderr)
+            return 2
+    baseline_path = pathlib.Path(args.baseline) if args.baseline \
+        else threadcheck.THREADCHECK_BASELINE
+
+    if args.update_baseline:
+        if (ids or paths) and baseline_path.resolve() \
+                == threadcheck.THREADCHECK_BASELINE.resolve():
+            print("lint: refusing to regenerate the threadcheck baseline "
+                  "from a subset of rules or paths (it would drop "
+                  "entries outside the selection); drop --select/the "
+                  "paths, or pass --baseline FILE for a scoped baseline",
+                  file=sys.stderr)
+            return 2
+        try:
+            _, findings = threadcheck.run_threads(
+                paths=paths, rule_ids=ids, no_baseline=True)
+        except KeyError as exc:
+            print(f"lint: {exc.args[0]}", file=sys.stderr)
+            return 2
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        baseline_path.write_text(
+            json.dumps(make_baseline(findings), indent=1) + "\n")
+        print(f"baseline: {len(findings)} finding(s) grandfathered "
+              f"-> {baseline_path}")
+        return 0
+
+    try:
+        report, _ = threadcheck.run_threads(
+            paths=paths, rule_ids=ids, baseline_path=baseline_path,
+            no_baseline=args.no_baseline, jobs=args.jobs)
+    except KeyError as exc:
+        print(f"lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+    except ValueError as exc:
+        print(f"lint: {exc}", file=sys.stderr)
+        return 2
+
+    summary = report["summary"]
+    stale = summary["stale"]
+    if args.format == "json":
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"threads: {len(report['modules'])} module(s) scanned, "
+              f"{summary['edges']} lock-order edge(s), "
+              f"{summary['cycles']} cycle(s)")
+        for edge in report["graph"]["edges"]:
+            print(f"lock edge: {edge['src']} -> {edge['dst']} "
+                  f"({', '.join(edge['sites'])})")
+        budget = report["timings"]["rules"]
+        total = round(sum(budget.values()), 3)
+        print(f"rule timings ({total}s total): "
+              + ", ".join(f"{k} {v}s" for k, v in budget.items()))
+        for f in report["findings"]:
+            print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} "
+                  f"[{f['severity']}] {f['message']}")
+        _render_stale(stale)
+        _summary_line(summary, stale)
+    return 1 if (summary["new"] or stale) else 0
+
+
 def main(argv=None):
     """Entry point; returns the exit code (the __main__ shim sys.exits)."""
     try:
@@ -223,6 +310,11 @@ def main(argv=None):
                   f"{doc} (--programs)")
         return 0
 
+    if args.programs and args.threads:
+        print("lint: --programs and --threads are separate tiers; run "
+              "them as two invocations", file=sys.stderr)
+        return 2
+
     if args.programs:
         if args.paths:
             print("lint: --programs checks the compiled census, not "
@@ -230,6 +322,9 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
         return _run_programs(args)
+
+    if args.threads:
+        return _run_threads(args)
 
     rules = None
     if args.rules:
@@ -283,6 +378,22 @@ def main(argv=None):
                   "outside them); drop the paths/--rules, or pass "
                   "--baseline FILE for a scoped baseline", file=sys.stderr)
             return 2
+        if baseline_path.resolve() == DEFAULT_BASELINE.resolve():
+            # the default run covers both tiers but each keeps its own
+            # checked-in baseline: split the findings back out by rule-id
+            # prefix so neither file grandfathers the other tier's rules
+            from .threadcheck import THREADCHECK_BASELINE
+            dtc = [f for f in result.findings if f.rule.startswith("DTC")]
+            dtl = [f for f in result.findings
+                   if not f.rule.startswith("DTC")]
+            for tier_findings, tier_path in ((dtl, baseline_path),
+                                             (dtc, THREADCHECK_BASELINE)):
+                tier_path.write_text(
+                    json.dumps(make_baseline(tier_findings), indent=1)
+                    + "\n")
+                print(f"baseline: {len(tier_findings)} finding(s) "
+                      f"grandfathered -> {tier_path}")
+            return 0
         baseline_path.parent.mkdir(parents=True, exist_ok=True)
         baseline_path.write_text(
             json.dumps(make_baseline(result.findings), indent=1) + "\n")
@@ -295,6 +406,13 @@ def main(argv=None):
     else:
         try:
             baseline = load_baseline(baseline_arg)
+            if pathlib.Path(baseline_arg).resolve() \
+                    == DEFAULT_BASELINE.resolve():
+                # the default scan runs the DTC rules too; merge their
+                # per-tier baseline (rule-id prefixes keep keys disjoint)
+                from .threadcheck import THREADCHECK_BASELINE
+                baseline = {**baseline,
+                            **load_baseline(THREADCHECK_BASELINE)}
         except ValueError as exc:
             print(f"lint: {exc}", file=sys.stderr)
             return 2

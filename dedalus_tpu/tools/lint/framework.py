@@ -366,6 +366,7 @@ def _scan_file_by_ids(args):
     Finding objects pickle whole — plain slots of builtin types."""
     path_str, rule_ids = args
     from . import rules as _rules  # noqa: F401  (registers the rule set)
+    from . import threadcheck as _tc  # noqa: F401  (registers DTC rules)
     return _scan_file(pathlib.Path(path_str), [RULES[r] for r in rule_ids])
 
 

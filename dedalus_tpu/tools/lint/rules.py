@@ -48,6 +48,15 @@ HOT_PATH_MODULES = (
     # block_until_ready smuggled into a span helper would charge every
     # instrumented phase a sync and break the <1% overhead budget
     "tools/tracing.py",
+    # chaos hooks wrap step/IO callables IN PLACE on the hot loop: a
+    # fault injector that gathers state to decide whether to fire would
+    # charge every un-faulted step the sync the suite exists to forbid
+    "tools/chaos.py",
+    # spec digesting + IC decoding run per request on the serving path;
+    # result encoding is the one place device arrays legitimately land
+    # on the host, but it must do so ONCE (explicitly), not via stray
+    # per-field syncs smuggled into validation helpers
+    "service/protocol.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its

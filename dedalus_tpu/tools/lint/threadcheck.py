@@ -1,0 +1,889 @@
+"""
+Thread-safety tier: static lock-discipline analysis (DTC rules) plus the
+opt-in runtime lock-order sanitizer (`lint --threads`).
+
+The serving stack is genuinely concurrent — per-connection reader
+threads, the single executor (replaced by the watchdog on a hang), the
+watchdog poll thread, the async sharded-checkpoint writer, the metrics
+signal hooks — and every shipped race so far was found by hand in
+review. This tier encodes those bug classes the way the DTL/DTP tiers
+encode the jit-hygiene and compiled-program ones:
+
+  DTC001 guarded-field-access — a curated lock catalog (LOCK_CATALOG)
+         declares which lock guards which fields per threaded class
+         (plus the module-level metrics exit-flush table and the
+         cross-object accesses batching makes into the server's
+         counters); any read/write of a guarded field outside a
+         `with <lock>:` scope is a finding. Encodes the PR-8
+         admission-reservation drift class: an unguarded `+= 1` on a
+         counter bumped from reader threads, the executor, the watchdog
+         and the drain sweep loses counts.
+  DTC002 thread-aliased-mutation — mutations reachable from
+         `Thread(target=...)` / `executor.submit(...)` callables that
+         subscript-assign into producer-held mutable state. A store
+         whose index derives only from the callable's own parameters is
+         the legitimate disjoint-slot pattern (tools/chaos.py storm
+         drivers); anything else — and ANY store into a buffer bound by
+         `asarray` (a zero-copy alias) — is the PR-11 host-mirror
+         aliasing class generalized: the thread rewrites value operands
+         of dispatches still queued on the async stream.
+  DTC003 lock-order-cycle — nested `with lockA: ... with lockB:`
+         acquisition pairs are extracted lexically per module, the
+         acquisition-order digraph is built globally over the threaded
+         modules (plus DECLARED_EDGES for orders established across
+         function boundaries), and any cycle is a potential deadlock.
+         Encodes the PR-8 buffered-writer-lock-vs-watchdog pair: the
+         watchdog writing the error frame shared ctx.wfile's writer
+         lock with the (possibly mid-send) wedged executor.
+
+Honesty bounds, like every tier here: the guarded-by pass is
+catalog-driven (fields the catalog does not name are not checked — the
+catalog at the bottom of this docstring documents the intentional
+EXCLUSIONS), dynamic getattr/setattr accesses (server._count) are
+invisible to it, and the lexical lock-graph misses acquisition orders
+established across function calls. The runtime sanitizer is precisely
+the completeness check for that last gap: `[sanitize] LOCK_ORDER = on`
+(or enable_lock_order()) makes named_lock() hand out instrumented locks
+that record ACTUAL acquisition edges while the service/batching/chaos
+suites run; an observed edge absent from the static graph fails the
+cross-validation (verify_runtime_edges). When off, named_lock returns a
+plain threading.Lock — zero overhead, empty dumps.
+
+Documented catalog exclusions (single-writer / GIL-atomic by design —
+the catalog must NOT flag them; see docs/static_analysis.md):
+  server._avg_run_sec        executor-only EWMA; single-word float
+                             reads from reader threads are GIL-atomic
+  server._draining           write-once cross-thread flag
+  RunContext.last_progress   single-word float stores (faults.py
+                             docstring documents the contract)
+  BatchContext.seats         executor-owned; the watchdog snapshot is
+                             `list(ctx.seats.values())` (C-atomic)
+  dcheckpoint written/submitted/stall_sec/errors
+                             single writer + GIL list append; drain
+                             returns `list(self.errors)`
+  tracing._recorder          intentional double-checked lazy init
+                             under _recorder_lock
+  metrics flush paths        read `list(_exit_solvers)` lock-free BY
+                             DESIGN (signal/atexit context must not
+                             block); only WRITES are guarded
+                             (writes_only in the catalog)
+
+Findings ride the shared Finding/baseline machinery under
+threadcheck_baseline.json (empty on a healthy tree); the rules register
+in the shared registry, so the DEFAULT `lint` run, `--rules`, `--jobs`
+parallel scanning and `# dedalus-lint: disable=DTC00x` suppressions all
+cover this tier. `lint --threads` additionally runs the tier standalone
+with per-rule timings, the global lock graph, and `--select` rule
+filtering — the shape `--programs` established.
+"""
+
+import ast
+import pathlib
+import threading
+import time
+
+from .framework import (Finding, ModuleContext, Rule, RULES, register,
+                        apply_baseline, collect_py_files, load_baseline,
+                        module_matches, name_matches, run_lint,
+                        PACKAGE_DIR)
+
+__all__ = ["LOCK_CATALOG", "THREADED_MODULES", "THREADCHECK_BASELINE",
+           "DECLARED_EDGES", "static_lock_graph", "find_cycles",
+           "run_threads", "named_lock", "enable_lock_order",
+           "disable_lock_order", "lock_order_enabled", "observed_edges",
+           "reset_observed", "held_locks_dump", "verify_runtime_edges"]
+
+# the threadcheck tier's own grandfather baseline (empty on a healthy
+# tree; waivers are baseline entries with their reason documented in
+# docs/static_analysis.md)
+THREADCHECK_BASELINE = PACKAGE_DIR / "tools" / "lint" / \
+    "threadcheck_baseline.json"
+
+# the modules where threads actually meet (package-relative; fixtures
+# opt in by mirroring a path suffix, exactly like the DTL scopes)
+THREADED_MODULES = (
+    "service/server.py",
+    "service/batching.py",
+    "service/faults.py",
+    "service/pool.py",
+    "tools/dcheckpoint.py",
+    "tools/tracing.py",
+    "tools/metrics.py",
+    "tools/chaos.py",
+)
+
+
+class GuardSpec:
+    """One lock -> guarded-fields declaration in the catalog.
+
+    cls is None for module-level globals (the metrics exit-flush table);
+    `aliases` are context-manager attributes that acquire the SAME lock
+    (the checkpointer's Conditions constructed on _lock); `held_methods`
+    are methods documented "caller holds the lock" (checked at their
+    call sites' enclosing scopes, not inside); `writes_only` restricts
+    the check to mutations (lock-free reads are part of the design —
+    metrics flush paths must not block in signal context)."""
+
+    __slots__ = ("module", "cls", "lock", "fields", "aliases",
+                 "held_methods", "writes_only", "exempt")
+
+    def __init__(self, module, cls, lock, fields, aliases=(),
+                 held_methods=(), writes_only=False, exempt=()):
+        self.module = module
+        self.cls = cls
+        self.lock = lock
+        self.fields = frozenset(fields)
+        self.aliases = frozenset(aliases)
+        self.held_methods = frozenset(held_methods)
+        self.writes_only = writes_only
+        # methods where unguarded access is part of the contract
+        # (constructors bind fields before any thread exists)
+        self.exempt = frozenset(exempt) | {"__init__", "__del__"}
+
+    def lock_id(self):
+        owner = self.cls if self.cls else ""
+        return f"{self.module}:{owner + '.' if owner else ''}{self.lock}"
+
+
+LOCK_CATALOG = (
+    # server: request accounting. Bumped from reader threads, the
+    # executor, the watchdog and the drain sweep; server.py documents
+    # the contract at the _counters_lock binding.
+    GuardSpec("service/server.py", "SolverService", "_counters_lock",
+              fields=("requests_served", "errors", "shed",
+                      "deadline_exceeded", "watchdog_fires",
+                      "client_drops", "mem_evictions", "error_codes",
+                      "_queued_runs", "_request_seq", "hists")),
+    # server: the active-run handoff between executor and watchdog
+    GuardSpec("service/server.py", "SolverService", "_active_lock",
+              fields=("_active_run",)),
+    # batching: dispatcher stats vs executor mutation
+    GuardSpec("service/batching.py", "BatchDispatcher", "_lock",
+              fields=("batches", "members_seated", "late_joins",
+                      "blocks", "detached", "peak_members",
+                      "batch_events", "_batch_seq")),
+    # faults: breaker key table (readers admit, the executor records)
+    GuardSpec("service/faults.py", "CircuitBreaker", "_lock",
+              fields=("_keys", "opens", "fastfails", "closes"),
+              held_methods=("_entry",)),
+    # faults: result-cache LRU (readers replay, the executor stores)
+    GuardSpec("service/faults.py", "ResultCache", "_lock",
+              fields=("_entries", "_bytes", "replays")),
+    # pool: bookkeeping dicts read by stats() from reader threads
+    GuardSpec("service/pool.py", "SolverPool", "_lock",
+              fields=("_entries", "_aliases", "hits", "misses",
+                      "evictions", "resets"),
+              held_methods=("_evict", "_remove", "_pop_lru")),
+    # checkpointer: the in-flight budget both Conditions wait on
+    GuardSpec("tools/dcheckpoint.py", "ShardedCheckpointer", "_lock",
+              fields=("_pending", "_closed"),
+              aliases=("_not_full", "_drained")),
+    # tracing: the process-wide span ring
+    GuardSpec("tools/tracing.py", "TraceRecorder", "_lock",
+              fields=("_spans", "_next_id")),
+    # metrics: exit-flush registration table (module-level). WRITES
+    # only: the flush paths read lock-free by design (signal context).
+    GuardSpec("tools/metrics.py", None, "_exit_lock",
+              fields=("_exit_solvers", "_signal_previous"),
+              writes_only=True),
+)
+
+# cross-object accesses: batching reaches into the server's guarded
+# counters as `svc.<field>`; the required lock is `svc.<lock>` (same
+# base name). Keyed by field name — these names are unambiguous across
+# the tiered modules.
+FOREIGN_GUARDS = {
+    "_queued_runs": ("_counters_lock", "SolverService"),
+    "_request_seq": ("_counters_lock", "SolverService"),
+    "error_codes": ("_counters_lock", "SolverService"),
+    "_active_run": ("_active_lock", "SolverService"),
+}
+
+# acquisition orders established ACROSS function boundaries, which the
+# lexical extractor cannot see. Curated, with the establishing call
+# path as the reason; the runtime sanitizer's cross-validation is what
+# keeps this list honest (an observed edge missing here AND from the
+# lexical graph fails verify_runtime_edges). Empty on HEAD: every
+# `with lock:` block in the tiered modules is tight — snapshots are
+# taken under one lock and cross-object stats calls happen outside it
+# (see SolverService.stats), so the service acquisition graph has no
+# edges at all.
+DECLARED_EDGES = ()
+
+# method calls that mutate their receiver (the write-detection set for
+# guarded container fields)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "move_to_end",
+})
+
+# with-item names recognized as lock acquisitions by DTC003 even
+# without "lock" in the name (Conditions constructed on a lock)
+_CONDITION_NAMES = frozenset({"_not_full", "_drained"})
+
+
+def _threaded(ctx):
+    return module_matches(ctx.rel, THREADED_MODULES)
+
+
+def _module_key(ctx):
+    """The THREADED_MODULES entry this file is (or mirrors — fixtures
+    opt in by path suffix); its own rel path otherwise."""
+    for mod in THREADED_MODULES:
+        if module_matches(ctx.rel, (mod,)):
+            return mod
+    return ctx.rel
+
+
+def _enclosing_class(ctx, node):
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = ctx.parent(cur)
+    return None
+
+
+def _is_writeish(ctx, node):
+    """Whether an Attribute/Name access mutates the guarded object:
+    direct (re)bind, subscript store/del, augmented assign, or a
+    mutating method call on it."""
+    if isinstance(getattr(node, "ctx", None), (ast.Store, ast.Del)):
+        return True
+    parent = ctx.parent(node)
+    if isinstance(parent, ast.Subscript) \
+            and isinstance(parent.ctx, (ast.Store, ast.Del)):
+        return True
+    if isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS:
+        grand = ctx.parent(parent)
+        if isinstance(grand, ast.Call) and grand.func is parent:
+            return True
+    return False
+
+
+def _guarded_by(ctx, node, lock_names, base):
+    """Whether `node` sits inside a `with <base>.<lock>:` (attribute
+    locks) or `with <lock>:` (module-level locks, base=None) for any
+    name in `lock_names`."""
+    cur = node
+    while cur is not None:
+        parent = ctx.parent(cur)
+        if isinstance(parent, (ast.With, ast.AsyncWith)):
+            for item in parent.items:
+                expr = item.context_expr
+                if base is None:
+                    if isinstance(expr, ast.Name) and expr.id in lock_names:
+                        return True
+                elif isinstance(expr, ast.Attribute) \
+                        and expr.attr in lock_names \
+                        and isinstance(expr.value, ast.Name) \
+                        and expr.value.id == base:
+                    return True
+        cur = parent
+    return False
+
+
+# ------------------------------------------------------------------ DTC001
+
+@register
+class GuardedFieldAccess(Rule):
+    """Guarded-by checker: reads/writes of catalog-guarded fields
+    outside their declaring `with <lock>:` scope. The lock catalog
+    (LOCK_CATALOG) declares, per threaded class, which lock guards
+    which fields — e.g. SolverService._counters_lock guards the
+    per-error-code counters and the admission reservation, the batch
+    dispatcher's _lock guards the seat-accounting tables, the sharded
+    checkpointer's _lock guards the in-flight budget its Conditions
+    wait on. Cross-object accesses (batching reading svc._queued_runs)
+    check against FOREIGN_GUARDS with the same base name. Constructors
+    and documented caller-holds-the-lock helpers are exempt; catalog
+    entries marked writes_only check mutations only (metrics flush
+    paths read lock-free in signal context by design). Dynamic
+    getattr/setattr accesses (server._count) are invisible to this
+    pass — they already take the lock inside."""
+
+    id = "DTC001"
+    severity = "error"
+    title = "guarded-field-access"
+
+    def check(self, ctx):
+        if not _threaded(ctx):
+            return
+        specs = [s for s in LOCK_CATALOG
+                 if module_matches(ctx.rel, (s.module,))]
+        class_specs = {}
+        for s in specs:
+            if s.cls:
+                class_specs.setdefault(s.cls, []).append(s)
+        module_specs = [s for s in specs if s.cls is None]
+        foreign_fields = frozenset(FOREIGN_GUARDS)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name):
+                base = node.value.id
+                if base in ("self", "cls"):
+                    cls = _enclosing_class(ctx, node)
+                    for spec in class_specs.get(cls.name, ()) if cls else ():
+                        if node.attr in spec.fields:
+                            f = self._check_access(ctx, node, spec, base)
+                            if f is not None:
+                                yield f
+                elif node.attr in foreign_fields:
+                    lock, owner = FOREIGN_GUARDS[node.attr]
+                    fn = ctx.enclosing_function(node)
+                    if fn is not None and fn.name in ("__init__",):
+                        continue
+                    if not _guarded_by(ctx, node, {lock}, base):
+                        yield self.finding(
+                            ctx, node,
+                            f"guarded field `{base}.{node.attr}` "
+                            f"accessed outside `with {base}.{lock}:` "
+                            f"({owner} lock catalog; cross-object "
+                            "access)")
+            elif isinstance(node, ast.Name):
+                for spec in module_specs:
+                    if node.id in spec.fields:
+                        f = self._check_access(ctx, node, spec, None)
+                        if f is not None:
+                            yield f
+
+    def _check_access(self, ctx, node, spec, base):
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            # module-scope / class-scope statements run before any
+            # second thread exists (initial bindings)
+            return None
+        if fn.name in spec.exempt or fn.name in spec.held_methods:
+            return None
+        if spec.writes_only and not _is_writeish(ctx, node):
+            return None
+        locks = {spec.lock} | spec.aliases
+        if _guarded_by(ctx, node, locks, base):
+            return None
+        name = node.attr if base else node.id
+        hold = f"{base}.{spec.lock}" if base else spec.lock
+        verb = "mutated" if _is_writeish(ctx, node) else "read"
+        owner = spec.cls or pathlib.PurePosixPath(spec.module).name
+        return self.finding(
+            ctx, node,
+            f"guarded field `{name}` {verb} outside `with {hold}:` "
+            f"({owner} lock catalog)")
+
+
+# ------------------------------------------------------------------ DTC002
+
+@register
+class ThreadAliasedMutation(Rule):
+    """Thread-aliasing checker: a callable handed to
+    `threading.Thread(target=...)` or `executor.submit(...)` that
+    subscript-assigns into a variable it does not own (free in the
+    callable — producer-held mutable state). The legitimate pattern is
+    a disjoint-slot store whose index derives ONLY from the callable's
+    own parameters (the chaos storm drivers' `results[i] = out`);
+    stores with any other index provenance race their siblings, and
+    stores into a buffer bound via `asarray` are the PR-11 host-mirror
+    aliasing class regardless of index — the zero-copy alias rewrites
+    value operands of dispatches still queued on the async stream."""
+
+    id = "DTC002"
+    severity = "error"
+    title = "thread-aliased-mutation"
+
+    def check(self, ctx):
+        if not _threaded(ctx):
+            return
+        targets = self._thread_targets(ctx)
+        if not targets:
+            return
+        aliased = self._asarray_bound(ctx)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in targets:
+                continue
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)}
+            if fn.args.vararg:
+                params.add(fn.args.vararg.arg)
+            if fn.args.kwarg:
+                params.add(fn.args.kwarg.arg)
+            owned = params | self._local_binds(fn)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, (ast.Store, ast.Del))
+                        and isinstance(node.value, ast.Name)):
+                    continue
+                name = node.value.id
+                if name in owned:
+                    continue
+                index_names = {n.id for n in ast.walk(node.slice)
+                               if isinstance(n, ast.Name)}
+                if name in aliased:
+                    yield self.finding(
+                        ctx, node,
+                        f"thread callable `{fn.name}` mutates "
+                        f"`{name}[...]`, which aliases device/host "
+                        "state via asarray (zero-copy): the store can "
+                        "rewrite value operands of dispatches still "
+                        "queued on the async stream (PR-11 class); "
+                        "bind by copy instead")
+                elif not index_names or not index_names <= params:
+                    yield self.finding(
+                        ctx, node,
+                        f"thread callable `{fn.name}` mutates "
+                        f"producer-held `{name}[...]` without a "
+                        "disjoint-index contract (index not derived "
+                        "from the callable's own parameters): "
+                        "concurrent workers race the slot")
+
+    @staticmethod
+    def _thread_targets(ctx):
+        """Names of plain functions entered by Thread(target=...) or
+        pool.submit(fn, ...). Bound methods (self._worker) resolve to
+        class scope, which DTC001's catalog covers instead."""
+        names = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = ctx.canon(node.func)
+            if canon is not None and name_matches(canon,
+                                                  "threading.Thread",
+                                                  "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                        names.add(kw.value.id)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "submit" and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+        return names
+
+    @staticmethod
+    def _local_binds(fn):
+        """Names the callable itself binds (stores, for/with targets):
+        mutations of its OWN state are not aliasing."""
+        owned = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                owned.add(node.id)
+        return owned
+
+    @staticmethod
+    def _asarray_bound(ctx):
+        """Module variables bound to an `asarray(...)` result — zero-
+        copy aliases of their operand."""
+        aliased = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                canon = ctx.canon(node.value.func)
+                if canon is not None and name_matches(canon, "asarray"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliased.add(t.id)
+        return aliased
+
+
+# ------------------------------------------------------------------ DTC003
+
+def _lockish(expr):
+    """Whether a with-item expression acquires a lock: a Name/Attribute
+    whose terminal name smells like a lock (or is a known Condition
+    constructed on one). Calls (`with _socket_deadline(...)`) are
+    context managers, not lock acquisitions."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return False
+    return "lock" in name.lower() or name in _CONDITION_NAMES
+
+
+def _canon_lock(ctx, expr, modkey):
+    """Canonical lock identity `module:Class.attr` (or `module:name`
+    for module-level locks). `self.X` resolves Condition aliases
+    through the catalog; a foreign `other.X` resolves to its owning
+    catalog entry when the attr names exactly one cataloged lock
+    (svc._counters_lock -> the server's)."""
+    if isinstance(expr, ast.Name):
+        return f"{modkey}:{expr.id}"
+    attr = expr.attr
+    if isinstance(expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+        cls = _enclosing_class(ctx, expr)
+        cls_name = cls.name if cls else "?"
+        for spec in LOCK_CATALOG:
+            if spec.cls == cls_name and attr in spec.aliases \
+                    and module_matches(ctx.rel, (spec.module,)):
+                attr = spec.lock
+                break
+        return f"{modkey}:{cls_name}.{attr}"
+    owners = [s for s in LOCK_CATALOG if s.cls and s.lock == attr]
+    if len(owners) == 1:
+        return owners[0].lock_id()
+    base = expr.value.id if isinstance(expr.value, ast.Name) else "?"
+    return f"{modkey}:{base}.{attr}"
+
+
+def _module_edges(ctx):
+    """Lexical acquisition-order edges in one module: for every lock
+    acquired while another is (lexically) held — nested `with` blocks
+    and multi-item `with A, B:` — yield (held, acquired, node)."""
+    modkey = _module_key(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        held = []
+        cur = ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    if _lockish(item.context_expr):
+                        held.append(_canon_lock(ctx, item.context_expr,
+                                                modkey))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                break   # lexical holding does not cross a def boundary
+            cur = ctx.parent(cur)
+        for item in node.items:
+            if not _lockish(item.context_expr):
+                continue
+            acquired = _canon_lock(ctx, item.context_expr, modkey)
+            for h in held:
+                yield h, acquired, node
+            held.append(acquired)   # `with A, B:` orders A before B
+
+
+def find_cycles(edges):
+    """Cycles in an acquisition-order digraph (edge iterable of (src,
+    dst) pairs): Tarjan SCCs of size > 1, plus self-loops (a
+    non-reentrant lock re-acquired under itself deadlocks outright).
+    Returns a list of node lists."""
+    graph = {}
+    for src, dst in edges:
+        graph.setdefault(src, set()).add(dst)
+        graph.setdefault(dst, set())
+    index = {}
+    low = {}
+    stack = []
+    on_stack = set()
+    cycles = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph[v]):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                cycles.append(sorted(comp))
+            elif v in graph[v]:
+                cycles.append([v])
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return cycles
+
+
+@register
+class LockOrderCycle(Rule):
+    """Lock-order analysis: nested `with lockA: ... with lockB:`
+    acquisition pairs are extracted lexically (including multi-item
+    `with A, B:`), and any cycle in the module's acquisition-order
+    digraph is a potential deadlock. Encodes the PR-8 buffered-writer-
+    lock-vs-watchdog pair: the watchdog's error write shared ctx.wfile's
+    writer lock with the wedged executor's mid-send — two threads
+    acquiring the same two locks in opposite orders. The module-local
+    pass runs per file; `lint --threads` additionally builds the GLOBAL
+    graph across the tiered modules (plus DECLARED_EDGES for orders
+    established through function calls) and the runtime sanitizer
+    cross-validates it against acquisition edges observed live."""
+
+    id = "DTC003"
+    severity = "error"
+    title = "lock-order-cycle"
+
+    def check(self, ctx):
+        if not _threaded(ctx):
+            return
+        edges = {}
+        for src, dst, node in _module_edges(ctx):
+            edges.setdefault((src, dst), node)
+        for cycle in find_cycles(edges):
+            involved = {(s, d): n for (s, d), n in edges.items()
+                        if s in cycle and d in cycle}
+            node = min(involved.values(), key=lambda n: n.lineno)
+            path = " -> ".join(cycle + [cycle[0]])
+            sites = ", ".join(
+                f"{s}->{d} at line {n.lineno}"
+                for (s, d), n in sorted(involved.items(),
+                                        key=lambda kv: kv[1].lineno))
+            yield self.finding(
+                ctx, node,
+                f"lock-order cycle (potential deadlock): {path}; "
+                f"acquisition sites: {sites}")
+
+
+DTC_RULE_IDS = ("DTC001", "DTC002", "DTC003")
+
+
+# ------------------------------------------------------- the global graph
+
+def static_lock_graph(paths=None):
+    """The global acquisition-order digraph: lexical edges over the
+    threaded modules (or explicit `paths`) plus DECLARED_EDGES.
+    Returns {"edges": {(src, dst): [site, ...]}, "cycles": [...]}."""
+    if paths is None:
+        files = [PACKAGE_DIR / m for m in THREADED_MODULES
+                 if (PACKAGE_DIR / m).exists()]
+    else:
+        files = collect_py_files(paths)
+    edges = {}
+    for path in files:
+        try:
+            ctx = ModuleContext(path, path.read_text())
+        except (OSError, SyntaxError, ValueError):
+            continue   # DTC runs through run_lint surface DTL000 there
+        for src, dst, node in _module_edges(ctx):
+            edges.setdefault((src, dst), []).append(
+                f"{ctx.rel}:{node.lineno}")
+    for src, dst, reason in DECLARED_EDGES:
+        edges.setdefault((src, dst), []).append(f"declared: {reason}")
+    return {"edges": edges, "cycles": find_cycles(edges)}
+
+
+def run_threads(paths=None, rule_ids=None, baseline_path=None,
+                no_baseline=False, jobs=None):
+    """The --threads tier runner: the DTC rules over the threaded-module
+    set (or explicit paths) with per-rule timings, plus the global
+    lock-order graph. Report mirrors run_programs: {"modules", "graph",
+    "findings" (new only), "summary", "timings"}."""
+    for rid in rule_ids or ():
+        if rid not in RULES or not rid.startswith("DTC"):
+            raise KeyError(f"unknown DTC rule id {rid!r}; known: "
+                           f"{list(DTC_RULE_IDS)}")
+    rules = [RULES[r] for r in (rule_ids or DTC_RULE_IDS)]
+    if paths is None:
+        files = [PACKAGE_DIR / m for m in THREADED_MODULES
+                 if (PACKAGE_DIR / m).exists()]
+    else:
+        files = collect_py_files(paths)
+    findings, suppressed = [], []
+    rule_timings = {}
+    for rule in rules:
+        t0 = time.perf_counter()
+        result = run_lint(files, rules=[rule], jobs=jobs)
+        rule_timings[rule.id] = round(time.perf_counter() - t0, 3)
+        findings.extend(f for f in result.findings
+                        if f.rule != "DTL000" or rule is rules[0])
+        suppressed.extend(result.suppressed)
+    t0 = time.perf_counter()
+    graph = static_lock_graph(paths)
+    # the per-module DTC003 pass already reported single-module cycles;
+    # the global graph adds cross-module + declared-edge cycles
+    global_findings = []
+    for cycle in graph["cycles"]:
+        modules = {n.split(":", 1)[0] for n in cycle}
+        declared = any((s, d) in graph["edges"]
+                       and any(site.startswith("declared:")
+                               for site in graph["edges"][(s, d)])
+                       for s in cycle for d in cycle)
+        if len(modules) > 1 or declared:
+            path = " -> ".join(cycle + [cycle[0]])
+            global_findings.append(Finding(
+                "DTC003", "error", "__locks__/graph", 1, 0,
+                f"global lock-order cycle (potential deadlock): {path}",
+                path))
+    findings.extend(global_findings)
+    rule_timings["lock-graph"] = round(time.perf_counter() - t0, 3)
+
+    baseline_path = THREADCHECK_BASELINE if baseline_path is None \
+        else pathlib.Path(baseline_path)
+    baseline = {} if no_baseline else load_baseline(baseline_path)
+    new, stale = apply_baseline(findings, baseline)
+    # a subset run (rule filter or explicit paths) leaves out-of-scope
+    # baseline entries unmatched by construction, not fixed
+    if rule_ids or paths is not None:
+        stale = []
+    return {
+        "modules": [str(f) for f in files],
+        "graph": {
+            "edges": [{"src": s, "dst": d, "sites": sites}
+                      for (s, d), sites in sorted(graph["edges"].items())],
+            "cycles": graph["cycles"],
+        },
+        "findings": [f.to_dict() for f in new],
+        "summary": {
+            "total": len(findings),
+            "new": len(new),
+            "baselined": len(findings) - len(new),
+            "suppressed": len(suppressed),
+            "stale": stale,
+            "edges": len(graph["edges"]),
+            "cycles": len(graph["cycles"]),
+        },
+        "timings": {"rules": rule_timings},
+    }, findings
+
+
+# ------------------------------------------------- runtime lock sanitizer
+#
+# Opt-in ([sanitize] LOCK_ORDER, or enable_lock_order() BEFORE the
+# instrumented objects construct): named_lock() hands out wrapped locks
+# that record actual acquisition edges + per-thread held/waiting state.
+# Off (the default), named_lock returns a plain threading.Lock — the
+# hot path pays nothing and the dumps are empty.
+
+_san_lock = threading.Lock()    # guards the sanitizer's OWN tables
+_observed = {}                  # (src, dst) -> acquisition count
+_held = {}                      # thread ident -> [lock names]
+_waiting = {}                   # thread ident -> lock name
+_enabled_override = None
+
+
+def lock_order_enabled():
+    if _enabled_override is not None:
+        return _enabled_override
+    from ..config import cfg_get
+    return str(cfg_get("sanitize", "LOCK_ORDER", "off")).strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def enable_lock_order():
+    """Turn the sanitizer on for locks constructed AFTER this call
+    (tests enable it before building the service)."""
+    global _enabled_override
+    _enabled_override = True
+
+
+def disable_lock_order():
+    global _enabled_override
+    _enabled_override = False
+
+
+def named_lock(name):
+    """A lock with a canonical identity (`module:Class.attr`, matching
+    the static graph's node ids). Plain threading.Lock when the
+    sanitizer is off — zero overhead; instrumented otherwise."""
+    if lock_order_enabled():
+        return _SanitizedLock(name)
+    return threading.Lock()
+
+
+class _SanitizedLock:
+    """threading.Lock wrapper recording acquisition-order edges and
+    per-thread held/waiting state. Condition-compatible: it exposes
+    only acquire/release/__enter__/__exit__/locked, so
+    threading.Condition(lock) falls back to its own default
+    _release_save/_acquire_restore/_is_owned built on those."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name):
+        self.name = str(name)
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ident = threading.get_ident()
+        if blocking:
+            with _san_lock:
+                _waiting[ident] = self.name
+        ok = self._lock.acquire(blocking, timeout)
+        with _san_lock:
+            _waiting.pop(ident, None)
+            if ok:
+                stack = _held.setdefault(ident, [])
+                for h in stack:
+                    if h != self.name:
+                        _observed[(h, self.name)] = \
+                            _observed.get((h, self.name), 0) + 1
+                stack.append(self.name)
+        return ok
+
+    def release(self):
+        self._lock.release()
+        ident = threading.get_ident()
+        with _san_lock:
+            stack = _held.get(ident)
+            if stack:
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] == self.name:
+                        del stack[i]
+                        break
+                if not stack:
+                    _held.pop(ident, None)
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return True
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        state = "locked" if self._lock.locked() else "unlocked"
+        return f"<_SanitizedLock {self.name} {state}>"
+
+
+def observed_edges():
+    """The acquisition edges recorded since the last reset: a set of
+    (held, acquired) canonical-name pairs."""
+    with _san_lock:
+        return set(_observed)
+
+
+def reset_observed():
+    with _san_lock:
+        _observed.clear()
+
+
+def held_locks_dump():
+    """Per-thread held/waiting lock names, for the watchdog postmortem:
+    {thread_name: {"held": [...], "waiting": name-or-None}}. Empty when
+    the sanitizer is off (nothing was ever recorded)."""
+    with _san_lock:
+        held = {ident: list(stack) for ident, stack in _held.items()
+                if stack}
+        waiting = dict(_waiting)
+    if not held and not waiting:
+        return {}
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident in sorted(set(held) | set(waiting), key=str):
+        out[str(names.get(ident, ident))] = {
+            "held": held.get(ident, []),
+            "waiting": waiting.get(ident),
+        }
+    return out
+
+
+def verify_runtime_edges(observed=None, static=None):
+    """Cross-validation: observed acquisition edges that the static
+    graph (lexical + declared) does not contain — the analyzer's own
+    completeness check. Returns the sorted list of missing (src, dst)
+    pairs; empty means every live acquisition order was statically
+    visible."""
+    if observed is None:
+        observed = observed_edges()
+    if static is None:
+        static = static_lock_graph()
+    return sorted(set(observed) - set(static["edges"]))

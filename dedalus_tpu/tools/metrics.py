@@ -88,6 +88,7 @@ import jax
 
 from . import tracing
 from .config import config
+from .lint.threadcheck import named_lock
 
 __all__ = ["PHASES", "SUM_PHASES", "BUILD_PHASES", "CadenceGate", "Counter",
            "PhaseTimer",
@@ -549,7 +550,7 @@ class Metrics:
 
 _exit_solvers = []          # weakrefs to registered solvers
 _signal_previous = {}       # {signum: previous handler} once installed
-_exit_lock = threading.Lock()
+_exit_lock = named_lock("tools/metrics.py:_exit_lock")
 
 
 def flush_pending(source="atexit"):

@@ -62,6 +62,7 @@ import time
 import uuid
 
 from .config import config
+from .lint.threadcheck import named_lock
 
 __all__ = ["Span", "LogHistogram", "TraceRecorder", "TraceContext",
            "enabled", "enable", "disable", "trace_sink", "recorder",
@@ -182,7 +183,7 @@ class TraceRecorder:
                                       fallback="4096") or 4096)
         self.capacity = max(int(capacity), 16)
         self._spans = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("tools/tracing.py:TraceRecorder._lock")
         self._next_id = 0
 
     def next_span_id(self):
@@ -216,7 +217,7 @@ class TraceRecorder:
 
 
 _recorder = None
-_recorder_lock = threading.Lock()
+_recorder_lock = named_lock("tools/tracing.py:_recorder_lock")
 
 
 def recorder():

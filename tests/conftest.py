@@ -102,7 +102,8 @@ def _service_test_watchdog(request):
               or request.node.get_closest_marker("fusion") is not None
               or request.node.get_closest_marker("solvecomp") is not None
               or request.node.get_closest_marker("distributed") is not None
-              or request.node.get_closest_marker("progcheck") is not None)
+              or request.node.get_closest_marker("progcheck") is not None
+              or request.node.get_closest_marker("threadcheck") is not None)
     if not marked or threading.current_thread() is not threading.main_thread():
         yield
         return
@@ -204,6 +205,15 @@ def pytest_configure(config):
         "solvecomp: solve-composition + precision-ladder tests "
         "(libraries/solvecomp.py: associative-scan/SPIKE substitution, "
         "mixed-precision refinement); tier-1 by default")
+    # threadcheck: thread-safety tier tests (tools/lint/threadcheck.py).
+    # Tier-1 by default; rides the same hard watchdog — the sanitizer
+    # cross-validation test drives a live in-process service worker, and
+    # a wedged one stalls exactly like a hung daemon.
+    config.addinivalue_line(
+        "markers",
+        "threadcheck: thread-safety tier tests (tools/lint/"
+        "threadcheck.py: DTC rules, lock-order graph, runtime "
+        "sanitizer); tier-1 by default")
 
 
 @pytest.fixture

@@ -47,8 +47,11 @@ def _rules_fired(result):
 # ----------------------------------------------------------------- rule set
 
 def test_rule_catalog():
+    # the DTC thread-safety rules (tools/lint/threadcheck.py) register
+    # in the shared rule set so the default run covers them
     rules = all_rules()
-    assert [r.id for r in rules] == ["DTL001", "DTL002", "DTL003",
+    assert [r.id for r in rules] == ["DTC001", "DTC002", "DTC003",
+                                     "DTL001", "DTL002", "DTL003",
                                      "DTL004", "DTL005", "DTL006",
                                      "DTL007", "DTL008", "DTL009"]
     for r in rules:
