@@ -26,6 +26,18 @@ Three scenarios:
                         micro-batches coalesce it — requests/s, p50/p95
                         both modes, the speedup (>= 1.5x acceptance),
                         and the batch occupancy stats.
+  router_scaling        the replica-fleet spec-locality multiplier: a
+                        closed-loop MIXED-spec storm (6 distinct
+                        problems, one pinned worker each) against
+                        1/2/4-replica fleets behind the spec-hash
+                        router (service/router.py), every replica
+                        capped at --pool-size 3 so a lone replica
+                        thrashes its warm pool on the mix while the
+                        hash-partitioned fleet keeps every spec
+                        resident — requests/s per fleet size, the 4v1
+                        speedup (>= 2.5x acceptance), and the router's
+                        forwarding overhead p50 (routed minus direct
+                        warm request wall, 1-replica fleet).
 
 Methodology: one fresh daemon per problem with an EMPTY private
 assembly-cache directory, so the first request is a true cold
@@ -469,6 +481,211 @@ def run_batching(config="diffusion64_batching", clients=8, rounds=4,
     return row
 
 
+def _balanced_specs(count=6, per_replica=2):
+    """`count` distinct diffusion specs whose 4-replica ring assignment
+    (deterministic: the ring depends only on names+vnodes) spreads at
+    most `per_replica` specs per replica — so the row measures the
+    LOCALITY multiplier, not one-off hash luck with an adversarial
+    spec set that happens to pile onto a single member."""
+    from dedalus_tpu.service.router import (ring_order, ring_points,
+                                            route_digest)
+    points = ring_points(["r0", "r1", "r2", "r3"], 64)
+    chosen, load = [], {}
+    for size in range(40, 400, 4):
+        spec = {"problem": "diffusion", "params": {"size": size}}
+        owner = ring_order(points, route_digest({"spec": spec}))[0]
+        if load.get(owner, 0) >= per_replica:
+            continue
+        load[owner] = load.get(owner, 0) + 1
+        chosen.append(spec)
+        if len(chosen) == count:
+            return chosen
+    raise RuntimeError("could not assemble a balanced spec set")
+
+
+def _start_router(n_replicas, workdir, pool_size, queue_depth):
+    """An in-process RouterService fronting `n_replicas` spawned
+    daemons. Returns (router, serve_thread)."""
+    import io
+    import threading
+
+    from dedalus_tpu.service.router import RouterService
+
+    router = RouterService(
+        replicas=n_replicas, workdir=workdir,
+        replica_args=["--pool-size", str(pool_size),
+                      "--queue-depth", str(queue_depth)],
+        probe_sec=0.5, probe_timeout=5.0, wedge_misses=8)
+    thread = threading.Thread(
+        target=router.serve_forever, kwargs={"ready_stream": io.StringIO()},
+        daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 600
+    while router.port == 0 or router._listener is None \
+            or len(router.fleet.routable()) < n_replicas:
+        if not thread.is_alive() or time.monotonic() > deadline:
+            raise RuntimeError(f"{n_replicas}-replica fleet failed to "
+                               f"come up (see {workdir})")
+        time.sleep(0.1)
+    return router, thread
+
+
+def _stop_router(router, thread):
+    router.request_drain("benchmark done")
+    thread.join(timeout=300)
+
+
+def run_router_scaling(config="router_scaling", fleet_sizes=(1, 2, 4),
+                       specs=6, rounds=3, steps=200, pool_size=3,
+                       overhead_probes=10):
+    """Spec-locality scaling behind the replica router: the same
+    closed-loop mixed-spec storm (one pinned worker per spec, each
+    re-submitting the moment its previous request resolves) against
+    1/2/4-replica fleets. Every replica's warm pool holds `pool_size`
+    solvers, fewer than the spec mix — a lone replica evicts and
+    rebuilds on nearly every arrival, while the spec-hash ring gives
+    each fleet member a subset that FITS, so the multiplier measures
+    warm-pool residency bought by routing, not extra cores. Also
+    records the router's forwarding overhead (routed minus direct warm
+    request wall p50, measured on the 1-replica fleet where both paths
+    hit the same warm pool). Acceptance: >= 2.5x requests/s at 4
+    replicas vs 1."""
+    import statistics as stats_mod
+    import threading
+
+    spec_list = _balanced_specs(count=specs, per_replica=pool_size - 1)
+    ics_list = [diffusion_ics(s["params"]["size"]) for s in spec_list]
+    workdir = tempfile.mkdtemp(prefix="dedalus_router_")
+    # one private assembly cache shared by every topology: the storm
+    # measures in-process warm-POOL residency, which the on-disk cache
+    # cannot provide, and sharing keeps later topologies' warmup short
+    saved_cache = os.environ.get("DEDALUS_TPU_ASSEMBLY_CACHE")
+    os.environ["DEDALUS_TPU_ASSEMBLY_CACHE"] = os.path.join(
+        workdir, "assembly")
+
+    def storm(port):
+        lat, errors = [], []
+        lock = threading.Lock()
+
+        def one_worker(i):
+            wclient = ServiceClient(port=port, timeout=1200)
+            for _ in range(rounds):
+                t_req = time.perf_counter()
+                try:
+                    wclient.run(spec_list[i], ics=ics_list[i], dt=1e-3,
+                                stop_iteration=steps)
+                    with lock:
+                        lat.append(time.perf_counter() - t_req)
+                except Exception as exc:
+                    with lock:
+                        errors.append(str(exc))
+        threads = [threading.Thread(target=one_worker, args=(i,),
+                                    daemon=True)
+                   for i in range(len(spec_list))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1200)
+        wall = time.perf_counter() - t0
+        assert not any(t.is_alive() for t in threads), "storm worker hung"
+        lats = sorted(lat)
+        return {"requests": len(lat), "errors": errors,
+                "wall_sec": round(wall, 3),
+                "requests_per_sec": round(len(lat) / wall, 3)
+                if wall else 0,
+                "p50_sec": round(lats[len(lats) // 2], 4)
+                if lats else None}
+
+    per_fleet = {}
+    overhead_ms = None
+    try:
+        for n in fleet_sizes:
+            subdir = os.path.join(workdir, f"fleet{n}")
+            os.makedirs(subdir, exist_ok=True)
+            router, thread = _start_router(n, subdir, pool_size,
+                                           queue_depth=2 * len(spec_list))
+            try:
+                mark(f"{config}: warming {len(spec_list)} specs on the "
+                     f"{n}-replica fleet")
+                for spec, ics in zip(spec_list, ics_list):
+                    ServiceClient(port=router.port, timeout=1200).run(
+                        spec, ics=ics, dt=1e-3, stop_iteration=steps)
+                mark(f"{config}: {n}-replica storm ({len(spec_list)} "
+                     f"pinned workers x {rounds} rounds x {steps} steps)")
+                per_fleet[n] = storm(router.port)
+                per_fleet[n]["forward_p50_ms"] = \
+                    router.stats()["router"]["forward"]["p50_ms"]
+                mark(f"{config}: {n} replica(s) -> "
+                     f"{per_fleet[n]['requests_per_sec']} requests/s "
+                     f"({len(per_fleet[n]['errors'])} errors)")
+                if n == 1 and overhead_probes:
+                    # routed vs direct warm request wall, same replica,
+                    # same warm pool: the difference IS the router
+                    host, port = router.fleet.endpoint(
+                        router.fleet.routable()[0])
+                    spec, ics = spec_list[0], ics_list[0]
+
+                    def p50_wall(client):
+                        samples = []
+                        for _ in range(overhead_probes):
+                            t0 = time.perf_counter()
+                            client.run(spec, ics=ics, dt=1e-3,
+                                       stop_iteration=steps)
+                            samples.append(time.perf_counter() - t0)
+                        return stats_mod.median(samples)
+
+                    routed = p50_wall(ServiceClient(port=router.port,
+                                                    timeout=1200))
+                    direct = p50_wall(ServiceClient(host=host, port=port,
+                                                    timeout=1200))
+                    overhead_ms = round(max(routed - direct, 0.0) * 1e3,
+                                        3)
+                    mark(f"{config}: forward overhead p50 "
+                         f"{overhead_ms} ms (routed {routed:.4f}s vs "
+                         f"direct {direct:.4f}s)")
+            finally:
+                _stop_router(router, thread)
+    finally:
+        if saved_cache is None:
+            os.environ.pop("DEDALUS_TPU_ASSEMBLY_CACHE", None)
+        else:
+            os.environ["DEDALUS_TPU_ASSEMBLY_CACHE"] = saved_cache
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    biggest, smallest = max(per_fleet), min(per_fleet)
+    base_rps = per_fleet[smallest]["requests_per_sec"] or 1e-9
+    speedup = round(per_fleet[biggest]["requests_per_sec"] / base_rps, 2)
+    row = {
+        "config": config,
+        "backend": os.environ.get("JAX_PLATFORMS", "cpu").split(",")[0],
+        # perfwatch-tracked measurement triplet: the 4-replica storm rate
+        "metric": f"router_requests_per_sec_{biggest}r",
+        "value": per_fleet[biggest]["requests_per_sec"],
+        "unit": "requests/sec",
+        "specs": len(spec_list),
+        "clients": len(spec_list),
+        "rounds": rounds,
+        "steps_per_request": steps,
+        "pool_size": pool_size,
+        "replica_requests_per_sec": {
+            str(n): per_fleet[n]["requests_per_sec"] for n in per_fleet},
+        "replica_p50_sec": {str(n): per_fleet[n]["p50_sec"]
+                            for n in per_fleet},
+        f"requests_speedup_{biggest}v{smallest}": speedup,
+        "forward_overhead_p50_ms": overhead_ms,
+        "errors": sum(len(per_fleet[n]["errors"]) for n in per_fleet),
+        "meets_2p5x": speedup >= 2.5
+        and not any(per_fleet[n]["errors"] for n in per_fleet),
+    }
+    mark(f"{config}: " + ", ".join(
+        f"{n}r={per_fleet[n]['requests_per_sec']}"
+        for n in sorted(per_fleet)) +
+        f" requests/s -> {speedup}x at {biggest} replicas "
+        f"(forward overhead p50 {overhead_ms} ms)")
+    return row
+
+
 def diffusion_ics(size=64):
     x = np.linspace(0, 2 * np.pi, size, endpoint=False)
     return {"u": ("g", np.sin(3 * x)), "a": ("g", 0.1 * np.cos(x))}
@@ -531,6 +748,18 @@ def main():
                                 steps=200 if quick else 400)
     _append_result(batching_row)
     print(json.dumps(batching_row), flush=True)
+    # the replica-fleet spec-locality multiplier: mixed-spec closed-loop
+    # storm against 1/2/4-replica fleets behind the spec-hash router
+    scaling_row = run_router_scaling(
+        fleet_sizes=(1, 4) if quick else (1, 2, 4),
+        rounds=2 if quick else 3,
+        steps=100 if quick else 200)
+    _append_result(scaling_row)
+    print(json.dumps(scaling_row), flush=True)
+    if not quick and not scaling_row["meets_2p5x"]:
+        mark("FAIL: 4-replica fleet is not >= 2.5x single-replica "
+             "requests/s under the mixed-spec storm")
+        sys.exit(1)
     if not quick and not batching_row["meets_1p5x"]:
         mark("FAIL: batched serving is not >= 1.5x single-executor "
              "requests/s under the same-spec storm")

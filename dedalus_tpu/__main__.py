@@ -13,6 +13,7 @@ subcommands — `python -m dedalus_tpu <command> --help` documents each:
                   compiled-program contract census under --programs
     serve         warm-pool solver daemon (dedalus_tpu/service/)
     submit        submit one run to a serve daemon
+    route         spec-hash router fronting a replica fleet
 """
 
 import argparse
@@ -340,6 +341,43 @@ def report(args):
                           f"{ev.get('blocks', 0)} blocks, {det_txt}"
                           + (" [ABANDONED]" if ev.get("abandoned")
                              else ""))
+        elif kind == "router_stats":
+            n_other += 1
+            router = record.get("router") or {}
+            fleet = record.get("fleet") or {}
+            forward = router.get("forward") or {}
+            ring = router.get("ring_members") or []
+            print(f"(router) {router.get('forwarded', 0)} forwarded, "
+                  f"{router.get('failovers', 0)} failovers, "
+                  f"{router.get('shed', 0)} shed, "
+                  f"{router.get('refusals', 0)} refusals absorbed, "
+                  f"ring [{', '.join(ring) or 'empty'}], "
+                  f"forward p50 {forward.get('p50_ms', '?')} ms / "
+                  f"p95 {forward.get('p95_ms', '?')} ms, "
+                  f"uptime {record.get('uptime_sec', '?')}s")
+            # fleet health census (service/fleet.py): one line per
+            # replica so a wedged or flapping member reads off directly
+            if fleet:
+                print(f"    fleet: {fleet.get('restarts', 0)} restarts, "
+                      f"{fleet.get('crashes', 0)} crashes, "
+                      f"{fleet.get('wedges', 0)} wedges, "
+                      f"{fleet.get('watchdog_fires', 0)} watchdog "
+                      "postmortems")
+                for name, rep in sorted(
+                        (fleet.get("replicas") or {}).items()):
+                    state = rep.get("state", "?")
+                    if rep.get("draining"):
+                        state += " (draining)"
+                    print(f"      {name}: {state}, "
+                          f"{rep.get('restarts', 0)} restarts, "
+                          f"port {rep.get('port', '?')}"
+                          + (f", pid {rep['pid']}"
+                             if rep.get("pid") else ""))
+            codes = router.get("error_codes") or {}
+            if codes:
+                print("    error codes: "
+                      + ", ".join(f"{v} {k}"
+                                  for k, v in sorted(codes.items())))
         elif kind == "trace":
             n_other += 1
             from .tools.tracing import summarize_trace
@@ -591,6 +629,19 @@ def report(args):
                              f"{record['max_queued_observed']}"
                              f"/{record.get('queue_depth', '?')}")
                 print(line)
+            # replica-fleet scaling rows (benchmarks/serving.py
+            # run_router_scaling): aggregate requests/s per replica
+            # count plus the routing tax, in one line
+            if record.get("requests_speedup_4v1") is not None:
+                sweep = record.get("replica_requests_per_sec") or {}
+                sweep_txt = ", ".join(
+                    f"{n}r={v}" for n, v in sorted(sweep.items()))
+                print(f"    router: {sweep_txt} requests/s "
+                      f"({record['requests_speedup_4v1']}x at 4 "
+                      f"replicas, {record.get('specs', '?')} specs, "
+                      f"{record.get('clients', '?')} clients, forward "
+                      f"overhead p50 "
+                      f"{record.get('forward_overhead_p50_ms', '?')} ms)")
     # perf-trajectory trend table (tools/perfwatch.py): only series with
     # enough history to analyze render, so short fixture files and fresh
     # sinks add nothing here
@@ -694,12 +745,19 @@ def submit(argv):
     sys.exit(submit_main(argv))
 
 
+def route(argv):
+    """Spec-hash router fronting a SolverService replica fleet
+    (dedalus_tpu/service/router.py; docs/serving.md#replica-fleet)."""
+    from .service.router import main as route_main
+    sys.exit(route_main(argv))
+
+
 # Subcommands that own their whole argument surface (each has its own
 # argparse parser, including --help): dispatched BEFORE the top-level
 # parser sees the argv tail — argparse's REMAINDER does not reliably
 # capture leading options like `--help`, so forwarding must bypass it.
 PASSTHROUGH = {"lint": lint, "perfwatch": perfwatch, "serve": serve,
-               "submit": submit}
+               "submit": submit, "route": route}
 
 
 def build_parser():
@@ -757,7 +815,10 @@ def build_parser():
             ("serve", "warm-pool solver daemon (docs/serving.md); "
                       "see `serve --help`"),
             ("submit", "submit one run to a serve daemon; "
-                       "see `submit --help`")):
+                       "see `submit --help`"),
+            ("route", "spec-hash router fronting a replica fleet "
+                      "(docs/serving.md#replica-fleet); see "
+                      "`route --help`")):
         sub.add_parser(name, help=helptext, add_help=False)
     return parser
 

@@ -24,8 +24,9 @@ Client-side resilience (`retries=` / `submit --retry`): connection
 failures, dropped streams, daemon drains, and `overloaded` refusals are
 retried with jittered exponential backoff (the tools/resilience
 RetryPolicy errno classification decides which OSErrors are worth
-retrying; an `overloaded` reply's `retry_after_sec` hint overrides the
-exponential schedule). Every RETRYING run carries an idempotent request
+retrying; an `overloaded` reply's `retry_after_sec` hint FLOORS the
+exponential schedule without replacing it, and `--retry-max-delay`
+caps both). Every RETRYING run carries an idempotent request
 id (auto-generated when `retries > 0` and none is supplied; explicit
 ids always work), so a retry after a dropped `result` frame replays the
 completed outcome from the daemon's result cache instead of re-running
@@ -57,8 +58,12 @@ __all__ = ["RunResult", "ServiceClient", "main"]
 
 # structured error codes a retry can help with: the stream died before
 # the result ("closed"), a rolling restart is in progress ("draining"),
-# or admission control shed us ("overloaded", with a retry_after hint)
-_RETRYABLE_CODES = frozenset({"closed", "draining", "overloaded"})
+# or admission control shed us ("overloaded", with a retry_after hint);
+# "fleet-unavailable" is the router's whole-fleet outage refusal
+# (service/router.py) — transient by construction, since the supervisor
+# is already restarting the replicas behind it
+_RETRYABLE_CODES = frozenset({"closed", "draining", "overloaded",
+                              "fleet-unavailable"})
 
 
 class RunResult:
@@ -100,7 +105,7 @@ class ServiceClient:
 
     def __init__(self, host="127.0.0.1", port=None, timeout=None,
                  connect_timeout=None, read_timeout=None, retries=0,
-                 retry_base_delay=0.5):
+                 retry_base_delay=0.5, retry_max_delay=30.0):
         if port is None:
             raise ValueError("ServiceClient needs the daemon port (the "
                              "'ready' banner printed by `serve` names it)")
@@ -116,7 +121,8 @@ class ServiceClient:
         self.retries = max(int(retries), 0)
         self.retry = RetryPolicy(max_attempts=self.retries + 1,
                                  base_delay=float(retry_base_delay),
-                                 max_delay=30.0, jitter=0.25)
+                                 max_delay=float(retry_max_delay),
+                                 jitter=0.25)
 
     # `timeout` kept readable for callers that used the old single knob
     @property
@@ -151,9 +157,13 @@ class ServiceClient:
     def _with_retries(self, fn, observe_attempt=None):
         """Run one request attempt, reconnecting with jittered backoff on
         transient failures. A structured `retry_after_sec` hint from the
-        daemon (overload shedding) overrides the exponential schedule.
-        The attempt budget lives in ONE place — the RetryPolicy's
-        max_attempts (retries + 1)."""
+        daemon (overload shedding) acts as a FLOOR under the exponential
+        schedule — never a replacement for it: a hint that short-circuits
+        backoff growth turns every saturated daemon into a retry-storm
+        metronome, with the whole rejected cohort knocking again exactly
+        when invited. The combined delay stays capped by `retry_max_delay`
+        and jittered so cohorts decorrelate. The attempt budget lives in
+        ONE place — the RetryPolicy's max_attempts (retries + 1)."""
         attempt = 0
         while True:
             try:
@@ -163,13 +173,12 @@ class ServiceClient:
                 if attempt >= self.retry.max_attempts \
                         or not self._retryable(exc):
                     raise
-                # the daemon's shed hint is capped by the same max_delay
-                # as the exponential schedule: a saturated daemon can
-                # suggest minutes, but a queue slot may free in seconds
                 hint = getattr(exc, "retry_after_sec", None)
-                delay = (self.retry.jittered(min(float(hint),
-                                                 self.retry.max_delay))
-                         if hint else self.retry.delay(attempt))
+                base = self.retry.base_delay * 2 ** (attempt - 1)
+                if hint:
+                    base = max(base, float(hint))
+                delay = self.retry.jittered(min(base,
+                                                self.retry.max_delay))
                 if observe_attempt is not None:
                     observe_attempt(attempt, exc)
                 logger.warning(
@@ -399,6 +408,10 @@ def build_parser():
     parser.add_argument("--retry-delay", type=float, default=0.5,
                         help="backoff base seconds between retries "
                              "(default: %(default)s)")
+    parser.add_argument("--retry-max-delay", type=float, default=30.0,
+                        help="backoff ceiling seconds: caps both the "
+                             "exponential schedule and any daemon "
+                             "retry_after_sec hint (default: %(default)s)")
     parser.add_argument("--ping", action="store_true",
                         help="just ping the daemon and exit")
     parser.add_argument("--stats", action="store_true",
@@ -444,7 +457,8 @@ def main(argv=None):
                            timeout=args.timeout,
                            connect_timeout=args.connect_timeout,
                            retries=args.retry,
-                           retry_base_delay=args.retry_delay)
+                           retry_base_delay=args.retry_delay,
+                           retry_max_delay=args.retry_max_delay)
     try:
         if args.ping:
             client.ping()

@@ -1,0 +1,468 @@
+"""
+Replica fleet supervision for the spec-hash router (service/router.py).
+
+A `ReplicaSupervisor` owns N `SolverService` replicas — SPAWNED as
+`python -m dedalus_tpu serve --port 0` subprocesses whose ready banner
+names the ephemeral port, or ADOPTED from `--attach host:port` pairs the
+operator already runs — and keeps one answer current for the router:
+which replicas can take traffic right now.
+
+Health model (docs/serving.md "Replica fleet"):
+
+  * crash  — a spawned replica's process exited. Detected on the next
+    prober cycle via `Popen.poll()`; restarted with exponential backoff
+    (base doubled per consecutive failure, capped, reset after the
+    replica proves healthy again).
+  * wedge  — the process is alive but the daemon stopped answering the
+    `stats` frame (`wedge_misses` consecutive probe timeouts). A wedged
+    SPAWNED replica is SIGKILLed and restarted through the same backoff
+    path; an attached one is only marked down (we do not own it) and
+    rejoins when its probes recover.
+  * drain  — the probe's stats reply carries `draining`; the replica is
+    reported non-routable so the router stops sending NEW work, while
+    its in-flight runs finish under the daemon's own drain grace. A
+    spawned replica that drains to exit comes back through the crash
+    path — a rolling restart, not an outage.
+  * watchdog postmortem — `faults.watchdog_fires` moving between probes
+    is surfaced per replica and counted fleet-wide. The daemon heals
+    itself (worker replacement + requeue), so the supervisor only
+    records the signal; it restarts nothing that still answers stats.
+
+Lock discipline: `_lock` guards the replica table and the fleet
+counters, and every `with self._lock:` block is TIGHT — probing,
+spawning, killing, and banner reads all happen outside it on snapshots,
+so the fleet never holds its lock across network or process IO and the
+static lock graph stays edge-free (tools/lint/threadcheck.py).
+"""
+
+import json
+import logging
+import os
+import select
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from . import protocol
+from ..tools.lint.threadcheck import named_lock
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Replica", "ReplicaSupervisor"]
+
+
+class Replica:
+    """One replica's record. Plain data: every mutation happens inside a
+    tight `supervisor._lock` section (enforced by review + the DTC tier
+    on the supervisor's table field, not per-attribute)."""
+
+    __slots__ = ("name", "host", "port", "proc", "attached", "state",
+                 "draining", "restarts", "misses", "watchdog_fires",
+                 "last_stats", "generation", "backoff_sec",
+                 "next_restart_ts", "log_path", "started_ts")
+
+    def __init__(self, name, host, port, proc=None, attached=False,
+                 log_path=None):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.proc = proc
+        self.attached = bool(attached)
+        self.state = "up"            # up | down | restarting
+        self.draining = False
+        self.restarts = 0
+        self.misses = 0
+        self.watchdog_fires = 0
+        self.last_stats = None
+        self.generation = 0
+        self.backoff_sec = 0.0
+        self.next_restart_ts = 0.0
+        self.log_path = log_path
+        self.started_ts = time.monotonic()
+
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+    def snapshot(self):
+        return {"name": self.name, "host": self.host, "port": self.port,
+                "state": self.state, "draining": self.draining,
+                "attached": self.attached, "restarts": self.restarts,
+                "misses": self.misses, "generation": self.generation,
+                "watchdog_fires": self.watchdog_fires,
+                "pid": self.pid(),
+                "backoff_sec": round(self.backoff_sec, 3)}
+
+
+class ReplicaSupervisor:
+    """Spawn/adopt `SolverService` replicas, health-check them via the
+    stats frame, and restart spawned casualties with exponential
+    backoff. The router reads `routable()` per request and `snapshot()`
+    for stats; both are cheap lock-bounded copies."""
+
+    def __init__(self, replicas=0, attach=(), host="127.0.0.1",
+                 replica_args=(), workdir=None, probe_sec=1.0,
+                 probe_timeout=3.0, wedge_misses=4, backoff_base=0.5,
+                 backoff_max=30.0, spawn_timeout=300.0, on_spawn=None):
+        self.host = host
+        self.n_spawn = int(replicas)
+        self.attach = [self._parse_endpoint(a) for a in attach]
+        self.replica_args = list(replica_args)
+        self.workdir = workdir
+        self.probe_sec = float(probe_sec)
+        self.probe_timeout = float(probe_timeout)
+        self.wedge_misses = max(int(wedge_misses), 1)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.spawn_timeout = float(spawn_timeout)
+        self.on_spawn = on_spawn     # hook(proc, log_path): test registry
+        self._replicas = {}          # name -> Replica
+        self._lock = named_lock(
+            "service/fleet.py:ReplicaSupervisor._lock")
+        self.restarts_total = 0
+        self.crashes_detected = 0
+        self.wedges_detected = 0
+        self.watchdog_fires_total = 0
+        self._stop = threading.Event()
+        self._prober = None
+
+    @staticmethod
+    def _parse_endpoint(entry):
+        if isinstance(entry, (tuple, list)):
+            return str(entry[0]), int(entry[1])
+        host, _, port = str(entry).rpartition(":")
+        return (host or "127.0.0.1"), int(port)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        """Spawn the owned replicas (concurrently — the banner reads
+        happen after every process has been launched), adopt the
+        attached endpoints, and start the prober thread."""
+        launched = []
+        for i in range(self.n_spawn):
+            name = f"r{i}"
+            proc, log_path = self._launch(name)
+            launched.append((name, proc, log_path))
+        adopted = []
+        for name, proc, log_path in launched:
+            port = self._read_banner(name, proc)
+            adopted.append(Replica(name, self.host, port, proc=proc,
+                                   log_path=log_path))
+        for j, (host, port) in enumerate(self.attach):
+            adopted.append(Replica(f"a{j}", host, port, attached=True))
+        with self._lock:
+            for replica in adopted:
+                self._replicas[replica.name] = replica
+        if not adopted:
+            raise ValueError("fleet: no replicas to supervise (use "
+                             "replicas=N or attach=...)")
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="fleet-prober", daemon=True)
+        self._prober.start()
+        return [r.name for r in adopted]
+
+    def _launch(self, name):
+        """Popen one replica daemon (stdout = the ready banner pipe,
+        stderr = its log file). No lock held — this is process IO."""
+        cmd = [sys.executable, "-m", "dedalus_tpu", "serve",
+               "--port", "0"] + list(self.replica_args)
+        log_path = None
+        stderr = subprocess.DEVNULL
+        if self.workdir:
+            os.makedirs(self.workdir, exist_ok=True)
+            if "--sink" not in self.replica_args:
+                cmd += ["--sink", os.path.join(self.workdir,
+                                               f"{name}.jsonl")]
+            log_path = os.path.join(self.workdir, f"{name}.stderr")
+            stderr = open(log_path, "ab")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=stderr, env=env)
+        if stderr is not subprocess.DEVNULL:
+            stderr.close()
+        if self.on_spawn is not None:
+            try:
+                self.on_spawn(proc, log_path)
+            except Exception:
+                logger.exception("fleet: on_spawn hook failed")
+        logger.info(f"fleet: launched replica {name} pid {proc.pid}")
+        return proc, log_path
+
+    def _read_banner(self, name, proc):
+        """Block (bounded) for the replica's one-line ready banner and
+        return its port. A replica that dies or stays silent past
+        `spawn_timeout` is killed and reported."""
+        deadline = time.monotonic() + self.spawn_timeout
+        buf = b""
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet: replica {name} exited rc={proc.returncode} "
+                    f"before its ready banner")
+            ready, _, _ = select.select([proc.stdout], [], [], 0.25)
+            if not ready:
+                continue
+            chunk = proc.stdout.readline()
+            if not chunk:
+                continue
+            buf = chunk
+            try:
+                banner = json.loads(buf.decode())
+            except ValueError:
+                continue
+            if banner.get("kind") == "ready":
+                return int(banner["port"])
+        proc.kill()
+        raise RuntimeError(f"fleet: replica {name} produced no ready "
+                           f"banner within {self.spawn_timeout}s")
+
+    def stop(self, shutdown_replicas=True, grace_sec=60.0):
+        """Stop the prober; drain-and-exit every SPAWNED replica (the
+        shutdown frame is the SIGTERM path), escalating to SIGKILL past
+        the grace. Attached replicas are left alone — we do not own
+        them."""
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=self.probe_timeout
+                              + self.probe_sec + 5.0)
+        with self._lock:
+            owned = [(r.name, r.host, r.port, r.proc)
+                     for r in self._replicas.values()
+                     if r.proc is not None]
+        if not shutdown_replicas:
+            return
+        for name, host, port, proc in owned:
+            if proc.poll() is not None:
+                continue
+            try:
+                self._request(host, port, {"kind": "shutdown"},
+                              timeout=5.0)
+            except Exception:
+                proc.terminate()
+        deadline = time.monotonic() + float(grace_sec)
+        for name, _host, _port, proc in owned:
+            remaining = max(deadline - time.monotonic(), 0.1)
+            try:
+                proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                logger.warning(f"fleet: replica {name} ignored drain; "
+                               "SIGKILL")
+                proc.kill()
+                proc.wait(timeout=10)
+
+    # ------------------------------------------------------------- probing
+
+    def _request(self, host, port, request, timeout=None):
+        """One frame round-trip to a replica (no lock held)."""
+        timeout = self.probe_timeout if timeout is None else timeout
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            wfile = conn.makefile("wb")
+            rfile = conn.makefile("rb")
+            protocol.send_frame(wfile, request)
+            header, payload = protocol.recv_frame(rfile)
+            return header, payload
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.probe_sec):
+            try:
+                self._probe_once()
+            except Exception:
+                logger.exception("fleet: prober cycle failed")
+
+    def _probe_once(self):
+        with self._lock:
+            work = [(r.name, r.host, r.port, r.proc, r.generation,
+                     r.state, r.next_restart_ts)
+                    for r in self._replicas.values()]
+        now = time.monotonic()
+        verdicts = []
+        respawns = []
+        for name, host, port, proc, gen, state, next_ts in work:
+            if proc is not None and proc.poll() is not None:
+                if state == "down":
+                    if now >= next_ts:
+                        respawns.append((name, gen))
+                    continue
+                verdicts.append((name, gen, "crashed", None))
+                continue
+            if state == "down" and proc is None:
+                # attached and unreachable: keep probing for recovery
+                pass
+            try:
+                header, _ = self._request(host, port, {"kind": "stats"})
+                if header is None or header.get("kind") != "stats":
+                    raise protocol.ProtocolError("no stats reply")
+                verdicts.append((name, gen, "ok", header))
+            except Exception:
+                verdicts.append((name, gen, "miss", None))
+        kills = self._apply_verdicts(verdicts)
+        for name, proc in kills:
+            logger.warning(f"fleet: replica {name} wedged; SIGKILL pid "
+                           f"{proc.pid}")
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        for name, gen in respawns:
+            self._respawn(name, gen)
+
+    def _apply_verdicts(self, verdicts):
+        """Fold one probe cycle's results into the table (tight lock;
+        returns the wedged processes to kill OUTSIDE it)."""
+        kills = []
+        now = time.monotonic()
+        with self._lock:
+            for name, gen, verdict, stats in verdicts:
+                replica = self._replicas.get(name)
+                if replica is None or replica.generation != gen:
+                    continue          # restarted under us; stale verdict
+                if verdict == "ok":
+                    fires = int(((stats.get("faults") or {})
+                                 .get("watchdog_fires") or 0))
+                    if fires > replica.watchdog_fires:
+                        self.watchdog_fires_total += (
+                            fires - replica.watchdog_fires)
+                        logger.warning(
+                            f"fleet: replica {name} reported a watchdog "
+                            f"postmortem (fires={fires}); daemon healed "
+                            "itself, not restarting")
+                    replica.watchdog_fires = fires
+                    replica.misses = 0
+                    replica.state = "up"
+                    replica.draining = bool(stats.get("draining"))
+                    replica.last_stats = stats
+                    replica.backoff_sec = 0.0
+                elif verdict == "crashed":
+                    self.crashes_detected += 1
+                    replica.state = "down"
+                    replica.draining = False
+                    replica.backoff_sec = (
+                        min(max(replica.backoff_sec * 2.0,
+                                self.backoff_base), self.backoff_max))
+                    replica.next_restart_ts = now + replica.backoff_sec
+                    logger.warning(
+                        f"fleet: replica {name} crashed "
+                        f"(rc={replica.proc.returncode}); restart in "
+                        f"{replica.backoff_sec:.2f}s")
+                elif verdict == "miss":
+                    replica.misses += 1
+                    if replica.misses < self.wedge_misses:
+                        continue
+                    self.wedges_detected += 1
+                    replica.draining = False
+                    if replica.proc is not None \
+                            and replica.state != "down":
+                        kills.append((name, replica.proc))
+                        # the kill lands outside this lock; the NEXT
+                        # cycle sees the exit and runs the crash path
+                    replica.state = "down"
+        return kills
+
+    def _respawn(self, name, generation):
+        """Relaunch one crashed spawned replica (process IO outside the
+        lock; the table swap is tight). A failed relaunch re-arms the
+        backoff clock."""
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is None or replica.generation != generation \
+                    or replica.state == "restarting":
+                return
+            replica.state = "restarting"
+        try:
+            proc, log_path = self._launch(name)
+            port = self._read_banner(name, proc)
+        except Exception:
+            logger.exception(f"fleet: relaunch of {name} failed")
+            now = time.monotonic()
+            with self._lock:
+                replica = self._replicas.get(name)
+                if replica is not None:
+                    replica.state = "down"
+                    replica.backoff_sec = min(
+                        max(replica.backoff_sec * 2.0, self.backoff_base),
+                        self.backoff_max)
+                    replica.next_restart_ts = now + replica.backoff_sec
+            return
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is None:
+                proc.kill()
+                return
+            replica.proc = proc
+            replica.port = port
+            replica.log_path = log_path
+            replica.state = "up"
+            replica.draining = False
+            replica.misses = 0
+            replica.watchdog_fires = 0
+            replica.last_stats = None
+            replica.generation += 1
+            replica.restarts += 1
+            replica.started_ts = time.monotonic()
+            self.restarts_total += 1
+        logger.warning(f"fleet: replica {name} restarted (pid "
+                       f"{proc.pid}, port {port})")
+
+    # ------------------------------------------------------------- queries
+
+    def snapshot(self):
+        """Per-replica state list (copies; safe to hold)."""
+        with self._lock:
+            return [r.snapshot() for r in self._replicas.values()]
+
+    def routable(self):
+        """Names of replicas the router may send NEW work to."""
+        with self._lock:
+            return [r.name for r in self._replicas.values()
+                    if r.state == "up" and not r.draining]
+
+    def endpoint(self, name):
+        """(host, port) of one replica, or None."""
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is None:
+                return None
+            return replica.host, replica.port
+
+    def pid_of(self, name):
+        with self._lock:
+            replica = self._replicas.get(name)
+            return replica.pid() if replica is not None else None
+
+    def set_endpoint(self, name, host=None, port=None):
+        """Repoint one replica's endpoint (ops/chaos machinery: DNS
+        repointing, or tools/chaos.partition simulating an unreachable
+        replica). Returns the previous (host, port)."""
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is None:
+                raise KeyError(f"fleet: no replica named {name!r}")
+            previous = (replica.host, replica.port)
+            if host is not None:
+                replica.host = str(host)
+            if port is not None:
+                replica.port = int(port)
+            return previous
+
+    def stats(self):
+        """The `fleet` stats block (docs/serving.md#replica-fleet)."""
+        snap = self.snapshot()
+        with self._lock:
+            counters = {"restarts": self.restarts_total,
+                        "crashes": self.crashes_detected,
+                        "wedges": self.wedges_detected,
+                        "watchdog_fires": self.watchdog_fires_total}
+        states = {}
+        for r in snap:
+            key = "draining" if r["draining"] else r["state"]
+            states[key] = states.get(key, 0) + 1
+        return dict(counters, replicas={r["name"]: r for r in snap},
+                    states=states,
+                    spawned=sum(1 for r in snap if not r["attached"]),
+                    attached=sum(1 for r in snap if r["attached"]))

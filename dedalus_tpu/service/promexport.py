@@ -28,7 +28,8 @@ import re
 
 from ..tools import tracing
 
-__all__ = ["render_stats", "render_histogram", "validate_exposition"]
+__all__ = ["render_stats", "render_router_stats", "render_histogram",
+           "validate_exposition"]
 
 _PREFIX = "dedalus"
 
@@ -254,6 +255,105 @@ def render_stats(stats, hists=None):
                  [({"cause": cause}, count)
                   for cause, count in sorted(
                       (batching.get("detached") or {}).items())])
+
+    for suffix, (hist, help_text) in sorted((hists or {}).items()):
+        render_histogram(w, f"{p}_{suffix}", hist, help_text)
+    return w.text()
+
+
+def render_router_stats(stats, hists=None):
+    """The router's exposition from one `RouterService.stats()` dict:
+    traffic counters under `dedalus_router_*`, fleet health under
+    `dedalus_fleet_*` (per-replica gauges labeled `replica=...`), plus
+    the forward-latency histogram. Served by the router's `stats` frame
+    with `prom: true`; pinned through `validate_exposition` like every
+    other rendered surface (docs/observability.md#scraping-the-daemon)."""
+    stats = stats or {}
+    router = stats.get("router") or {}
+    fleet = stats.get("fleet") or {}
+    breaker = router.get("breaker") or {}
+    replicas = fleet.get("replicas") or {}
+    w = _Writer()
+    p = _PREFIX
+
+    w.family(f"{p}_router_up", "gauge",
+             "1 while the router is serving.", [(None, 1)])
+    w.family(f"{p}_router_uptime_seconds", "gauge",
+             "Seconds since the router bound its socket.",
+             [(None, stats.get("uptime_sec"))])
+    w.family(f"{p}_router_draining", "gauge",
+             "1 once the router began draining (new work is refused).",
+             [(None, stats.get("draining") is not None)])
+    w.family(f"{p}_router_forwarded_total", "counter",
+             "Run requests relayed to a replica result.",
+             [(None, router.get("forwarded"))])
+    w.family(f"{p}_router_failovers_total", "counter",
+             "Runs re-dispatched to a sibling after a replica fault.",
+             [(None, router.get("failovers"))])
+    w.family(f"{p}_router_shed_total", "counter",
+             "Runs refused fleet-wide (every routable replica refused "
+             "or faulted).", [(None, router.get("shed"))])
+    w.family(f"{p}_router_refusals_total", "counter",
+             "Per-replica refusals absorbed during routing.",
+             [(None, router.get("refusals"))])
+    w.family(f"{p}_router_replica_faults_total", "counter",
+             "Replica faults observed mid-relay (EOF, watchdog, "
+             "connect failure).", [(None, router.get("replica_faults"))])
+    w.family(f"{p}_router_client_drops_total", "counter",
+             "Clients that vanished while the router held their run.",
+             [(None, router.get("client_drops"))])
+    w.family(f"{p}_router_acks_suppressed_total", "counter",
+             "Duplicate replica acks hidden from clients on failover.",
+             [(None, router.get("acks_suppressed"))])
+    w.family(f"{p}_router_errors_by_code_total", "counter",
+             "Error frames relayed or emitted, by protocol code.",
+             [({"code": code}, count)
+              for code, count in sorted(
+                  (router.get("error_codes") or {}).items())])
+    w.family(f"{p}_router_ring_members", "gauge",
+             "Replicas currently routable on the hash ring.",
+             [(None, len(router.get("ring_members") or ())
+               if "ring_members" in router else None)])
+    w.family(f"{p}_router_breaker_opens_total", "counter",
+             "Per-replica circuit opens.", [(None, breaker.get("opens"))])
+    w.family(f"{p}_router_breaker_fastfails_total", "counter",
+             "Routing attempts fast-failed by an open replica circuit.",
+             [(None, breaker.get("fastfails"))])
+    w.family(f"{p}_router_breaker_open_circuits", "gauge",
+             "Replica circuits currently open.",
+             [(None, len(breaker.get("open") or ())
+               if "open" in breaker else None)])
+
+    # ---- fleet health
+    w.family(f"{p}_fleet_replicas", "gauge",
+             "Replicas under supervision, by state.",
+             [({"state": state}, count)
+              for state, count in sorted(
+                  (fleet.get("states") or {}).items())])
+    w.family(f"{p}_fleet_restarts_total", "counter",
+             "Replica restarts performed by the supervisor.",
+             [(None, fleet.get("restarts"))])
+    w.family(f"{p}_fleet_crashes_total", "counter",
+             "Replica process exits detected.",
+             [(None, fleet.get("crashes"))])
+    w.family(f"{p}_fleet_wedges_total", "counter",
+             "Replicas declared wedged (stats probes timed out).",
+             [(None, fleet.get("wedges"))])
+    w.family(f"{p}_fleet_watchdog_fires_total", "counter",
+             "Watchdog postmortems reported across the fleet.",
+             [(None, fleet.get("watchdog_fires"))])
+    w.family(f"{p}_fleet_replica_up", "gauge",
+             "1 while the named replica answers its health probe.",
+             [({"replica": name}, r.get("state") == "up")
+              for name, r in sorted(replicas.items())])
+    w.family(f"{p}_fleet_replica_draining", "gauge",
+             "1 while the named replica reports a drain in progress.",
+             [({"replica": name}, bool(r.get("draining")))
+              for name, r in sorted(replicas.items())])
+    w.family(f"{p}_fleet_replica_restarts_total", "counter",
+             "Restarts of the named replica.",
+             [({"replica": name}, r.get("restarts"))
+              for name, r in sorted(replicas.items())])
 
     for suffix, (hist, help_text) in sorted((hists or {}).items()):
         render_histogram(w, f"{p}_{suffix}", hist, help_text)

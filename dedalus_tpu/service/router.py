@@ -1,0 +1,581 @@
+"""
+Spec-hash router: one front-end daemon fanning the wire protocol out
+across N `SolverService` replicas.
+
+`python -m dedalus_tpu route --replicas N` (or `--attach host:port,...`)
+speaks the exact client protocol (service/protocol.py) on one port and
+forwards each `run` to the replica chosen by consistent-hashing the
+canonical `spec_digest` (the warm-pool key, protocol.py:296) onto a
+vnode ring — so same-spec traffic keeps landing on the replica whose
+warm pool and live continuous batch already hold that program, and
+adding or losing a replica only remaps the keys it owned.
+
+Robustness model (docs/serving.md#replica-fleet):
+
+  * failover — the router fronts the daemons' idempotent replay
+    machinery. Every forwarded run carries a request id (minted here
+    when the client sent none, BEFORE the first dispatch), so when a
+    replica dies mid-stream (EOF/reset before the terminal frame), or
+    its own watchdog abandons the run, the SAME id is re-dispatched to
+    the next distinct replica on the ring with any `chaos` block
+    STRIPPED (faults fire once); the client sees one ack and one
+    bit-identical result. The PR-5 shared assembly cache means the
+    failover target warms from its dead sibling's builds.
+  * degradation — a `draining`/`overloaded`/`circuit-open` refusal is
+    not a fault: the router tries the next ring replica without
+    penalizing the refuser, and only when EVERY routable replica
+    refused does the client get one structured error carrying the
+    MINIMUM `retry_after_sec` hint observed (the soonest any replica
+    expects capacity). Replica faults feed per-replica circuit
+    breakers (service/faults.py) so a flapping replica is excluded
+    from the ring for a cool-off, and failover hops are spaced by
+    jittered exponential backoff so retry storms never synchronize.
+  * fleet health — replica liveness (crash/wedge/drain detection,
+    restart with backoff) is `fleet.ReplicaSupervisor`'s job; the
+    router only reads its `routable()` view per request.
+
+Lock discipline: `_lock` guards the router counters and latency
+histogram only; every `with self._lock:` block is tight (no IO, no
+calls into fleet/breaker objects) so the static lock graph over the
+service tier stays edge-free (tools/lint/threadcheck.py).
+"""
+
+import argparse
+import hashlib
+import json
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+import uuid
+from bisect import bisect_right
+
+from . import protocol
+from .faults import CircuitBreaker
+from .fleet import ReplicaSupervisor
+from ..tools import tracing
+from ..tools.lint.threadcheck import named_lock
+from ..tools.resilience import RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RouterService", "ring_points", "ring_order", "route_digest",
+           "build_parser", "main"]
+
+# Refusals: the replica is healthy but won't take THIS request now.
+# Failover continues without a breaker penalty; hints are aggregated.
+_REFUSAL_CODES = frozenset({"draining", "overloaded", "circuit-open"})
+# Replica faults: the replica broke while holding the run. Failover
+# continues AND the replica's breaker records a failure.
+_FAULT_CODES = frozenset({"watchdog-timeout", "internal"})
+
+
+# ------------------------------------------------------------- hash ring
+
+def route_digest(header):
+    """The routing key for one run header: the canonical `spec_digest`
+    when the spec normalizes (registry membership is the replica's
+    business — the router must not import builders), else a digest of
+    the raw spec text so malformed requests still route deterministically
+    to SOME replica, whose structured `bad-spec` answer is relayed."""
+    spec = header.get("spec")
+    try:
+        blob = json.dumps(protocol.normalize_spec(spec,
+                                                  check_registry=False),
+                          sort_keys=True).encode()
+    except Exception:
+        blob = json.dumps(spec, sort_keys=True, default=str).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def ring_points(names, vnodes=64):
+    """The consistent-hash ring: `vnodes` points per replica, positioned
+    by blake2b so membership changes only remap the leaving/joining
+    replica's arcs. Returns sorted [(point, name), ...]."""
+    points = []
+    for name in names:
+        for i in range(vnodes):
+            token = hashlib.blake2b(f"{name}#{i}".encode(),
+                                    digest_size=8).digest()
+            points.append((int.from_bytes(token, "big"), name))
+    points.sort()
+    return points
+
+
+def ring_order(points, digest):
+    """Failover order for one routing key: the distinct replicas met
+    walking the ring clockwise from the key's position. First entry is
+    the primary (spec affinity); the rest are the replay targets."""
+    if not points:
+        return []
+    key = int.from_bytes(hashlib.blake2b(str(digest).encode(),
+                                         digest_size=8).digest(), "big")
+    start = bisect_right(points, (key, "￿"))
+    order = []
+    seen = set()
+    for offset in range(len(points)):
+        name = points[(start + offset) % len(points)][1]
+        if name not in seen:
+            seen.add(name)
+            order.append(name)
+    return order
+
+
+# ---------------------------------------------------------------- router
+
+class RouterService:
+    """The router daemon: accept loop + one reader thread per client
+    connection, forwarding frames between the client and the chosen
+    replica. Single-purpose by design — it never touches solver state,
+    so a router restart loses nothing but open sockets."""
+
+    def __init__(self, host="127.0.0.1", port=0, replicas=0, attach=(),
+                 replica_args=(), workdir=None, vnodes=64,
+                 probe_sec=1.0, probe_timeout=3.0, wedge_misses=4,
+                 backoff_base=0.5, connect_timeout=5.0,
+                 forward_timeout=600.0, breaker_failures=3,
+                 breaker_cooloff=30.0, sink=None, fleet=None):
+        self.host = host
+        self.port = int(port)
+        self.vnodes = max(int(vnodes), 1)
+        self.connect_timeout = float(connect_timeout)
+        self.forward_timeout = float(forward_timeout)
+        self.sink = sink
+        self.fleet = fleet if fleet is not None else ReplicaSupervisor(
+            replicas=replicas, attach=attach, replica_args=replica_args,
+            workdir=workdir, probe_sec=probe_sec,
+            probe_timeout=probe_timeout, wedge_misses=wedge_misses,
+            backoff_base=backoff_base)
+        self.breaker = CircuitBreaker(failures=breaker_failures,
+                                      cooloff_sec=breaker_cooloff)
+        # failover hops are spaced by this schedule (jittered so
+        # simultaneous failovers from many clients never synchronize)
+        self.forward_retry = RetryPolicy(max_attempts=8, base_delay=0.1,
+                                         max_delay=2.0, jitter=0.25)
+        self._lock = named_lock("service/router.py:RouterService._lock")
+        self.started = time.monotonic()
+        self.forwarded = 0           # runs relayed to completion
+        self.failovers = 0           # re-dispatches after a replica fault
+        self.shed = 0                # runs refused fleet-wide
+        self.refusals = 0            # per-replica refusals absorbed
+        self.replica_faults = 0      # faults observed (EOF, watchdog, ...)
+        self.client_drops = 0        # clients gone mid-relay
+        self.acks_suppressed = 0     # duplicate acks hidden on failover
+        self.error_codes = {}        # code -> count relayed/emitted
+        self.hists = {"forward_seconds": tracing.LogHistogram()}
+        self._listener = None
+        self._draining = None
+        self._shutdown = threading.Event()
+
+    # ----------------------------------------------------------- serving
+
+    def serve_forever(self, ready_stream=None):
+        """Start the fleet, bind, print the ready banner, and serve
+        until a `shutdown` frame arrives."""
+        members = self.fleet.start()
+        try:
+            self._listener = socket.create_server((self.host, self.port))
+            self._listener.settimeout(0.5)
+            self.port = self._listener.getsockname()[1]
+            if ready_stream is None:
+                ready_stream = sys.stdout
+            banner = {"kind": "ready", "role": "router",
+                      "port": self.port, "pid": os.getpid(),
+                      "replicas": members}
+            print(json.dumps(banner), file=ready_stream, flush=True)
+            logger.info(f"router: serving on {self.host}:{self.port} "
+                        f"fronting {len(members)} replica(s)")
+            while not self._shutdown.is_set():
+                try:
+                    conn, addr = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._serve_connection,
+                                 args=(conn,), daemon=True).start()
+        finally:
+            if self._listener is not None:
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+            self.fleet.stop(shutdown_replicas=True)
+            self._flush_stats()
+            logger.info(f"router: stopped ({self._draining})")
+
+    def request_drain(self, why="shutdown frame"):
+        self._draining = why
+        self._shutdown.set()
+
+    def _flush_stats(self):
+        """One `router_stats` record to the sink (and the log) at drain —
+        after `fleet.stop`, so the record carries the FINAL restart /
+        crash / wedge tallies of the fleet it supervised."""
+        record = dict(self.stats(), kind="router_stats",
+                      ts=round(time.time(), 1))
+        if self.sink:
+            from ..tools import metrics as metrics_mod
+            metrics_mod.Metrics(sink=self.sink, enabled=True).emit(record)
+        logger.info(f"router: final stats {json.dumps(record)}")
+
+    def _serve_connection(self, conn):
+        """One client connection: the router accepts the same one-shot
+        frame kinds the daemon does and answers `run` by relaying."""
+        try:
+            conn.settimeout(self.forward_timeout)
+            rfile = conn.makefile("rb")
+            wfile = conn.makefile("wb")
+            header = protocol.recv_header(rfile)
+            if header is None:
+                return
+            payload = protocol.recv_payload(rfile, header)
+            kind = header.get("kind")
+            if kind == "ping":
+                protocol.send_frame(wfile, {"kind": "pong",
+                                            "role": "router"})
+            elif kind == "stats":
+                if header.get("prom"):
+                    protocol.send_frame(
+                        wfile, {"kind": "stats", "format": "prometheus"},
+                        self.prom_text().encode("utf-8"))
+                else:
+                    protocol.send_frame(wfile, self.stats())
+            elif kind == "shutdown":
+                protocol.send_frame(wfile, {"kind": "ok",
+                                            "role": "router"})
+                self.request_drain()
+            elif kind == "run":
+                self._handle_run(wfile, header, payload)
+            else:
+                self._send_error(wfile, "unknown-kind",
+                                 f"router does not handle {kind!r}")
+        except (protocol.ProtocolError, OSError, ValueError) as exc:
+            logger.debug(f"router: connection dropped: {exc}")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send_error(self, wfile, code, message, **extra):
+        with self._lock:
+            self.error_codes[code] = self.error_codes.get(code, 0) + 1
+        try:
+            frame = {"kind": "error", "code": code, "message": message}
+            frame.update(extra)
+            protocol.send_frame(wfile, frame)
+        except OSError:
+            pass
+
+    # -------------------------------------------------------- run routing
+
+    def route_of(self, spec):
+        """The primary replica a spec routes to right now (ops/debug
+        surface, and what tests use to aim chaos at the right replica)."""
+        order = self._order_for({"spec": spec})
+        return order[0] if order else None
+
+    def _order_for(self, header):
+        members = self.fleet.routable()
+        return ring_order(ring_points(sorted(members), self.vnodes),
+                          route_digest(header))
+
+    def _handle_run(self, wfile, header, payload):
+        """Forward one run with failover. The request id is pinned
+        BEFORE the first dispatch so every re-dispatch replays the same
+        idempotent identity; chaos is stripped after attempt 1 so
+        injected faults fire exactly once."""
+        if self._draining:
+            self._send_error(wfile, "draining",
+                             f"router draining: {self._draining}",
+                             retry_after_sec=5.0)
+            return
+        if not header.get("id"):
+            header["id"] = uuid.uuid4().hex[:16]
+        order = self._order_for(header)
+        if not order:
+            with self._lock:
+                self.shed += 1
+            self._send_error(
+                wfile, "fleet-unavailable",
+                "no routable replica (fleet down or fully draining)",
+                retry_after_sec=self.fleet.probe_sec * 2
+                + self.fleet.probe_timeout)
+            return
+        t0 = time.monotonic()
+        hints = []
+        attempt = 0
+        relay = _RelayState()
+        for name in order:
+            allowed, retry_after, breaker_state = self.breaker.admit(name)
+            if not allowed:
+                hints.append(retry_after or 1.0)
+                continue
+            attempt += 1
+            if attempt > 1:
+                time.sleep(self.forward_retry.delay(attempt - 1))
+            verdict, detail = self._relay_once(name, wfile, header,
+                                               payload, attempt, relay)
+            if verdict == "served":
+                self.breaker.record_success(name)
+                wall = time.monotonic() - t0
+                with self._lock:
+                    self.forwarded += 1
+                    if attempt > 1:
+                        self.failovers += 1
+                    self.hists["forward_seconds"].add(wall)
+                return
+            if verdict == "client-error":
+                # deterministic structured answer: the replica judged
+                # the REQUEST, not itself — already relayed verbatim
+                self.breaker.record_success(name)
+                code = (detail or {}).get("code", "error")
+                with self._lock:
+                    self.error_codes[code] = (
+                        self.error_codes.get(code, 0) + 1)
+                return
+            if verdict == "client-gone":
+                with self._lock:
+                    self.client_drops += 1
+                return
+            if verdict == "refused":
+                if breaker_state == "probe":
+                    self.breaker.abandon_probe(name)
+                hints.append((detail or {}).get("retry_after_sec") or 1.0)
+                with self._lock:
+                    self.refusals += 1
+                continue
+            # verdict == "fault": penalize and fail over
+            self.breaker.record_failure(name)
+            with self._lock:
+                self.replica_faults += 1
+            logger.warning(f"router: replica {name} fault on request "
+                           f"{header['id']} (attempt {attempt}): "
+                           f"{detail}")
+        if hints:
+            with self._lock:
+                self.shed += 1
+            self._send_error(
+                wfile, "overloaded",
+                f"all {len(order)} routable replica(s) refused",
+                retry_after_sec=round(min(hints), 3))
+        else:
+            with self._lock:
+                self.shed += 1
+            self._send_error(
+                wfile, "fleet-unavailable",
+                f"all {len(order)} routable replica(s) faulted",
+                retry_after_sec=self.fleet.backoff_base * 2
+                + self.fleet.probe_timeout)
+
+    def _relay_once(self, name, wfile, header, payload, attempt, relay):
+        """One forwarding attempt. Returns (verdict, detail) where
+        verdict is `served` / `client-error` / `client-gone` /
+        `refused` / `fault`."""
+        endpoint = self.fleet.endpoint(name)
+        if endpoint is None:
+            return "fault", "replica vanished from the fleet"
+        fwd = dict(header)
+        if attempt > 1:
+            fwd.pop("chaos", None)       # injected faults fire once
+            fwd["failover"] = attempt - 1
+        read_timeout = self.forward_timeout
+        deadline = fwd.get("deadline_sec")
+        if deadline:
+            # a stalled replica must not pin the relay past the point
+            # the run could still meet its deadline
+            read_timeout = min(read_timeout, float(deadline) + 2.0)
+        try:
+            rconn = socket.create_connection(
+                endpoint, timeout=self.connect_timeout)
+        except OSError as exc:
+            return "fault", f"connect {endpoint}: {exc}"
+        try:
+            rconn.settimeout(read_timeout)
+            rr = rconn.makefile("rb")
+            rw = rconn.makefile("wb")
+            try:
+                protocol.send_frame(rw, fwd, payload)
+            except OSError as exc:
+                return "fault", f"send: {exc}"
+            while True:
+                try:
+                    frame, fpayload = protocol.recv_frame(rr)
+                except (protocol.ProtocolError, OSError) as exc:
+                    return "fault", f"stream: {exc}"
+                if frame is None:
+                    return "fault", "EOF before terminal frame"
+                kind = frame.get("kind")
+                if kind == "error":
+                    code = frame.get("code")
+                    if code in _REFUSAL_CODES:
+                        return "refused", frame
+                    if code in _FAULT_CODES:
+                        return "fault", frame
+                    if not self._to_client(wfile, frame, fpayload):
+                        return "client-gone", None
+                    return "client-error", frame
+                if kind == "ack":
+                    if relay.acked:
+                        with self._lock:
+                            self.acks_suppressed += 1
+                        continue
+                    relay.acked = True
+                    frame["replica"] = name
+                    if not self._to_client(wfile, frame, fpayload):
+                        return "client-gone", None
+                    continue
+                if kind == "result":
+                    frame["replica"] = name
+                    if attempt > 1:
+                        frame["failover"] = attempt - 1
+                    if not self._to_client(wfile, frame, fpayload):
+                        return "client-gone", None
+                    return "served", frame
+                # progress / telemetry / anything future: relay verbatim
+                if not self._to_client(wfile, frame, fpayload):
+                    return "client-gone", None
+        finally:
+            try:
+                rconn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _to_client(wfile, frame, fpayload):
+        try:
+            protocol.send_frame(wfile, frame, fpayload)
+            return True
+        except OSError:
+            return False
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self):
+        """The router/fleet stats frame (`kind: stats, role: router`)."""
+        with self._lock:
+            router = {"forwarded": self.forwarded,
+                      "failovers": self.failovers,
+                      "shed": self.shed,
+                      "refusals": self.refusals,
+                      "replica_faults": self.replica_faults,
+                      "client_drops": self.client_drops,
+                      "acks_suppressed": self.acks_suppressed,
+                      "error_codes": dict(self.error_codes)}
+            fwd_hist = self.hists["forward_seconds"]
+            forward = {"p50_ms": round(
+                fwd_hist.percentile(50) * 1e3, 3),
+                "p95_ms": round(fwd_hist.percentile(95) * 1e3, 3),
+                "count": fwd_hist.total}
+        fleet_stats = self.fleet.stats()
+        routable = self.fleet.routable()
+        open_keys = self.breaker.stats().get("open") or []
+        ring = [n for n in routable
+                if not any(n == k or k.startswith(n) for k in open_keys)]
+        return {"kind": "stats", "role": "router", "port": self.port,
+                "uptime_sec": round(time.monotonic() - self.started, 3),
+                "draining": self._draining,
+                "router": dict(router, forward=forward,
+                               ring_members=sorted(ring),
+                               breaker=self.breaker.stats()),
+                "fleet": fleet_stats}
+
+    def prom_text(self):
+        from . import promexport
+        with self._lock:
+            hist = self.hists["forward_seconds"]
+            hists = {"router_forward_seconds":
+                     ({"counts": dict(hist.counts), "total": hist.total,
+                       "sum": hist.sum},
+                      "Wall seconds per routed run, failover included.")}
+        return promexport.render_router_stats(self.stats(), hists)
+
+
+class _RelayState:
+    """Per-request relay memory shared across failover attempts: the
+    client must see exactly one ack no matter how many replicas touched
+    the run."""
+
+    __slots__ = ("acked",)
+
+    def __init__(self):
+        self.acked = False
+
+
+# ------------------------------------------------------------------- CLI
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m dedalus_tpu route",
+        description="Spec-hash router fronting a SolverService replica "
+                    "fleet: consistent-hash routing on spec_digest, "
+                    "health-checked failover, idempotent cross-replica "
+                    "replay.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0,
+                        help="router port (0 = ephemeral, banner names it)")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="spawn N local replicas (serve --port 0)")
+    parser.add_argument("--attach", default="",
+                        help="adopt running replicas: host:port,host:port")
+    parser.add_argument("--replica-arg", action="append", default=[],
+                        dest="replica_args", metavar="ARG",
+                        help="extra `serve` argv token for SPAWNED "
+                             "replicas (repeat; option-like tokens need "
+                             "the = form: --replica-arg=--pool-size "
+                             "--replica-arg=4)")
+    parser.add_argument("--workdir", default=None,
+                        help="directory for replica sinks + stderr logs")
+    parser.add_argument("--vnodes", type=int, default=64,
+                        help="ring points per replica")
+    parser.add_argument("--probe-sec", type=float, default=1.0,
+                        help="health-probe cadence")
+    parser.add_argument("--probe-timeout", type=float, default=3.0,
+                        help="stats-frame probe timeout")
+    parser.add_argument("--wedge-misses", type=int, default=4,
+                        help="consecutive probe misses before a replica "
+                             "is declared wedged (SIGKILL + restart)")
+    parser.add_argument("--backoff-base", type=float, default=0.5,
+                        help="restart backoff base (doubles per failure)")
+    parser.add_argument("--connect-timeout", type=float, default=5.0)
+    parser.add_argument("--forward-timeout", type=float, default=600.0,
+                        help="per-forward read timeout")
+    parser.add_argument("--breaker-failures", type=int, default=3,
+                        help="consecutive faults opening a replica's "
+                             "circuit")
+    parser.add_argument("--breaker-cooloff", type=float, default=30.0)
+    parser.add_argument("--sink", default=None,
+                        help="telemetry sink for router stats records")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.replicas <= 0 and not args.attach:
+        build_parser().error("need --replicas N and/or --attach "
+                             "host:port,...")
+    attach = [a for a in args.attach.split(",") if a.strip()]
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    router = RouterService(
+        host=args.host, port=args.port, replicas=args.replicas,
+        attach=attach, replica_args=args.replica_args,
+        workdir=args.workdir, vnodes=args.vnodes,
+        probe_sec=args.probe_sec, probe_timeout=args.probe_timeout,
+        wedge_misses=args.wedge_misses, backoff_base=args.backoff_base,
+        connect_timeout=args.connect_timeout,
+        forward_timeout=args.forward_timeout,
+        breaker_failures=args.breaker_failures,
+        breaker_cooloff=args.breaker_cooloff, sink=args.sink)
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        router.request_drain("SIGINT")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -921,6 +921,14 @@ class SolverService:
             # spurious fire on a slow-but-alive step would then serve
             # corrupted state as a healthy result
             self.pool.discard(ctx.digest)
+        # replace the executor BEFORE the client-visible error write:
+        # the write below can block up to the socket deadline, and the
+        # daemon must not sit executor-less for that window. The order
+        # is also an observability contract — once a client holds the
+        # watchdog-timeout error, the replacement generation is visible
+        # (stats/_worker_gen), so "error received then state inspected"
+        # can never race the bookkeeping.
+        self._start_worker()
         # the error write shares ctx.wfile's buffered-writer lock with
         # the (possibly mid-send) wedged executor: if the stall IS a
         # blocked send to a byte-dripping client, writing here would
@@ -938,7 +946,6 @@ class SolverService:
             ctx.conn.close()
         except OSError:
             pass
-        self._start_worker()
 
     # ---------------------------------------------------------------- run
 

@@ -68,6 +68,19 @@ run-header chaos blocks on a `--chaos --batch` daemon):
     daemon detaches (abort) or completes-for-replay (complete) that
     member only, mid-batch.
 
+Replica-fleet faults (aimed at ONE named replica of a `dedalus_tpu
+route` deployment through its ReplicaSupervisor; tests/test_router.py —
+every fault fires once and must be invisible to clients):
+
+  * `kill_replica` — SIGKILL the replica process (abrupt crash; the
+    router fails the cut run over, the supervisor restarts the body),
+  * `wedge_replica` — SIGSTOP forever (alive but protocol-dead; probes
+    miss until the supervisor SIGKILLs and restarts it),
+  * `slow_replica_sec` — SIGSTOP then SIGCONT after N seconds (a stall
+    below the wedge threshold: failover without a restart),
+  * `partition` — repoint the supervisor's endpoint at a dead port
+    (healthy process, unreachable network; returns a heal() callable).
+
 Each armed ChaosInjector fault fires ONCE (rewind replays the
 triggering iteration; a re-firing fault would deadlock the recovery it
 is testing) and is logged loudly when it fires. Everything here is test
@@ -90,9 +103,10 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 __all__ = ["ChaosInjector", "corrupt_checkpoint", "corrupt_shard",
-           "half_frame", "late_join_storm", "poison_fleet_member",
-           "queue_storm", "sigkill_client", "slow_loris",
-           "vanish_client"]
+           "half_frame", "kill_replica", "late_join_storm", "partition",
+           "poison_fleet_member", "queue_storm", "sigkill_client",
+           "slow_loris", "slow_replica_sec", "vanish_client",
+           "wedge_replica"]
 
 
 def _field_slice(solver, name):
@@ -687,3 +701,91 @@ def queue_storm(port, header, payload=None, n=8, host="127.0.0.1",
         f"{sum(1 for r in results if r and r['code'] == 'overloaded')} "
         "shed")
     return results
+
+
+# --------------------------------------------------------- replica faults
+#
+# Fleet-level faults aimed at a `dedalus_tpu route` deployment
+# (service/fleet.py ReplicaSupervisor). Each targets ONE named replica
+# through the supervisor's own snapshot/endpoint surface and fires once;
+# the router must absorb the fault invisibly (failover/replay: the
+# client still sees one bit-identical result) and the supervisor must
+# recover the replica. Expected client-visible outcomes are documented
+# per fault in docs/serving.md#replica-fleet.
+
+def kill_replica(fleet, name):
+    """SIGKILL one replica's process — the abrupt replica crash. A run
+    in flight there dies mid-stream; the router re-dispatches it (same
+    request id, chaos stripped) to the next ring replica, and the
+    supervisor restarts the casualty with backoff. Returns the killed
+    pid."""
+    pid = fleet.pid_of(name)
+    if pid is None:
+        raise KeyError(f"chaos: replica {name!r} has no live process")
+    os.kill(pid, signal.SIGKILL)
+    logger.warning(f"chaos: SIGKILLed replica {name} (pid {pid})")
+    return pid
+
+
+def wedge_replica(fleet, name):
+    """SIGSTOP one replica indefinitely — alive to the OS, dead to the
+    protocol. Its stats probes time out until the supervisor's
+    `wedge_misses` threshold declares it wedged, SIGKILLs it, and
+    restarts it. Returns the stopped pid (the supervisor delivers the
+    SIGKILL; no SIGCONT is ever sent)."""
+    pid = fleet.pid_of(name)
+    if pid is None:
+        raise KeyError(f"chaos: replica {name!r} has no live process")
+    os.kill(pid, signal.SIGSTOP)
+    logger.warning(f"chaos: wedged replica {name} (pid {pid} SIGSTOPped "
+                   "until the supervisor kills it)")
+    return pid
+
+
+def slow_replica_sec(fleet, name, sec):
+    """SIGSTOP one replica for `sec` seconds, then SIGCONT — a transient
+    stall (GC pause, CPU-starved neighbor, NFS hiccup), NOT a wedge:
+    `sec` must sit below the supervisor's wedge threshold so the replica
+    rejoins the ring unrestarted. A routed run with a `deadline_sec`
+    bound fails over under the router's deadline-derived read timeout.
+    Returns the timer delivering the SIGCONT (armed; already started)."""
+    pid = fleet.pid_of(name)
+    if pid is None:
+        raise KeyError(f"chaos: replica {name!r} has no live process")
+    os.kill(pid, signal.SIGSTOP)
+
+    def _resume():
+        try:
+            os.kill(pid, signal.SIGCONT)
+            logger.warning(f"chaos: replica {name} (pid {pid}) resumed "
+                           f"after {sec}s stall")
+        except OSError:
+            pass   # supervisor already replaced it
+
+    timer = threading.Timer(float(sec), _resume)
+    timer.daemon = True
+    timer.start()
+    logger.warning(f"chaos: stalled replica {name} (pid {pid}) for "
+                   f"{sec}s")
+    return timer
+
+
+def partition(fleet, name, host="127.0.0.1"):
+    """Repoint one replica's endpoint at a dead port — the network
+    partition: the process is healthy but unreachable, so probes miss
+    and forwards fail with connection-refused faults. Returns a `heal()`
+    callable restoring the real endpoint."""
+    with socket.socket() as probe:
+        probe.bind((host, 0))
+        dead_port = probe.getsockname()[1]
+    # the socket is closed again: nothing listens on dead_port
+    previous = fleet.set_endpoint(name, host=host, port=dead_port)
+    logger.warning(f"chaos: partitioned replica {name} "
+                   f"({previous[0]}:{previous[1]} -> dead port "
+                   f"{dead_port})")
+
+    def heal():
+        fleet.set_endpoint(name, host=previous[0], port=previous[1])
+        logger.warning(f"chaos: healed partition of replica {name}")
+
+    return heal

@@ -57,6 +57,12 @@ HOT_PATH_MODULES = (
     # on the host, but it must do so ONCE (explicitly), not via stray
     # per-field syncs smuggled into validation helpers
     "service/protocol.py",
+    # the router relays every served frame and the supervisor probes
+    # every replica each probe tick: both are pure host/socket plumbing
+    # by contract — any device call here would charge every forwarded
+    # request (or every health probe) a sync it has no business paying
+    "service/router.py",
+    "service/fleet.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its

@@ -106,6 +106,8 @@ THREADED_MODULES = (
     "service/batching.py",
     "service/faults.py",
     "service/pool.py",
+    "service/router.py",
+    "service/fleet.py",
     "tools/dcheckpoint.py",
     "tools/tracing.py",
     "tools/metrics.py",
@@ -169,6 +171,19 @@ LOCK_CATALOG = (
     # faults: result-cache LRU (readers replay, the executor stores)
     GuardSpec("service/faults.py", "ResultCache", "_lock",
               fields=("_entries", "_bytes", "replays")),
+    # router: relay accounting. Bumped from per-connection handler
+    # threads, read by stats()/prom_text() from other handler threads;
+    # router.py documents the tight-block contract at the _lock binding.
+    GuardSpec("service/router.py", "RouterService", "_lock",
+              fields=("forwarded", "failovers", "shed", "refusals",
+                      "replica_faults", "client_drops",
+                      "acks_suppressed", "error_codes", "hists")),
+    # fleet: the replica table and supervision counters. Mutated by the
+    # prober thread's verdict fold and the restart path, read by
+    # routing (routable/endpoint) from every handler thread.
+    GuardSpec("service/fleet.py", "ReplicaSupervisor", "_lock",
+              fields=("_replicas", "restarts_total", "crashes_detected",
+                      "wedges_detected", "watchdog_fires_total")),
     # pool: bookkeeping dicts read by stats() from reader threads
     GuardSpec("service/pool.py", "SolverPool", "_lock",
               fields=("_entries", "_aliases", "hits", "misses",

@@ -57,7 +57,8 @@ DEFAULT_WAIVERS = PACKAGE_DIR.parent / "benchmarks" / "perfwatch_waivers.json"
 
 # row kinds that are bookkeeping, not measurements
 _NON_MEASUREMENT_KINDS = {"probe", "trace", "service_stats",
-                          "health_postmortem", "watchdog_postmortem"}
+                          "router_stats", "health_postmortem",
+                          "watchdog_postmortem"}
 
 # ledger fields watched for UPWARD drift (field -> metric name)
 _LEDGER_METRICS = (("flops", "ledger_flops"),

@@ -1,0 +1,833 @@
+"""
+Replica-fleet router (service/router.py + service/fleet.py): the fault
+matrix behind the fleet's robustness claim — every injected replica
+fault must be INVISIBLE to clients (one ack, one bit-identical result,
+diffusion64 SBDF2) and followed by a healthy bit-identical request
+proving the fleet recovered:
+
+  * spec-digest affinity: same-spec traffic lands on the same replica,
+    and the consistent-hash ring's membership-change property holds
+    (losing a replica only remaps the keys it owned);
+  * mid-run replica SIGKILL → failover re-dispatch (same request id,
+    next ring replica), then a supervisor restart with backoff;
+  * wedged replica (hang chaos): the REPLICA's watchdog abandons the
+    run, the router treats `watchdog-timeout` as a replica fault and
+    re-dispatches with the chaos block STRIPPED (fire-once);
+  * slow replica (SIGSTOP/SIGCONT stall below the wedge threshold):
+    the deadline-derived forward timeout fails the run over without a
+    restart;
+  * rolling drain (SIGTERM): the draining replica leaves the ring
+    without dropping in-flight work and returns via the crash path;
+  * network partition (endpoint repointed at a dead port): failover on
+    connection refusal, full recovery on heal();
+  * degradation discipline: whole-fleet saturation aggregates the
+    MINIMUM `retry_after_sec` hint into one structured `overloaded`
+    error; a fully-faulted fleet answers `fleet-unavailable` (which
+    the client treats as retryable);
+  * client retry hardening: `retry_after_sec` hints FLOOR the capped
+    jittered exponential schedule instead of replacing it, under a
+    configurable attempt budget — asserted against a scripted fake
+    server with captured sleeps;
+  * observability: the `router`/`fleet` stats block, its Prometheus
+    exposition under `validate_exposition`, and the `report` CLI
+    rendering of router stats + the `router_scaling` bench row.
+
+Scripted fake replicas cover the protocol/degradation matrix cheaply
+(tier-1); the spawned-fleet tests (real `serve` subprocesses, real
+SIGKILL/SIGSTOP) carry the `slow` marker like the other process-heavy
+drills and run in the extended sweep and CI stage that invokes them
+explicitly.
+"""
+
+import contextlib
+import json
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dedalus_tpu.service import promexport, protocol
+from dedalus_tpu.service import client as client_mod
+from dedalus_tpu.service.client import ServiceClient
+from dedalus_tpu.service.protocol import ServiceError
+from dedalus_tpu.service.router import (RouterService, ring_order,
+                                        ring_points, route_digest)
+from dedalus_tpu.tools import chaos as chaos_mod
+
+REPO = pathlib.Path(__file__).parent.parent
+
+pytestmark = [pytest.mark.service, pytest.mark.chaos]
+
+SIZE = 64
+DT = 1e-3
+STEPS = 40
+SPEC = {"problem": "diffusion", "params": {"size": SIZE,
+                                           "scheme": "SBDF2"}}
+SPEC_B = {"problem": "diffusion", "params": {"size": 48,
+                                             "scheme": "SBDF2"}}
+
+
+def diff_ics(size=SIZE, k=3, amp=0.2):
+    x = np.linspace(0, 2 * np.pi, size, endpoint=False)
+    return {"u": ("g", np.sin(k * x)), "a": ("g", amp * np.cos(x))}
+
+
+_references = {}
+
+
+def direct_reference(spec, ics, dt, steps):
+    """The direct in-process solve a routed run must bit-match (same
+    discipline as tests/test_service_batching.py)."""
+    from dedalus_tpu.service.server import SolverService
+    key = json.dumps([spec, sorted(ics), dt, steps], sort_keys=True,
+                     default=str)
+    ics_key = (key, tuple(np.asarray(v[1]).tobytes()
+                          for v in ics.values()))
+    if ics_key not in _references:
+        solver = protocol.resolve_builder(spec)()
+        SolverService._install_ics(solver, ics)
+        for _ in range(steps):
+            solver.step(dt)
+        _references[ics_key] = {
+            v.name: np.asarray(v.coeff_data()).copy()
+            for v in solver.state}
+    return _references[ics_key]
+
+
+# ------------------------------------------------------------- hash ring
+
+class TestRing:
+    def test_order_is_a_stable_permutation(self):
+        points = ring_points(["r0", "r1", "r2", "r3"], vnodes=64)
+        order = ring_order(points, "some-digest")
+        assert sorted(order) == ["r0", "r1", "r2", "r3"]
+        assert order == ring_order(points, "some-digest")
+
+    def test_distribution_is_roughly_balanced(self):
+        points = ring_points(["r0", "r1", "r2", "r3"], vnodes=64)
+        owners = [ring_order(points, f"digest{i}")[0]
+                  for i in range(2000)]
+        for name in ("r0", "r1", "r2", "r3"):
+            share = owners.count(name) / 2000
+            assert 0.10 < share < 0.45, (name, share)
+
+    def test_membership_change_only_remaps_owned_keys(self):
+        full = ring_points(["r0", "r1", "r2", "r3"], vnodes=64)
+        reduced = ring_points(["r0", "r1", "r2"], vnodes=64)
+        moved = owned = 0
+        for i in range(2000):
+            before = ring_order(full, f"digest{i}")[0]
+            owned += before == "r3"
+            moved += before != ring_order(reduced, f"digest{i}")[0]
+        assert moved == owned   # the consistent-hash property, exactly
+
+    def test_route_digest_is_the_pool_key(self):
+        # the router must route by the SAME digest the warm pool keys
+        # on, or affinity silently evaporates
+        assert route_digest({"spec": SPEC}) == protocol.spec_digest(SPEC)
+        # malformed specs still route deterministically (the replica
+        # owns the structured bad-spec reply)
+        bad = {"spec": {"problem": 7}}
+        assert route_digest(bad) == route_digest(bad)
+
+
+# ---------------------------------------------------------- fake fleet
+#
+# Scripted replicas speaking just enough protocol to exercise every
+# router verdict deterministically, with zero JAX: behaviors are
+# per-connection scripts consumed in order (the last repeats).
+
+class FakeReplica:
+    def __init__(self, *script):
+        self.script = list(script) or ["serve"]
+        self.runs = 0
+        self.listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self.listener.getsockname()[1]
+        self._lock = threading.Lock()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _next(self):
+        with self._lock:
+            self.runs += 1
+            if len(self.script) > 1:
+                return self.script.pop(0)
+            return self.script[0]
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        with conn:
+            rfile = conn.makefile("rb")
+            wfile = conn.makefile("wb")
+            try:
+                header = protocol.recv_header(rfile)
+                if header is None:
+                    return
+                protocol.recv_payload(rfile, header)
+                kind = header.get("kind")
+                if kind == "stats":
+                    protocol.send_frame(wfile, {"kind": "stats",
+                                                "faults": {}})
+                    return
+                if kind == "shutdown":
+                    protocol.send_frame(wfile, {"kind": "ok"})
+                    return
+                if kind != "run":
+                    return
+                step = self._next()
+                if step == "die":
+                    return             # EOF before any frame
+                if step == "die_after_ack":
+                    protocol.send_frame(wfile, {"kind": "ack",
+                                                "pool_verdict": "hit"})
+                    return             # EOF mid-stream
+                if step.startswith("refuse:"):
+                    _, code, hint = step.split(":")
+                    protocol.send_frame(
+                        wfile, {"kind": "error", "code": code,
+                                "message": f"scripted {code}",
+                                "retry_after_sec": float(hint)})
+                    return
+                if step == "watchdog":
+                    protocol.send_frame(wfile, {"kind": "ack",
+                                                "pool_verdict": "hit"})
+                    protocol.send_frame(
+                        wfile, {"kind": "error",
+                                "code": "watchdog-timeout",
+                                "message": "scripted wedge"})
+                    return
+                if step == "bad-spec":
+                    protocol.send_frame(
+                        wfile, {"kind": "error", "code": "bad-spec",
+                                "message": "scripted rejection"})
+                    return
+                # "serve": ack + one result frame echoing the request id
+                protocol.send_frame(wfile, {"kind": "ack",
+                                            "pool_verdict": "hit"})
+                protocol.send_frame(
+                    wfile, {"kind": "result", "iteration": 1,
+                            "sim_time": DT, "stopped_by": "scripted",
+                            "id": header.get("id")})
+            except (protocol.ProtocolError, OSError):
+                pass
+
+    def close(self):
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+@contextlib.contextmanager
+def fake_router(*scripts, **router_kw):
+    """A RouterService fronting one FakeReplica per script tuple."""
+    fakes = [FakeReplica(*script) for script in scripts]
+    router_kw.setdefault("probe_sec", 0.2)
+    router_kw.setdefault("probe_timeout", 1.0)
+    router = RouterService(
+        attach=[f"127.0.0.1:{f.port}" for f in fakes], **router_kw)
+    thread = threading.Thread(target=router.serve_forever, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 30
+    while router.port == 0 or router._listener is None:
+        if time.monotonic() > deadline:
+            raise RuntimeError("fake router did not come up")
+        time.sleep(0.01)
+    try:
+        yield router, fakes
+    finally:
+        router.request_drain("test teardown")
+        thread.join(timeout=30)
+        assert not thread.is_alive(), "router failed to drain"
+        for fake in fakes:
+            fake.close()
+
+
+def fake_named(router, fakes, name):
+    """The FakeReplica adopted under fleet name `name`."""
+    port = router.fleet.endpoint(name)[1]
+    return next(f for f in fakes if f.port == port)
+
+
+def primary_fake(router, fakes, spec=SPEC):
+    """(primary_name, its FakeReplica) for `spec` — script THIS one
+    with the fault so the failover target stays healthy."""
+    name = router.route_of(spec)
+    return name, fake_named(router, fakes, name)
+
+
+def wait_for(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestFakeFleetRouting:
+    def test_failover_suppresses_duplicate_ack(self):
+        # primary acks then dies mid-stream; the sibling serves. The
+        # client must see ONE ack and one result carrying failover
+        # provenance.
+        with fake_router(("serve",), ("serve",)) as (router, fakes):
+            primary, dying = primary_fake(router, fakes)
+            dying.script[:] = ["die_after_ack", "serve"]
+            client = ServiceClient(port=router.port, timeout=20)
+            result = client.run(SPEC, dt=DT, stop_iteration=1)
+            assert result.result["replica"] != primary
+            assert result.result["failover"] == 1
+            assert result.ack is not None
+            wait_for(lambda: router.stats()["router"]["failovers"] == 1,
+                     5, "failover accounting")
+            stats = router.stats()["router"]
+            assert stats["forwarded"] == 1
+            assert stats["replica_faults"] == 1
+            assert stats["acks_suppressed"] == 1
+
+    def test_request_id_is_pinned_across_failover(self):
+        # the id minted by the router on attempt 1 must reach the
+        # failover target unchanged — it IS the idempotent replay key
+        with fake_router(("serve",), ("serve",)) as (router, fakes):
+            _, dying = primary_fake(router, fakes)
+            dying.script[:] = ["die_after_ack", "serve"]
+            client = ServiceClient(port=router.port, timeout=20)
+            result = client.run(SPEC, dt=DT, stop_iteration=1)
+            assert result.result["id"]   # echoed by the serving fake
+            assert result.result["failover"] == 1
+
+    def test_watchdog_timeout_is_a_replica_fault(self):
+        with fake_router(("serve",), ("serve",)) as (router, fakes):
+            _, wedged = primary_fake(router, fakes)
+            wedged.script[:] = ["watchdog", "serve"]
+            client = ServiceClient(port=router.port, timeout=20)
+            result = client.run(SPEC, dt=DT, stop_iteration=1)
+            assert result.result["failover"] == 1
+            wait_for(lambda: router.stats()["router"]["replica_faults"]
+                     == 1, 5, "fault accounting")
+
+    def test_refusal_fails_over_without_breaker_penalty(self):
+        with fake_router(("serve",), ("serve",)) as (router, fakes):
+            _, refusing = primary_fake(router, fakes)
+            refusing.script[:] = ["refuse:draining:3.0", "serve"]
+            client = ServiceClient(port=router.port, timeout=20)
+            result = client.run(SPEC, dt=DT, stop_iteration=1)
+            assert result.result["failover"] == 1
+            stats = router.stats()["router"]
+            assert stats["refusals"] == 1
+            assert stats["breaker"]["opens"] == 0
+
+    def test_saturation_aggregates_min_retry_after(self):
+        with fake_router(("refuse:overloaded:11.0",),
+                         ("refuse:overloaded:7.0",)) as (router, fakes):
+            client = ServiceClient(port=router.port, timeout=20)
+            with pytest.raises(ServiceError) as err:
+                client.run(SPEC, dt=DT, stop_iteration=1)
+            assert err.value.code == "overloaded"
+            assert err.value.retry_after_sec == 7.0
+            assert router.stats()["router"]["shed"] == 1
+
+    def test_fully_faulted_fleet_is_fleet_unavailable(self):
+        with fake_router(("die",), ("die",)) as (router, fakes):
+            client = ServiceClient(port=router.port, timeout=20)
+            with pytest.raises(ServiceError) as err:
+                client.run(SPEC, dt=DT, stop_iteration=1)
+            assert err.value.code == "fleet-unavailable"
+            assert err.value.retry_after_sec > 0
+        # the client-side retry machinery must classify it transient:
+        # the supervisor is restarting the fleet behind that error
+        assert "fleet-unavailable" in client_mod._RETRYABLE_CODES
+
+    def test_deterministic_errors_relay_verbatim(self):
+        # bad-spec is the CLIENT's fault: no failover, no breaker
+        # penalty, the replica's structured answer passes through
+        with fake_router(("bad-spec",), ("bad-spec",)) as (router,
+                                                           fakes):
+            client = ServiceClient(port=router.port, timeout=20)
+            with pytest.raises(ServiceError) as err:
+                client.run(SPEC, dt=DT, stop_iteration=1)
+            assert err.value.code == "bad-spec"
+            stats = router.stats()["router"]
+            assert stats["replica_faults"] == 0
+            assert sum(f.runs for f in fakes) == 1
+
+    def test_draining_router_refuses_new_runs(self):
+        with fake_router(("serve",)) as (router, fakes):
+            client = ServiceClient(port=router.port, timeout=20)
+            client.run(SPEC, dt=DT, stop_iteration=1)
+            router._draining = "test drain"
+            with pytest.raises(ServiceError) as err:
+                client.run(SPEC, dt=DT, stop_iteration=1)
+            assert err.value.code == "draining"
+            router._draining = None   # let teardown drain normally
+
+
+# ------------------------------------------------- client retry backoff
+
+@contextlib.contextmanager
+def fake_server_client(script, sleeps, **client_kw):
+    """A ServiceClient aimed at ONE FakeReplica, with time.sleep in the
+    client module captured instead of slept."""
+    fake = FakeReplica(*script)
+    real_sleep = client_mod.time.sleep
+    client_mod.time.sleep = lambda s: sleeps.append(s)
+    try:
+        yield ServiceClient(port=fake.port, **client_kw), fake
+    finally:
+        client_mod.time.sleep = real_sleep
+        fake.close()
+
+
+class TestClientRetryHardening:
+    def test_hint_floors_the_exponential_schedule(self):
+        # a 5s hint must not be outrun by the young exponential
+        # schedule (0.2, 0.4, ...): every delay sits at >= jittered 5s
+        sleeps = []
+        with fake_server_client(["refuse:overloaded:5.0",
+                                 "refuse:overloaded:5.0", "serve"],
+                                sleeps, retries=3, retry_base_delay=0.2,
+                                retry_max_delay=8.0) as (client, fake):
+            result = client.run(SPEC, dt=DT, stop_iteration=1)
+            assert result.result is not None
+        assert len(sleeps) == 2
+        for delay in sleeps:
+            assert 5.0 * 0.75 - 1e-9 <= delay <= 8.0 * 1.25
+
+    def test_tiny_hint_keeps_exponential_growth(self):
+        # a near-zero hint must NOT collapse backoff growth — that is
+        # the retry-storm metronome this hardening removes
+        sleeps = []
+        with fake_server_client(["refuse:overloaded:0.01",
+                                 "refuse:overloaded:0.01", "serve"],
+                                sleeps, retries=3, retry_base_delay=0.2,
+                                retry_max_delay=8.0) as (client, fake):
+            client.run(SPEC, dt=DT, stop_iteration=1)
+        assert len(sleeps) == 2
+        assert sleeps[0] <= 0.2 * 1.25 + 1e-9
+        assert 0.4 * 0.75 - 1e-9 <= sleeps[1] <= 0.4 * 1.25 + 1e-9
+
+    def test_retry_max_delay_caps_the_hint(self):
+        sleeps = []
+        with fake_server_client(["refuse:overloaded:300.0", "serve"],
+                                sleeps, retries=2, retry_base_delay=0.2,
+                                retry_max_delay=2.0) as (client, fake):
+            client.run(SPEC, dt=DT, stop_iteration=1)
+        assert len(sleeps) == 1
+        assert sleeps[0] <= 2.0 * 1.25 + 1e-9
+
+    def test_attempt_budget_is_configurable_and_finite(self):
+        sleeps = []
+        with fake_server_client(["refuse:overloaded:0.1"], sleeps,
+                                retries=2,
+                                retry_base_delay=0.01) as (client, fake):
+            with pytest.raises(ServiceError) as err:
+                client.run(SPEC, dt=DT, stop_iteration=1)
+            assert err.value.code == "overloaded"
+            assert fake.runs == 3       # retries + 1, not one more
+        assert len(sleeps) == 2
+
+    def test_deterministic_errors_are_not_retried(self):
+        sleeps = []
+        with fake_server_client(["bad-spec"], sleeps,
+                                retries=5,
+                                retry_base_delay=0.01) as (client, fake):
+            with pytest.raises(ServiceError) as err:
+                client.run(SPEC, dt=DT, stop_iteration=1)
+            assert err.value.code == "bad-spec"
+            assert fake.runs == 1
+        assert sleeps == []
+
+    def test_submit_cli_exposes_retry_max_delay(self):
+        parser = client_mod.build_parser()
+        args = parser.parse_args(["--port", "1", "--retry", "3",
+                                  "--retry-max-delay", "4.5"])
+        assert args.retry_max_delay == 4.5
+
+
+# --------------------------------------------------------- observability
+
+def _router_stats_fixture():
+    """A RouterService.stats()-shaped dict (kept in sync by the live
+    scrape test below, which validates the real surface end to end)."""
+    return {
+        "kind": "stats", "role": "router", "port": 9999,
+        "uptime_sec": 12.5, "draining": None,
+        "router": {
+            "forwarded": 7, "failovers": 2, "shed": 1, "refusals": 3,
+            "replica_faults": 2, "client_drops": 1,
+            "acks_suppressed": 2,
+            "error_codes": {"overloaded": 1, "bad-spec": 2},
+            "forward": {"p50_ms": 2.0, "p95_ms": 11.0, "count": 7},
+            "ring_members": ["r0", "r1"],
+            "breaker": {"opens": 1, "closes": 0, "fastfails": 4,
+                        "open": ["r2"]},
+        },
+        "fleet": {
+            "restarts": 3, "crashes": 2, "wedges": 1,
+            "watchdog_fires": 1,
+            "states": {"up": 2, "down": 1},
+            "spawned": 3, "attached": 0,
+            "replicas": {
+                "r0": {"name": "r0", "state": "up", "draining": False,
+                       "restarts": 0, "port": 1001, "pid": 11},
+                "r1": {"name": "r1", "state": "up", "draining": True,
+                       "restarts": 1, "port": 1002, "pid": 12},
+                "r2": {"name": "r2", "state": "down", "draining": False,
+                       "restarts": 2, "port": 1003, "pid": None},
+            },
+        },
+    }
+
+
+class TestRouterObservability:
+    def test_drain_flushes_router_stats_to_sink(self, tmp_path):
+        # the CLI's --sink contract: one `router_stats` record at drain,
+        # written AFTER fleet.stop so it carries the final fleet tallies
+        sink = tmp_path / "router.jsonl"
+        with fake_router(("serve",), ("serve",), sink=str(sink)):
+            pass
+        records = [json.loads(line)
+                   for line in sink.read_text().splitlines()]
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["kind"] == "router_stats"
+        assert "ts" in rec
+        assert rec["draining"] == "test teardown"
+        assert rec["fleet"]["attached"] == 2
+        assert set(rec["router"]) >= {"forwarded", "failovers",
+                                      "error_codes", "forward"}
+
+    def test_render_router_stats_exposition(self):
+        hists = {"router_forward_seconds":
+                 ({"counts": {0: 3, 5: 4}, "total": 7, "sum": 0.42},
+                  "Wall seconds per routed run.")}
+        text = promexport.render_router_stats(_router_stats_fixture(),
+                                              hists)
+        families = promexport.validate_exposition(text)
+        lines = text.splitlines()
+        assert "dedalus_router_up 1" in lines
+        assert "dedalus_router_forwarded_total 7" in lines
+        assert "dedalus_router_failovers_total 2" in lines
+        assert "dedalus_router_ring_members 2" in lines
+        assert ('dedalus_router_errors_by_code_total{code="overloaded"}'
+                " 1") in lines
+        assert 'dedalus_fleet_replicas{state="up"} 2' in lines
+        assert "dedalus_fleet_restarts_total 3" in lines
+        assert 'dedalus_fleet_replica_up{replica="r0"} 1' in lines
+        assert 'dedalus_fleet_replica_up{replica="r2"} 0' in lines
+        assert ('dedalus_fleet_replica_draining{replica="r1"} 1'
+                in lines)
+        assert families["dedalus_router_forward_seconds"]["type"] \
+            == "histogram"
+
+    def test_live_router_prom_scrape(self):
+        with fake_router(("serve",), ("serve",)) as (router, fakes):
+            client = ServiceClient(port=router.port, timeout=20)
+            client.run(SPEC, dt=DT, stop_iteration=1)
+            text = client.stats_prom()
+        families = promexport.validate_exposition(text)
+        assert "dedalus_router_up" in families
+        assert "dedalus_router_forwarded_total" in families
+        assert "dedalus_fleet_replica_up" in families
+        assert "dedalus_router_forward_seconds" in families
+
+    def test_router_stats_frame_shape(self):
+        with fake_router(("serve",), ("serve",)) as (router, fakes):
+            client = ServiceClient(port=router.port, timeout=20)
+            stats = client.stats()
+            assert stats["role"] == "router"
+            assert sorted(stats["router"]["ring_members"]) \
+                == ["a0", "a1"]
+            fleet = stats["fleet"]
+            assert fleet["attached"] == 2 and fleet["spawned"] == 0
+            assert set(fleet["replicas"]) == {"a0", "a1"}
+
+    def test_report_renders_router_stats_and_scaling_row(self, tmp_path):
+        sink = tmp_path / "router.jsonl"
+        rows = [
+            dict(_router_stats_fixture(), kind="router_stats"),
+            {"config": "router_scaling", "benchmark": "router",
+             "metric": "router_requests_per_sec_4r", "value": 4.2,
+             "unit": "requests/sec", "backend": "cpu", "ts": 1e9,
+             "requests_speedup_4v1": 3.1,
+             "replica_requests_per_sec": {"1": 1.35, "2": 2.4,
+                                          "4": 4.2},
+             "specs": 6, "clients": 6, "forward_overhead_p50_ms": 2.2},
+        ]
+        sink.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        out = subprocess.run(
+            [sys.executable, "-m", "dedalus_tpu", "report", str(sink)],
+            capture_output=True, text=True, cwd=str(REPO), timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "(router) 7 forwarded, 2 failovers" in out.stdout
+        assert "fleet: 3 restarts, 2 crashes, 1 wedges" in out.stdout
+        assert "3.1x at 4 replicas" in out.stdout
+        assert "forward overhead p50 2.2 ms" in out.stdout
+
+
+# ------------------------------------------------- spawned-fleet matrix
+#
+# Real `serve` subprocess replicas under the supervisor, real signals.
+# One module-scoped fleet; faults land sequentially against it (the
+# long-lived survival claim), and EVERY fault test ends with a healthy
+# bit-identical request through the router.
+
+@pytest.fixture(scope="module")
+def fleet_router(tmp_path_factory):
+    from conftest import register_daemon
+    workdir = str(tmp_path_factory.mktemp("fleet"))
+    router = RouterService(
+        replicas=2, workdir=workdir,
+        replica_args=["--pool-size", "4", "--chaos",
+                      "--watchdog-sec", "6", "--queue-depth", "8"],
+        probe_sec=0.25, probe_timeout=1.0, wedge_misses=8,
+        backoff_base=0.25, breaker_failures=3, breaker_cooloff=2.0)
+    router.fleet.on_spawn = register_daemon
+    thread = threading.Thread(target=router.serve_forever, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 120
+    while router.port == 0 or router._listener is None:
+        if time.monotonic() > deadline:
+            raise RuntimeError("fleet router did not come up")
+        time.sleep(0.1)
+    wait_for(lambda: len(router.fleet.routable()) == 2, 30,
+             "both replicas routable")
+    yield router
+    router.request_drain("test teardown")
+    thread.join(timeout=90)
+    assert not thread.is_alive(), "fleet router failed to drain"
+
+
+def routed_client(router, **kw):
+    kw.setdefault("timeout", 300)
+    return ServiceClient(port=router.port, **kw)
+
+
+def assert_healthy(router, tag):
+    """The post-fault invariant: a fresh routed run still bit-matches
+    the direct in-process solve."""
+    result = routed_client(router).run(SPEC, ics=diff_ics(), dt=DT,
+                                       stop_iteration=STEPS)
+    reference = direct_reference(SPEC, diff_ics(), DT, STEPS)
+    for name, expected in reference.items():
+        served = result.fields[name][1]
+        assert np.array_equal(served, expected), \
+            f"{tag}: served {name} diverged from the direct solve"
+
+
+def prewarm(router, spec, size):
+    """Build `spec` warm on EVERY replica (direct, bypassing the ring)
+    so failover targets serve from a warm pool deterministically."""
+    for name in router.fleet.routable():
+        host, port = router.fleet.endpoint(name)
+        ServiceClient(host=host, port=port, timeout=300).run(
+            spec, ics=diff_ics(size), dt=DT, stop_iteration=2)
+
+
+@pytest.mark.slow
+class TestSpawnedFleet:
+    def test_affinity_and_bit_identity(self, fleet_router):
+        router = fleet_router
+        client = routed_client(router)
+        first = client.run(SPEC, ics=diff_ics(), dt=DT,
+                           stop_iteration=STEPS)
+        again = client.run(SPEC, ics=diff_ics(), dt=DT,
+                           stop_iteration=STEPS)
+        # same spec -> same replica (the warm-pool affinity claim),
+        # and the router's preview agrees with where it actually went
+        assert first.result["replica"] == again.result["replica"]
+        assert first.result["replica"] == router.route_of(SPEC)
+        reference = direct_reference(SPEC, diff_ics(), DT, STEPS)
+        for name, expected in reference.items():
+            assert np.array_equal(again.fields[name][1], expected)
+        other = routed_client(router).run(SPEC_B, ics=diff_ics(48),
+                                          dt=DT, stop_iteration=STEPS)
+        ref_b = direct_reference(SPEC_B, diff_ics(48), DT, STEPS)
+        for name, expected in ref_b.items():
+            assert np.array_equal(other.fields[name][1], expected)
+
+    def test_replica_sigkill_mid_run_fails_over(self, fleet_router):
+        router = fleet_router
+        prewarm(router, SPEC, SIZE)
+        primary = router.route_of(SPEC)
+        baseline_restarts = {s["name"]: s["restarts"]
+                             for s in router.fleet.snapshot()}
+        in_flight = threading.Event()
+        out = {}
+
+        def go():
+            out["result"] = routed_client(router).run(
+                SPEC, ics=diff_ics(), dt=DT, stop_iteration=12000,
+                progress_every=10,
+                on_progress=lambda f: in_flight.set())
+
+        worker = threading.Thread(target=go)
+        worker.start()
+        assert in_flight.wait(120), "run never streamed progress"
+        chaos_mod.kill_replica(router.fleet, primary)
+        worker.join(timeout=150)
+        assert not worker.is_alive(), "failover never completed"
+        result = out["result"]
+        assert result.result["replica"] != primary
+        assert result.result["failover"] >= 1
+        reference = direct_reference(SPEC, diff_ics(), DT, 12000)
+        for name, expected in reference.items():
+            assert np.array_equal(result.fields[name][1], expected), \
+                f"failover result for {name} is not bit-identical"
+        wait_for(lambda: any(
+            s["name"] == primary and s["state"] == "up"
+            and s["restarts"] == baseline_restarts[primary] + 1
+            for s in router.fleet.snapshot()), 90,
+            "supervisor restart of the killed replica")
+        assert_healthy(router, "after SIGKILL failover")
+
+    def test_wedged_run_watchdog_fires_over(self, fleet_router):
+        router = fleet_router
+        prewarm(router, SPEC, SIZE)
+        t0 = time.monotonic()
+        result = routed_client(router).run(
+            SPEC, ics=diff_ics(), dt=DT, stop_iteration=STEPS,
+            chaos={"hang_iteration": 20, "hang_sec": 90})
+        wall = time.monotonic() - t0
+        # served by FAILOVER (chaos stripped fire-once), not by waiting
+        # out the 90s hang on the wedged replica
+        assert wall < 60, f"hang released instead of failing over " \
+                          f"({wall:.1f}s)"
+        assert result.result["failover"] >= 1
+        reference = direct_reference(SPEC, diff_ics(), DT, STEPS)
+        for name, expected in reference.items():
+            assert np.array_equal(result.fields[name][1], expected)
+        # the wedged replica healed ITSELF (watchdog postmortem +
+        # worker replacement); the supervisor observes, not restarts
+        wait_for(lambda: router.fleet.stats()["watchdog_fires"] >= 1,
+                 30, "fleet-level watchdog postmortem accounting")
+        assert_healthy(router, "after watchdog failover")
+
+    def test_slow_replica_transient_stall_is_waited_out(self, fleet_router):
+        # a stall SHORTER than the deadline-derived read timeout is not a
+        # fault: the router waits, the primary serves after resuming, and
+        # neither a failover hop nor a restart is spent on it
+        router = fleet_router
+        prewarm(router, SPEC, SIZE)
+        primary = router.route_of(SPEC)
+        restarts_before = {s["name"]: s["restarts"]
+                           for s in router.fleet.snapshot()}
+        chaos_mod.slow_replica_sec(router.fleet, primary, 4.0)
+        result = routed_client(router).run(
+            SPEC, ics=diff_ics(), dt=DT, stop_iteration=STEPS,
+            deadline_sec=30.0)
+        assert result.result["replica"] == primary
+        assert result.result.get("failover", 0) == 0
+        reference = direct_reference(SPEC, diff_ics(), DT, STEPS)
+        for name, expected in reference.items():
+            assert np.array_equal(result.fields[name][1], expected)
+        # a stall below the wedge threshold must NOT cost a restart
+        wait_for(lambda: any(s["name"] == primary and s["state"] == "up"
+                             and s["misses"] == 0
+                             for s in router.fleet.snapshot()), 60,
+                 "stalled replica shedding its probe misses")
+        assert {s["name"]: s["restarts"]
+                for s in router.fleet.snapshot()} == restarts_before
+        assert_healthy(router, "after transient stall")
+
+    def test_slow_replica_past_deadline_fails_over(self, fleet_router):
+        # a stall LONGER than the deadline-derived read timeout
+        # (min(forward_timeout, deadline_sec + 2)) is a replica fault:
+        # the forward times out, the router re-dispatches to the next
+        # ring replica, and the client still sees one bit-exact result
+        router = fleet_router
+        prewarm(router, SPEC, SIZE)
+        primary = router.route_of(SPEC)
+        chaos_mod.slow_replica_sec(router.fleet, primary, 30.0)
+        t0 = time.monotonic()
+        result = routed_client(router).run(
+            SPEC, ics=diff_ics(), dt=DT, stop_iteration=STEPS,
+            deadline_sec=6.0)
+        wall = time.monotonic() - t0
+        assert result.result["replica"] != primary
+        assert result.result["failover"] >= 1
+        # served by the failover target while the primary was still
+        # stalled — not by waiting the stall out
+        assert wall < 25, wall
+        reference = direct_reference(SPEC, diff_ics(), DT, STEPS)
+        for name, expected in reference.items():
+            assert np.array_equal(result.fields[name][1], expected)
+        # a 30 s unresponsive replica IS a wedge by the supervisor's
+        # contract — let it restart (or resume) and rejoin before the
+        # next test
+        wait_for(lambda: any(s["name"] == primary and s["state"] == "up"
+                             and s["misses"] == 0
+                             for s in router.fleet.snapshot()), 90,
+                 "stalled primary rejoining the ring")
+        assert_healthy(router, "after slow-replica failover")
+
+    def test_rolling_drain_is_invisible(self, fleet_router):
+        router = fleet_router
+        prewarm(router, SPEC, SIZE)
+        primary = router.route_of(SPEC)
+        restarts_before = {s["name"]: s["restarts"]
+                           for s in router.fleet.snapshot()}
+        import os
+        os.kill(router.fleet.pid_of(primary), signal.SIGTERM)
+        # the drain (or the exit behind it) must push the primary off
+        # the ring; requests keep landing on the sibling meanwhile
+        wait_for(lambda: router.route_of(SPEC) != primary, 30,
+                 "draining replica leaving the ring")
+        result = routed_client(router).run(SPEC, ics=diff_ics(), dt=DT,
+                                           stop_iteration=STEPS)
+        assert result.result["replica"] != primary
+        reference = direct_reference(SPEC, diff_ics(), DT, STEPS)
+        for name, expected in reference.items():
+            assert np.array_equal(result.fields[name][1], expected)
+        # rolling restart: the drained replica exits and comes back
+        wait_for(lambda: any(
+            s["name"] == primary and s["state"] == "up"
+            and s["restarts"] == restarts_before[primary] + 1
+            for s in router.fleet.snapshot()), 120,
+            "drained replica restarting")
+        assert_healthy(router, "after rolling drain")
+
+    def test_partition_heals(self, fleet_router):
+        router = fleet_router
+        prewarm(router, SPEC, SIZE)
+        primary = router.route_of(SPEC)
+        heal = chaos_mod.partition(router.fleet, primary)
+        try:
+            result = routed_client(router).run(
+                SPEC, ics=diff_ics(), dt=DT, stop_iteration=STEPS)
+            assert result.result["replica"] != primary
+            assert result.result["failover"] >= 1
+        finally:
+            heal()
+        wait_for(lambda: any(s["name"] == primary and s["state"] == "up"
+                             and s["misses"] == 0
+                             for s in router.fleet.snapshot()), 60,
+                 "partitioned replica recovering after heal")
+        assert_healthy(router, "after partition heal")
+
+    def test_wedge_replica_supervisor_restarts(self, fleet_router):
+        router = fleet_router
+        victim = router.fleet.routable()[0]
+        restarts_before = {s["name"]: s["restarts"]
+                           for s in router.fleet.snapshot()}
+        chaos_mod.wedge_replica(router.fleet, victim)
+        wait_for(lambda: any(
+            s["name"] == victim and s["state"] == "up"
+            and s["restarts"] == restarts_before[victim] + 1
+            for s in router.fleet.snapshot()), 150,
+            "supervisor wedge detection + restart")
+        assert router.fleet.stats()["wedges"] >= 1
+        assert_healthy(router, "after wedge restart")

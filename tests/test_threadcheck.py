@@ -346,6 +346,45 @@ def test_dtc003_fires_on_seeded_pr8_deadlock_pair(tmp_path):
     assert "acquisition sites" in f.message
 
 
+FLEET_DEADLOCK_SRC = """
+import threading
+
+class ReplicaSupervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prober_lock = threading.Lock()
+
+    def restart(self):
+        # restart path: replica table first, then the prober's verdict
+        # state
+        with self._lock:
+            with self._prober_lock:
+                pass
+
+    def probe_tick(self):
+        # prober: verdict state first, then reaching back into the table
+        with self._prober_lock:
+            with self._lock:
+                pass
+"""
+
+
+def test_dtc003_fires_on_seeded_fleet_prober_pair(tmp_path):
+    """The supervisor-lock-vs-health-prober ordering hazard the fleet's
+    tight-block discipline exists to prevent: the prober folding
+    verdicts while holding its own lock and reaching back into the
+    replica table, against a restart path nesting the other way. On
+    HEAD both paths snapshot under ONE lock and do IO outside it, so
+    the real fleet.py contributes zero edges (see
+    test_static_graph_is_cycle_free_on_head); this fixture pins that
+    the analyzer would catch the regression."""
+    result = _fixture(tmp_path, "service/fleet.py", FLEET_DEADLOCK_SRC)
+    assert _rules_fired(result) == ["DTC003"]
+    (f,) = result.findings
+    assert "lock-order cycle (potential deadlock)" in f.message
+    assert "_lock" in f.message and "_prober_lock" in f.message
+
+
 def test_dtc003_consistent_order_is_clean(tmp_path):
     result = _fixture(tmp_path, "service/server.py", """
 import threading
