@@ -65,16 +65,17 @@ def set_fusion(mode):
 def set_solve(composition="auto", solve_dtype="auto", sweeps="auto",
               spike_chunks="auto"):
     """Pin the solve composition + precision ladder for one build
-    (libraries/solvecomp.py; the [fusion]/[precision] knobs of the
-    solve-composition sweep)."""
+    (delegates to tools/autotune.py set_solve_config — the benchmark and
+    the tuner pin cells through ONE code path). The tuner itself stays
+    off in this process: the sweep must measure the pinned cells, not a
+    cached decision."""
+    from dedalus_tpu.tools.autotune import set_solve_config
     from dedalus_tpu.tools.config import config
-    for section in ("fusion", "precision"):
-        if not config.has_section(section):
-            config.add_section(section)
-    config["fusion"]["SOLVE_COMPOSITION"] = composition
-    config["fusion"]["SPIKE_CHUNKS"] = spike_chunks
-    config["precision"]["SOLVE_DTYPE"] = solve_dtype
-    config["precision"]["REFINE_SWEEPS"] = sweeps
+    set_solve_config(composition=composition, solve_dtype=solve_dtype,
+                     sweeps=sweeps, spike_chunks=spike_chunks)
+    if not config.has_section("autotune"):
+        config.add_section("autotune")
+    config["autotune"]["MODE"] = "off"
 
 
 def build_diffusion(size=64, dtype=np.float64):
@@ -112,35 +113,19 @@ def probe_phases(solver, reps=12):
 
 def measure(build, n_steps, block, blocks, solver_out=None):
     """Build, advance n_steps (trajectory checkpointing), then measure
-    median steps/s over `blocks` scanned step_many blocks. `solver_out`
-    (a list) receives the live solver for post-measurement probes."""
-    import jax
-    solver, dt = build()
+    median steps/s over `blocks` scanned step_many blocks. The core
+    machinery lives in tools/autotune.py `measure_build` (extracted in
+    PR 20 so the tuner and this benchmark share ONE harness); this
+    wrapper adds the per-phase breakdown the fusion rows report."""
+    from dedalus_tpu.tools.autotune import measure_build
+    holder = []
+    result, state = measure_build(build, n_steps, block, blocks,
+                                  solver_out=holder)
+    solver = holder[0]
     if solver_out is not None:
         solver_out.append(solver)
-    # trajectory steps run singly so only ONE scanned block size
-    # compiles below — the retrace sentinel stays quiet post-warmup
-    for _ in range(n_steps):
-        solver.step(dt)
-    jax.block_until_ready(solver.X)
-    state = np.asarray(solver.X).copy()
-    solver.step_many(block, dt)               # compile the block program
-    jax.block_until_ready(solver.X)
-    rates = []
-    for _ in range(blocks):
-        t0 = time.perf_counter()
-        solver.step_many(block, dt)
-        jax.block_until_ready(solver.X)
-        rates.append(block / (time.perf_counter() - t0))
-    phases = probe_phases(solver)
-    finite = bool(np.isfinite(np.asarray(solver.X)).all())
-    return {
-        "steps_per_sec": round(float(np.median(rates)), 3),
-        "steps_per_sec_iqr": round(float(np.percentile(rates, 75)
-                                         - np.percentile(rates, 25)), 3),
-        "phases_ms": phases,
-        "finite": finite,
-    }, state
+    result["phases_ms"] = probe_phases(solver)
+    return result, state
 
 
 def run_case(name, build, dtype, n_steps, block, blocks):
@@ -199,21 +184,10 @@ def run_case(name, build, dtype, n_steps, block, blocks):
 
 def solve_residual(solver):
     """Achieved relative residual of one probe solve against the live
-    LHS factorization (the ladder accuracy record), or None."""
-    import jax.numpy as jnp
-    import numpy as np
-    ts = solver.timestepper
-    aux = getattr(ts, "_lhs_aux", None)
-    if aux is None or not hasattr(solver.ops, "solve_report"):
-        return None
-    aux0 = aux[0] if isinstance(aux, list) else aux
-    try:
-        _, rel = solver.ops.solve_report(
-            aux0, jnp.asarray(solver.X),
-            mats=(solver.M_mat, solver.L_mat))
-    except Exception:
-        return None
-    return None if rel is None else float(np.asarray(rel))
+    LHS factorization (tools/autotune.py `probe_solve_residual` — one
+    definition shared with the tuner's offline harness)."""
+    from dedalus_tpu.tools.autotune import probe_solve_residual
+    return probe_solve_residual(solver)
 
 
 # The solve-composition x precision sweep (ISSUE-15): every cell builds

@@ -136,21 +136,33 @@ def _flag(section, key, default, auto_value):
     return auto_value
 
 
-def resolve_fusion():
+def resolve_fusion(decision=None):
     """Resolve the [fusion] config against the active backend. `auto`
     semantics are profile-driven (module docstring): solve/matvec/donate
     fuse everywhere; transform composition defaults on only where MMT
-    GEMMs beat the DCT/FFT fast paths (accelerator backends)."""
+    GEMMs beat the DCT/FFT fast paths (accelerator backends).
+
+    `decision` (a tools.autotune.Decision) supplies MEASURED auto values
+    for the tunable flags: PALLAS (the substitution kernel is a
+    first-class autotuner candidate — `auto` means off unless a tuned
+    decision selected it) and FUSED_TRANSFORMS when the decision pins
+    one. Explicit on/off still wins, exactly as before."""
     section = config["fusion"] if config.has_section("fusion") else None
     accel = jax.default_backend() in _ACCEL_BACKENDS
+    cell = getattr(decision, "cell", None) or {}
+    transforms_auto = cell.get("fused_transforms")
+    if transforms_auto is None:
+        transforms_auto = accel
     solve = _flag(section, "FUSED_SOLVE", "auto", True)
     return FusionPlan(
         solve=solve,
         matvec=_flag(section, "FUSED_MATVEC", "auto", True),
-        transforms=_flag(section, "FUSED_TRANSFORMS", "auto", accel),
+        transforms=_flag(section, "FUSED_TRANSFORMS", "auto",
+                         bool(transforms_auto)),
         donate=_flag(section, "DONATE_STEP", "auto", True),
         # the Pallas substitution consumes the precomposed inverses
-        pallas=_flag(section, "PALLAS", "off", False) and solve,
+        pallas=_flag(section, "PALLAS", "auto",
+                     bool(cell.get("pallas", False))) and solve,
     )
 
 

@@ -219,9 +219,13 @@ class DenseOps(AdjointSolveOps):
         # restructure — accepted as no-ops so one [fusion] config drives
         # mixed dense/banded fleets); the precision ladder routes the
         # solve through the refined low-dtype inverse + f64 residual
-        # polish (matsolvers.refined_ladder).
+        # polish (matsolvers.refined_ladder). The bare-ops fallback goes
+        # through the TUNER-AWARE resolver (dense ops carry no system
+        # size at construction, so 0 = "no registered shape"): a bare
+        # build and a solver build must never silently pick different
+        # plans for the same shape (tools/autotune.py).
         if solve_plan is None:
-            solve_plan = solvecomp.resolve_solve_plan()
+            solve_plan = solvecomp.resolve_solve_plan_for_ops("dense", 0)
         self._solve_plan = solve_plan
         self._composition = "sequential"
         if solve_plan.dtype != "native":
@@ -360,8 +364,13 @@ class BandedOps(AdjointSolveOps):
         # like `fusion`, resolved once per solver build and passed in so
         # a mid-build config edit can never split one solver across two
         # compositions; the plan token rides the assembly/pool keys.
+        # The bare-ops fallback goes through the TUNER-AWARE resolver
+        # keyed on this structure's system size, so a bare BandedOps and
+        # a tuned solver build can never silently pick different plans
+        # for the same shape (tools/autotune.py).
         if solve_plan is None:
-            solve_plan = solvecomp.resolve_solve_plan()
+            solve_plan = solvecomp.resolve_solve_plan_for_ops(
+                "banded", structure.S)
         self._solve_plan = solve_plan
         if solve_plan.composition != "sequential" and not plan.solve:
             raise ValueError(

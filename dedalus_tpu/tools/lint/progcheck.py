@@ -916,6 +916,67 @@ def _census_rb_ladder():
     return [rec]
 
 
+@census("rb_step_tuned", fast=False)
+def _census_rb_tuned():
+    """The banded RB step built under an AUTOTUNED plan decision
+    (tools/autotune.py): a seeded spike/f32 decision is consulted from
+    the in-process memo at build time — zero microbench probes, the
+    warm-path contract — and the resulting tuned step program must
+    honor the same compiled contracts as the hand-picked plans: no
+    full-state gather (DTP101), no triangular/pivot custom calls in the
+    fused solve (DTP102), and the scan depth bounded by the decision's
+    own chunk/sweep schedule (DTP106)."""
+    from ...extras.bench_problems import build_rb_solver
+    from ...libraries import solvecomp
+    from ...tools import autotune
+    with _pinned_config("fusion", FUSED_SOLVE="on",
+                        SOLVE_COMPOSITION="auto", SPIKE_CHUNKS="auto",
+                        PALLAS="off"):
+        with _pinned_config("precision", SOLVE_DTYPE="auto",
+                            REFINE_SWEEPS="auto"):
+            with _pinned_config("autotune", MODE="off"):
+                # plan-independent signature probe (matrices and shape
+                # do not depend on the solve plan)
+                ref, _ = build_rb_solver(16, 32, np.float64,
+                                         matsolver="banded")
+                sig = autotune.solver_signature(ref)
+            autotune.seed_decision(sig, {
+                "composition": "spike", "solve_dtype": "f32",
+                "refine_sweeps": 2, "spike_chunks": 0, "pallas": False,
+                "fused_transforms": None, "transpose_chunks": None},
+                evidence_kind="seeded")
+            try:
+                with _pinned_config("autotune", MODE="cached"):
+                    before = autotune.probe_count()
+                    solver, _ = build_rb_solver(16, 32, np.float64,
+                                                matsolver="banded")
+                    probes = autotune.probe_count() - before
+            finally:
+                autotune.clear_memo()
+    if probes:
+        raise AssertionError(
+            f"tuned build ran {probes} microbench probe(s); a cached "
+            "decision must build probe-free")
+    if getattr(solver, "_plan_source", None) != "tuned" \
+            or solver._solve_plan.composition != "spike" \
+            or solver._solve_plan.dtype != "f32":
+        raise AssertionError(
+            f"seeded decision not applied: source="
+            f"{getattr(solver, '_plan_source', None)}, "
+            f"plan={solver._solve_plan!r}")
+    solver.step(1e-3)
+    chunks = solvecomp.spike_chunk_count(
+        solver.ops.NB - 1, solver._solve_plan.spike_chunks)
+    sweeps = solver._solve_plan.sweeps or 0
+    rec = _solver_record(
+        "rb_step_tuned", solver,
+        "banded RB RK222 step under a seeded autotune decision "
+        f"(spike/f32 ladder, C={chunks}, {sweeps} sweeps, zero probes)",
+        extra_meta={"fused_solve": True,
+                    "max_scan_length": max(chunks, sweeps)})
+    return [rec]
+
+
 @census("traced_step")
 def _census_traced_step():
     """The dense diffusion step lowered twice — request tracing disabled,

@@ -63,6 +63,13 @@ HOT_PATH_MODULES = (
     # request (or every health probe) a sync it has no business paying
     "service/router.py",
     "service/fleet.py",
+    # the autotuner's consult runs inside every solver build and its
+    # decision feeds the plan the step program compiles under: config
+    # must be read at build/CLI time only (DTL008 — a tuned step that
+    # re-read [autotune] per step would retrace), and the microbench
+    # harness synchronizes via explicit np.asarray host gathers on
+    # probe results, never via stray syncs a step path could inherit
+    "tools/autotune.py",
 )
 
 # Device-state attribute names (the gathered pencil/fleet state and its

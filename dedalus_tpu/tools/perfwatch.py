@@ -36,9 +36,14 @@ memory, flops, bytes, HLO size, and scan depth regress UP.
 Waivers
 -------
 benchmarks/perfwatch_waivers.json lists intentional trade-offs as
-`{"series": <fnmatch pattern>, "reason": ...}` entries — e.g. the
-PR-15-documented ascan 0.40x CPU cell. A waived regression is reported
-(counted, never hidden) but does not fail `--check`.
+`{"series": <fnmatch pattern>, "reason": ...}` entries. A waived
+regression is reported (counted, never hidden) but does not fail
+`--check`. Plan changes generally should NOT need waivers: a plan
+switch (including an autotune decision, tools/autotune.py) changes the
+`plan_key` digest and therefore starts a NEW series — the PR-15 ascan
+CPU waiver was retired on exactly that basis once `plan_source` landed
+(the slow cell is a tuner-rejected candidate row, not a standing
+regression against the sequential baseline).
 
 Entry points: `python -m dedalus_tpu perfwatch [--check|--json]`,
 `lint --perfwatch` (the standalone-CI tail), and `trend_lines()` (the
@@ -55,10 +60,12 @@ PACKAGE_DIR = pathlib.Path(__file__).resolve().parent.parent
 DEFAULT_RESULTS = PACKAGE_DIR.parent / "benchmarks" / "results.jsonl"
 DEFAULT_WAIVERS = PACKAGE_DIR.parent / "benchmarks" / "perfwatch_waivers.json"
 
-# row kinds that are bookkeeping, not measurements
+# row kinds that are bookkeeping, not measurements (autotune rows carry
+# per-cell microbench evidence, not trend-worthy throughput: a tuning
+# probe's solves/s must never seed a regression baseline)
 _NON_MEASUREMENT_KINDS = {"probe", "trace", "service_stats",
                           "router_stats", "health_postmortem",
-                          "watchdog_postmortem"}
+                          "watchdog_postmortem", "autotune"}
 
 # ledger fields watched for UPWARD drift (field -> metric name)
 _LEDGER_METRICS = (("flops", "ledger_flops"),

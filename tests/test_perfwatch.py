@@ -201,6 +201,52 @@ def test_solvecomp_sweep_cells_are_series():
     assert reg["series"] == "steps_per_sec:rb/ascan/f64:cpu:unversioned"
 
 
+def test_autotune_plan_switch_starts_new_series():
+    """An autotune-induced plan switch (the tuner flips the resolved
+    composition/dtype, stamping plan_source: tuned) starts a NEW
+    plan_key series: the tuned points must not fire a false regression
+    against the old plan's baseline even when the tuned cell is slower
+    (the retired PR-15 ascan waiver's scenario), and the two plans'
+    histories never share a baseline."""
+    old_plan = {"plan_version": 1,
+                "fusion": {"solve": True, "matvec": True},
+                "solve_composition": "sequential", "solve_dtype": "native",
+                "refine_sweeps": None, "spike_chunks": 0,
+                "transpose_chunks": 2, "plan_source": "default"}
+    tuned_plan = {"plan_version": 1,
+                  "fusion": {"solve": True, "matvec": True},
+                  "solve_composition": "ascan", "solve_dtype": "f32",
+                  "refine_sweeps": 2, "spike_chunks": 0,
+                  "transpose_chunks": 2, "plan_source": "tuned",
+                  "tuning": {"evidence_kind": "ops_probe"}}
+    rows = [{"config": "rbX", "backend": "cpu", "steps_per_sec": v,
+             "ts": float(i), "plan": old_plan}
+            for i, v in enumerate([10.0, 10.1, 9.9, 10.0])]
+    # the switch point: a 60% drop that WOULD fire inside the old series
+    rows.append({"config": "rbX", "backend": "cpu", "steps_per_sec": 4.0,
+                 "ts": 4.0, "plan": tuned_plan})
+    assert perfwatch.plan_key(old_plan) != perfwatch.plan_key(tuned_plan)
+    report = perfwatch.analyze(rows)
+    assert not report["regressions"]
+    assert len(perfwatch.build_series(rows)) == 2
+    # identical plan VALUES must still share one series regardless of
+    # how they were chosen: plan_source alone is not a program change
+    retuned = dict(old_plan, plan_source="tuned",
+                   tuning={"evidence_kind": "step_sweep"})
+    assert perfwatch.plan_key(old_plan) == perfwatch.plan_key(retuned)
+
+
+def test_autotune_rows_are_not_measurements():
+    """kind: autotune evidence rows (per-cell microbench numbers) never
+    seed trend series."""
+    rows = [{"kind": "autotune", "config": "rb256x64", "backend": "cpu",
+             "ts": float(i), "steps_per_sec": 3.0,
+             "cells": [{"composition": "ascan", "solve_dtype": "f32",
+                        "steps_per_sec": 3.0}]}
+            for i in range(5)]
+    assert perfwatch.extract_points(rows) == []
+
+
 # --------------------------------------------------------------- waivers
 
 def test_waiver_matches_and_exits_zero(tmp_path):
@@ -218,10 +264,14 @@ def test_waiver_matches_and_exits_zero(tmp_path):
 
 
 def test_repo_waiver_file_loads():
-    """The checked-in waiver file must parse and carry the PR-15 ascan
-    entry (the one known intentional CPU slowdown)."""
+    """The checked-in waiver file must parse, every entry must carry a
+    reason, and the PR-15 ascan waiver must stay RETIRED: with
+    plan_source in provenance an autotune-rejected cell is evidence in
+    the decision row, not a standing regression waiver (plan switches
+    start new series instead — test below)."""
     waivers = perfwatch.load_waivers()
-    assert any("solvecomp/ascan" in w["series"] for w in waivers)
+    assert not any("solvecomp/ascan" in w.get("series", "")
+                   for w in waivers)
     assert all(w.get("reason") for w in waivers)
 
 
